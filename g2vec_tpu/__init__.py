@@ -1,0 +1,25 @@
+"""g2vec_tpu — a TPU-native framework for network-based cancer-biomarker discovery.
+
+A brand-new JAX/XLA implementation of the capabilities of mathcom/G2Vec
+(J.H. Choi et al., "G2Vec: Distributed gene representations for identification
+of cancer prognostic genes", Scientific Reports 8.1 (2018)).
+
+The reference (/root/reference/G2Vec.py) is a single-file CPU NumPy/TF1 tool.
+This package re-designs the same seven-stage pipeline TPU-first:
+
+- L0 config/CLI           -> :mod:`g2vec_tpu.config`
+- L1 data IO              -> :mod:`g2vec_tpu.io`
+- L2 preprocess           -> :mod:`g2vec_tpu.preprocess`
+- L3 graph + random walks -> :mod:`g2vec_tpu.ops.pcc`, :mod:`g2vec_tpu.ops.walks`
+- L4 trainer (CBOW)       -> :mod:`g2vec_tpu.models.cbow`, :mod:`g2vec_tpu.train`
+- L5 analysis             -> :mod:`g2vec_tpu.ops.stats`, :mod:`g2vec_tpu.ops.kmeans`
+- L6 output writers       -> :mod:`g2vec_tpu.io.writers`
+- parallelism             -> :mod:`g2vec_tpu.parallel`
+
+This module intentionally avoids importing jax at package-import time so that
+callers (CLI, tests) can configure platform/env first.
+"""
+
+__version__ = "0.1.0"
+
+from g2vec_tpu.config import G2VecConfig  # noqa: F401  (jax-free)

@@ -1,0 +1,188 @@
+"""L0 — configuration and CLI.
+
+Mirrors the reference CLI exactly (ref: G2Vec.py:505-518): four positional
+arguments plus ``-p/-r/-s/-e/-l/-n`` options with the same defaults, and adds
+framework-level flags (seed, precision, mesh, profiling, checkpointing).
+
+The reference's hardcoded "silent config" constants (ref: G2Vec.py:389 PCC
+threshold 0.5, :220 80/20 split, :262 max epochs, :254 display step, :169
+k-means k=3/random_state=0, :249 decision threshold, :102 score mix, :234-235
+init std) are all named fields here.
+
+Quirks resolved (documented in SURVEY.md §7):
+- ``--epoch`` is HONORED here (the reference parses it but hardcodes
+  ``range(500)``, ref: G2Vec.py:262 vs :515).
+- ``--compat-lgroup-tiebreak`` reproduces the reference's degenerate good/poor
+  cluster vote (ref: G2Vec.py:186-189, list-vs-int comparison bug).
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass
+class G2VecConfig:
+    """Full configuration for a g2vec_tpu run.
+
+    Field names for the reference-compatible options keep the reference's
+    camelCase spelling so CLI round-tripping is obvious.
+    """
+
+    # ---- positional (ref: G2Vec.py:508-511) ----
+    expression_file: str = ""
+    clinical_file: str = ""
+    network_file: str = ""
+    result_name: str = "result"
+
+    # ---- reference options (ref: G2Vec.py:512-517) ----
+    lenPath: int = 80                # max random-walk length
+    numRepetition: int = 10          # walks started from every gene, per group
+    sizeHiddenlayer: int = 128       # embedding width
+    epoch: int = 500                 # max epochs (honored, unlike the reference)
+    learningRate: float = 0.005      # Adam lr
+    numBiomarker: int = 50           # top-N per L-group
+
+    # ---- silent constants promoted to config ----
+    pcc_threshold: float = 0.5       # edge kept iff |PCC| > threshold (ref: G2Vec.py:389)
+    val_fraction: float = 0.2        # hold-out fraction (ref: G2Vec.py:220)
+    display_step: int = 5            # epoch log cadence (ref: G2Vec.py:254)
+    n_lgroups: int = 3               # k-means k (ref: G2Vec.py:169)
+    kmeans_seed: int = 0             # ref: random_state=0 (G2Vec.py:169)
+    kmeans_iters: int = 300          # Lloyd iterations cap (sklearn default)
+    decision_threshold: float = 0.5  # sigmoid(O) > t (ref: G2Vec.py:249)
+    score_mix: float = 0.5           # gene score = mix*d + (1-mix)*t (ref: G2Vec.py:102)
+
+    # ---- new framework flags ----
+    seed: int = 0                    # global PRNG seed (reference is unseeded)
+    compat_lgroup_tiebreak: bool = False
+    compute_dtype: str = "bfloat16"  # matmul dtype on TPU ("float32" for parity tests)
+    param_dtype: str = "float32"
+    walker_batch: int = 0            # 0 = one repetition (n_genes walkers) per device batch
+    mesh_shape: Optional[Tuple[int, int]] = None  # (data, model); None = single device
+    platform: Optional[str] = None   # force jax platform (e.g. "cpu")
+    profile_dir: Optional[str] = None
+    checkpoint_dir: Optional[str] = None
+    resume: bool = False
+    metrics_jsonl: Optional[str] = None
+    use_native_io: bool = True       # use the C++ TSV reader when available
+    debug_nans: bool = False
+
+    def validate(self) -> None:
+        if self.lenPath < 1:
+            raise ValueError(f"lenPath must be >= 1, got {self.lenPath}")
+        if self.numRepetition < 1:
+            raise ValueError(f"numRepetition must be >= 1, got {self.numRepetition}")
+        if self.sizeHiddenlayer < 1:
+            raise ValueError(f"sizeHiddenlayer must be >= 1, got {self.sizeHiddenlayer}")
+        if self.epoch < 1:
+            raise ValueError(f"epoch must be >= 1, got {self.epoch}")
+        if self.learningRate <= 0.0:
+            raise ValueError(f"learningRate must be > 0, got {self.learningRate}")
+        if self.numBiomarker < 1:
+            raise ValueError(f"numBiomarker must be >= 1, got {self.numBiomarker}")
+        if self.walker_batch < 0:
+            raise ValueError(f"walker_batch must be >= 0, got {self.walker_batch}")
+        if self.mesh_shape is not None and any(d < 1 for d in self.mesh_shape):
+            raise ValueError(f"mesh axes must be >= 1, got {self.mesh_shape}")
+        if not (0.0 < self.val_fraction < 1.0):
+            raise ValueError(f"val_fraction must be in (0,1), got {self.val_fraction}")
+        if not (0.0 <= self.pcc_threshold < 1.0):
+            raise ValueError(f"pcc_threshold must be in [0,1), got {self.pcc_threshold}")
+        if self.compute_dtype not in ("bfloat16", "float32"):
+            raise ValueError(f"compute_dtype must be bfloat16|float32, got {self.compute_dtype}")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    """CLI mirroring the reference parser (ref: G2Vec.py:505-518) + new flags."""
+    parser = argparse.ArgumentParser(
+        prog="g2vec-tpu",
+        description=(
+            "g2vec_tpu is a TPU-native network-based deep learning framework for "
+            "identifying prognostic gene signatures (biomarkers). "
+            "Reference capabilities: mathcom/G2Vec (Sci. Reports 8.1, 2018)."
+        ),
+    )
+    parser.add_argument("EXPRESSION_FILE", type=str,
+                        help="Tab-delimited file for gene expression profiles.")
+    parser.add_argument("CLINICAL_FILE", type=str,
+                        help="Tab-delimited file for patient's clinical data. "
+                             "LABEL=0:good prognosis and 1:poor prognosis.")
+    parser.add_argument("NETWORK_FILE", type=str,
+                        help="Tab-delimited file for gene interaction network.")
+    parser.add_argument("RESULT_NAME", type=str,
+                        help="Results are saved as 1) *_biomarkers.txt, "
+                             "2) *_lgroups.txt, and 3) *_vectors.txt")
+    parser.add_argument("-p", "--lenPath", type=int, default=80)
+    parser.add_argument("-r", "--numRepetition", type=int, default=10)
+    parser.add_argument("-s", "--sizeHiddenlayer", type=int, default=128)
+    parser.add_argument("-e", "--epoch", type=int, default=500)
+    parser.add_argument("-l", "--learningRate", type=float, default=0.005)
+    parser.add_argument("-n", "--numBiomarker", type=int, default=50)
+    # framework flags
+    parser.add_argument("--seed", type=int, default=0,
+                        help="Global PRNG seed (the reference is unseeded).")
+    parser.add_argument("--pcc-threshold", type=float, default=0.5)
+    parser.add_argument("--val-fraction", type=float, default=0.2)
+    parser.add_argument("--compat-lgroup-tiebreak", action="store_true",
+                        help="Reproduce the reference's degenerate L-group vote.")
+    parser.add_argument("--compute-dtype", type=str, default="bfloat16",
+                        choices=["bfloat16", "float32"])
+    parser.add_argument("--walker-batch", type=int, default=0)
+    parser.add_argument("--mesh", type=str, default=None, metavar="DATAxMODEL",
+                        help="Device mesh shape, e.g. 4x2 (data x model).")
+    parser.add_argument("--platform", type=str, default=None,
+                        help="Force a jax platform (e.g. cpu).")
+    parser.add_argument("--profile-dir", type=str, default=None,
+                        help="Write a jax.profiler trace of the run here.")
+    parser.add_argument("--checkpoint-dir", type=str, default=None)
+    parser.add_argument("--resume", action="store_true")
+    parser.add_argument("--metrics-jsonl", type=str, default=None,
+                        help="Write structured per-stage/per-epoch metrics here.")
+    parser.add_argument("--no-native-io", action="store_true",
+                        help="Disable the C++ TSV reader.")
+    parser.add_argument("--debug-nans", action="store_true")
+    return parser
+
+
+def parse_mesh(spec: Optional[str]) -> Optional[Tuple[int, int]]:
+    if spec is None:
+        return None
+    try:
+        d, m = spec.lower().split("x")
+        return (int(d), int(m))
+    except Exception as e:
+        raise ValueError(f"--mesh must look like 4x2, got {spec!r}") from e
+
+
+def config_from_args(argv=None) -> G2VecConfig:
+    args = build_parser().parse_args(argv)
+    cfg = G2VecConfig(
+        expression_file=args.EXPRESSION_FILE,
+        clinical_file=args.CLINICAL_FILE,
+        network_file=args.NETWORK_FILE,
+        result_name=args.RESULT_NAME,
+        lenPath=args.lenPath,
+        numRepetition=args.numRepetition,
+        sizeHiddenlayer=args.sizeHiddenlayer,
+        epoch=args.epoch,
+        learningRate=args.learningRate,
+        numBiomarker=args.numBiomarker,
+        seed=args.seed,
+        pcc_threshold=args.pcc_threshold,
+        val_fraction=args.val_fraction,
+        compat_lgroup_tiebreak=args.compat_lgroup_tiebreak,
+        compute_dtype=args.compute_dtype,
+        walker_batch=args.walker_batch,
+        mesh_shape=parse_mesh(args.mesh),
+        platform=args.platform,
+        profile_dir=args.profile_dir,
+        checkpoint_dir=args.checkpoint_dir,
+        resume=args.resume,
+        metrics_jsonl=args.metrics_jsonl,
+        use_native_io=not args.no_native_io,
+        debug_nans=args.debug_nans,
+    )
+    cfg.validate()
+    return cfg

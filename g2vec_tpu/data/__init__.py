@@ -1,0 +1,3 @@
+"""Dataset utilities: synthetic example generation (the bundled
+``ex_EXPRESSION.txt`` is absent from the reference mount)."""
+from g2vec_tpu.data.synthetic import SyntheticSpec, make_synthetic, write_synthetic_tsv  # noqa: F401

@@ -1,0 +1,13 @@
+"""L1/L6 — data IO: TSV readers and byte-identical output writers."""
+from g2vec_tpu.io.readers import (  # noqa: F401
+    ExpressionData,
+    NetworkData,
+    load_clinical,
+    load_expression,
+    load_network,
+)
+from g2vec_tpu.io.writers import (  # noqa: F401
+    write_biomarkers,
+    write_lgroups,
+    write_vectors,
+)

@@ -1,0 +1,41 @@
+"""Test environment: force JAX onto CPU with 8 virtual devices.
+
+This must run before the first ``import jax`` anywhere in the test session —
+pytest imports conftest.py first, and g2vec_tpu avoids importing jax at
+package-import time, so setting env here is sufficient. This is the standard
+JAX trick for exercising pjit/psum sharding in CI without a TPU pod
+(SURVEY.md §4 item 5).
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def small_spec():
+    from g2vec_tpu.data.synthetic import SyntheticSpec
+
+    return SyntheticSpec(
+        n_good=24, n_poor=20, module_size=12, n_background=24,
+        n_expr_only=4, n_net_only=4, module_chords=2,
+        background_edges=40, seed=7,
+    )
+
+
+@pytest.fixture(scope="session")
+def small_dataset(small_spec):
+    from g2vec_tpu.data.synthetic import make_synthetic
+
+    return make_synthetic(small_spec)
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(0)

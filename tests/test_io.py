@@ -1,0 +1,106 @@
+"""L1/L6 tests: reader contracts and byte-identical writer formats.
+
+Writer expectations are transcribed from the reference implementations
+(G2Vec.py:127-131, 159-165, 203-215) and the manual.pdf output samples.
+"""
+import numpy as np
+import pytest
+
+from g2vec_tpu.io import (
+    load_clinical,
+    load_expression,
+    load_network,
+    write_biomarkers,
+    write_lgroups,
+    write_vectors,
+)
+
+
+@pytest.fixture()
+def tsv_dir(tmp_path):
+    (tmp_path / "expr.txt").write_text(
+        "PATIENT\tS1\tS2\tS3\n"
+        "GENEB\t1.5\t-0.25\t0.0\n"
+        "GENEA\t2.0\t3.0\t4.0\n"
+    )
+    (tmp_path / "clin.txt").write_text(
+        "PATIENT_BARCODE\tLABEL\nS1\t0\nS2\t1\nS3\t0\n")
+    (tmp_path / "net.txt").write_text(
+        "src\tdest\nGENEA\tGENEB\nGENEB\tGENEC\nGENEA\tGENEB\n")
+    return tmp_path
+
+
+def test_load_expression_transposes_to_samples_x_genes(tsv_dir):
+    d = load_expression(str(tsv_dir / "expr.txt"), use_native=False)
+    assert list(d.sample) == ["S1", "S2", "S3"]
+    assert list(d.gene) == ["GENEB", "GENEA"]  # file order preserved here
+    assert d.expr.shape == (3, 2)
+    assert d.expr.dtype == np.float32
+    np.testing.assert_allclose(d.expr[:, 0], [1.5, -0.25, 0.0])
+    np.testing.assert_allclose(d.expr[1], [-0.25, 3.0])
+
+
+def test_load_expression_tolerates_crlf_and_trailing_blank(tmp_path):
+    p = tmp_path / "e.txt"
+    p.write_text("PATIENT\tS1\r\nG1\t1.0\r\n\r\n")
+    d = load_expression(str(p), use_native=False)
+    assert d.expr.shape == (1, 1)
+
+
+def test_load_expression_ragged_row_raises(tmp_path):
+    p = tmp_path / "e.txt"
+    p.write_text("PATIENT\tS1\tS2\nG1\t1.0\n")
+    with pytest.raises(ValueError, match="G1"):
+        load_expression(str(p), use_native=False)
+
+
+def test_load_clinical(tsv_dir):
+    c = load_clinical(str(tsv_dir / "clin.txt"))
+    assert c == {"S1": 0, "S2": 1, "S3": 0}
+
+
+def test_load_clinical_bad_label(tmp_path):
+    p = tmp_path / "c.txt"
+    p.write_text("P\tL\nS1\t2\n")
+    with pytest.raises(ValueError, match="label"):
+        load_clinical(str(p))
+
+
+def test_load_network_keeps_direction_order_and_duplicates(tsv_dir):
+    n = load_network(str(tsv_dir / "net.txt"))
+    assert n.edges == [("GENEA", "GENEB"), ("GENEB", "GENEC"), ("GENEA", "GENEB")]
+    assert n.genes == {"GENEA", "GENEB", "GENEC"}
+
+
+def test_write_biomarkers_bytes(tmp_path):
+    path = write_biomarkers(str(tmp_path / "res"), ["BRCA1", "TP53"])
+    assert open(path).read() == "GeneSymbol\nBRCA1\nTP53\n"
+
+
+def test_write_lgroups_bytes(tmp_path):
+    idx = np.array([2, 0, 1], dtype=np.int32)
+    path = write_lgroups(str(tmp_path / "res"), idx, ["A1", "B2", "C3"])
+    assert open(path).read() == (
+        "GeneSymbol\tLgroup(0:good,1:poor,2:other)\n"
+        "A1\t2\nB2\t0\nC3\t1\n")
+
+
+def test_write_vectors_bytes(tmp_path):
+    vec = np.array([[0.1234567, -1.0], [2.0, 3.5]], dtype=np.float32)
+    path = write_vectors(str(tmp_path / "res"), vec, ["A1", "B2"])
+    assert open(path).read() == (
+        "GeneSymbol\tV0\tV1\n"
+        "A1\t0.123457\t-1.000000\n"
+        "B2\t2.000000\t3.500000\n")
+
+
+def test_writer_reader_roundtrip_on_synthetic(tmp_path, small_spec):
+    from g2vec_tpu.data.synthetic import write_synthetic_tsv
+
+    paths = write_synthetic_tsv(small_spec, str(tmp_path))
+    d = load_expression(paths["expression"], use_native=False)
+    c = load_clinical(paths["clinical"])
+    n = load_network(paths["network"])
+    assert d.expr.shape == (small_spec.n_samples, len(d.gene))
+    assert set(d.sample) == set(c.keys())
+    assert len(n.edges) > 0

@@ -1,0 +1,79 @@
+"""L2 tests: gene-order invariant, restriction, label matching."""
+import numpy as np
+import pytest
+
+from g2vec_tpu.io.readers import ExpressionData, NetworkData
+from g2vec_tpu.preprocess import (
+    SampleMismatchError,
+    edges_to_indices,
+    find_common_genes,
+    make_gene2idx,
+    match_labels,
+    restrict_data,
+    restrict_network,
+)
+
+
+def _toy():
+    data = ExpressionData(
+        sample=np.array(["S1", "S2"]),
+        gene=np.array(["C", "A", "B", "Z"]),
+        expr=np.array([[1, 2, 3, 4], [5, 6, 7, 8]], dtype=np.float32),
+    )
+    net = NetworkData(
+        edges=[("A", "B"), ("B", "C"), ("A", "Q"), ("C", "A")],
+        genes={"A", "B", "C", "Q"},
+    )
+    return data, net
+
+
+def test_common_genes_sorted():
+    data, net = _toy()
+    common = find_common_genes(net.genes, data.gene)
+    assert common == ["A", "B", "C"]  # sorted, Q and Z dropped
+
+
+def test_restrict_data_reorders_columns():
+    data, net = _toy()
+    common = find_common_genes(net.genes, data.gene)
+    r = restrict_data(data, common)
+    assert list(r.gene) == ["A", "B", "C"]
+    np.testing.assert_array_equal(r.expr, [[2, 3, 1], [6, 7, 5]])
+
+
+def test_restrict_network_drops_noncommon_keeps_direction():
+    data, net = _toy()
+    common = find_common_genes(net.genes, data.gene)
+    r = restrict_network(net, common)
+    assert r.edges == [("A", "B"), ("B", "C"), ("C", "A")]
+    assert r.genes == {"A", "B", "C"}  # whole common set (ref quirk)
+
+
+def test_edges_to_indices():
+    data, net = _toy()
+    common = find_common_genes(net.genes, data.gene)
+    rnet = restrict_network(net, common)
+    g2i = make_gene2idx(np.array(common))
+    src, dst = edges_to_indices(rnet, g2i)
+    np.testing.assert_array_equal(src, [0, 1, 2])
+    np.testing.assert_array_equal(dst, [1, 2, 0])
+
+
+def test_match_labels_ok_and_missing():
+    labels = match_labels({"S1": 0, "S2": 1}, np.array(["S1", "S2"]))
+    np.testing.assert_array_equal(labels, [0, 1])
+    with pytest.raises(SampleMismatchError, match="S3"):
+        match_labels({"S1": 0}, np.array(["S1", "S3"]))
+
+
+def test_synthetic_dataset_shapes(small_dataset, small_spec):
+    expression, clinical, network, membership = small_dataset
+    common = find_common_genes(network.genes, expression.gene)
+    # all module genes survive the intersection; expr/net-only genes don't
+    for mod in ("good", "poor", "shared"):
+        assert set(membership[mod]) <= set(common)
+    assert not any(g.startswith("XONL") for g in common)
+    assert not any(g.startswith("NONL") for g in common)
+    labels = match_labels(clinical, expression.sample)
+    assert (labels == 0).sum() == small_spec.n_good
+    assert (labels == 1).sum() == small_spec.n_poor
