@@ -1,0 +1,90 @@
+"""Benchmark: modified-CBOW training throughput at the bundled-example scale.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Workload (matched to the reference's example transcript, README.md:26-41 and
+BASELINE.md): full-batch training of the two-matmul CBOW classifier on a
+45,402 x 7,523 multi-hot path matrix, hidden=128 — each epoch is one
+fwd+bwd+Adam step over the whole 80% train split plus TWO full forward
+accuracy evals (val and train), exactly the reference's per-epoch work
+(ref: G2Vec.py:264-267).
+
+Baseline: the reference's transcript reports ~2.2 s/epoch steady-state on
+its (unstated) CPU with 36,321 train paths -> ~16.5k paths/s. vs_baseline
+is our paths/s over that number.
+
+The data is synthetic (the bundled expression matrix is stripped from the
+mount — BASELINE.md note) with planted group structure so the accuracy
+trajectory is non-trivial; throughput does not depend on the data values.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+# Reference transcript numbers (README.md:26-41, see BASELINE.md).
+N_PATHS = 45402
+N_GENES = 7523
+HIDDEN = 128
+VAL_FRACTION = 0.2
+BASELINE_EPOCH_SECONDS = 2.2
+BASELINE_PATHS_PER_SEC = int(N_PATHS * (1 - VAL_FRACTION)) / BASELINE_EPOCH_SECONDS
+
+WARMUP_EPOCHS = 3     # excludes compile + first-touch from the measurement
+MEASURE_EPOCHS = 15
+
+
+def make_paths(rng: np.random.Generator, n_paths: int, n_genes: int):
+    """Multi-hot paths with planted good/poor gene blocks (~40 genes/path,
+    matching the reference's mean path occupancy at lenPath=80)."""
+    labels = (rng.random(n_paths) < 0.5).astype(np.int32)
+    paths = np.zeros((n_paths, n_genes), dtype=np.int8)
+    half = n_genes // 2
+    genes_per_path = 40
+    for i in range(n_paths):
+        lo = 0 if labels[i] == 0 else half
+        idx = rng.integers(0, half, size=genes_per_path) + lo
+        paths[i, idx] = 1
+    return paths, labels
+
+
+def main() -> None:
+    from g2vec_tpu.train.trainer import train_cbow
+
+    rng = np.random.default_rng(0)
+    paths, labels = make_paths(rng, N_PATHS, N_GENES)
+
+    epoch_secs = []
+
+    def on_epoch(step, acc_val, acc_tr, secs):
+        epoch_secs.append(secs)
+
+    t0 = time.time()
+    train_cbow(paths, labels, hidden=HIDDEN, learning_rate=0.005,
+               max_epochs=WARMUP_EPOCHS + MEASURE_EPOCHS,
+               val_fraction=VAL_FRACTION, compute_dtype="bfloat16",
+               seed=0, on_epoch=on_epoch)
+    total = time.time() - t0
+
+    steady = epoch_secs[WARMUP_EPOCHS:]
+    if not steady:           # early stop before warmup ended — use what we have
+        steady = epoch_secs
+    sec_per_epoch = float(np.median(steady))
+    train_paths = int(N_PATHS * (1 - VAL_FRACTION))
+    paths_per_sec = train_paths / sec_per_epoch
+
+    print(json.dumps({
+        "metric": "cbow_train_paths_per_sec_per_chip",
+        "value": round(paths_per_sec, 1),
+        "unit": "paths/s",
+        "vs_baseline": round(paths_per_sec / BASELINE_PATHS_PER_SEC, 2),
+    }))
+    import sys
+    print(f"# sec/epoch={sec_per_epoch:.4f} (baseline {BASELINE_EPOCH_SECONDS}) "
+          f"epochs={len(epoch_secs)} total={total:.1f}s", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

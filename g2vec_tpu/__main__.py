@@ -1,0 +1,42 @@
+"""CLI entry: ``python -m g2vec_tpu EXPR CLIN NET NAME [options]``.
+
+Same invocation shape as the reference (``python G2Vec.py ...``,
+README.md:15-19) plus the framework flags documented in
+:mod:`g2vec_tpu.config`. Platform env vars are set BEFORE jax is imported
+anywhere (the pipeline defers its jax imports for exactly this reason).
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    from g2vec_tpu.config import config_from_args
+
+    cfg = config_from_args(argv)
+    if cfg.platform == "cpu" and cfg.mesh_shape:
+        # Virtual-device convenience: an NxM mesh on CPU means the user wants
+        # the sharding dry-run — give them the devices. XLA reads this flag
+        # lazily at first backend creation, so it works even though a
+        # sitecustomize may have imported jax already.
+        need = cfg.mesh_shape[0] * cfg.mesh_shape[1]
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={need}").strip()
+    if cfg.platform:
+        os.environ["JAX_PLATFORMS"] = cfg.platform
+        # A sitecustomize may already have pinned jax_platforms via
+        # jax.config.update (which outranks the env var) — re-force it.
+        import jax
+
+        jax.config.update("jax_platforms", cfg.platform)
+    from g2vec_tpu.pipeline import run
+
+    run(cfg)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

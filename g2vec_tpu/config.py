@@ -86,6 +86,14 @@ class G2VecConfig:
             raise ValueError(f"walker_batch must be >= 0, got {self.walker_batch}")
         if self.mesh_shape is not None and any(d < 1 for d in self.mesh_shape):
             raise ValueError(f"mesh axes must be >= 1, got {self.mesh_shape}")
+        if self.n_lgroups < 3:
+            raise ValueError(
+                f"n_lgroups must be >= 3 (good/poor/other), got {self.n_lgroups}")
+        if self.display_step < 1:
+            raise ValueError(f"display_step must be >= 1, got {self.display_step}")
+        if not (0.0 < self.decision_threshold < 1.0):
+            raise ValueError(
+                f"decision_threshold must be in (0,1), got {self.decision_threshold}")
         if not (0.0 < self.val_fraction < 1.0):
             raise ValueError(f"val_fraction must be in (0,1), got {self.val_fraction}")
         if not (0.0 <= self.pcc_threshold < 1.0):

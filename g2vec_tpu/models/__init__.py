@@ -1,0 +1,3 @@
+"""Model families. The flagship is the "modified CBOW" bag-of-genes
+classifier whose first weight matrix is the gene-embedding table."""
+from g2vec_tpu.models.cbow import CBOWParams, forward, init_params, predict_logits  # noqa: F401

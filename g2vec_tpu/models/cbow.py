@@ -1,0 +1,60 @@
+"""The "modified CBOW" model — a two-matmul bag-of-genes sigmoid classifier.
+
+Reference architecture (ref: G2Vec.py:231-251): multi-hot gene-set input
+``X [batch, n_genes]`` -> hidden ``H = X @ W_ih`` -> scalar logit
+``O = H @ W_ho``; no biases, no nonlinearity. The learned ``W_ih
+[n_genes, hidden]`` IS the gene-embedding table (ref: G2Vec.py:286).
+
+TPU mapping: both matmuls hit the MXU. The multi-hot X is kept in the compute
+dtype (0/1 are exact in bfloat16); accumulation is forced to float32 via
+``preferred_element_type`` so bf16 inputs don't cost accuracy in the
+reduction. With a ('data','model') mesh, X is sharded [data, model] and
+W_ih [model, -] so the gene-axis contraction psums over the model axis —
+XLA/GSPMD inserts the collective from the sharding constraints alone.
+"""
+from __future__ import annotations
+
+from math import sqrt
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class CBOWParams(NamedTuple):
+    w_ih: jax.Array  # [n_genes, hidden] — the gene-embedding table
+    w_ho: jax.Array  # [hidden, 1]
+
+
+def init_params(key: jax.Array, n_genes: int, hidden: int,
+                param_dtype=jnp.float32) -> CBOWParams:
+    """Truncated-normal init, std 1/sqrt(hidden) (ref: G2Vec.py:234-235).
+
+    ``jax.random.truncated_normal(-2, 2)`` matches TF1's
+    ``tf.truncated_normal`` (resample beyond 2 sigma)."""
+    k1, k2 = jax.random.split(key)
+    std = 1.0 / sqrt(hidden)
+    w_ih = jax.random.truncated_normal(k1, -2.0, 2.0, (n_genes, hidden)) * std
+    w_ho = jax.random.truncated_normal(k2, -2.0, 2.0, (hidden, 1)) * std
+    return CBOWParams(w_ih=w_ih.astype(param_dtype), w_ho=w_ho.astype(param_dtype))
+
+
+def forward(params: CBOWParams, x: jax.Array,
+            compute_dtype=jnp.bfloat16) -> jax.Array:
+    """Logits [batch, 1] in float32 regardless of compute dtype."""
+    xc = x.astype(compute_dtype)
+    h = jax.lax.dot_general(
+        xc, params.w_ih.astype(compute_dtype),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    o = jax.lax.dot_general(
+        h.astype(compute_dtype), params.w_ho.astype(compute_dtype),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return o
+
+
+def predict_logits(params: CBOWParams, x: jax.Array,
+                   compute_dtype=jnp.bfloat16) -> jax.Array:
+    """Alias used by serving/entry points."""
+    return forward(params, x, compute_dtype)

@@ -1,0 +1,6 @@
+"""L3/L5 device ops: PCC adjacency, random walks, statistics, k-means.
+
+Everything here is jit-compiled JAX operating on device-resident arrays;
+host-side glue (dedup, dict building, sorting by gene symbol) lives in
+:mod:`g2vec_tpu.analysis` and :mod:`g2vec_tpu.ops.paths`.
+"""

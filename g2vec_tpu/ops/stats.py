@@ -1,0 +1,66 @@
+"""L5 statistics ops — jitted, vectorized over the gene axis.
+
+The reference computes these one gene at a time in Python loops
+(compute_tscores, G2Vec.py:151-157; compute_tstatistics, G2Vec.py:138-149;
+transform_minmax, G2Vec.py:133-136). Here each is one fused XLA kernel over
+the whole gene axis.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=())
+def tscores(expr_good: jax.Array, expr_poor: jax.Array) -> jax.Array:
+    """|pooled-variance two-sample t| per gene (ref: G2Vec.py:138-157).
+
+    ``expr_good``: [n0, G] expression of label-0 samples; ``expr_poor``:
+    [n1, G] of label-1 samples. Matches the reference exactly:
+
+    - sample std with ddof=1 (G2Vec.py:140)
+    - pooled denominator sqrt(((n0-1)s0^2 + (n1-1)s1^2) / (n0+n1-2))
+      times sqrt(1/n0 + 1/n1) (G2Vec.py:143-144)
+    - 0.0 whenever either denominator is not strictly positive
+      (G2Vec.py:145-148), which also covers the constant-gene case
+    - absolute value taken by the caller loop in the reference
+      (G2Vec.py:156); taken here directly.
+
+    Note the reference's argument names ("n_poor" for the label-0 group) are
+    misleading; the formula is symmetric up to sign, and abs() is applied.
+    """
+    n0 = expr_good.shape[0]
+    n1 = expr_poor.shape[0]
+    m0 = expr_good.mean(axis=0)
+    m1 = expr_poor.mean(axis=0)
+    s0 = expr_good.std(axis=0, ddof=1)
+    s1 = expr_poor.std(axis=0, ddof=1)
+    pooled = ((n0 - 1.0) * s0 * s0 + (n1 - 1.0) * s1 * s1) / (n0 + n1 - 2.0)
+    d1 = jnp.sqrt(pooled)
+    d2 = jnp.sqrt(1.0 / n0 + 1.0 / n1)
+    ok = (d1 > 0.0) & (d2 > 0.0)
+    t = jnp.where(ok, (m0 - m1) / jnp.where(ok, d1, 1.0) / d2, 0.0)
+    return jnp.abs(t)
+
+
+@jax.jit
+def minmax(scores: jax.Array, new_min: float = 0.0, new_max: float = 1.0) -> jax.Array:
+    """Linear rescale to [new_min, new_max] (ref: G2Vec.py:133-136).
+
+    Guarded: a constant score vector maps to all-new_min instead of the
+    reference's division by zero (SURVEY.md §7 quirk (f))."""
+    old_min = scores.min()
+    old_max = scores.max()
+    span = old_max - old_min
+    safe = jnp.where(span > 0.0, span, 1.0)
+    return jnp.where(span > 0.0,
+                     (new_max - new_min) / safe * (scores - old_min) + new_min,
+                     jnp.full_like(scores, new_min))
+
+
+@jax.jit
+def dscores(embeddings: jax.Array) -> jax.Array:
+    """Row-wise L2 norm of embedding rows (ref: G2Vec.py:96)."""
+    return jnp.sqrt(jnp.sum(embeddings * embeddings, axis=1))

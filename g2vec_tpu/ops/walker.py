@@ -1,0 +1,189 @@
+"""L3 — vectorized weighted random walks on device.
+
+Reference semantics (generate_pathSet / generate_randomPath,
+G2Vec.py:324-352), reproduced distributionally:
+
+- every gene is a start node, ``numRepetition`` times (G2Vec.py:348-349);
+- a path holds at most ``lenPath`` nodes (the append happens at the top of
+  the step loop, G2Vec.py:331-332 — the node sampled on the final iteration
+  is never appended);
+- no revisiting: sampling weights of every node already on the path are
+  zeroed (``prob[path] = 0.``, G2Vec.py:336);
+- the next node is Categorical(weights / sum) (G2Vec.py:338-341);
+- a walker stops early when every unvisited neighbor has weight 0
+  ("dead end", G2Vec.py:342-344);
+- a finished path is canonicalized as its sorted node tuple and deduplicated
+  through a set (G2Vec.py:345, 351).
+
+TPU design — the reference walks one node at a time in Python with an
+O(n_genes) ``deepcopy`` per step (G2Vec.py:334; ~4.5e10 element touches per
+group at example scale, its self-declared "most time consuming step").
+Here ALL walkers advance in lockstep inside one jitted ``lax.scan``:
+
+- walker state is (visited [W, G] bool, current [W] int32, alive [W] bool);
+- the per-step transition row gather ``adj[current]`` and the visited mask
+  are dense [W, G] ops (HBM-bandwidth bound, MXU-free, XLA fuses the
+  mask/normalize/sample chain);
+- the categorical draw is Gumbel-max over masked log-weights — exactly
+  Categorical(w/Σw) without materializing the normalization;
+- a dead-ended walker freezes (alive gate) and its state is carried
+  unchanged through the remaining steps — fixed trip count, no dynamic
+  control flow, one compiled program;
+- the final visited mask [W, G] IS the path's canonical encoding: a
+  multi-hot row over genes == the sorted-tuple-of-unique-nodes set form
+  (G2Vec.py:345), so dedup is row-dedup (packed to bytes host-side).
+
+The walk itself never leaves the device; only the packed bool masks cross to
+host for set semantics (dedup / common-path drop), which are
+order-sensitive-free and cheap (n_paths × G/8 bytes).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Optional, Sequence, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NEG_INF = -1e30  # large-negative instead of -inf: keeps argmax well-defined
+
+
+@partial(jax.jit, static_argnames=("len_path",))
+def random_walks(adj: jax.Array, starts: jax.Array, key: jax.Array,
+                 len_path: int) -> jax.Array:
+    """Walk |starts| walkers for <= len_path nodes; return visited [W, G] bool.
+
+    ``adj``: [G, G] float32 non-negative directed transition weights (zero =
+    no edge). ``starts``: [W] int32 start nodes. ``key`` is either ONE PRNG
+    key (per-walker keys derived by position) or a [W] array of per-walker
+    keys — the latter is what makes :func:`generate_path_set` invariant to
+    ``walker_batch``: each walker's stream is keyed by its global identity,
+    not by which launch it rode in. The returned multi-hot rows are the
+    canonical path encodings (see module docstring).
+    """
+    n_genes = adj.shape[0]
+    n_walkers = starts.shape[0]
+    if key.ndim == 0:
+        walker_keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
+            jnp.arange(n_walkers))
+    else:
+        walker_keys = key
+
+    visited0 = jax.nn.one_hot(starts, n_genes, dtype=jnp.bool_)
+    state0 = (visited0, starts.astype(jnp.int32),
+              jnp.ones((n_walkers,), dtype=jnp.bool_))
+
+    def step(state, step_idx):
+        visited, current, alive = state
+        w = adj[current]                                   # [W, G] gather
+        w = jnp.where(visited, 0.0, w)                     # no revisit
+        norm = w.sum(axis=1)                               # [W]
+        can_move = alive & (norm > 0.0)                    # dead-end freeze
+        logits = jnp.where(w > 0.0, jnp.log(jnp.where(w > 0.0, w, 1.0)), NEG_INF)
+        gumbel = jax.vmap(
+            lambda k: jax.random.gumbel(jax.random.fold_in(k, step_idx),
+                                        (n_genes,)))(walker_keys)
+        nxt = jnp.argmax(logits + gumbel, axis=1).astype(jnp.int32)
+        current = jnp.where(can_move, nxt, current)
+        moved = jax.nn.one_hot(nxt, n_genes, dtype=jnp.bool_) & can_move[:, None]
+        visited = visited | moved
+        return (visited, current, can_move), None
+
+    # len_path nodes total = the start node + (len_path - 1) sampled moves.
+    (visited, _, _), _ = jax.lax.scan(
+        step, state0, jnp.arange(max(len_path - 1, 0)))
+    return visited
+
+
+def generate_path_set(adj, key: jax.Array, *, len_path: int, reps: int,
+                      starts: Optional[np.ndarray] = None,
+                      walker_batch: int = 0) -> Set[bytes]:
+    """All-sources x reps walks -> set of packed multi-hot path rows.
+
+    Mirrors generate_pathSet (G2Vec.py:324-352): every gene is a start node,
+    ``reps`` times; results are set-deduplicated. Each element is
+    ``np.packbits`` of the [G] bool row (fixed G; unpack with
+    :func:`unpack_paths`).
+
+    ``walker_batch`` caps walkers per device launch (0 = one full repetition,
+    i.e. n_genes walkers — 56 MB of state at example scale). The adjacency is
+    transferred once; each batch returns only its packed masks. The result is
+    INVARIANT to ``walker_batch``: every walker's PRNG stream is keyed by its
+    (repetition, global walker index), not by its launch batch, so the memory
+    knob never changes which paths a given --seed produces.
+    """
+    n_genes = int(adj.shape[0])
+    if starts is None:
+        starts = np.arange(n_genes, dtype=np.int32)
+    starts = np.asarray(starts, dtype=np.int32)
+    batch = walker_batch if walker_batch > 0 else starts.size
+    adj_dev = jax.device_put(jnp.asarray(adj, dtype=jnp.float32))
+
+    paths: Set[bytes] = set()
+    for rep_key in jax.random.split(key, reps):
+        all_keys = jax.vmap(lambda i: jax.random.fold_in(rep_key, i))(
+            jnp.arange(starts.size))
+        for lo in range(0, starts.size, batch):
+            chunk = starts[lo:lo + batch]
+            visited = random_walks(adj_dev, jnp.asarray(chunk),
+                                   all_keys[lo:lo + batch], len_path)
+            packed = np.packbits(np.asarray(visited), axis=1)
+            paths.update(row.tobytes() for row in packed)
+    return paths
+
+
+def unpack_paths(packed: Sequence[bytes], n_genes: int) -> np.ndarray:
+    """Packed path rows -> [N, n_genes] uint8 multi-hot (sorted for determinism).
+
+    uint8, not int32: at reference scale (45k x 7.5k) the multi-hot matrix is
+    ~340 MB this way; every consumer re-casts anyway (the trainer to its
+    compute dtype, the frequency vote through numpy's promoting sum).
+    """
+    if not packed:
+        return np.zeros((0, n_genes), dtype=np.uint8)
+    rows = np.frombuffer(b"".join(sorted(packed)), dtype=np.uint8)
+    rows = rows.reshape(len(packed), -1)
+    return np.unpackbits(rows, axis=1)[:, :n_genes]
+
+
+def integrate_path_sets(path_set_good: Set[bytes], path_set_poor: Set[bytes],
+                        n_genes: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Drop paths common to both groups; return (multi-hot, labels).
+
+    Reference: integrate_pathSet (G2Vec.py:310-322) — a path gene-set present
+    in BOTH groups' sets carries no prognosis signal and is removed from
+    both; survivors get their group index as the label. The reference's
+    trailing label column is a separate array here (the trainer takes
+    (paths, labels), not a glued matrix). Row order: good block then poor
+    block, each sorted by packed bytes (the reference iterates Python-set
+    order — nondeterministic; we pin it).
+    """
+    common = path_set_good & path_set_poor
+    good = unpack_paths(path_set_good - common, n_genes)
+    poor = unpack_paths(path_set_poor - common, n_genes)
+    paths = np.concatenate([good, poor], axis=0)
+    labels = np.concatenate([
+        np.zeros(good.shape[0], dtype=np.int32),
+        np.ones(poor.shape[0], dtype=np.int32)])
+    return paths, labels
+
+
+def count_gene_freq(paths: np.ndarray, labels: np.ndarray,
+                    genes: Sequence[str]) -> Dict[str, int]:
+    """Per-gene majority vote over the integrated path set.
+
+    Reference: count_geneFreq (G2Vec.py:288-308) — for each gene appearing in
+    at least one path, count good vs poor paths containing it; majority ->
+    0/1, tie -> 2. Genes in no path are absent from the dict (callers default
+    them to 2, ref: G2Vec.py:172).
+    """
+    good_counts = paths[labels == 0].sum(axis=0)
+    poor_counts = paths[labels == 1].sum(axis=0)
+    result: Dict[str, int] = {}
+    for i, g in enumerate(genes):
+        fg, fp = int(good_counts[i]), int(poor_counts[i])
+        if fg == 0 and fp == 0:
+            continue
+        result[g] = 0 if fg > fp else (1 if fg < fp else 2)
+    return result

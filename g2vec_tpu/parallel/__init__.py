@@ -1,0 +1,15 @@
+"""Parallelism: device mesh, sharding specs, distributed init.
+
+The reference is single-process/single-device (SURVEY.md §2); here DP and TP
+are first-class. The strategy (SURVEY.md §2 "Parallelism strategies"):
+
+- **DP**: shard the path batch over the ``data`` mesh axis; gradient psum is
+  inserted by GSPMD because params are replicated along ``data``.
+- **TP**: shard the gene axis — rows of ``W_ih`` and columns of the multi-hot
+  ``X`` — over the ``model`` axis; the hidden activations of ``X @ W_ih``
+  are psum-reduced over ``model`` by GSPMD.
+- PP/EP/CP/SP are structurally inapplicable (no layer stack, no experts, no
+  sequence axis — paths are orderless gene sets); the gene axis IS this
+  workload's long-context axis, and TP over it is its scaling story.
+"""
+from g2vec_tpu.parallel.mesh import MeshContext, make_mesh_context  # noqa: F401

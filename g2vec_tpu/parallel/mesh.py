@@ -1,0 +1,105 @@
+"""Device mesh + sharding specs for the CBOW trainer and walker.
+
+All sharding is expressed declaratively with ``NamedSharding`` /
+``with_sharding_constraint``; XLA GSPMD inserts the actual collectives
+(psum over ``model`` for the gene-axis contraction, gradient psum over
+``data``) — no hand-written collective calls, riding ICI within a slice and
+DCN across slices exactly as compiled (cf. the NCCL/MPI backends the survey
+template asks about: JAX collectives ARE this framework's comm backend).
+
+``make_mesh_context(None)`` gives a no-op context so every call site works
+unchanged on a single chip.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshContext:
+    """Holds the mesh and the canonical PartitionSpecs of this framework."""
+
+    mesh: Optional[Mesh]
+
+    # ---- specs ----
+    @property
+    def batch_spec(self) -> P:
+        """Multi-hot path batch X [paths, genes]: DP over rows, TP over cols."""
+        return P(DATA_AXIS, MODEL_AXIS)
+
+    @property
+    def label_spec(self) -> P:
+        return P(DATA_AXIS, None)
+
+    @property
+    def w_ih_spec(self) -> P:
+        """Embedding table [genes, hidden]: row-sharded over model axis."""
+        return P(MODEL_AXIS, None)
+
+    @property
+    def w_ho_spec(self) -> P:
+        return P(None, None)
+
+    @property
+    def hidden_spec(self) -> P:
+        """Activations H [paths, hidden] after the psum over model."""
+        return P(DATA_AXIS, None)
+
+    @property
+    def adj_spec(self) -> P:
+        """Dense transition matrix [genes, genes]: row-sharded."""
+        return P(MODEL_AXIS, None)
+
+    @property
+    def walker_spec(self) -> P:
+        """Walker state [walkers, ...]: DP over walkers."""
+        return P(DATA_AXIS, None)
+
+    # ---- helpers ----
+    def sharding(self, spec: P) -> Optional[NamedSharding]:
+        if self.mesh is None:
+            return None
+        return NamedSharding(self.mesh, spec)
+
+    def put(self, x, spec: P):
+        """Device-put with this context's sharding (no-op spec on 1 device)."""
+        s = self.sharding(spec)
+        return jax.device_put(x, s) if s is not None else jax.device_put(x)
+
+    def constrain(self, x, spec: P):
+        if self.mesh is None:
+            return x
+        return jax.lax.with_sharding_constraint(x, NamedSharding(self.mesh, spec))
+
+    @property
+    def n_devices(self) -> int:
+        return 1 if self.mesh is None else self.mesh.size
+
+
+def make_mesh_context(mesh_shape: Optional[Tuple[int, int]],
+                      devices=None) -> MeshContext:
+    """Build a ('data','model') mesh, or a no-op context if shape is None."""
+    if mesh_shape is None:
+        return MeshContext(mesh=None)
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    need = mesh_shape[0] * mesh_shape[1]
+    if devices.size < need:
+        raise ValueError(
+            f"mesh {mesh_shape} needs {need} devices, only {devices.size} visible "
+            f"(hint: XLA_FLAGS=--xla_force_host_platform_device_count={need} "
+            "JAX_PLATFORMS=cpu for a virtual mesh)")
+    grid = devices[:need].reshape(mesh_shape)
+    return MeshContext(mesh=Mesh(grid, (DATA_AXIS, MODEL_AXIS)))
+
+
+def pad_to_multiple(n: int, k: int) -> int:
+    """Smallest multiple of k that is >= n (shard-even padding helper)."""
+    return ((n + k - 1) // k) * k

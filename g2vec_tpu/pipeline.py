@@ -1,0 +1,209 @@
+"""The seven-stage pipeline orchestrator.
+
+Drives L0-L6 in the reference's order (ref: main, G2Vec.py:11-120) and
+reproduces its console transcript (the only golden spec the reference
+publishes, README.md:21-49): stage banners ``>>> N. ...``, the indented
+preprocessing stats, the epoch log cadence, and the saved-file listing —
+while running stages 3-5 on device (adjacency, walks, trainer, k-means all
+jit-compiled JAX).
+
+Differences from the reference, all deliberate (SURVEY.md §7):
+- seeded end to end (the reference is unseeded);
+- ``--epoch`` is honored (the reference hardcodes 500, G2Vec.py:262);
+- structured JSONL metrics / profiler traces / checkpoints behind flags;
+- stage 3 walks all sources in lockstep on device instead of one Python
+  walker at a time (ops/walker.py docstring has the mapping).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from g2vec_tpu.config import G2VecConfig
+
+
+@dataclasses.dataclass
+class PipelineResult:
+    genes: np.ndarray            # [G] str — global sorted-intersection order
+    embeddings: np.ndarray       # [G, hidden] float32
+    lgroup_idx: np.ndarray       # [G] int32 in {0 good, 1 poor, 2 other}
+    biomarkers: List[str]
+    output_files: List[str]
+    n_samples: int = 0
+    n_genes: int = 0
+    n_edges: int = 0
+    n_paths: int = 0
+    n_path_genes: int = 0
+    train_history: List[dict] = dataclasses.field(default_factory=list)
+    acc_val: float = 0.0
+    stage_seconds: Dict[str, float] = dataclasses.field(default_factory=dict)
+
+
+class _EpochReporter:
+    """Reproduces the reference's epoch log cadence (ref: G2Vec.py:269-278).
+
+    A line is printed whenever ``step % display_step == 0``, showing the wall
+    time accumulated since the previous printed line; on early stop the
+    ``Epoch(stop)`` line reports the PREVIOUS epoch's accuracies.
+    """
+
+    def __init__(self, console: Callable[[str], None], display_step: int):
+        self.console = console
+        self.display_step = display_step
+        self.block_secs = 0.0
+
+    def on_epoch(self, step: int, acc_val: float, acc_tr: float, secs: float) -> None:
+        self.block_secs += secs
+        if step % self.display_step == 0:
+            self.console("    - Epoch: %03d\tACC[val]=%.4f\tACC[tr]=%.4f (%.3f sec)"
+                         % (step, acc_val, acc_tr, self.block_secs))
+            self.block_secs = 0.0
+
+    def on_stop(self, stop_epoch: int, acc_val: float, acc_tr: float) -> None:
+        self.console("    - Epoch(stop): %03d\tACC[val]=%.4f\tACC[tr]=%.4f (%.3f sec)"
+                     % (stop_epoch, acc_val, acc_tr, self.block_secs))
+
+
+def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineResult:
+    """Execute the full pipeline; returns all artifacts plus run stats."""
+    # Deferred imports: jax must not be pulled in before the CLI has had the
+    # chance to set platform env vars (see __main__.py).
+    import jax
+
+    from g2vec_tpu.analysis import find_lgroups, select_biomarkers
+    from g2vec_tpu.io.readers import load_clinical, load_expression, load_network
+    from g2vec_tpu.io.writers import write_biomarkers, write_lgroups, write_vectors
+    from g2vec_tpu.ops.graph import build_adjacency
+    from g2vec_tpu.ops.walker import (count_gene_freq, generate_path_set,
+                                      integrate_path_sets)
+    from g2vec_tpu.parallel.mesh import make_mesh_context
+    from g2vec_tpu.preprocess import (edges_to_indices, find_common_genes,
+                                      make_gene2idx, match_labels,
+                                      restrict_data, restrict_network)
+    from g2vec_tpu.train.trainer import train_cbow
+    from g2vec_tpu.utils.metrics import MetricsWriter
+    from g2vec_tpu.utils.timing import StageTimer
+
+    cfg.validate()
+    if cfg.debug_nans:
+        jax.config.update("jax_debug_nans", True)
+
+    timer = StageTimer()
+    metrics = MetricsWriter(cfg.metrics_jsonl)
+    if cfg.profile_dir:
+        jax.profiler.start_trace(cfg.profile_dir)
+
+    try:
+        console(">>> 0. Arguments")
+        console(str(cfg))
+        metrics.emit("config", **{f.name: str(getattr(cfg, f.name))
+                                  for f in dataclasses.fields(cfg)})
+
+        console(">>> 1. Load data")
+        with timer.stage("load"):
+            data = load_expression(cfg.expression_file, use_native=cfg.use_native_io)
+            clinical = load_clinical(cfg.clinical_file)
+            network = load_network(cfg.network_file)
+
+        console(">>> 2. Preprocess data")
+        with timer.stage("preprocess"):
+            data.label = match_labels(clinical, data.sample)
+            common = find_common_genes(network.genes, data.gene)
+            network = restrict_network(network, common)
+            data = restrict_data(data, common)
+            gene2idx = make_gene2idx(data.gene)
+            src, dst = edges_to_indices(network, gene2idx)
+        n_samples, n_genes = data.expr.shape
+        n_edges = len(network.edges)
+        console("    n_samples: %d" % n_samples)
+        console("    n_genes  : %d\t(common genes in both EXPRESSION and NETWORK)" % n_genes)
+        console("    n_edges  : %d\t(edges with the common genes)" % n_edges)
+        metrics.emit("preprocess", n_samples=n_samples, n_genes=n_genes, n_edges=n_edges)
+
+        console(">>> 3. Generate random paths from each group")
+        console("    *** most time consuming step ***")
+        key = jax.random.key(cfg.seed)
+        path_sets = []
+        with timer.stage("paths"):
+            for i, group in enumerate(["g", "p"]):
+                expr_group = data.expr[data.label == i]
+                adj = build_adjacency(expr_group, src, dst, n_genes,
+                                      threshold=cfg.pcc_threshold)
+                path_sets.append(generate_path_set(
+                    adj, jax.random.fold_in(key, i), len_path=cfg.lenPath,
+                    reps=cfg.numRepetition, walker_batch=cfg.walker_batch))
+            paths, labels = integrate_path_sets(path_sets[0], path_sets[1], n_genes)
+            gene_freq = count_gene_freq(paths, labels, data.gene)
+        n_paths = paths.shape[0]
+        if n_paths < 2:
+            raise ValueError(
+                "fewer than 2 distinct group-specific paths were generated — "
+                "the |PCC| > %.2f graphs are too sparse for this dataset; try "
+                "lowering --pcc-threshold or raising -r/--numRepetition"
+                % cfg.pcc_threshold)
+        console("    n_paths : %d" % n_paths)
+        console("    n_genes : %d\t(genes in good or poor random paths)" % len(gene_freq))
+        metrics.emit("paths", n_paths=n_paths, n_path_genes=len(gene_freq))
+
+        console(">>> 4. Compute distributed representations using modified CBOW")
+        console("     Start training the modified CBOW with early stopping")
+        reporter = _EpochReporter(console, cfg.display_step)
+        mesh_ctx = make_mesh_context(cfg.mesh_shape)
+
+        def on_epoch(step, acc_val, acc_tr, secs):
+            reporter.on_epoch(step, acc_val, acc_tr, secs)
+            metrics.emit("epoch", step=step, acc_val=acc_val, acc_tr=acc_tr, secs=secs)
+
+        with timer.stage("train"):
+            result = train_cbow(
+                paths, labels,
+                hidden=cfg.sizeHiddenlayer, learning_rate=cfg.learningRate,
+                max_epochs=cfg.epoch, val_fraction=cfg.val_fraction,
+                decision_threshold=cfg.decision_threshold,
+                compute_dtype=cfg.compute_dtype, param_dtype=cfg.param_dtype,
+                seed=cfg.seed, mesh_ctx=mesh_ctx, on_epoch=on_epoch,
+                checkpoint_dir=cfg.checkpoint_dir, resume=cfg.resume)
+        if result.stopped_early:
+            reporter.on_stop(result.stop_epoch, result.acc_val, result.acc_tr)
+        console("    Optimization Finish")
+        metrics.emit("train_done", stop_epoch=result.stop_epoch,
+                     acc_val=result.acc_val, acc_tr=result.acc_tr,
+                     stopped_early=result.stopped_early)
+
+        console(">>> 5. Find L-groups")
+        with timer.stage("lgroups"):
+            lgroup_idx = find_lgroups(
+                result.w_ih, data.gene, gene_freq,
+                key=jax.random.key(cfg.kmeans_seed), k=cfg.n_lgroups,
+                compat_tiebreak=cfg.compat_lgroup_tiebreak, iters=cfg.kmeans_iters)
+
+        console(">>> 6. Select biomarkers with gene scores")
+        with timer.stage("biomarkers"):
+            biomarkers, _ = select_biomarkers(
+                result.w_ih, data.expr, data.label, data.gene, lgroup_idx,
+                cfg.numBiomarker, score_mix=cfg.score_mix)
+
+        console(">>> 7. Save results")
+        with timer.stage("save"):
+            outputs = [
+                write_biomarkers(cfg.result_name, biomarkers),
+                write_lgroups(cfg.result_name, lgroup_idx, data.gene),
+                write_vectors(cfg.result_name, result.w_ih, data.gene),
+            ]
+        for path in outputs:
+            console("    %s" % path)
+        metrics.emit("done", outputs=outputs, stage_seconds=timer.as_dict())
+
+        return PipelineResult(
+            genes=data.gene, embeddings=result.w_ih, lgroup_idx=lgroup_idx,
+            biomarkers=biomarkers, output_files=outputs,
+            n_samples=n_samples, n_genes=n_genes, n_edges=n_edges,
+            n_paths=n_paths, n_path_genes=len(gene_freq),
+            train_history=result.history, acc_val=result.acc_val,
+            stage_seconds=timer.as_dict())
+    finally:
+        if cfg.profile_dir:
+            jax.profiler.stop_trace()
+        metrics.close()

@@ -1,0 +1,2 @@
+"""L4 — training: the modified-CBOW trainer, checkpointing."""
+from g2vec_tpu.train.trainer import TrainResult, train_cbow  # noqa: F401

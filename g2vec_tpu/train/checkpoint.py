@@ -1,0 +1,83 @@
+"""Checkpoint / resume for the CBOW trainer.
+
+The reference has no checkpointing at all — training state lives only inside
+the TF session and dies with the process (SURVEY.md §5 "Checkpoint/resume").
+Here the full trainer state — params, Adam state, the early-stopping
+snapshot/accuracy pair, and the epoch counter — round-trips through a single
+``.npz`` so an interrupted run resumes mid-epoch-loop with identical
+numerics (full-batch training has no data-order state to restore).
+
+Format: pytree leaves flattened in deterministic order and keyed by index,
+plus a scalar metadata array. Restoring unflattens against a freshly
+initialized state's treedef, so the format never hard-codes optax internals.
+Writes are atomic (tmp file + ``os.replace``) so a crash mid-write can't
+corrupt the latest checkpoint.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Tuple
+
+import jax
+import numpy as np
+
+CKPT_NAME = "cbow_state.npz"
+
+
+# ``done`` codes in the meta record: the trainer refuses to continue a
+# finished run on --resume (it would re-apply updates on top of themselves
+# after an early stop — the saved params are post-dip, the snapshot pre-dip).
+RUN_IN_PROGRESS = 0
+RUN_COMPLETED = 1      # reached max_epochs
+RUN_EARLY_STOPPED = 2  # first val-accuracy dip
+
+
+def save_state(directory: str, params: Any, opt_state: Any, snapshot: Any,
+               epoch: int, before_val: float, before_tr: float,
+               done: int = RUN_IN_PROGRESS) -> str:
+    """Atomically write the full trainer state under ``directory``."""
+    os.makedirs(directory, exist_ok=True)
+    leaves, _ = jax.tree_util.tree_flatten((params, opt_state, snapshot))
+    arrays = {f"leaf_{i}": np.asarray(leaf) for i, leaf in enumerate(leaves)}
+    arrays["meta"] = np.array([float(epoch), before_val, before_tr, float(done)])
+    path = os.path.join(directory, CKPT_NAME)
+    tmp = path + ".tmp"
+    np.savez(tmp, **arrays)
+    # np.savez appends .npz to names without it.
+    os.replace(tmp + ".npz" if os.path.exists(tmp + ".npz") else tmp, path)
+    return path
+
+
+def load_state(directory: str, params_like: Any, opt_state_like: Any
+               ) -> Optional[Tuple[Any, Any, Any, int, float, float, int]]:
+    """Restore (params, opt_state, snapshot, epoch, before_val, before_tr, done).
+
+    ``params_like`` / ``opt_state_like`` supply the treedefs (from a fresh
+    init at the same shapes). Returns None when no checkpoint exists; raises
+    with a clear message on a shape mismatch (e.g. resuming with a different
+    ``--sizeHiddenlayer``).
+    """
+    path = os.path.join(directory, CKPT_NAME)
+    if not os.path.exists(path):
+        return None
+    like = (params_like, opt_state_like, params_like)
+    like_leaves, treedef = jax.tree_util.tree_flatten(like)
+    with np.load(path) as data:
+        leaves = [data[f"leaf_{i}"] for i in range(len(like_leaves))]
+        meta = data["meta"]
+    for i, (got, want) in enumerate(zip(leaves, like_leaves)):
+        if hasattr(want, "shape") and tuple(got.shape) != tuple(np.shape(want)):
+            raise ValueError(
+                f"checkpoint {path}: leaf {i} has shape {got.shape}, current "
+                f"model expects {np.shape(want)} — was the config changed "
+                "between save and resume?")
+        # np.savez stores ml_dtypes types (bfloat16 et al.) as raw void
+        # bytes; reinterpret them against the expected leaf's dtype so a
+        # bf16-param checkpoint round-trips instead of surfacing as '|V2'.
+        want_dtype = np.asarray(want).dtype
+        if got.dtype.kind == "V" and got.dtype != want_dtype:
+            leaves[i] = got.view(want_dtype)
+    params, opt_state, snapshot = jax.tree_util.tree_unflatten(treedef, leaves)
+    done = int(meta[3]) if meta.shape[0] > 3 else RUN_IN_PROGRESS
+    return (params, opt_state, snapshot,
+            int(meta[0]), float(meta[1]), float(meta[2]), done)

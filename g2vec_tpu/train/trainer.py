@@ -1,0 +1,257 @@
+"""The modified-CBOW trainer (ref: compute_genetovec, G2Vec.py:217-286).
+
+Reference behavior, reproduced exactly (SURVEY.md §7 quirk (c)):
+
+- shuffled 80/20 hold-out (ref: G2Vec.py:219-226) — here with a seeded PRNG
+  (the reference is unseeded);
+- full-batch training: the whole train split in every optimizer step
+  (ref: G2Vec.py:264);
+- Adam with TF1 defaults (b1=0.9, b2=0.999, eps=1e-8; ref: G2Vec.py:246);
+- after each step, val and train accuracies are evaluated with the UPDATED
+  weights (ref: G2Vec.py:264-267);
+- early stop on the FIRST strict decrease of val accuracy, returning the
+  PREVIOUS epoch's embedding table (the reference fetches W_ih every epoch at
+  G2Vec.py:283, after the break check, so on stop the previous epoch's value
+  survives);
+- ``--epoch`` caps the loop (the reference parses but ignores it,
+  hardcoding 500 — SURVEY.md §7 quirk (b); we honor it).
+
+TPU design vs the reference: the TF1 version re-feeds the full dense path
+matrix host->runtime three times per epoch through ``feed_dict``
+(~1.3 GB/epoch at example scale, ref: G2Vec.py:264-267) and pulls the whole
+W_ih back every epoch (G2Vec.py:283). Here the path matrix and parameters are
+device-resident; one jit-compiled epoch function performs step + both evals,
+and exactly two scalars cross to the host per epoch. The previous-epoch
+snapshot is a device-side reference (params are immutable pytrees — keeping
+the old one costs nothing and no transfer happens until training ends).
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from g2vec_tpu.models.cbow import CBOWParams, forward, init_params
+from g2vec_tpu.parallel.mesh import MeshContext, make_mesh_context
+
+
+@dataclasses.dataclass
+class TrainResult:
+    w_ih: np.ndarray            # [n_genes, hidden] float32 — the embeddings
+    stop_epoch: int             # reported stop epoch (reference convention)
+    stopped_early: bool
+    acc_val: float              # accuracy pair at the reported epoch
+    acc_tr: float
+    history: List[dict]         # per-epoch {epoch, acc_val, acc_tr, loss, secs}
+    params: Optional[CBOWParams] = None  # device params (for checkpointing)
+
+
+def _make_epoch_fn(tx: optax.GradientTransformation, compute_dtype,
+                   decision_threshold: float, ctx: MeshContext):
+    logit_threshold = float(np.log(decision_threshold / (1.0 - decision_threshold)))
+
+    # ``w`` is a [batch, 1] 1/0 mask: 1 for real rows, 0 for shard-even
+    # padding rows (see train_cbow). Weighted means make the padded program
+    # numerically identical to the unpadded one.
+    def loss_fn(params, x, y, w):
+        logits = forward(params, x, compute_dtype)
+        logits = ctx.constrain(logits, ctx.label_spec)
+        bce = optax.sigmoid_binary_cross_entropy(logits, y)
+        return jnp.sum(bce * w) / jnp.sum(w)
+
+    def accuracy(params, x, y, w):
+        logits = forward(params, x, compute_dtype)
+        pred = (logits > logit_threshold).astype(jnp.float32)
+        return jnp.sum((pred == y).astype(jnp.float32) * w) / jnp.sum(w)
+
+    def epoch(params, opt_state, xtr, ytr, wtr, xval, yval, wval):
+        loss, grads = jax.value_and_grad(loss_fn)(params, xtr, ytr, wtr)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        if ctx.mesh is not None:
+            params = CBOWParams(
+                w_ih=ctx.constrain(params.w_ih, ctx.w_ih_spec),
+                w_ho=ctx.constrain(params.w_ho, ctx.w_ho_spec))
+        acc_val = accuracy(params, xval, yval, wval)
+        acc_tr = accuracy(params, xtr, ytr, wtr)
+        return params, opt_state, acc_val, acc_tr, loss
+
+    return jax.jit(epoch)
+
+
+def _pad_rows(arr: np.ndarray, n_rows: int) -> np.ndarray:
+    """Zero-pad axis 0 to exactly n_rows."""
+    if arr.shape[0] == n_rows:
+        return arr
+    pad = np.zeros((n_rows - arr.shape[0],) + arr.shape[1:], dtype=arr.dtype)
+    return np.concatenate([arr, pad], axis=0)
+
+
+def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
+               hidden: int, learning_rate: float, max_epochs: int,
+               val_fraction: float = 0.2, decision_threshold: float = 0.5,
+               compute_dtype: str = "bfloat16", param_dtype: str = "float32",
+               seed: int = 0, mesh_ctx: Optional[MeshContext] = None,
+               on_epoch: Optional[Callable[[int, float, float, float], None]] = None,
+               checkpoint_dir: Optional[str] = None, resume: bool = False,
+               checkpoint_every: int = 25,
+               ) -> TrainResult:
+    """Train the modified CBOW; returns the embedding table and history.
+
+    ``paths``: [n_paths, n_genes] multi-hot (any integer/float dtype);
+    ``labels``: [n_paths] in {0, 1}. ``on_epoch(step, acc_val, acc_tr, secs)``
+    fires every epoch so the CLI can render the reference's log cadence.
+    """
+    if paths.shape[0] < 2:
+        raise ValueError(f"need at least 2 paths to split, got {paths.shape[0]}")
+    ctx = mesh_ctx if mesh_ctx is not None else make_mesh_context(None)
+    cdtype = jnp.bfloat16 if compute_dtype == "bfloat16" else jnp.float32
+    pdtype = jnp.float32 if param_dtype == "float32" else jnp.bfloat16
+    n_paths, n_genes = paths.shape
+
+    # ---- shuffled hold-out split (ref: G2Vec.py:219-226) ----
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(n_paths)
+    pivot = int(n_paths * (1.0 - val_fraction))
+    if pivot in (0, n_paths):
+        raise ValueError(
+            f"val_fraction={val_fraction} leaves an empty split for {n_paths} paths")
+    tr_idx, vl_idx = perm[:pivot], perm[pivot:]
+
+    # ---- shard-even padding (SPMD needs dims divisible by mesh axes) ----
+    # Rows pad to a multiple of the data axis, the gene axis to a multiple of
+    # the model axis. Padding rows carry weight 0 (masked means above);
+    # padding gene columns are all-zero in X, so the matching W_ih rows get
+    # exactly zero gradient and are sliced off before returning.
+    from g2vec_tpu.parallel.mesh import pad_to_multiple
+
+    if ctx.mesh is not None:
+        from g2vec_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+        data_dim = ctx.mesh.shape[DATA_AXIS]
+        model_dim = ctx.mesh.shape[MODEL_AXIS]
+    else:
+        data_dim = model_dim = 1
+    n_genes_pad = pad_to_multiple(n_genes, model_dim)
+
+    def _prep(idx):
+        # Keep the multi-hot in its narrow integer dtype through slicing and
+        # padding; cast to the compute dtype once, at device-put time.
+        x = paths[idx]
+        y = labels[idx].astype(np.float32).reshape(-1, 1)
+        n_pad = pad_to_multiple(x.shape[0], data_dim)
+        w = _pad_rows(np.ones((x.shape[0], 1), np.float32), n_pad)
+        x = _pad_rows(x, n_pad)
+        if n_genes_pad != n_genes:
+            x = np.concatenate(
+                [x, np.zeros((x.shape[0], n_genes_pad - n_genes), x.dtype)], axis=1)
+        return (ctx.put(x.astype(np.dtype(cdtype)), ctx.batch_spec),
+                ctx.put(_pad_rows(y, n_pad), ctx.label_spec),
+                ctx.put(w, ctx.label_spec))
+
+    xtr, ytr, wtr = _prep(tr_idx)
+    xval, yval, wval = _prep(vl_idx)
+
+    # ---- params + optimizer ----
+    key = jax.random.key(seed)
+    params = init_params(key, n_genes_pad, hidden, param_dtype=pdtype)
+    if ctx.mesh is not None:
+        params = CBOWParams(w_ih=ctx.put(params.w_ih, ctx.w_ih_spec),
+                            w_ho=ctx.put(params.w_ho, ctx.w_ho_spec))
+    tx = optax.adam(learning_rate, b1=0.9, b2=0.999, eps=1e-8)
+    opt_state = tx.init(params)
+    epoch_fn = _make_epoch_fn(tx, cdtype, decision_threshold, ctx)
+
+    # ---- epoch loop with first-val-dip early stopping ----
+    history: List[dict] = []
+    before_val, before_tr = -1.0, -1.0
+    snapshot = params            # device-side reference, no copy
+    start_epoch = 0
+    stopped_early = False
+    stop_epoch = max_epochs - 1
+    if checkpoint_dir and resume:
+        from g2vec_tpu.train.checkpoint import (RUN_EARLY_STOPPED,
+                                                RUN_IN_PROGRESS, load_state)
+
+        restored = load_state(checkpoint_dir, params, opt_state)
+        if restored is not None:
+            (params, opt_state, snapshot, last_epoch,
+             before_val, before_tr, done) = restored
+            if ctx.mesh is not None:
+                # Restored leaves are host arrays; re-apply the DP/TP
+                # shardings the fresh-init path declares, or the resumed
+                # program compiles with replicated (possibly OOM-ing) params.
+                # Classification is by tree position (CBOWParams containers
+                # inside params/opt_state/snapshot), never by shape — shapes
+                # are ambiguous when hidden == n_genes_pad.
+                from jax.sharding import PartitionSpec as P
+
+                def _reshard_params(p: CBOWParams) -> CBOWParams:
+                    return CBOWParams(
+                        w_ih=ctx.put(np.asarray(p.w_ih), ctx.w_ih_spec),
+                        w_ho=ctx.put(np.asarray(p.w_ho), ctx.w_ho_spec))
+
+                params = _reshard_params(params)
+                snapshot = _reshard_params(snapshot)
+                opt_state = jax.tree.map(
+                    lambda sub: (_reshard_params(sub)
+                                 if isinstance(sub, CBOWParams)
+                                 else ctx.put(np.asarray(sub), P())),
+                    opt_state,
+                    is_leaf=lambda x: isinstance(x, CBOWParams))
+            if (done == RUN_EARLY_STOPPED
+                    or (done != RUN_IN_PROGRESS and last_epoch + 1 >= max_epochs)):
+                # Terminal state: an early stop is final (stepping on would
+                # re-apply the dip epoch's update — the saved params are
+                # post-dip, the snapshot pre-dip), and a completed run with
+                # no additional epoch budget has nothing to do. A completed
+                # run CAN continue when max_epochs was raised.
+                w_ih = np.asarray(jax.device_get(snapshot.w_ih),
+                                  dtype=np.float32)[:n_genes]
+                return TrainResult(
+                    w_ih=w_ih, stop_epoch=last_epoch,
+                    stopped_early=(done == RUN_EARLY_STOPPED),
+                    acc_val=before_val, acc_tr=before_tr,
+                    history=[], params=snapshot)
+            start_epoch = last_epoch + 1
+    t0 = time.time()
+    for step in range(start_epoch, max_epochs):
+        params, opt_state, acc_val, acc_tr, loss = epoch_fn(
+            params, opt_state, xtr, ytr, wtr, xval, yval, wval)
+        av, at = float(acc_val), float(acc_tr)   # the only host syncs
+        secs = time.time() - t0
+        t0 = time.time()
+        history.append({"epoch": step, "acc_val": av, "acc_tr": at,
+                        "loss": float(loss), "secs": secs})
+        if on_epoch is not None:
+            on_epoch(step, av, at, secs)
+        if av < before_val:                      # first strict decrease
+            stopped_early = True
+            stop_epoch = step - 1
+            break
+        before_val, before_tr = av, at
+        snapshot = params                        # params AFTER this epoch's step
+        if checkpoint_dir and (step + 1) % checkpoint_every == 0:
+            from g2vec_tpu.train.checkpoint import save_state
+
+            save_state(checkpoint_dir, params, opt_state, snapshot,
+                       step, before_val, before_tr)
+
+    if checkpoint_dir:
+        from g2vec_tpu.train.checkpoint import (RUN_COMPLETED,
+                                                RUN_EARLY_STOPPED, save_state)
+
+        save_state(checkpoint_dir, params, opt_state, snapshot,
+                   stop_epoch if stopped_early else max_epochs - 1,
+                   before_val, before_tr,
+                   done=RUN_EARLY_STOPPED if stopped_early else RUN_COMPLETED)
+    w_ih = np.asarray(jax.device_get(snapshot.w_ih), dtype=np.float32)[:n_genes]
+    return TrainResult(w_ih=w_ih, stop_epoch=stop_epoch,
+                       stopped_early=stopped_early,
+                       acc_val=before_val, acc_tr=before_tr,
+                       history=history, params=snapshot)

@@ -1,0 +1,9 @@
+"""Observability utilities: structured metrics, stage timing, profiling.
+
+The reference's only observability is ``print`` (SURVEY.md §5) — its console
+transcript (README.md:21-49) is the de-facto golden spec, reproduced by
+:mod:`g2vec_tpu.pipeline`. This package adds what the reference lacks:
+JSONL metrics, per-stage wall timing, and ``jax.profiler`` trace capture.
+"""
+from g2vec_tpu.utils.metrics import MetricsWriter  # noqa: F401
+from g2vec_tpu.utils.timing import StageTimer  # noqa: F401

@@ -1,0 +1,38 @@
+"""Structured JSONL metrics (the reference has none — SURVEY.md §5).
+
+One JSON object per line, each stamped with wall time and a monotonically
+increasing sequence number, so post-hoc tooling can reconstruct the run
+without parsing the console transcript.
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import IO, Optional
+
+
+class MetricsWriter:
+    """Append-only JSONL metrics sink; no-op when constructed with None."""
+
+    def __init__(self, path: Optional[str]):
+        self._fout: Optional[IO[str]] = open(path, "w") if path else None
+        self._seq = 0
+
+    def emit(self, event: str, **fields) -> None:
+        if self._fout is None:
+            return
+        record = {"seq": self._seq, "ts": time.time(), "event": event, **fields}
+        self._fout.write(json.dumps(record) + "\n")
+        self._fout.flush()
+        self._seq += 1
+
+    def close(self) -> None:
+        if self._fout is not None:
+            self._fout.close()
+            self._fout = None
+
+    def __enter__(self) -> "MetricsWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

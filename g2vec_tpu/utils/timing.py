@@ -1,0 +1,33 @@
+"""Per-stage wall-clock timing.
+
+The reference only times training epochs (``time.time()`` deltas,
+ref: G2Vec.py:260-272). This timer covers every pipeline stage and feeds both
+the metrics JSONL and the end-of-run summary.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Iterator, List, Tuple
+
+
+class StageTimer:
+    """Records (stage, seconds) pairs in order of completion."""
+
+    def __init__(self) -> None:
+        self.stages: List[Tuple[str, float]] = []
+
+    @contextlib.contextmanager
+    def stage(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.stages.append((name, time.perf_counter() - t0))
+
+    def as_dict(self) -> Dict[str, float]:
+        return dict(self.stages)
+
+    @property
+    def total(self) -> float:
+        return sum(s for _, s in self.stages)

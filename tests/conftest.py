@@ -8,11 +8,22 @@ JAX trick for exercising pjit/psum sharding in CI without a TPU pod
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
+# Unconditional: the ambient environment may point JAX_PLATFORMS at a real
+# TPU (e.g. the axon tunnel); tests must never grab it.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+          if "xla_force_host_platform_device_count" not in f]
+os.environ["XLA_FLAGS"] = " ".join(
+    _flags + ["--xla_force_host_platform_device_count=8"])
+
+# The env var alone is NOT enough: a TPU-tunnel sitecustomize may have
+# already called jax.config.update("jax_platforms", ...) at interpreter
+# startup, which takes precedence over the env var. Re-force the config
+# explicitly or every jitted test silently dials the remote TPU (and blocks
+# on its socket).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

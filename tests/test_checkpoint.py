@@ -1,0 +1,77 @@
+"""Checkpoint/resume tests (capability the reference lacks — SURVEY.md §5)."""
+import numpy as np
+import pytest
+
+from g2vec_tpu.train import train_cbow
+
+
+def _data(rng, n_paths=120, n_genes=40, flip=0.0):
+    labels = (rng.random(n_paths) < 0.5).astype(np.int32)
+    paths = np.zeros((n_paths, n_genes), dtype=np.int8)
+    half = n_genes // 2
+    for i, lab in enumerate(labels):
+        idx = rng.choice(half, size=5, replace=False) + (0 if lab == 0 else half)
+        paths[i, idx] = 1
+        if rng.random() < flip:
+            labels[i] = 1 - labels[i]
+    return paths, labels
+
+
+def test_resume_matches_uninterrupted_run(rng, tmp_path):
+    paths, labels = _data(rng)
+    kwargs = dict(hidden=8, learning_rate=0.05, compute_dtype="float32", seed=0)
+
+    full = train_cbow(paths, labels, max_epochs=12, **kwargs)
+
+    # Interrupted run: checkpoint every 3 epochs, stop at 6, resume to 12.
+    ckpt = str(tmp_path / "ck")
+    train_cbow(paths, labels, max_epochs=6, checkpoint_dir=ckpt,
+               checkpoint_every=3, **kwargs)
+    resumed = train_cbow(paths, labels, max_epochs=12, checkpoint_dir=ckpt,
+                         resume=True, checkpoint_every=3, **kwargs)
+
+    assert not full.stopped_early and not resumed.stopped_early
+    np.testing.assert_allclose(resumed.w_ih, full.w_ih, rtol=1e-5, atol=1e-7)
+    assert resumed.acc_val == pytest.approx(full.acc_val)
+
+
+def test_resume_of_finished_run_returns_without_training(rng, tmp_path):
+    # Noisy data forces an early stop; resuming afterwards must NOT step
+    # further (that would re-apply the dip epoch's update).
+    paths, labels = _data(rng, flip=0.3)
+    ckpt = str(tmp_path / "ck")
+    kwargs = dict(hidden=8, learning_rate=0.05, compute_dtype="float32",
+                  seed=3, max_epochs=200, checkpoint_dir=ckpt)
+    first = train_cbow(paths, labels, **kwargs)
+    assert first.stopped_early
+    again = train_cbow(paths, labels, resume=True, **kwargs)
+    assert again.stopped_early
+    assert again.stop_epoch == first.stop_epoch
+    assert again.history == []          # no epochs were run
+    np.testing.assert_array_equal(again.w_ih, first.w_ih)
+    assert again.acc_val == first.acc_val
+
+
+def test_bfloat16_params_roundtrip(rng, tmp_path):
+    # np.savez stores ml_dtypes bfloat16 as raw void bytes; load_state must
+    # reinterpret them (it once surfaced '|V2' arrays that crashed epoch 1).
+    paths, labels = _data(rng)
+    ckpt = str(tmp_path / "ck")
+    kwargs = dict(hidden=8, learning_rate=0.05, compute_dtype="bfloat16",
+                  param_dtype="bfloat16", seed=0, checkpoint_dir=ckpt,
+                  checkpoint_every=2)
+    train_cbow(paths, labels, max_epochs=4, **kwargs)
+    resumed = train_cbow(paths, labels, max_epochs=8, resume=True, **kwargs)
+    assert np.isfinite(resumed.w_ih).all()
+    assert len(resumed.history) == 4          # epochs 4..7 actually ran
+
+
+def test_resume_rejects_shape_mismatch(rng, tmp_path):
+    paths, labels = _data(rng)
+    ckpt = str(tmp_path / "ck")
+    train_cbow(paths, labels, hidden=8, learning_rate=0.05, max_epochs=3,
+               compute_dtype="float32", seed=0, checkpoint_dir=ckpt)
+    with pytest.raises(ValueError, match="shape"):
+        train_cbow(paths, labels, hidden=16, learning_rate=0.05, max_epochs=3,
+                   compute_dtype="float32", seed=0, checkpoint_dir=ckpt,
+                   resume=True)

@@ -1,0 +1,60 @@
+"""L3 PCC adjacency tests vs a straightforward NumPy reference
+(semantics of construct_adjMat/compute_PCC, G2Vec.py:354-391)."""
+import numpy as np
+
+from g2vec_tpu.ops.graph import build_adjacency, edge_weights
+
+
+def _np_pcc(a: np.ndarray, b: np.ndarray) -> float:
+    """Population-normalized Pearson r, 0.0 on zero std (ref: G2Vec.py:354-368)."""
+    sa, sb = a.std(), b.std()
+    if sa == 0.0 or sb == 0.0:
+        return 0.0
+    return float(np.mean((a - a.mean()) / sa * (b - b.mean()) / sb))
+
+
+def test_edge_weights_match_numpy(rng):
+    expr = rng.standard_normal((20, 8)).astype(np.float32)
+    src = np.array([0, 1, 2, 3, 7], dtype=np.int32)
+    dst = np.array([1, 0, 5, 4, 6], dtype=np.int32)
+    w = np.asarray(edge_weights(expr, src, dst))
+    for k in range(src.size):
+        expected = abs(_np_pcc(expr[:, src[k]], expr[:, dst[k]]))
+        np.testing.assert_allclose(w[k], expected, rtol=1e-5, atol=1e-6)
+
+
+def test_degenerate_gene_gets_zero_weight(rng):
+    expr = rng.standard_normal((10, 4)).astype(np.float32)
+    expr[:, 2] = 3.14  # constant gene -> zero std -> PCC 0 everywhere
+    src = np.array([2, 0], dtype=np.int32)
+    dst = np.array([1, 2], dtype=np.int32)
+    w = np.asarray(edge_weights(expr, src, dst))
+    assert w[0] == 0.0 and w[1] == 0.0
+
+
+def test_adjacency_directed_and_thresholded(rng):
+    n = 6
+    s = rng.standard_normal(30).astype(np.float32)
+    expr = rng.standard_normal((30, n)).astype(np.float32) * 0.1
+    expr[:, 0] += s   # genes 0 and 1 strongly correlated
+    expr[:, 1] += s
+    src = np.array([0, 2], dtype=np.int32)
+    dst = np.array([1, 3], dtype=np.int32)
+    adj = np.asarray(build_adjacency(expr, src, dst, n, threshold=0.5))
+    assert adj[0, 1] > 0.5            # strong edge kept, weight = |PCC|
+    assert adj[1, 0] == 0.0           # NOT symmetrized (ref: G2Vec.py:390)
+    assert adj[2, 3] == 0.0           # weak edge dropped by strict '>'
+    assert np.count_nonzero(adj) == 1
+
+
+def test_strict_threshold_boundary(rng):
+    # |PCC| == 1 edge with threshold 1.0-eps kept; with exactly |PCC| cut off.
+    expr = np.zeros((8, 2), dtype=np.float32)
+    expr[:, 0] = np.arange(8)
+    expr[:, 1] = 2.0 * np.arange(8) + 1.0     # perfectly correlated
+    src = np.array([0], dtype=np.int32)
+    dst = np.array([1], dtype=np.int32)
+    w = np.asarray(edge_weights(expr, src, dst))
+    np.testing.assert_allclose(w[0], 1.0, rtol=1e-6)
+    adj = np.asarray(build_adjacency(expr, src, dst, 2, threshold=1.0))
+    assert adj[0, 1] == 0.0           # strict '>' (ref: G2Vec.py:389)

@@ -1,0 +1,103 @@
+"""L5 clustering tests: k-means recovery, L-group renumbering and the
+compat tie-break (ref: G2Vec.py:167-200)."""
+import numpy as np
+import pytest
+
+from g2vec_tpu.analysis import find_lgroups, select_biomarkers
+
+
+@pytest.fixture(scope="module")
+def key():
+    import jax
+
+    return jax.random.key(0)
+
+
+def _blob_data(rng, sizes=(60, 15, 12), centers=((0, 0), (8, 8), (-8, 8))):
+    pts = []
+    for s, c in zip(sizes, centers):
+        pts.append(rng.normal(scale=0.4, size=(s, 2)) + np.array(c))
+    x = np.concatenate(pts).astype(np.float32)
+    membership = np.repeat(np.arange(len(sizes)), sizes)
+    return x, membership
+
+
+def test_kmeans_recovers_separated_blobs(rng, key):
+    from g2vec_tpu.ops.kmeans import kmeans
+
+    x, member = _blob_data(rng)
+    labels, centers, inertia = kmeans(x, 3, key)
+    labels = np.asarray(labels)
+    # Same-blob points share a label; different blobs get different labels.
+    for b in range(3):
+        blob_labels = labels[member == b]
+        assert len(set(blob_labels.tolist())) == 1
+    assert len({labels[member == b][0] for b in range(3)}) == 3
+    assert float(inertia) < 100.0
+
+
+def test_find_lgroups_vote_and_renumbering(rng, key):
+    # blob 0 (largest, near origin) = "other"; blob 1 mostly good-freq genes;
+    # blob 2 mostly poor-freq genes.
+    x, member = _blob_data(rng)
+    genes = np.array([f"G{i:03d}" for i in range(len(member))])
+    freq = {}
+    for i, b in enumerate(member):
+        if b == 1:
+            freq[genes[i]] = 0        # good-majority genes
+        elif b == 2:
+            freq[genes[i]] = 1        # poor-majority genes
+    lg = find_lgroups(x, genes, freq, key=key)
+    assert set(np.unique(lg)) == {0, 1, 2}
+    assert np.all(lg[member == 0] == 2)     # largest cluster -> other
+    assert np.all(lg[member == 1] == 0)     # good vote -> 0
+    assert np.all(lg[member == 2] == 1)     # poor vote -> 1
+
+
+def test_find_lgroups_compat_ignores_vote(rng, key):
+    x, member = _blob_data(rng)
+    genes = np.array([f"G{i:03d}" for i in range(len(member))])
+    freq = {g: (0 if member[i] == 1 else 1) for i, g in enumerate(genes) if member[i] != 0}
+    lg_fixed = find_lgroups(x, genes, freq, key=key)
+    lg_compat = find_lgroups(x, genes, freq, key=key, compat_tiebreak=True)
+    # Compat mode ignores the vote entirely: good/poor depend only on cluster
+    # index order, so the two modes either agree or are exactly swapped.
+    swapped = lg_compat.copy()
+    swapped[lg_compat == 0] = 1
+    swapped[lg_compat == 1] = 0
+    assert np.array_equal(lg_fixed, lg_compat) or np.array_equal(lg_fixed, swapped)
+    assert np.all(lg_compat[member == 0] == 2)  # "other" unaffected by the bug
+
+
+def test_select_biomarkers_order_and_ties(rng):
+    # 6 genes: 3 in good group, 3 in poor group; engineered scores.
+    genes = np.array(["GB", "GA", "GC", "PZ", "PA", "PM"])
+    lg = np.array([0, 0, 0, 1, 1, 1], dtype=np.int32)
+    emb = np.zeros((6, 4), dtype=np.float32)
+    emb[0] = 3.0   # GB largest d-score in good group
+    emb[1] = 3.0   # GA ties GB -> stable sort keeps GB first
+    emb[2] = 0.1
+    emb[3] = 5.0
+    emb[4] = 0.2
+    emb[5] = 4.0
+    n0, n1 = 10, 8
+    # Identical expression for every gene -> all t-scores equal -> the minmax
+    # guard zeroes them, so ranking is driven purely by d-scores.
+    expr = np.tile(rng.normal(size=(n0 + n1, 1)).astype(np.float32), (1, 6))
+    labels = np.array([0] * n0 + [1] * n1)
+    bio, detail = select_biomarkers(emb, expr, labels, genes, lg,
+                                    num_biomarker=2)
+    # good group picks {GB, GA} (tie kept in gene order), poor picks {PZ, PM};
+    # each block alphabetized then the whole list alphabetized.
+    assert bio == sorted(sorted(["GB", "GA"]) + sorted(["PZ", "PM"]))
+    assert set(detail) == {"good", "poor"}
+
+
+def test_select_biomarkers_handles_fewer_genes_than_n(rng):
+    genes = np.array(["A", "B"])
+    lg = np.array([0, 1], dtype=np.int32)
+    emb = rng.normal(size=(2, 3)).astype(np.float32)
+    expr = rng.normal(size=(7, 2)).astype(np.float32)
+    labels = np.array([0, 0, 0, 0, 1, 1, 1])
+    bio, _ = select_biomarkers(emb, expr, labels, genes, lg, num_biomarker=50)
+    assert bio == ["A", "B"]

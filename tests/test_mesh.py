@@ -1,0 +1,61 @@
+"""Sharded-training tests on the 8-virtual-device CPU mesh (SURVEY.md §4
+item 5): DP+TP mesh runs produce the same numerics as single-device runs,
+including with shard-uneven shapes (padding + masked means)."""
+import jax
+import numpy as np
+import pytest
+
+from g2vec_tpu.parallel.mesh import make_mesh_context, pad_to_multiple
+from g2vec_tpu.train import train_cbow
+
+
+def _data(rng, n_paths=100, n_genes=50):
+    labels = (rng.random(n_paths) < 0.5).astype(np.int32)
+    paths = np.zeros((n_paths, n_genes), dtype=np.int8)
+    half = n_genes // 2
+    for i, lab in enumerate(labels):
+        idx = rng.choice(half, size=6, replace=False) + (0 if lab == 0 else half)
+        paths[i, idx] = 1
+    return paths, labels
+
+
+def test_pad_to_multiple():
+    assert pad_to_multiple(7, 4) == 8
+    assert pad_to_multiple(8, 4) == 8
+    assert pad_to_multiple(1, 1) == 1
+    assert pad_to_multiple(0, 4) == 0
+
+
+@pytest.mark.parametrize("mesh_shape", [(8, 1), (4, 2), (2, 4)])
+def test_mesh_training_matches_single_device(rng, mesh_shape):
+    # 100 paths and 50 genes are NOT divisible by most mesh axes — this
+    # exercises the shard-even padding path too.
+    paths, labels = _data(rng)
+    kwargs = dict(hidden=8, learning_rate=0.05, max_epochs=6,
+                  compute_dtype="float32", seed=0)
+    single = train_cbow(paths, labels, **kwargs)
+    ctx = make_mesh_context(mesh_shape)
+    sharded = train_cbow(paths, labels, mesh_ctx=ctx, **kwargs)
+    # Same split, same init, same math -> near-identical accuracies and
+    # embeddings (tiny float drift from different reduction orders allowed).
+    assert len(single.history) == len(sharded.history)
+    for h1, h2 in zip(single.history, sharded.history):
+        assert abs(h1["acc_val"] - h2["acc_val"]) < 1e-6
+    np.testing.assert_allclose(single.w_ih, sharded.w_ih, rtol=5e-4, atol=1e-5)
+
+
+def test_mesh_needs_enough_devices():
+    with pytest.raises(ValueError, match="needs 16 devices"):
+        make_mesh_context((8, 2))
+
+
+def test_padded_genes_get_zero_update(rng):
+    # 50 genes on a model axis of 4 -> pad to 52; the two pad rows of W_ih
+    # must come back sliced off, and real outputs must be unaffected.
+    paths, labels = _data(rng, n_paths=64, n_genes=50)
+    ctx = make_mesh_context((2, 4))
+    res = train_cbow(paths, labels, hidden=8, learning_rate=0.05,
+                     max_epochs=3, compute_dtype="float32", seed=0,
+                     mesh_ctx=ctx)
+    assert res.w_ih.shape == (50, 8)
+    assert np.isfinite(res.w_ih).all()

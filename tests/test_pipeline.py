@@ -1,0 +1,101 @@
+"""End-to-end pipeline tests on the synthetic dataset (SURVEY.md §4 item 4):
+full CLI-config run producing the three output files, format checks against
+the reference's published samples, and same-seed byte determinism."""
+import os
+
+import numpy as np
+import pytest
+
+from g2vec_tpu.config import G2VecConfig
+from g2vec_tpu.data.synthetic import write_synthetic_tsv
+
+
+@pytest.fixture(scope="module")
+def tsv_paths(tmp_path_factory, ):
+    from g2vec_tpu.data.synthetic import SyntheticSpec
+
+    spec = SyntheticSpec(n_good=24, n_poor=20, module_size=12, n_background=24,
+                         n_expr_only=4, n_net_only=4, module_chords=2,
+                         background_edges=40, seed=7)
+    out = tmp_path_factory.mktemp("syn")
+    return write_synthetic_tsv(spec, str(out))
+
+
+def _cfg(tsv_paths, tmp_path, **overrides):
+    defaults = dict(
+        expression_file=tsv_paths["expression"],
+        clinical_file=tsv_paths["clinical"],
+        network_file=tsv_paths["network"],
+        result_name=os.path.join(str(tmp_path), "out"),
+        lenPath=8, numRepetition=2, sizeHiddenlayer=16, epoch=30,
+        learningRate=0.05, numBiomarker=5, compute_dtype="float32",
+        kmeans_iters=50, seed=0,
+    )
+    defaults.update(overrides)
+    return G2VecConfig(**defaults)
+
+
+def test_full_pipeline_end_to_end(tsv_paths, tmp_path):
+    from g2vec_tpu.pipeline import run
+
+    lines = []
+    result = run(_cfg(tsv_paths, tmp_path), console=lines.append)
+
+    # --- console transcript structure (ref: README.md:21-49) ---
+    banners = [ln for ln in lines if ln.startswith(">>>")]
+    assert banners[0] == ">>> 0. Arguments"
+    assert banners[-1] == ">>> 7. Save results"
+    assert len(banners) == 8
+    assert any(ln.startswith("    - Epoch: 000") for ln in lines)
+
+    # --- artifacts ---
+    assert len(result.output_files) == 3
+    for path in result.output_files:
+        assert os.path.exists(path)
+    assert result.n_samples == 44
+    assert result.embeddings.shape == (result.n_genes, 16)
+    assert set(np.unique(result.lgroup_idx)) <= {0, 1, 2}
+    assert result.biomarkers == sorted(result.biomarkers)
+    assert len(result.biomarkers) <= 2 * 5
+
+    # --- output formats (ref: G2Vec.py:127-131,159-165,203-215) ---
+    bio, lg, vec = result.output_files
+    with open(bio) as f:
+        assert f.readline() == "GeneSymbol\n"
+    with open(lg) as f:
+        assert f.readline() == "GeneSymbol\tLgroup(0:good,1:poor,2:other)\n"
+        rows = f.readlines()
+        assert len(rows) == result.n_genes
+        for row in rows[:5]:
+            gene, idx = row.rstrip("\n").split("\t")
+            assert idx in ("0", "1", "2")
+    with open(vec) as f:
+        header = f.readline().rstrip("\n").split("\t")
+        assert header == ["GeneSymbol"] + [f"V{i}" for i in range(16)]
+        first = f.readline().rstrip("\n").split("\t")
+        assert len(first) == 17
+        float(first[1])  # parses
+
+
+def test_pipeline_is_deterministic_per_seed(tsv_paths, tmp_path):
+    from g2vec_tpu.pipeline import run
+
+    r1 = run(_cfg(tsv_paths, tmp_path, result_name=str(tmp_path / "a")),
+             console=lambda s: None)
+    r2 = run(_cfg(tsv_paths, tmp_path, result_name=str(tmp_path / "b")),
+             console=lambda s: None)
+    for f1, f2 in zip(r1.output_files, r2.output_files):
+        with open(f1, "rb") as a, open(f2, "rb") as b:
+            assert a.read() == b.read(), f"{f1} differs from {f2}"
+
+
+def test_pipeline_recovers_planted_modules(tsv_paths, tmp_path):
+    """The planted good/poor modules should dominate the biomarker list."""
+    from g2vec_tpu.pipeline import run
+
+    result = run(_cfg(tsv_paths, tmp_path, result_name=str(tmp_path / "m"),
+                      numBiomarker=8),
+                 console=lambda s: None)
+    planted = sum(1 for g in result.biomarkers
+                  if g.startswith("GMOD") or g.startswith("PMOD"))
+    assert planted >= len(result.biomarkers) * 0.5, result.biomarkers

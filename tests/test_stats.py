@@ -1,0 +1,57 @@
+"""L5 stats tests: t-scores vs a straight NumPy port of the reference
+formulas (G2Vec.py:138-157), minmax guard, d-scores."""
+import numpy as np
+import pytest
+
+from g2vec_tpu.ops.stats import dscores, minmax, tscores
+
+
+def _ref_tstat(x, y):
+    """Direct NumPy transcription of the reference formula semantics."""
+    from math import sqrt
+
+    s0, s1 = x.std(ddof=1), y.std(ddof=1)
+    n0, n1 = len(x), len(y)
+    d1 = sqrt(((n0 - 1) * s0 * s0 + (n1 - 1) * s1 * s1) / (n0 + n1 - 2))
+    d2 = sqrt(1.0 / n0 + 1.0 / n1)
+    if d1 > 0 and d2 > 0:
+        return abs((x.mean() - y.mean()) / d1 / d2)
+    return 0.0
+
+
+def test_tscores_match_reference_formula(rng):
+    g = rng.normal(size=(13, 7)).astype(np.float32)
+    p = rng.normal(loc=0.5, size=(9, 7)).astype(np.float32)
+    ours = np.asarray(tscores(g, p))
+    expected = [_ref_tstat(g[:, i], p[:, i]) for i in range(7)]
+    np.testing.assert_allclose(ours, expected, rtol=1e-5)
+
+
+def test_tscores_constant_gene_is_zero(rng):
+    g = np.ones((10, 3), dtype=np.float32)
+    p = np.ones((8, 3), dtype=np.float32)
+    np.testing.assert_array_equal(np.asarray(tscores(g, p)), 0.0)
+
+
+def test_tscores_against_scipy(rng):
+    scipy_stats = pytest.importorskip("scipy.stats")
+    g = rng.normal(size=(20, 5)).astype(np.float32)
+    p = rng.normal(loc=1.0, size=(15, 5)).astype(np.float32)
+    ours = np.asarray(tscores(g, p))
+    ref = np.abs(scipy_stats.ttest_ind(g, p, axis=0, equal_var=True).statistic)
+    np.testing.assert_allclose(ours, ref, rtol=1e-4)
+
+
+def test_minmax_basic_and_guard():
+    s = np.array([2.0, 4.0, 3.0], dtype=np.float32)
+    np.testing.assert_allclose(np.asarray(minmax(s)), [0.0, 1.0, 0.5], atol=1e-6)
+    const = np.full(4, 7.0, dtype=np.float32)
+    out = np.asarray(minmax(const))
+    assert np.all(np.isfinite(out))
+    np.testing.assert_array_equal(out, 0.0)
+
+
+def test_dscores(rng):
+    e = rng.normal(size=(6, 4)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(dscores(e)), np.linalg.norm(e, axis=1), rtol=1e-5)

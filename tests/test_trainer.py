@@ -1,0 +1,107 @@
+"""L4 trainer tests: learning on separable data, early-stop semantics
+(previous-epoch weights, ref: G2Vec.py:276-283), and numeric parity of one
+training step against a NumPy reimplementation of the same model."""
+import numpy as np
+import pytest
+
+from g2vec_tpu.train import train_cbow
+
+
+def _separable_paths(rng, n_paths=400, n_genes=60, flip=0.0):
+    """Multi-hot paths: label-0 paths draw from the first half of genes,
+    label-1 from the second half."""
+    labels = (rng.random(n_paths) < 0.5).astype(np.int32)
+    paths = np.zeros((n_paths, n_genes), dtype=np.int8)
+    half = n_genes // 2
+    for i, lab in enumerate(labels):
+        lo = 0 if lab == 0 else half
+        k = rng.integers(3, 10)
+        idx = rng.choice(half, size=k, replace=False) + lo
+        paths[i, idx] = 1
+        if rng.random() < flip:
+            labels[i] = 1 - labels[i]
+    return paths, labels
+
+
+def test_trainer_learns_separable_data(rng):
+    paths, labels = _separable_paths(rng)
+    res = train_cbow(paths, labels, hidden=16, learning_rate=0.05,
+                     max_epochs=200, compute_dtype="float32", seed=1)
+    assert res.acc_val >= 0.95
+    assert res.w_ih.shape == (60, 16)
+    assert res.w_ih.dtype == np.float32
+
+
+def test_early_stop_returns_previous_epoch_weights(rng):
+    # Noisy labels force a val-accuracy dip well before max_epochs.
+    paths, labels = _separable_paths(rng, flip=0.25)
+    res = train_cbow(paths, labels, hidden=8, learning_rate=0.05,
+                     max_epochs=300, compute_dtype="float32", seed=3)
+    assert res.stopped_early, "expected an early stop on noisy data"
+    assert res.stop_epoch == len(res.history) - 2
+    # Reported accuracies are the PREVIOUS epoch's (ref: G2Vec.py:278).
+    assert res.acc_val == res.history[-2]["acc_val"]
+    assert res.acc_tr == res.history[-2]["acc_tr"]
+    # The returned W_ih equals what training for exactly stop_epoch+1 epochs
+    # yields — i.e. the dip epoch's update was discarded.
+    res2 = train_cbow(paths, labels, hidden=8, learning_rate=0.05,
+                      max_epochs=res.stop_epoch + 1, compute_dtype="float32",
+                      seed=3)
+    np.testing.assert_array_equal(res.w_ih, res2.w_ih)
+
+
+def test_on_epoch_callback_and_history(rng):
+    paths, labels = _separable_paths(rng, n_paths=100, n_genes=20)
+    seen = []
+    res = train_cbow(paths, labels, hidden=4, learning_rate=0.05,
+                     max_epochs=5, compute_dtype="float32", seed=0,
+                     on_epoch=lambda e, av, at, s: seen.append((e, av, at)))
+    assert len(seen) == len(res.history)
+    assert [s[0] for s in seen] == [h["epoch"] for h in res.history]
+
+
+def test_trainer_rejects_degenerate_split():
+    paths = np.zeros((1, 4), dtype=np.int8)
+    with pytest.raises(ValueError, match="at least 2 paths"):
+        train_cbow(paths, np.zeros(1, np.int32), hidden=2,
+                   learning_rate=0.01, max_epochs=1)
+
+
+def test_one_step_matches_numpy_adam(rng):
+    """One full-batch Adam step vs a NumPy transcription of the same math."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from g2vec_tpu.models.cbow import forward, init_params
+
+    n, g, h = 32, 12, 4
+    x = (rng.random((n, g)) < 0.3).astype(np.float32)
+    y = (rng.random(n) < 0.5).astype(np.float32).reshape(-1, 1)
+    params = init_params(jax.random.key(0), g, h)
+    lr = 0.01
+
+    # --- jax step ---
+    def loss_fn(p):
+        return jnp.mean(optax.sigmoid_binary_cross_entropy(
+            forward(p, jnp.asarray(x), jnp.float32), jnp.asarray(y)))
+
+    grads = jax.grad(loss_fn)(params)
+    tx = optax.adam(lr, b1=0.9, b2=0.999, eps=1e-8)
+    updates, _ = tx.update(grads, tx.init(params), params)
+    new_params = optax.apply_updates(params, updates)
+
+    # --- numpy step ---
+    w_ih = np.asarray(params.w_ih, np.float64)
+    w_ho = np.asarray(params.w_ho, np.float64)
+    logits = x @ w_ih @ w_ho
+    p_sig = 1.0 / (1.0 + np.exp(-logits))
+    dlogits = (p_sig - y) / n
+    g_ho = (x @ w_ih).T @ dlogits
+    g_ih = x.T @ (dlogits @ w_ho.T)
+    # Adam step 1: mhat = g/(1-b1), vhat = g^2/(1-b2) -> update = -lr*mhat/(sqrt(vhat)+eps)
+    for w, grad, ours in ((w_ih, g_ih, new_params.w_ih), (w_ho, g_ho, new_params.w_ho)):
+        mhat = grad
+        vhat = grad * grad
+        ref = w - lr * mhat / (np.sqrt(vhat) + 1e-8)
+        np.testing.assert_allclose(np.asarray(ours), ref, rtol=2e-4, atol=2e-6)

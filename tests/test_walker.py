@@ -1,0 +1,145 @@
+"""L3 random-walk property tests (invariants of generate_pathSet,
+G2Vec.py:324-352: no revisits, length cap, positive-weight transitions,
+dead-end stop) plus integration/vote semantics (G2Vec.py:288-322)."""
+import jax
+import numpy as np
+import pytest
+
+from g2vec_tpu.ops.walker import (count_gene_freq, generate_path_set,
+                                  integrate_path_sets, random_walks,
+                                  unpack_paths)
+
+
+def _ring_adj(n, w=1.0):
+    """Directed ring 0->1->...->n-1->0."""
+    adj = np.zeros((n, n), dtype=np.float32)
+    for i in range(n):
+        adj[i, (i + 1) % n] = w
+    return adj
+
+
+def test_walk_respects_length_cap_and_no_revisit():
+    n = 10
+    adj = _ring_adj(n)
+    starts = np.arange(n, dtype=np.int32)
+    for len_path in (1, 3, 10):
+        visited = np.asarray(random_walks(adj, starts, jax.random.key(0), len_path))
+        sizes = visited.sum(axis=1)
+        # On a ring every walker moves deterministically until the cap.
+        assert (sizes == min(len_path, n)).all()
+        assert visited.dtype == np.bool_
+
+
+def test_dead_end_stops_walk():
+    # 0 -> 1 -> 2, nothing out of 2.
+    adj = np.zeros((4, 4), dtype=np.float32)
+    adj[0, 1] = adj[1, 2] = 1.0
+    visited = np.asarray(random_walks(adj, np.array([0], np.int32),
+                                      jax.random.key(0), len_path=50))
+    assert visited[0].sum() == 3
+    assert visited[0, :3].all() and not visited[0, 3]
+
+
+def test_no_revisit_blocks_return_edge():
+    # 0 <-> 1 both directions: walker must stop after 0,1 (can't go back).
+    adj = np.zeros((3, 3), dtype=np.float32)
+    adj[0, 1] = adj[1, 0] = 1.0
+    visited = np.asarray(random_walks(adj, np.array([0], np.int32),
+                                      jax.random.key(1), len_path=50))
+    assert visited[0].sum() == 2
+
+
+def test_transitions_only_on_positive_weights(rng):
+    # Random sparse graph: every visited node other than the start must be
+    # reachable via an edge chain of positive weights. Weak check: the set of
+    # genes visited from src is a subset of nodes reachable from src.
+    n = 12
+    adj = (rng.random((n, n)) < 0.2).astype(np.float32)
+    np.fill_diagonal(adj, 0.0)
+    # reachability closure
+    reach = adj > 0
+    for _ in range(n):
+        reach = reach | (reach.astype(np.int32) @ reach.astype(np.int32) > 0)
+    visited = np.asarray(random_walks(adj, np.arange(n, dtype=np.int32),
+                                      jax.random.key(2), len_path=6))
+    for s in range(n):
+        others = np.flatnonzero(visited[s])
+        for g in others:
+            if g != s:
+                assert reach[s, g], f"walker from {s} visited unreachable {g}"
+
+
+def test_weighted_sampling_prefers_heavy_edge():
+    # From 0: edge to 1 with weight 9, edge to 2 with weight 1 -> ~90/10.
+    adj = np.zeros((3, 3), dtype=np.float32)
+    adj[0, 1], adj[0, 2] = 9.0, 1.0
+    starts = np.zeros(4000, dtype=np.int32)
+    visited = np.asarray(random_walks(adj, starts, jax.random.key(3), len_path=2))
+    frac_to_1 = visited[:, 1].mean()
+    assert 0.86 < frac_to_1 < 0.94, frac_to_1
+
+
+def test_generate_path_set_dedups():
+    # Deterministic ring: every start yields a distinct rotation-invariant
+    # node SET; with len_path=n all walks visit all nodes -> one unique path.
+    n = 6
+    adj = _ring_adj(n)
+    paths = generate_path_set(adj, jax.random.key(0), len_path=n, reps=3)
+    assert len(paths) == 1
+    arr = unpack_paths(sorted(paths), n)
+    assert (arr == 1).all()
+    # With len_path=2 there are exactly n distinct 2-node sets.
+    paths2 = generate_path_set(adj, jax.random.key(0), len_path=2, reps=2)
+    assert len(paths2) == n
+
+
+def test_walker_batching_equivalence(rng):
+    # STOCHASTIC graph: batch size must not change which Gumbel stream each
+    # walker draws (per-walker keys are bound to global walker identity).
+    n = 10
+    adj = rng.random((n, n)).astype(np.float32)
+    np.fill_diagonal(adj, 0.0)
+    full = generate_path_set(adj, jax.random.key(5), len_path=4, reps=2)
+    batched = generate_path_set(adj, jax.random.key(5), len_path=4, reps=2,
+                                walker_batch=3)
+    assert full == batched
+
+
+def test_integrate_drops_common_paths():
+    n = 5
+    a = np.zeros(n, np.uint8); a[[0, 1]] = 1
+    b = np.zeros(n, np.uint8); b[[2, 3]] = 1
+    c = np.zeros(n, np.uint8); c[[1, 4]] = 1
+    pa, pb, pc = (np.packbits(x).tobytes() for x in (a, b, c))
+    good = {pa, pb}
+    poor = {pb, pc}
+    paths, labels = integrate_path_sets(good, poor, n)
+    assert paths.shape == (2, n)
+    # pb was common -> dropped from both (ref: G2Vec.py:313-315)
+    assert labels.tolist() == [0, 1]
+    np.testing.assert_array_equal(paths[0], a)
+    np.testing.assert_array_equal(paths[1], c)
+
+
+def test_count_gene_freq_majority_and_ties():
+    genes = ["A", "B", "C", "D"]
+    paths = np.array([
+        [1, 1, 0, 0],   # good
+        [1, 0, 1, 0],   # good
+        [1, 0, 1, 0],   # poor
+    ], dtype=np.int32)
+    labels = np.array([0, 0, 1], dtype=np.int32)
+    freq = count_gene_freq(paths, labels, genes)
+    assert freq["A"] == 0        # 2 good vs 1 poor
+    assert freq["B"] == 0        # 1 good vs 0 poor
+    assert freq["C"] == 2        # 1 vs 1 tie
+    assert "D" not in freq       # in no path (ref: G2Vec.py:292-297)
+
+
+def test_single_node_paths_when_no_edges():
+    n = 4
+    adj = np.zeros((n, n), dtype=np.float32)
+    paths = generate_path_set(adj, jax.random.key(0), len_path=10, reps=1)
+    assert len(paths) == n       # each start is its own singleton path
+    arr = unpack_paths(sorted(paths), n)
+    assert (arr.sum(axis=1) == 1).all()
