@@ -43,10 +43,9 @@ def make_paths(rng: np.random.Generator, n_paths: int, n_genes: int):
     paths = np.zeros((n_paths, n_genes), dtype=np.int8)
     half = n_genes // 2
     genes_per_path = 40
-    for i in range(n_paths):
-        lo = 0 if labels[i] == 0 else half
-        idx = rng.integers(0, half, size=genes_per_path) + lo
-        paths[i, idx] = 1
+    idx = rng.integers(0, half, size=(n_paths, genes_per_path))
+    idx += labels[:, None] * half
+    np.put_along_axis(paths, idx, 1, axis=1)
     return paths, labels
 
 

@@ -1,0 +1,55 @@
+"""CLI: write a synthetic reference-format dataset.
+
+``python -m g2vec_tpu.data.make_example OUT_DIR [--scale small|example]``
+
+The reference bundles an example dataset whose expression matrix is absent
+from this mount (SURVEY.md §0); this generates statistically similar
+stand-ins. ``--scale example`` approximates the bundled example's shape
+(135 samples, ~7.5k genes, planted co-expression modules so |PCC| > 0.5
+edges and separable paths exist); ``small`` is a seconds-fast smoke size.
+"""
+from __future__ import annotations
+
+import argparse
+
+from g2vec_tpu.data.synthetic import SyntheticSpec, write_synthetic_tsv
+
+SCALES = {
+    # seconds-fast smoke size
+    "small": SyntheticSpec(),
+    # ~0.9 val-ACC achievable in well under a minute on CPU; used by the
+    # acceptance test (tests/test_acceptance.py)
+    "medium": SyntheticSpec(
+        n_good=77, n_poor=58, module_size=100, shared_module_size=16,
+        n_background=700, n_expr_only=20, n_net_only=20, module_chords=6,
+        background_edges=2000, noise=0.25, seed=0),
+    # matched to the reference's bundled-example statistics (README.md:26-32):
+    # 135 samples (77/58 labels), ~7.5k common genes, ~3.7k genes reachable
+    # by walks, tens of thousands of group-specific paths at -p 80 -r 10
+    "example": SyntheticSpec(
+        n_good=77, n_poor=58, module_size=1700, shared_module_size=150,
+        n_background=2300, n_expr_only=80, n_net_only=80,
+        module_chords=6, background_edges=20000, noise=0.25, seed=0),
+}
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="python -m g2vec_tpu.data.make_example")
+    parser.add_argument("out_dir")
+    parser.add_argument("--scale", choices=sorted(SCALES), default="small")
+    parser.add_argument("--prefix", default="syn")
+    parser.add_argument("--seed", type=int, default=None)
+    args = parser.parse_args(argv)
+    spec = SCALES[args.scale]
+    if args.seed is not None:
+        import dataclasses
+
+        spec = dataclasses.replace(spec, seed=args.seed)
+    paths = write_synthetic_tsv(spec, args.out_dir, prefix=args.prefix)
+    for name, path in paths.items():
+        print(f"{name}: {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -38,7 +38,13 @@ from g2vec_tpu.io.readers import ExpressionData, NetworkData
 class SyntheticSpec:
     n_good: int = 40            # good-prognosis samples (label 0)
     n_poor: int = 30            # poor-prognosis samples (label 1)
-    module_size: int = 24       # genes per planted module
+    module_size: int = 24       # genes per group-specific planted module
+    shared_module_size: int | None = None  # Ms size; None = module_size.
+    # Keep Ms small relative to Mg/Mp for high-accuracy datasets: walks
+    # through the shared module occur in BOTH group graphs with near-equal
+    # gene support, so they are label-ambiguous by construction — the
+    # fraction of Ms walks is an upper bound on the achievable error from
+    # this source (it exists to exercise the common-path drop).
     n_background: int = 60      # noise genes in both expression and network
     n_expr_only: int = 8        # genes only in the expression file
     n_net_only: int = 8         # genes only in the network file
@@ -70,10 +76,11 @@ def make_synthetic(spec: SyntheticSpec
     """Build (expression, clinical, network, module-membership) in memory."""
     rng = np.random.default_rng(spec.seed)
     m = spec.module_size
+    ms_size = spec.shared_module_size if spec.shared_module_size is not None else m
 
     mg = [f"GMOD{i:04d}" for i in range(m)]              # good module
     mp = [f"PMOD{i:04d}" for i in range(m)]              # poor module
-    ms = [f"SMOD{i:04d}" for i in range(m)]              # shared module
+    ms = [f"SMOD{i:04d}" for i in range(ms_size)]        # shared module
     bg = [f"BACK{i:04d}" for i in range(spec.n_background)]
     expr_only = [f"XONL{i:04d}" for i in range(spec.n_expr_only)]
     net_only = [f"NONL{i:04d}" for i in range(spec.n_net_only)]

@@ -62,14 +62,15 @@ def _read_tsv_lines(path: str) -> List[List[str]]:
 def load_expression(path: str, use_native: bool = True) -> ExpressionData:
     """Read a gene-expression TSV (ref: G2Vec.py:478-503 contract)."""
     if use_native:
+        # Unavailability (no toolchain, load failure) falls back to the
+        # Python parser with a one-time warning; actual PARSE errors
+        # (ValueError) propagate — a malformed file is malformed in any
+        # language and must not be silently re-parsed.
         try:
             from g2vec_tpu.native import bindings as _native
 
             parsed = _native.read_expression(path)
-            if parsed is not None:
-                sample, gene, expr = parsed
-                return ExpressionData(sample=sample, gene=gene, expr=expr)
-        except Exception as e:  # fall back transparently, but say why once
+        except (RuntimeError, ImportError, OSError) as e:
             global _warned_native
             if not _warned_native:
                 _warned_native = True
@@ -77,6 +78,10 @@ def load_expression(path: str, use_native: bool = True) -> ExpressionData:
 
                 warnings.warn(f"native TSV reader unavailable ({e!r}); "
                               "using the Python parser", RuntimeWarning)
+        else:
+            if parsed is not None:
+                sample, gene, expr = parsed
+                return ExpressionData(sample=sample, gene=gene, expr=expr)
     rows = _read_tsv_lines(path)
     if len(rows) < 2:
         raise ValueError(f"{path}: expression file needs a header and at least one gene row")

@@ -1,0 +1,10 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference has no native code of its own (SURVEY.md §2 "Native
+components" — its speed came from NumPy/TF wheels). This package provides
+the framework's native layer where host-side work is the bottleneck: a fast
+expression-TSV parser (single pass, writes the transposed samples x genes
+matrix directly). The build is one ``g++ -O3 -shared`` invocation, run
+on demand and cached next to the sources; everything degrades gracefully to
+the pure-Python readers when a toolchain is unavailable.
+"""

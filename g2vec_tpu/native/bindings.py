@@ -1,0 +1,96 @@
+"""ctypes bindings for the native TSV parser, with on-demand compilation.
+
+``read_expression(path)`` returns (samples, genes, expr[s, g] float32) or
+None when the native library cannot be built/loaded — callers
+(g2vec_tpu.io.readers.load_expression) fall back to the Python parser.
+
+The shared object is compiled once per checkout (``g++ -O3 -shared
+-fPIC``) and cached as ``_tsv_reader.so`` beside the sources; a stale .so
+(older than the .cpp) is rebuilt.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "tsv_reader.cpp")
+_SO = os.path.join(_HERE, "_tsv_reader.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_error: Optional[str] = None
+
+
+def _build() -> None:
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", _SO, _SRC]
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    if proc.returncode != 0:
+        raise RuntimeError(f"native build failed: {' '.join(cmd)}\n{proc.stderr}")
+
+
+def _load() -> ctypes.CDLL:
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_error is not None:
+            raise RuntimeError(_build_error)
+        try:
+            if (not os.path.exists(_SO)
+                    or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+                _build()
+            lib = ctypes.CDLL(_SO)
+        except Exception as e:  # remember, so we don't rebuild per call
+            _build_error = str(e)
+            raise
+        lib.g2v_expr_read.restype = ctypes.c_void_p
+        lib.g2v_expr_read.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                      ctypes.c_int]
+        lib.g2v_expr_nsamples.restype = ctypes.c_int
+        lib.g2v_expr_nsamples.argtypes = [ctypes.c_void_p]
+        lib.g2v_expr_ngenes.restype = ctypes.c_int
+        lib.g2v_expr_ngenes.argtypes = [ctypes.c_void_p]
+        lib.g2v_expr_sample.restype = ctypes.c_char_p
+        lib.g2v_expr_sample.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.g2v_expr_gene.restype = ctypes.c_char_p
+        lib.g2v_expr_gene.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.g2v_expr_copy.restype = None
+        lib.g2v_expr_copy.argtypes = [ctypes.c_void_p,
+                                      ctypes.POINTER(ctypes.c_float)]
+        lib.g2v_expr_free.restype = None
+        lib.g2v_expr_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+def read_expression(path: str
+                    ) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """Parse an expression TSV natively; raises ValueError on malformed input.
+
+    Returns (samples [S] str, genes [G] str, expr [S, G] float32). Returns
+    None only if the native library is unavailable (build/load failure) —
+    parse errors raise, matching the Python reader's behavior.
+    """
+    lib = _load()
+    err = ctypes.create_string_buffer(512)
+    handle = lib.g2v_expr_read(path.encode(), err, len(err))
+    if not handle:
+        raise ValueError(err.value.decode() or f"{path}: native parse failed")
+    try:
+        n_s = lib.g2v_expr_nsamples(handle)
+        n_g = lib.g2v_expr_ngenes(handle)
+        samples = np.array([lib.g2v_expr_sample(handle, i).decode()
+                            for i in range(n_s)])
+        genes = np.array([lib.g2v_expr_gene(handle, j).decode()
+                          for j in range(n_g)])
+        expr = np.empty((n_s, n_g), dtype=np.float32)
+        lib.g2v_expr_copy(handle, expr.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_float)))
+        return samples, genes, expr
+    finally:
+        lib.g2v_expr_free(handle)

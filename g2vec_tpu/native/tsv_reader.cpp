@@ -1,0 +1,172 @@
+// Fast expression-TSV parser (native side of g2vec_tpu.io.readers).
+//
+// File contract (same as the Python reader, ref: G2Vec.py:478-503): first
+// row is "PATIENT\t<sample ids...>", each body row "gene\tfloat...", rows
+// may end in \r\n or trailing whitespace, header column count defines the
+// sample count. The matrix is gene-major in the file; this parser writes
+// straight into a samples x genes float32 buffer (the transpose the Python
+// reader does as a second pass, ref: G2Vec.py:498).
+//
+// C API (ctypes-friendly, no C++ types across the boundary):
+//   g2v_expr_read(path, err, errlen) -> opaque handle or NULL (err filled)
+//   g2v_expr_nsamples / g2v_expr_ngenes
+//   g2v_expr_sample / g2v_expr_gene   (borrowed pointers, valid until free)
+//   g2v_expr_copy(handle, out)        (out: samples*genes float32)
+//   g2v_expr_free
+#include <cerrno>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Expr {
+  std::vector<std::string> samples;
+  std::vector<std::string> genes;
+  std::vector<float> matrix;  // samples x genes (transposed from file)
+};
+
+void fail(char* err, int errlen, const std::string& msg) {
+  if (err && errlen > 0) {
+    std::snprintf(err, static_cast<size_t>(errlen), "%s", msg.c_str());
+  }
+}
+
+// Split one line on tabs after stripping trailing whitespace.
+void split_fields(const char* begin, const char* end,
+                  std::vector<std::pair<const char*, const char*>>* out) {
+  while (end > begin &&
+         (end[-1] == ' ' || end[-1] == '\t' || end[-1] == '\r')) {
+    --end;
+  }
+  out->clear();
+  const char* field = begin;
+  for (const char* p = begin; p <= end; ++p) {
+    if (p == end || *p == '\t') {
+      out->push_back({field, p});
+      field = p + 1;
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* g2v_expr_read(const char* path, char* err, int errlen) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) {
+    fail(err, errlen, std::string(path) + ": " + std::strerror(errno));
+    return nullptr;
+  }
+  std::fseek(f, 0, SEEK_END);
+  long size = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  std::string buf(static_cast<size_t>(size), '\0');
+  if (size > 0 && std::fread(&buf[0], 1, static_cast<size_t>(size), f) !=
+                      static_cast<size_t>(size)) {
+    std::fclose(f);
+    fail(err, errlen, std::string(path) + ": short read");
+    return nullptr;
+  }
+  std::fclose(f);
+
+  auto expr = new Expr();
+  std::vector<std::pair<const char*, const char*>> fields;
+  const char* p = buf.data();
+  const char* bufend = buf.data() + buf.size();
+  long lineno = 0;
+  // First pass: collect gene rows as (name, value-span) so we can size the
+  // matrix once; value parsing happens in the second pass, writing
+  // transposed.
+  std::vector<std::pair<const char*, const char*>> gene_rows;
+  while (p < bufend) {
+    const char* nl = static_cast<const char*>(
+        std::memchr(p, '\n', static_cast<size_t>(bufend - p)));
+    const char* line_end = nl ? nl : bufend;
+    ++lineno;
+    if (lineno == 1) {
+      split_fields(p, line_end, &fields);
+      if (fields.size() < 2) {
+        fail(err, errlen, std::string(path) +
+                              ": expression header needs at least one sample");
+        delete expr;
+        return nullptr;
+      }
+      for (size_t i = 1; i < fields.size(); ++i) {
+        expr->samples.emplace_back(fields[i].first,
+                                   fields[i].second - fields[i].first);
+      }
+    } else if (line_end > p) {  // skip blank lines
+      gene_rows.push_back({p, line_end});
+    }
+    p = nl ? nl + 1 : bufend;
+  }
+  size_t n_samples = expr->samples.size();
+  size_t n_genes = gene_rows.size();
+  if (n_genes == 0) {
+    fail(err, errlen, std::string(path) + ": no gene rows after the header");
+    delete expr;
+    return nullptr;
+  }
+  expr->genes.reserve(n_genes);
+  expr->matrix.resize(n_samples * n_genes);
+
+  for (size_t j = 0; j < n_genes; ++j) {
+    split_fields(gene_rows[j].first, gene_rows[j].second, &fields);
+    if (fields.size() != n_samples + 1) {
+      fail(err, errlen,
+           std::string(path) + ": gene row " + std::to_string(j + 2) +
+               " has " + std::to_string(fields.size() - 1) +
+               " values, expected " + std::to_string(n_samples));
+      delete expr;
+      return nullptr;
+    }
+    expr->genes.emplace_back(fields[0].first,
+                             fields[0].second - fields[0].first);
+    for (size_t i = 1; i <= n_samples; ++i) {
+      // strtof needs NUL-terminated input; fields point into one big buffer,
+      // so parse through a bounded copy only when the field is suspiciously
+      // long, else patch parse from the span (strtof stops at '\t'/'\n'
+      // naturally since those can't appear inside a float).
+      char* parse_end = nullptr;
+      float v = std::strtof(fields[i].first, &parse_end);
+      if (parse_end != fields[i].second) {  // empty, garbage, or trailing junk
+        fail(err, errlen,
+             std::string(path) + ": non-numeric value in gene row " +
+                 std::to_string(j + 2));
+        delete expr;
+        return nullptr;
+      }
+      expr->matrix[(i - 1) * n_genes + j] = v;  // transposed write
+    }
+  }
+  return expr;
+}
+
+int g2v_expr_nsamples(void* h) {
+  return static_cast<int>(static_cast<Expr*>(h)->samples.size());
+}
+
+int g2v_expr_ngenes(void* h) {
+  return static_cast<int>(static_cast<Expr*>(h)->genes.size());
+}
+
+const char* g2v_expr_sample(void* h, int i) {
+  return static_cast<Expr*>(h)->samples[static_cast<size_t>(i)].c_str();
+}
+
+const char* g2v_expr_gene(void* h, int j) {
+  return static_cast<Expr*>(h)->genes[static_cast<size_t>(j)].c_str();
+}
+
+void g2v_expr_copy(void* h, float* out) {
+  Expr* e = static_cast<Expr*>(h);
+  std::memcpy(out, e->matrix.data(), e->matrix.size() * sizeof(float));
+}
+
+void g2v_expr_free(void* h) { delete static_cast<Expr*>(h); }
+
+}  // extern "C"

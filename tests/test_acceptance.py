@@ -1,0 +1,39 @@
+"""Acceptance test (SURVEY.md §4 item 6 / BASELINE.md target): the full
+pipeline reaches val-ACC >= 0.88 on a dataset with the reference's label
+balance and planted prognostic structure, and the biomarker list is
+dominated by the planted module genes.
+
+Runs at the 'medium' make_example scale (~940 common genes) so it finishes
+in tens of seconds on CPU; the full-scale 'example' run is the TPU bench's
+job. Distributional, not byte-golden: the reference is unseeded and its
+bundled expression matrix is absent (BASELINE.md note).
+"""
+import os
+
+import pytest
+
+from g2vec_tpu.config import G2VecConfig
+from g2vec_tpu.data.make_example import SCALES
+from g2vec_tpu.data.synthetic import write_synthetic_tsv
+
+
+@pytest.mark.slow
+def test_pipeline_reaches_baseline_accuracy(tmp_path):
+    from g2vec_tpu.pipeline import run
+
+    paths = write_synthetic_tsv(SCALES["medium"], str(tmp_path))
+    cfg = G2VecConfig(
+        expression_file=paths["expression"], clinical_file=paths["clinical"],
+        network_file=paths["network"],
+        result_name=os.path.join(str(tmp_path), "acc"),
+        lenPath=40, numRepetition=10, sizeHiddenlayer=128, epoch=200,
+        learningRate=0.005, numBiomarker=50, compute_dtype="bfloat16", seed=0)
+    result = run(cfg, console=lambda s: None)
+
+    assert result.n_samples == 135          # reference label balance
+    assert result.acc_val >= 0.88, (
+        f"val-ACC {result.acc_val:.4f} below the 0.88 acceptance bar")
+    planted = sum(g.startswith(("GMOD", "PMOD")) for g in result.biomarkers)
+    assert planted / len(result.biomarkers) >= 0.8
+    for f in result.output_files:
+        assert os.path.exists(f)

@@ -1,0 +1,74 @@
+"""Native TSV parser: byte-level parity with the Python reader, error
+behavior, and the transparent-fallback contract."""
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from g2vec_tpu.io.readers import load_expression
+
+g_plus_plus = shutil.which("g++")
+pytestmark = pytest.mark.skipif(g_plus_plus is None,
+                                reason="no C++ toolchain in this environment")
+
+
+@pytest.fixture(scope="module")
+def expr_file(tmp_path_factory):
+    p = tmp_path_factory.mktemp("native") / "expr.txt"
+    rng = np.random.default_rng(3)
+    samples = [f"S{i}" for i in range(7)]
+    with open(p, "w") as f:
+        f.write("PATIENT\t" + "\t".join(samples) + "\r\n")   # CRLF on purpose
+        for j in range(11):
+            vals = "\t".join("%.6f" % v for v in rng.normal(size=7))
+            f.write(f"GENE{j:03d}\t{vals}\n")
+    return str(p)
+
+
+def test_native_matches_python_reader(expr_file):
+    native = load_expression(expr_file, use_native=True)
+    python = load_expression(expr_file, use_native=False)
+    np.testing.assert_array_equal(native.sample, python.sample)
+    np.testing.assert_array_equal(native.gene, python.gene)
+    np.testing.assert_allclose(native.expr, python.expr, rtol=0, atol=0)
+    assert native.expr.dtype == np.float32
+    assert native.expr.shape == (7, 11)
+
+
+def test_native_rejects_ragged_rows(tmp_path):
+    p = tmp_path / "bad.txt"
+    p.write_text("PATIENT\tS1\tS2\nG1\t1.0\n")
+    with pytest.raises(ValueError, match="1 values, expected 2"):
+        load_expression(str(p), use_native=True)
+
+
+def test_native_rejects_garbage_floats(tmp_path):
+    p = tmp_path / "bad.txt"
+    p.write_text("PATIENT\tS1\nG1\t1.5x\n")
+    with pytest.raises(ValueError, match="non-numeric"):
+        load_expression(str(p), use_native=True)
+
+
+def test_native_missing_file_raises(tmp_path):
+    with pytest.raises(ValueError, match="No such file"):
+        from g2vec_tpu.native import bindings
+
+        bindings.read_expression(str(tmp_path / "nope.txt"))
+
+
+def test_native_large_roundtrip(tmp_path):
+    # A bigger matrix to catch indexing/transpose bugs the tiny case misses.
+    rng = np.random.default_rng(0)
+    s, g = 23, 57
+    expr = rng.normal(size=(s, g)).astype(np.float32)
+    p = tmp_path / "big.txt"
+    with open(p, "w") as f:
+        f.write("PATIENT\t" + "\t".join(f"S{i}" for i in range(s)) + "\n")
+        for j in range(g):
+            f.write(f"G{j}\t" + "\t".join("%.6f" % v for v in expr[:, j]) + "\n")
+    d = load_expression(str(p), use_native=True)
+    np.testing.assert_allclose(d.expr, np.loadtxt(
+        str(p), skiprows=1, usecols=range(1, s + 1), dtype=np.float32).T,
+        rtol=1e-6)
+    assert d.gene[0] == "G0" and d.sample[-1] == f"S{s-1}"
