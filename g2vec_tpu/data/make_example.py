@@ -1,6 +1,6 @@
 """CLI: write a synthetic reference-format dataset.
 
-``python -m g2vec_tpu.data.make_example OUT_DIR [--scale small|example]``
+``python -m g2vec_tpu.data.make_example OUT_DIR [--scale small|medium|example]``
 
 The reference bundles an example dataset whose expression matrix is absent
 from this mount (SURVEY.md §0); this generates statistically similar

@@ -79,9 +79,8 @@ def load_expression(path: str, use_native: bool = True) -> ExpressionData:
                 warnings.warn(f"native TSV reader unavailable ({e!r}); "
                               "using the Python parser", RuntimeWarning)
         else:
-            if parsed is not None:
-                sample, gene, expr = parsed
-                return ExpressionData(sample=sample, gene=gene, expr=expr)
+            sample, gene, expr = parsed
+            return ExpressionData(sample=sample, gene=gene, expr=expr)
     rows = _read_tsv_lines(path)
     if len(rows) < 2:
         raise ValueError(f"{path}: expression file needs a header and at least one gene row")

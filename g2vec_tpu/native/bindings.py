@@ -47,7 +47,10 @@ def _load() -> ctypes.CDLL:
             lib = ctypes.CDLL(_SO)
         except Exception as e:  # remember, so we don't rebuild per call
             _build_error = str(e)
-            raise
+            # Normalize to RuntimeError so callers have ONE "unavailable"
+            # exception type regardless of how the build died (missing g++,
+            # compiler timeout, dlopen failure, ...).
+            raise RuntimeError(_build_error) from e
         lib.g2v_expr_read.restype = ctypes.c_void_p
         lib.g2v_expr_read.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
                                       ctypes.c_int]
@@ -68,13 +71,13 @@ def _load() -> ctypes.CDLL:
         return lib
 
 
-def read_expression(path: str
-                    ) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
-    """Parse an expression TSV natively; raises ValueError on malformed input.
+def read_expression(path: str) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Parse an expression TSV natively.
 
-    Returns (samples [S] str, genes [G] str, expr [S, G] float32). Returns
-    None only if the native library is unavailable (build/load failure) —
-    parse errors raise, matching the Python reader's behavior.
+    Returns (samples [S] str, genes [G] str, expr [S, G] float32). Raises
+    ValueError on malformed input (matching the Python reader's behavior)
+    and RuntimeError when the native library is unavailable (build/load
+    failure) — callers fall back to the Python parser on the latter only.
     """
     lib = _load()
     err = ctypes.create_string_buffer(512)

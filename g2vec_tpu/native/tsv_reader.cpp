@@ -17,6 +17,7 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <memory>
 #include <string>
 #include <vector>
 
@@ -55,7 +56,7 @@ void split_fields(const char* begin, const char* end,
 
 extern "C" {
 
-void* g2v_expr_read(const char* path, char* err, int errlen) {
+void* g2v_expr_read(const char* path, char* err, int errlen) try {
   FILE* f = std::fopen(path, "rb");
   if (!f) {
     fail(err, errlen, std::string(path) + ": " + std::strerror(errno));
@@ -64,6 +65,11 @@ void* g2v_expr_read(const char* path, char* err, int errlen) {
   std::fseek(f, 0, SEEK_END);
   long size = std::ftell(f);
   std::fseek(f, 0, SEEK_SET);
+  if (size < 0) {  // unseekable (FIFO, ...) — refuse instead of overflowing
+    std::fclose(f);
+    fail(err, errlen, std::string(path) + ": not a regular seekable file");
+    return nullptr;
+  }
   std::string buf(static_cast<size_t>(size), '\0');
   if (size > 0 && std::fread(&buf[0], 1, static_cast<size_t>(size), f) !=
                       static_cast<size_t>(size)) {
@@ -73,7 +79,7 @@ void* g2v_expr_read(const char* path, char* err, int errlen) {
   }
   std::fclose(f);
 
-  auto expr = new Expr();
+  auto expr = std::make_unique<Expr>();
   std::vector<std::pair<const char*, const char*>> fields;
   const char* p = buf.data();
   const char* bufend = buf.data() + buf.size();
@@ -92,15 +98,25 @@ void* g2v_expr_read(const char* path, char* err, int errlen) {
       if (fields.size() < 2) {
         fail(err, errlen, std::string(path) +
                               ": expression header needs at least one sample");
-        delete expr;
         return nullptr;
       }
       for (size_t i = 1; i < fields.size(); ++i) {
         expr->samples.emplace_back(fields[i].first,
                                    fields[i].second - fields[i].first);
       }
-    } else if (line_end > p) {  // skip blank lines
-      gene_rows.push_back({p, line_end});
+    } else {
+      // Blank-line test AFTER stripping trailing whitespace, so a CRLF
+      // file's trailing "\r\n" line is skipped exactly like the Python
+      // reader's rstrip() path.
+      const char* stripped_end = line_end;
+      while (stripped_end > p &&
+             (stripped_end[-1] == ' ' || stripped_end[-1] == '\t' ||
+              stripped_end[-1] == '\r')) {
+        --stripped_end;
+      }
+      if (stripped_end > p) {
+        gene_rows.push_back({p, line_end});
+      }
     }
     p = nl ? nl + 1 : bufend;
   }
@@ -108,7 +124,6 @@ void* g2v_expr_read(const char* path, char* err, int errlen) {
   size_t n_genes = gene_rows.size();
   if (n_genes == 0) {
     fail(err, errlen, std::string(path) + ": no gene rows after the header");
-    delete expr;
     return nullptr;
   }
   expr->genes.reserve(n_genes);
@@ -121,29 +136,34 @@ void* g2v_expr_read(const char* path, char* err, int errlen) {
            std::string(path) + ": gene row " + std::to_string(j + 2) +
                " has " + std::to_string(fields.size() - 1) +
                " values, expected " + std::to_string(n_samples));
-      delete expr;
       return nullptr;
     }
     expr->genes.emplace_back(fields[0].first,
                              fields[0].second - fields[0].first);
     for (size_t i = 1; i <= n_samples; ++i) {
-      // strtof needs NUL-terminated input; fields point into one big buffer,
-      // so parse through a bounded copy only when the field is suspiciously
-      // long, else patch parse from the span (strtof stops at '\t'/'\n'
-      // naturally since those can't appear inside a float).
+      // Parsing in place is safe: std::string guarantees buf is
+      // NUL-terminated, and strtof stops at the field's '\t'/'\n'/'\r'
+      // delimiter (none of which can appear inside a float).
       char* parse_end = nullptr;
       float v = std::strtof(fields[i].first, &parse_end);
       if (parse_end != fields[i].second) {  // empty, garbage, or trailing junk
         fail(err, errlen,
              std::string(path) + ": non-numeric value in gene row " +
                  std::to_string(j + 2));
-        delete expr;
         return nullptr;
       }
       expr->matrix[(i - 1) * n_genes + j] = v;  // transposed write
     }
   }
-  return expr;
+  return expr.release();
+} catch (const std::exception& e) {
+  // Never let a C++ exception cross the C ABI into ctypes (it aborts the
+  // whole Python process). bad_alloc on oversized files lands here too.
+  fail(err, errlen, std::string(path) + ": " + e.what());
+  return nullptr;
+} catch (...) {
+  fail(err, errlen, std::string(path) + ": unknown native parser error");
+  return nullptr;
 }
 
 int g2v_expr_nsamples(void* h) {

@@ -36,6 +36,18 @@ def test_native_matches_python_reader(expr_file):
     assert native.expr.shape == (7, 11)
 
 
+def test_native_crlf_trailing_blank_line_parity(tmp_path):
+    # Windows-produced file with a trailing blank CRLF line: both parsers
+    # must accept it identically (the blank-line skip runs after \r strip).
+    p = tmp_path / "crlf.txt"
+    p.write_bytes(b"PATIENT\tS1\r\nG1\t1.0\r\n\r\n")
+    native = load_expression(str(p), use_native=True)
+    python = load_expression(str(p), use_native=False)
+    np.testing.assert_array_equal(native.gene, python.gene)
+    np.testing.assert_array_equal(native.expr, python.expr)
+    assert native.expr.shape == (1, 1)
+
+
 def test_native_rejects_ragged_rows(tmp_path):
     p = tmp_path / "bad.txt"
     p.write_text("PATIENT\tS1\tS2\nG1\t1.0\n")
