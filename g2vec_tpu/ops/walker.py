@@ -49,20 +49,18 @@ import numpy as np
 NEG_INF = -1e30  # large-negative instead of -inf: keeps argmax well-defined
 
 
-@partial(jax.jit, static_argnames=("len_path",))
-def random_walks(adj: jax.Array, starts: jax.Array, key: jax.Array,
-                 len_path: int) -> jax.Array:
-    """Walk |starts| walkers for <= len_path nodes; return visited [W, G] bool.
+def _walk(n_genes: int, candidates, starts: jax.Array, key: jax.Array,
+          len_path: int) -> jax.Array:
+    """Shared walk scaffold for the dense and sparse transition formats.
 
-    ``adj``: [G, G] float32 non-negative directed transition weights (zero =
-    no edge). ``starts``: [W] int32 start nodes. ``key`` is either ONE PRNG
-    key (per-walker keys derived by position) or a [W] array of per-walker
-    keys — the latter is what makes :func:`generate_path_set` invariant to
-    ``walker_batch``: each walker's stream is keyed by its global identity,
-    not by which launch it rode in. The returned multi-hot rows are the
-    canonical path encodings (see module docstring).
+    ``candidates(current, visited) -> (w, cand)`` supplies, per step, the
+    [W, K] sampling weights (already zeroed for visited/padding targets) and
+    the [W, K] global gene index of each slot (``None`` when slots ARE gene
+    indices, i.e. K == G). Everything else — per-walker key fan-out,
+    Gumbel-max categorical draw, dead-end freeze, visited bookkeeping, the
+    fixed-trip-count scan — is format-independent and lives only here, so
+    the two walkers cannot drift semantically.
     """
-    n_genes = adj.shape[0]
     n_walkers = starts.shape[0]
     if key.ndim == 0:
         walker_keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
@@ -76,15 +74,17 @@ def random_walks(adj: jax.Array, starts: jax.Array, key: jax.Array,
 
     def step(state, step_idx):
         visited, current, alive = state
-        w = adj[current]                                   # [W, G] gather
-        w = jnp.where(visited, 0.0, w)                     # no revisit
-        norm = w.sum(axis=1)                               # [W]
-        can_move = alive & (norm > 0.0)                    # dead-end freeze
+        w, cand = candidates(current, visited)             # [W, K] each
+        can_move = alive & (w.sum(axis=1) > 0.0)           # dead-end freeze
         logits = jnp.where(w > 0.0, jnp.log(jnp.where(w > 0.0, w, 1.0)), NEG_INF)
         gumbel = jax.vmap(
             lambda k: jax.random.gumbel(jax.random.fold_in(k, step_idx),
-                                        (n_genes,)))(walker_keys)
-        nxt = jnp.argmax(logits + gumbel, axis=1).astype(jnp.int32)
+                                        (w.shape[1],)))(walker_keys)
+        slot = jnp.argmax(logits + gumbel, axis=1)
+        if cand is None:
+            nxt = slot.astype(jnp.int32)
+        else:
+            nxt = jnp.take_along_axis(cand, slot[:, None], axis=1)[:, 0]
         current = jnp.where(can_move, nxt, current)
         moved = jax.nn.one_hot(nxt, n_genes, dtype=jnp.bool_) & can_move[:, None]
         visited = visited | moved
@@ -94,6 +94,52 @@ def random_walks(adj: jax.Array, starts: jax.Array, key: jax.Array,
     (visited, _, _), _ = jax.lax.scan(
         step, state0, jnp.arange(max(len_path - 1, 0)))
     return visited
+
+
+@partial(jax.jit, static_argnames=("len_path",))
+def random_walks(adj: jax.Array, starts: jax.Array, key: jax.Array,
+                 len_path: int) -> jax.Array:
+    """Walk |starts| walkers for <= len_path nodes; return visited [W, G] bool.
+
+    ``adj``: [G, G] float32 non-negative directed transition weights (zero =
+    no edge). ``starts``: [W] int32 start nodes. ``key`` is either ONE PRNG
+    key (per-walker keys derived by position) or a [W] array of per-walker
+    keys — the latter is what makes :func:`generate_path_set` invariant to
+    ``walker_batch``: each walker's stream is keyed by its global identity,
+    not by which launch it rode in. The returned multi-hot rows are the
+    canonical path encodings (see module docstring).
+    """
+
+    def candidates(current, visited):
+        w = jnp.where(visited, 0.0, adj[current])          # no revisit
+        return w, None                                     # slots == genes
+
+    return _walk(adj.shape[0], candidates, starts, key, len_path)
+
+
+@partial(jax.jit, static_argnames=("len_path",))
+def random_walks_sparse(nbr_idx: jax.Array, nbr_w: jax.Array,
+                        starts: jax.Array, key: jax.Array,
+                        len_path: int) -> jax.Array:
+    """Sparse-transition twin of :func:`random_walks`.
+
+    ``nbr_idx``/``nbr_w``: [G, D] padded out-neighbor lists from
+    :func:`g2vec_tpu.ops.graph.neighbor_table` (padding = weight 0). Same
+    walk semantics, but each step works on [W, D] instead of [W, G]:
+    gather the current nodes' neighbor rows, mask visited targets via a
+    per-row take_along_axis into the visited table, Gumbel-max over the D
+    slots, then map the winning slot back to its global gene index. At the
+    reference scale D is ~2 orders of magnitude smaller than G, and the
+    O(W*G) work that remains (the visited-bit scatter) is a single one-hot
+    OR. Returns visited [W, G] bool — identical encoding to the dense path.
+    """
+    def candidates(current, visited):
+        cand = nbr_idx[current]                            # [W, D] gather
+        seen = jnp.take_along_axis(visited, cand, axis=1)  # [W, D]
+        w = jnp.where(seen, 0.0, nbr_w[current])           # no revisit (+pads stay 0)
+        return w, cand
+
+    return _walk(nbr_idx.shape[0], candidates, starts, key, len_path)
 
 
 def generate_path_set(adj, key: jax.Array, *, len_path: int, reps: int,
@@ -106,28 +152,45 @@ def generate_path_set(adj, key: jax.Array, *, len_path: int, reps: int,
     ``np.packbits`` of the [G] bool row (fixed G; unpack with
     :func:`unpack_paths`).
 
-    ``walker_batch`` caps walkers per device launch (0 = one full repetition,
-    i.e. n_genes walkers — 56 MB of state at example scale). The adjacency is
-    transferred once; each batch returns only its packed masks. The result is
-    INVARIANT to ``walker_batch``: every walker's PRNG stream is keyed by its
-    (repetition, global walker index), not by its launch batch, so the memory
-    knob never changes which paths a given --seed produces.
+    ``adj`` is either a dense [G, G] transition matrix or a
+    ``(nbr_idx [G, D], nbr_w [G, D])`` neighbor-table pair from
+    :func:`g2vec_tpu.ops.graph.neighbor_table` — the sparse form is the
+    TPU-efficient default for the pipeline (O(W*D) per step, no dense G^2
+    HBM residency). ``walker_batch`` caps walkers per device launch (0 = one
+    full repetition, i.e. n_genes walkers). Transition tables are
+    transferred once; each batch returns only its packed masks. The result
+    is INVARIANT to ``walker_batch``: every walker's PRNG stream is keyed by
+    its (repetition, global walker index), not by its launch batch, so the
+    memory knob never changes which paths a given --seed produces. (It is
+    NOT invariant to the dense/sparse choice — the two draw differently
+    shaped Gumbel noise — but each is deterministic per seed.)
     """
-    n_genes = int(adj.shape[0])
+    sparse = isinstance(adj, tuple)
+    if sparse:
+        nbr_idx, nbr_w = adj
+        n_genes = int(nbr_idx.shape[0])
+        table = (jax.device_put(jnp.asarray(nbr_idx, dtype=jnp.int32)),
+                 jax.device_put(jnp.asarray(nbr_w, dtype=jnp.float32)))
+    else:
+        n_genes = int(adj.shape[0])
+        table = jax.device_put(jnp.asarray(adj, dtype=jnp.float32))
     if starts is None:
         starts = np.arange(n_genes, dtype=np.int32)
     starts = np.asarray(starts, dtype=np.int32)
     batch = walker_batch if walker_batch > 0 else starts.size
-    adj_dev = jax.device_put(jnp.asarray(adj, dtype=jnp.float32))
 
     paths: Set[bytes] = set()
     for rep_key in jax.random.split(key, reps):
         all_keys = jax.vmap(lambda i: jax.random.fold_in(rep_key, i))(
             jnp.arange(starts.size))
         for lo in range(0, starts.size, batch):
-            chunk = starts[lo:lo + batch]
-            visited = random_walks(adj_dev, jnp.asarray(chunk),
-                                   all_keys[lo:lo + batch], len_path)
+            chunk = jnp.asarray(starts[lo:lo + batch])
+            chunk_keys = all_keys[lo:lo + batch]
+            if sparse:
+                visited = random_walks_sparse(table[0], table[1], chunk,
+                                              chunk_keys, len_path)
+            else:
+                visited = random_walks(table, chunk, chunk_keys, len_path)
             packed = np.packbits(np.asarray(visited), axis=1)
             paths.update(row.tobytes() for row in packed)
     return paths

@@ -75,7 +75,7 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
     from g2vec_tpu.analysis import find_lgroups, select_biomarkers
     from g2vec_tpu.io.readers import load_clinical, load_expression, load_network
     from g2vec_tpu.io.writers import write_biomarkers, write_lgroups, write_vectors
-    from g2vec_tpu.ops.graph import build_adjacency
+    from g2vec_tpu.ops.graph import neighbor_table, thresholded_edges
     from g2vec_tpu.ops.walker import (count_gene_freq, generate_path_set,
                                       integrate_path_sets)
     from g2vec_tpu.parallel.mesh import make_mesh_context
@@ -129,10 +129,13 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
         with timer.stage("paths"):
             for i, group in enumerate(["g", "p"]):
                 expr_group = data.expr[data.label == i]
-                adj = build_adjacency(expr_group, src, dst, n_genes,
-                                      threshold=cfg.pcc_threshold)
+                # Sparse transitions: per-step walk cost O(W*D) instead of
+                # O(W*G), and no dense G^2 matrix in HBM (ops/graph.py).
+                s_k, d_k, w_k = thresholded_edges(expr_group, src, dst,
+                                                  threshold=cfg.pcc_threshold)
+                table = neighbor_table(s_k, d_k, w_k, n_genes)
                 path_sets.append(generate_path_set(
-                    adj, jax.random.fold_in(key, i), len_path=cfg.lenPath,
+                    table, jax.random.fold_in(key, i), len_path=cfg.lenPath,
                     reps=cfg.numRepetition, walker_batch=cfg.walker_batch))
             paths, labels = integrate_path_sets(path_sets[0], path_sets[1], n_genes)
             gene_freq = count_gene_freq(paths, labels, data.gene)
