@@ -39,6 +39,18 @@ def init_params(key: jax.Array, n_genes: int, hidden: int,
     return CBOWParams(w_ih=w_ih.astype(param_dtype), w_ho=w_ho.astype(param_dtype))
 
 
+def output_logits(h: jax.Array, w_ho: jax.Array,
+                  compute_dtype=jnp.bfloat16) -> jax.Array:
+    """Hidden [batch, hidden] -> logits [batch, 1] f32 (ref: G2Vec.py:240).
+
+    Shared by the dense forward and the trainer's fused packed-X path so the
+    output projection has exactly one definition."""
+    return jax.lax.dot_general(
+        h.astype(compute_dtype), w_ho.astype(compute_dtype),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
 def forward(params: CBOWParams, x: jax.Array,
             compute_dtype=jnp.bfloat16) -> jax.Array:
     """Logits [batch, 1] in float32 regardless of compute dtype."""
@@ -47,11 +59,7 @@ def forward(params: CBOWParams, x: jax.Array,
         xc, params.w_ih.astype(compute_dtype),
         (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
-    o = jax.lax.dot_general(
-        h.astype(compute_dtype), params.w_ho.astype(compute_dtype),
-        (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    return o
+    return output_logits(h, params.w_ho, compute_dtype)
 
 
 def predict_logits(params: CBOWParams, x: jax.Array,

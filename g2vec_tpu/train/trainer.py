@@ -19,11 +19,15 @@ Reference behavior, reproduced exactly (SURVEY.md §7 quirk (c)):
 TPU design vs the reference: the TF1 version re-feeds the full dense path
 matrix host->runtime three times per epoch through ``feed_dict``
 (~1.3 GB/epoch at example scale, ref: G2Vec.py:264-267) and pulls the whole
-W_ih back every epoch (G2Vec.py:283). Here the path matrix and parameters are
-device-resident; one jit-compiled epoch function performs step + both evals,
-and exactly two scalars cross to the host per epoch. The previous-epoch
-snapshot is a device-side reference (params are immutable pytrees — keeping
-the old one costs nothing and no transfer happens until training ends).
+W_ih back every epoch (G2Vec.py:283). Here the path matrix and parameters
+are device-resident, epochs run in device-side chunks of DEFAULT_CHUNK
+inside a ``lax.while_loop`` (the early-stop comparison included), and the
+host sees one small (state, per-epoch history) transfer per chunk — on a
+tunneled TPU that round trip dwarfs the epoch math, so it must be
+amortized. The previous-epoch snapshot stays on device (a per-epoch
+``jnp.where`` select of the param tree; W_ih only crosses to the host once,
+after training). On a single chip the X@W_ih matmuls run through the fused
+bit-packed Pallas kernel (ops/packed_matmul.py) so X stays packed in HBM.
 """
 from __future__ import annotations
 
@@ -36,7 +40,9 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from g2vec_tpu.models.cbow import CBOWParams, forward, init_params
+from g2vec_tpu.models.cbow import (CBOWParams, forward, init_params,
+                                   output_logits)
+from g2vec_tpu.ops import packed_matmul as pm
 from g2vec_tpu.parallel.mesh import MeshContext, make_mesh_context
 
 
@@ -58,7 +64,8 @@ class TrainResult:
 
 
 def _make_chunk_fn(tx: optax.GradientTransformation, compute_dtype,
-                   decision_threshold: float, ctx: MeshContext, chunk: int):
+                   decision_threshold: float, ctx: MeshContext, chunk: int,
+                   packed: bool = False, interpret: bool = False):
     """Compile a device-resident loop over up to ``chunk`` epochs.
 
     The reference syncs with the host three times per epoch (optimizer run +
@@ -72,17 +79,28 @@ def _make_chunk_fn(tx: optax.GradientTransformation, compute_dtype,
     """
     logit_threshold = float(np.log(decision_threshold / (1.0 - decision_threshold)))
 
+    if packed:
+        # Pallas path: ``x`` is the bit-packed [rows, n_genes/8] uint8 matrix
+        # in pack_blockwise layout; the fused kernel unpacks tiles in VMEM
+        # (ops/packed_matmul.py) — 16x less HBM traffic than a dense bf16 X.
+        def logits_fn(params, x):
+            h = pm.packed_matmul(x, params.w_ih.astype(compute_dtype), interpret)
+            return output_logits(h, params.w_ho, compute_dtype)
+    else:
+        def logits_fn(params, x):
+            return forward(params, x, compute_dtype)
+
     # ``w`` is a [batch, 1] 1/0 mask: 1 for real rows, 0 for shard-even
     # padding rows (see train_cbow). Weighted means make the padded program
     # numerically identical to the unpadded one.
     def loss_fn(params, x, y, w):
-        logits = forward(params, x, compute_dtype)
+        logits = logits_fn(params, x)
         logits = ctx.constrain(logits, ctx.label_spec)
         bce = optax.sigmoid_binary_cross_entropy(logits, y)
         return jnp.sum(bce * w) / jnp.sum(w)
 
     def accuracy(params, x, y, w):
-        logits = forward(params, x, compute_dtype)
+        logits = logits_fn(params, x)
         pred = (logits > logit_threshold).astype(jnp.float32)
         return jnp.sum((pred == y).astype(jnp.float32) * w) / jnp.sum(w)
 
@@ -140,13 +158,15 @@ _CHUNK_FN_CACHE_MAX = 16   # hyperparameter sweeps must not pin old executables
 
 
 def _get_chunk_fn(learning_rate: float, compute_dtype, decision_threshold: float,
-                  ctx: MeshContext, chunk: int):
+                  ctx: MeshContext, chunk: int, packed: bool = False,
+                  interpret: bool = False):
     key = (learning_rate, jnp.dtype(compute_dtype).name, decision_threshold,
-           ctx.mesh, chunk)
+           ctx.mesh, chunk, packed, interpret)
     fn = _CHUNK_FN_CACHE.get(key)
     if fn is None:
         tx = optax.adam(learning_rate, b1=0.9, b2=0.999, eps=1e-8)
-        fn = _make_chunk_fn(tx, compute_dtype, decision_threshold, ctx, chunk)
+        fn = _make_chunk_fn(tx, compute_dtype, decision_threshold, ctx, chunk,
+                            packed, interpret)
         while len(_CHUNK_FN_CACHE) >= _CHUNK_FN_CACHE_MAX:
             _CHUNK_FN_CACHE.pop(next(iter(_CHUNK_FN_CACHE)))
         _CHUNK_FN_CACHE[key] = fn
@@ -188,7 +208,7 @@ def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
                seed: int = 0, mesh_ctx: Optional[MeshContext] = None,
                on_epoch: Optional[Callable[[int, float, float, float], None]] = None,
                checkpoint_dir: Optional[str] = None, resume: bool = False,
-               checkpoint_every: int = 25,
+               checkpoint_every: int = 25, use_pallas: Optional[bool] = None,
                ) -> TrainResult:
     """Train the modified CBOW; returns the embedding table and history.
 
@@ -226,32 +246,66 @@ def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
         model_dim = ctx.mesh.shape[MODEL_AXIS]
     else:
         data_dim = model_dim = 1
-    # Gene axis pads to a multiple of 8*model_dim so the PACKED byte columns
-    # split evenly over the model axis and byte boundaries coincide with
-    # shard boundaries.
-    n_genes_pad = pad_to_multiple(n_genes, 8 * model_dim)
-    unpack_fn = _get_unpack_fn(ctx, cdtype)
+
+    # Pallas fused packed-matmul path (ops/packed_matmul.py): single-chip,
+    # bf16 compute, shapes within the kernel's VMEM budget. The multi-hot
+    # stays BIT-PACKED in HBM (16x smaller than dense bf16) and is unpacked
+    # tile-by-tile in VMEM fused into the MXU matmul. ``use_pallas=None``
+    # auto-detects; True forces it (tests use interpret mode off-TPU).
+    if use_pallas is None:
+        use_pallas = (
+            ctx.mesh is None and compute_dtype == "bfloat16"
+            and jax.default_backend() == "tpu"
+            and pm.packed_matmul_available(
+                n_paths, pad_to_multiple(n_genes, pm.LANE_BLOCK), hidden))
+    elif use_pallas:
+        # Forced on (tests / power users): enforce the same preconditions the
+        # auto-detect checks, loudly — the kernel is single-chip and bf16.
+        if ctx.mesh is not None:
+            raise ValueError("use_pallas=True is single-chip only; it cannot "
+                             "be combined with a device mesh")
+        if compute_dtype != "bfloat16":
+            raise ValueError("use_pallas=True requires compute_dtype="
+                             "'bfloat16' (the kernel computes in bf16)")
+        if hidden % 128:
+            raise ValueError(f"use_pallas=True requires hidden % 128 == 0, "
+                             f"got {hidden}")
+    pallas_interpret = use_pallas and jax.default_backend() != "tpu"
+
+    if use_pallas:
+        # Gene axis pads to the kernel's lane block; rows to its row tile.
+        n_genes_pad = pad_to_multiple(n_genes, pm.LANE_BLOCK)
+        row_multiple = pm.ROW_BLOCK
+    else:
+        # Gene axis pads to a multiple of 8*model_dim so the PACKED byte
+        # columns split evenly over the model axis and byte boundaries
+        # coincide with shard boundaries.
+        n_genes_pad = pad_to_multiple(n_genes, 8 * model_dim)
+        row_multiple = data_dim
+        unpack_fn = _get_unpack_fn(ctx, cdtype)
 
     def _prep(idx):
         # The multi-hot crosses the host->device boundary as packed bits
-        # (np.packbits, 8 genes/byte) and is unpacked + cast on device —
-        # a ~13x smaller transfer than shipping bf16, and no host-side
-        # ml_dtypes cast of a third of a billion elements.
-        x = paths[idx]
+        # (8 genes/byte) and — in the XLA path — is unpacked + cast on
+        # device: a ~13x smaller transfer than shipping bf16, and no
+        # host-side ml_dtypes cast of a third of a billion elements. In the
+        # pallas path it additionally STAYS packed in HBM.
+        n_rows = len(idx)
         y = labels[idx].astype(np.float32).reshape(-1, 1)
-        n_pad = pad_to_multiple(x.shape[0], data_dim)
-        w = _pad_rows(np.ones((x.shape[0], 1), np.float32), n_pad)
-        x = _pad_rows(x, n_pad)
-        packed = np.packbits(x.astype(bool), axis=1)   # cols pad to a byte
-        n_bytes = n_genes_pad // 8
-        if packed.shape[1] != n_bytes:
-            packed = np.concatenate(
-                [packed,
-                 np.zeros((packed.shape[0], n_bytes - packed.shape[1]), np.uint8)],
-                axis=1)
-        return (unpack_fn(ctx.put(packed, ctx.batch_spec)),
-                ctx.put(_pad_rows(y, n_pad), ctx.label_spec),
-                ctx.put(w, ctx.label_spec))
+        n_pad = pad_to_multiple(n_rows, row_multiple)
+        w = _pad_rows(np.ones((n_rows, 1), np.float32), n_pad)
+        # One zeroed buffer provides both the row and the gene padding.
+        xb = np.zeros((n_pad, n_genes_pad), dtype=bool)
+        xb[:n_rows, :n_genes] = paths[idx] != 0
+        if use_pallas:
+            packed = pm.pack_blockwise(xb)
+        else:
+            packed = np.packbits(xb, axis=1)
+        y_dev = ctx.put(_pad_rows(y, n_pad), ctx.label_spec)
+        w_dev = ctx.put(w, ctx.label_spec)
+        if use_pallas:
+            return jax.device_put(packed), y_dev, w_dev
+        return unpack_fn(ctx.put(packed, ctx.batch_spec)), y_dev, w_dev
 
     xtr, ytr, wtr = _prep(tr_idx)
     xval, yval, wval = _prep(vl_idx)
@@ -271,7 +325,9 @@ def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
     # host round trip over DEFAULT_CHUNK epochs.
     chunk = checkpoint_every if checkpoint_dir else DEFAULT_CHUNK
     chunk = max(1, min(chunk, max_epochs))
-    chunk_fn = _get_chunk_fn(learning_rate, cdtype, decision_threshold, ctx, chunk)
+    chunk_fn = _get_chunk_fn(learning_rate, cdtype, decision_threshold, ctx,
+                             chunk, packed=use_pallas,
+                             interpret=pallas_interpret)
 
     # ---- epoch loop with first-val-dip early stopping ----
     history: List[dict] = []
