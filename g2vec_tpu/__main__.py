@@ -32,6 +32,11 @@ def main(argv=None) -> int:
         import jax
 
         jax.config.update("jax_platforms", cfg.platform)
+    if cfg.distributed:
+        # Must happen before the first backend use in this process.
+        from g2vec_tpu.parallel.distributed import initialize
+
+        initialize(cfg.coordinator, cfg.process_id, cfg.num_processes)
     from g2vec_tpu.pipeline import run
 
     run(cfg)

@@ -69,6 +69,12 @@ class G2VecConfig:
     use_native_io: bool = True       # use the C++ TSV reader when available
     debug_nans: bool = False
 
+    # ---- multi-host (parallel/distributed.py) ----
+    distributed: bool = False        # join the multi-process JAX runtime
+    coordinator: Optional[str] = None    # host:port of process 0 (or env/auto)
+    process_id: Optional[int] = None
+    num_processes: Optional[int] = None
+
     def validate(self) -> None:
         if self.lenPath < 1:
             raise ValueError(f"lenPath must be >= 1, got {self.lenPath}")
@@ -151,6 +157,16 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--no-native-io", action="store_true",
                         help="Disable the C++ TSV reader.")
     parser.add_argument("--debug-nans", action="store_true")
+    # multi-host
+    parser.add_argument("--distributed", action="store_true",
+                        help="Join the multi-process JAX runtime (one process "
+                             "per host; TPU pods auto-detect the topology).")
+    parser.add_argument("--coordinator", type=str, default=None,
+                        metavar="HOST:PORT",
+                        help="Coordinator address for --distributed off-TPU "
+                             "(or env G2VEC_COORDINATOR).")
+    parser.add_argument("--process-id", type=int, default=None)
+    parser.add_argument("--num-processes", type=int, default=None)
     return parser
 
 
@@ -191,6 +207,10 @@ def config_from_args(argv=None) -> G2VecConfig:
         metrics_jsonl=args.metrics_jsonl,
         use_native_io=not args.no_native_io,
         debug_nans=args.debug_nans,
+        distributed=args.distributed,
+        coordinator=args.coordinator,
+        process_id=args.process_id,
+        num_processes=args.num_processes,
     )
     cfg.validate()
     return cfg

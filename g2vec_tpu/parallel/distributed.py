@@ -1,0 +1,161 @@
+"""Multi-host (multi-process) runtime: initialization + hybrid DCN/ICI mesh.
+
+The reference is a single process with no communication backend (SURVEY.md
+§2 "Parallelism strategies": no NCCL/MPI/Gloo anywhere in G2Vec.py). This
+framework's comm backend is JAX's: one process per host, all chips of all
+hosts in one global device list, XLA collectives compiled from sharding
+annotations — riding ICI inside a slice and DCN between slices. This module
+owns the two pieces a multi-host launch needs:
+
+1. ``initialize()`` — a thin, env-var-aware wrapper over
+   ``jax.distributed.initialize``. On TPU pods the coordinator/process
+   topology is auto-detected from the TPU metadata, so a bare
+   ``initialize()`` suffices; on CPU/GPU fleets (or forced topologies) pass
+   ``coordinator/process_id/num_processes`` or set ``G2VEC_COORDINATOR``,
+   ``G2VEC_PROCESS_ID``, ``G2VEC_NUM_PROCESSES``.
+
+2. ``make_global_mesh(data, model)`` — a ('data', 'model') mesh over ALL
+   global devices. When the mesh spans multiple slices/hosts it is built
+   with ``mesh_utils.create_hybrid_device_mesh`` so the *model* axis (the
+   gene-sharded W_ih contraction, which psums every step — see
+   parallel/mesh.py) stays inside a slice on ICI, and the *data* axis (one
+   gradient psum per step) crosses DCN. That assignment is this workload's
+   whole bandwidth story: activations-heavy collectives on the fast fabric,
+   gradient reduction on the slow one.
+
+Single-host virtual testing: ``XLA_FLAGS=--xla_force_host_platform_device_count=N``
+with ``make_global_mesh`` exercises the identical code path (SURVEY.md §4
+item 5); the driver's ``dryrun_multichip`` does exactly that.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+from g2vec_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, MeshContext
+
+_ENV_COORD = "G2VEC_COORDINATOR"
+_ENV_PID = "G2VEC_PROCESS_ID"
+_ENV_NPROC = "G2VEC_NUM_PROCESSES"
+
+_initialized = False
+
+
+def initialize(coordinator: Optional[str] = None,
+               process_id: Optional[int] = None,
+               num_processes: Optional[int] = None) -> None:
+    """Join (or bootstrap) the multi-process JAX runtime. Idempotent.
+
+    Argument > environment > auto-detection (TPU metadata). Must run before
+    the first jax backend use in the process.
+    """
+    global _initialized
+    if _initialized:
+        return
+    import jax
+
+    coordinator = coordinator or os.environ.get(_ENV_COORD)
+    if process_id is None and os.environ.get(_ENV_PID):
+        process_id = int(os.environ[_ENV_PID])
+    if num_processes is None and os.environ.get(_ENV_NPROC):
+        num_processes = int(os.environ[_ENV_NPROC])
+    kwargs = {}
+    if coordinator:
+        kwargs["coordinator_address"] = coordinator
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    try:
+        jax.distributed.initialize(**kwargs)
+    except ValueError:
+        if kwargs:
+            raise
+        # Off-TPU with nothing specified there is no cluster auto-detection;
+        # bootstrap a single-process "cluster" on localhost so --distributed
+        # is a no-op rather than an error (useful for smoke tests).
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        jax.distributed.initialize(
+            coordinator_address=f"127.0.0.1:{port}",
+            num_processes=1, process_id=0)
+    _initialized = True
+
+
+def make_global_mesh(mesh_shape: Tuple[int, int],
+                     allow_hybrid: bool = True) -> MeshContext:
+    """('data', 'model') MeshContext over all global devices.
+
+    ``mesh_shape=(data, model)`` must multiply to the global device count.
+    Multi-slice topologies get a hybrid mesh (model inside a slice on ICI,
+    data across slices on DCN); single-slice falls back to
+    ``create_device_mesh`` which picks an ICI-contiguous layout.
+    """
+    import jax
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh
+
+    data, model = mesh_shape
+    devices = jax.devices()
+    if data * model != len(devices):
+        raise ValueError(
+            f"mesh {mesh_shape} needs {data * model} devices; the global "
+            f"runtime has {len(devices)} "
+            f"(processes: {jax.process_count()})")
+    n_slices = len({getattr(d, "slice_index", 0) for d in devices})
+    if allow_hybrid and n_slices > 1:
+        if data % n_slices:
+            raise ValueError(
+                f"data axis {data} must be divisible by the slice count "
+                f"{n_slices} so the model axis stays on ICI")
+        grid = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(data // n_slices, model),
+            dcn_mesh_shape=(n_slices, 1),
+            devices=devices)
+    else:
+        grid = mesh_utils.create_device_mesh((data, model), devices=devices)
+    return MeshContext(mesh=Mesh(grid, (DATA_AXIS, MODEL_AXIS)))
+
+
+def fetch_global(arr) -> "np.ndarray":  # noqa: F821 — np imported lazily
+    """Device array -> host numpy, correct across process boundaries.
+
+    ``np.asarray``/``jax.device_get`` raise on a global array whose shards
+    live on devices other processes own (e.g. the model-sharded W_ih under
+    a multi-host mesh). This gathers the full value on every process — it
+    is a COLLECTIVE: all processes must call it, in the same order.
+    """
+    import jax
+    import numpy as np
+
+    if getattr(arr, "is_fully_addressable", True):
+        return np.asarray(jax.device_get(arr))
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+
+
+def process_info() -> dict:
+    """Who am I in the job — for logs and the metrics stream."""
+    import jax
+
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }
+
+
+def is_coordinator() -> bool:
+    """True on the process that should write outputs (process 0).
+
+    The pipeline's three text writers and the console transcript run only
+    here; worker processes compute and hold shards but do not write files.
+    """
+    import jax
+
+    return jax.process_index() == 0

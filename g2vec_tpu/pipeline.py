@@ -89,6 +89,23 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
     cfg.validate()
     if cfg.debug_nans:
         jax.config.update("jax_debug_nans", True)
+    if cfg.distributed:
+        # Worker processes compute shards but neither narrate nor write:
+        # transcript, metrics stream, profiler trace, and the three outputs
+        # all belong to the coordinator (checkpoint writes are gated inside
+        # save_state, which every process must still enter — it gathers
+        # cross-process shards collectively).
+        from g2vec_tpu.parallel.distributed import is_coordinator
+
+        if jax.process_count() > 1 and not cfg.mesh_shape:
+            raise ValueError(
+                f"--distributed with {jax.process_count()} processes needs "
+                "--mesh (e.g. --mesh 8x1); without it every process would "
+                "redundantly train the full model on one local device")
+        if not is_coordinator():
+            console = lambda s: None  # noqa: E731
+            cfg = dataclasses.replace(cfg, metrics_jsonl=None,
+                                      profile_dir=None)
 
     timer = StageTimer()
     metrics = MetricsWriter(cfg.metrics_jsonl)
@@ -153,7 +170,12 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
         console(">>> 4. Compute distributed representations using modified CBOW")
         console("     Start training the modified CBOW with early stopping")
         reporter = _EpochReporter(console, cfg.display_step)
-        mesh_ctx = make_mesh_context(cfg.mesh_shape)
+        if cfg.distributed and cfg.mesh_shape:
+            from g2vec_tpu.parallel.distributed import make_global_mesh
+
+            mesh_ctx = make_global_mesh(cfg.mesh_shape)
+        else:
+            mesh_ctx = make_mesh_context(cfg.mesh_shape)
 
         def on_epoch(step, acc_val, acc_tr, secs):
             reporter.on_epoch(step, acc_val, acc_tr, secs)
@@ -189,12 +211,19 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
                 cfg.numBiomarker, score_mix=cfg.score_mix)
 
         console(">>> 7. Save results")
+        write_outputs = True
+        if cfg.distributed:
+            from g2vec_tpu.parallel.distributed import is_coordinator
+
+            write_outputs = is_coordinator()
         with timer.stage("save"):
-            outputs = [
-                write_biomarkers(cfg.result_name, biomarkers),
-                write_lgroups(cfg.result_name, lgroup_idx, data.gene),
-                write_vectors(cfg.result_name, result.w_ih, data.gene),
-            ]
+            outputs = []
+            if write_outputs:
+                outputs = [
+                    write_biomarkers(cfg.result_name, biomarkers),
+                    write_lgroups(cfg.result_name, lgroup_idx, data.gene),
+                    write_vectors(cfg.result_name, result.w_ih, data.gene),
+                ]
         for path in outputs:
             console("    %s" % path)
         metrics.emit("done", outputs=outputs, stage_seconds=timer.as_dict())

@@ -35,12 +35,21 @@ RUN_EARLY_STOPPED = 2  # first val-accuracy dip
 def save_state(directory: str, params: Any, opt_state: Any, snapshot: Any,
                epoch: int, before_val: float, before_tr: float,
                done: int = RUN_IN_PROGRESS) -> str:
-    """Atomically write the full trainer state under ``directory``."""
-    os.makedirs(directory, exist_ok=True)
+    """Atomically write the full trainer state under ``directory``.
+
+    Multi-host safe: gathering the (possibly cross-process-sharded) leaves
+    is a collective every process performs; only process 0 touches the
+    filesystem, so N hosts on a shared checkpoint_dir never race.
+    """
+    from g2vec_tpu.parallel.distributed import fetch_global
+
     leaves, _ = jax.tree_util.tree_flatten((params, opt_state, snapshot))
-    arrays = {f"leaf_{i}": np.asarray(leaf) for i, leaf in enumerate(leaves)}
+    arrays = {f"leaf_{i}": fetch_global(leaf) for i, leaf in enumerate(leaves)}
     arrays["meta"] = np.array([float(epoch), before_val, before_tr, float(done)])
     path = os.path.join(directory, CKPT_NAME)
+    if jax.process_index() != 0:
+        return path
+    os.makedirs(directory, exist_ok=True)
     tmp = path + ".tmp"
     np.savez(tmp, **arrays)
     # np.savez appends .npz to names without it.

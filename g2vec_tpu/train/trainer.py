@@ -373,8 +373,9 @@ def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
                 # post-dip, the snapshot pre-dip), and a completed run with
                 # no additional epoch budget has nothing to do. A completed
                 # run CAN continue when max_epochs was raised.
-                w_ih = np.asarray(jax.device_get(snapshot.w_ih),
-                                  dtype=np.float32)[:n_genes]
+                from g2vec_tpu.parallel.distributed import fetch_global
+
+                w_ih = fetch_global(snapshot.w_ih).astype(np.float32)[:n_genes]
                 return TrainResult(
                     w_ih=w_ih, stop_epoch=last_epoch,
                     stopped_early=(done == RUN_EARLY_STOPPED),
@@ -417,7 +418,9 @@ def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
                    stop_epoch if stopped_early else max_epochs - 1,
                    before_val, before_tr,
                    done=RUN_EARLY_STOPPED if stopped_early else RUN_COMPLETED)
-    w_ih = np.asarray(jax.device_get(snapshot.w_ih), dtype=np.float32)[:n_genes]
+    from g2vec_tpu.parallel.distributed import fetch_global
+
+    w_ih = fetch_global(snapshot.w_ih).astype(np.float32)[:n_genes]
     return TrainResult(w_ih=w_ih, stop_epoch=stop_epoch,
                        stopped_early=stopped_early,
                        acc_val=before_val, acc_tr=before_tr,

@@ -1,0 +1,81 @@
+"""Multi-host runtime helpers (parallel/distributed.py).
+
+True multi-process runs need multiple hosts; here we validate everything
+that can be validated in-process: global-mesh construction over the 8
+virtual CPU devices, shape/divisibility errors, env-var plumbing, and the
+coordinator gate. SURVEY.md §4 item 5 is the testing strategy.
+"""
+import numpy as np
+import pytest
+
+from g2vec_tpu.parallel import distributed as dist
+from g2vec_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+
+def test_make_global_mesh_shapes():
+    ctx = dist.make_global_mesh((4, 2))
+    assert ctx.mesh.shape[DATA_AXIS] == 4
+    assert ctx.mesh.shape[MODEL_AXIS] == 2
+    assert ctx.n_devices == 8
+
+
+def test_make_global_mesh_wrong_count():
+    with pytest.raises(ValueError, match="needs 6 devices"):
+        dist.make_global_mesh((3, 2))
+
+
+def test_global_mesh_trains(rng):
+    """A train step over the global mesh — same path dryrun_multichip uses."""
+    from g2vec_tpu.train.trainer import train_cbow
+
+    paths = (rng.random((48, 40)) < 0.2).astype(np.int8)
+    labels = (rng.random(48) < 0.5).astype(np.int32)
+    ctx = dist.make_global_mesh((2, 4))
+    res = train_cbow(paths, labels, hidden=16, learning_rate=0.01,
+                     max_epochs=2, compute_dtype="float32", seed=0,
+                     mesh_ctx=ctx)
+    assert res.w_ih.shape == (40, 16)
+    assert np.isfinite(res.w_ih).all()
+
+
+def test_initialize_env_plumbing(monkeypatch):
+    """initialize() must read G2VEC_* env vars; we intercept the jax call."""
+    import jax
+
+    captured = {}
+
+    def fake_init(**kwargs):
+        captured.update(kwargs)
+
+    monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+    monkeypatch.setattr(dist, "_initialized", False)
+    monkeypatch.setenv("G2VEC_COORDINATOR", "10.0.0.1:1234")
+    monkeypatch.setenv("G2VEC_PROCESS_ID", "3")
+    monkeypatch.setenv("G2VEC_NUM_PROCESSES", "8")
+    dist.initialize()
+    assert captured == {"coordinator_address": "10.0.0.1:1234",
+                        "process_id": 3, "num_processes": 8}
+    # Idempotent: a second call must not re-initialize.
+    captured.clear()
+    dist.initialize()
+    assert captured == {}
+    monkeypatch.setattr(dist, "_initialized", False)
+
+
+def test_process_info_and_coordinator_single_process():
+    info = dist.process_info()
+    assert info["process_index"] == 0
+    assert info["process_count"] == 1
+    assert dist.is_coordinator()
+
+
+def test_cli_flags_parse():
+    from g2vec_tpu.config import config_from_args
+
+    cfg = config_from_args([
+        "e.txt", "c.txt", "n.txt", "out", "--distributed",
+        "--coordinator", "host:99", "--process-id", "1",
+        "--num-processes", "4", "--mesh", "2x2"])
+    assert cfg.distributed and cfg.coordinator == "host:99"
+    assert cfg.process_id == 1 and cfg.num_processes == 4
+    assert cfg.mesh_shape == (2, 2)
