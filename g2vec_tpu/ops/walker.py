@@ -203,15 +203,13 @@ def unpack_paths(packed: Sequence[bytes], n_genes: int) -> np.ndarray:
     ~340 MB this way; every consumer re-casts anyway (the trainer to its
     compute dtype, the frequency vote through numpy's promoting sum).
     """
-    if not packed:
-        return np.zeros((0, n_genes), dtype=np.uint8)
-    rows = np.frombuffer(b"".join(sorted(packed)), dtype=np.uint8)
-    rows = rows.reshape(len(packed), -1)
+    rows = _packed_rows(packed, n_genes)
     return np.unpackbits(rows, axis=1)[:, :n_genes]
 
 
 def integrate_path_sets(path_set_good: Set[bytes], path_set_poor: Set[bytes],
-                        n_genes: int) -> Tuple[np.ndarray, np.ndarray]:
+                        n_genes: int, packed: bool = False,
+                        ) -> Tuple[np.ndarray, np.ndarray]:
     """Drop paths common to both groups; return (multi-hot, labels).
 
     Reference: integrate_pathSet (G2Vec.py:310-322) — a path gene-set present
@@ -221,10 +219,16 @@ def integrate_path_sets(path_set_good: Set[bytes], path_set_poor: Set[bytes],
     (paths, labels), not a glued matrix). Row order: good block then poor
     block, each sorted by packed bytes (the reference iterates Python-set
     order — nondeterministic; we pin it).
+
+    ``packed=True`` returns the paths still bit-packed ([N, ceil(G/8)]
+    uint8, np.packbits layout) — the scalable form the pipeline feeds
+    straight to the trainer: the dense uint8 [N, G] matrix is never
+    materialized on host (8x smaller at any scale).
     """
     common = path_set_good & path_set_poor
-    good = unpack_paths(path_set_good - common, n_genes)
-    poor = unpack_paths(path_set_poor - common, n_genes)
+    fn = _packed_rows if packed else unpack_paths
+    good = fn(path_set_good - common, n_genes)
+    poor = fn(path_set_poor - common, n_genes)
     paths = np.concatenate([good, poor], axis=0)
     labels = np.concatenate([
         np.zeros(good.shape[0], dtype=np.int32),
@@ -232,17 +236,48 @@ def integrate_path_sets(path_set_good: Set[bytes], path_set_poor: Set[bytes],
     return paths, labels
 
 
+def _packed_rows(packed: Set[bytes], n_genes: int) -> np.ndarray:
+    """Set of packed rows -> [N, ceil(G/8)] uint8 (sorted for determinism)."""
+    nb = (n_genes + 7) // 8
+    if not packed:
+        return np.zeros((0, nb), dtype=np.uint8)
+    rows = np.frombuffer(b"".join(sorted(packed)), dtype=np.uint8)
+    return rows.reshape(len(packed), nb)
+
+
 def count_gene_freq(paths: np.ndarray, labels: np.ndarray,
-                    genes: Sequence[str]) -> Dict[str, int]:
+                    genes: Sequence[str], packed: bool = False,
+                    ) -> Dict[str, int]:
     """Per-gene majority vote over the integrated path set.
 
     Reference: count_geneFreq (G2Vec.py:288-308) — for each gene appearing in
     at least one path, count good vs poor paths containing it; majority ->
     0/1, tie -> 2. Genes in no path are absent from the dict (callers default
     them to 2, ref: G2Vec.py:172).
+
+    With ``packed=True``, ``paths`` is the bit-packed [N, ceil(G/8)] uint8
+    form (integrate_path_sets(packed=True)); rows are expanded in bounded
+    chunks so the dense matrix never materializes whole.
     """
-    good_counts = paths[labels == 0].sum(axis=0)
-    poor_counts = paths[labels == 1].sum(axis=0)
+    n_genes = len(genes)
+    if packed:
+        if paths.shape[1] != (n_genes + 7) // 8:
+            raise ValueError(
+                f"packed paths width {paths.shape[1]} inconsistent with "
+                f"{n_genes} genes (expected {(n_genes + 7) // 8})")
+
+        def colsum(block):
+            total = np.zeros(n_genes, dtype=np.int64)
+            for lo in range(0, block.shape[0], 4096):
+                rows = np.unpackbits(block[lo:lo + 4096], axis=1)[:, :n_genes]
+                total += rows.sum(axis=0, dtype=np.int64)
+            return total
+
+        good_counts = colsum(paths[labels == 0])
+        poor_counts = colsum(paths[labels == 1])
+    else:
+        good_counts = paths[labels == 0].sum(axis=0)
+        poor_counts = paths[labels == 1].sum(axis=0)
     result: Dict[str, int] = {}
     for i, g in enumerate(genes):
         fg, fp = int(good_counts[i]), int(poor_counts[i])

@@ -154,8 +154,12 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
                 path_sets.append(generate_path_set(
                     table, jax.random.fold_in(key, i), len_path=cfg.lenPath,
                     reps=cfg.numRepetition, walker_batch=cfg.walker_batch))
-            paths, labels = integrate_path_sets(path_sets[0], path_sets[1], n_genes)
-            gene_freq = count_gene_freq(paths, labels, data.gene)
+            # Paths stay bit-packed from the walker all the way into the
+            # trainer — the dense uint8 [n_paths, n_genes] matrix never
+            # materializes on the host (8x smaller at any scale).
+            paths, labels = integrate_path_sets(path_sets[0], path_sets[1],
+                                                n_genes, packed=True)
+            gene_freq = count_gene_freq(paths, labels, data.gene, packed=True)
         n_paths = paths.shape[0]
         if n_paths < 2:
             raise ValueError(
@@ -183,7 +187,7 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
 
         with timer.stage("train"):
             result = train_cbow(
-                paths, labels,
+                paths, labels, packed_genes=n_genes,
                 hidden=cfg.sizeHiddenlayer, learning_rate=cfg.learningRate,
                 max_epochs=cfg.epoch, val_fraction=cfg.val_fraction,
                 decision_threshold=cfg.decision_threshold,

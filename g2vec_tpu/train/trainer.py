@@ -209,10 +209,14 @@ def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
                on_epoch: Optional[Callable[[int, float, float, float], None]] = None,
                checkpoint_dir: Optional[str] = None, resume: bool = False,
                checkpoint_every: int = 25, use_pallas: Optional[bool] = None,
+               packed_genes: Optional[int] = None,
                ) -> TrainResult:
     """Train the modified CBOW; returns the embedding table and history.
 
-    ``paths``: [n_paths, n_genes] multi-hot (any integer/float dtype);
+    ``paths``: [n_paths, n_genes] multi-hot (any integer/float dtype) — or,
+    with ``packed_genes=G``, the bit-packed [n_paths, ceil(G/8)] uint8 form
+    (np.packbits layout, e.g. from ``integrate_path_sets(packed=True)``);
+    the dense matrix is then never materialized whole on the host.
     ``labels``: [n_paths] in {0, 1}. ``on_epoch(step, acc_val, acc_tr, secs)``
     fires every epoch so the CLI can render the reference's log cadence.
     """
@@ -221,7 +225,15 @@ def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
     ctx = mesh_ctx if mesh_ctx is not None else make_mesh_context(None)
     cdtype = jnp.bfloat16 if compute_dtype == "bfloat16" else jnp.float32
     pdtype = jnp.float32 if param_dtype == "float32" else jnp.bfloat16
-    n_paths, n_genes = paths.shape
+    if packed_genes is not None:
+        n_paths, nb_in = paths.shape
+        n_genes = packed_genes
+        if nb_in != (n_genes + 7) // 8 or paths.dtype != np.uint8:
+            raise ValueError(
+                f"packed_genes={n_genes} expects uint8 paths of width "
+                f"{(n_genes + 7) // 8}, got {paths.dtype} width {nb_in}")
+    else:
+        n_paths, n_genes = paths.shape
 
     # ---- shuffled hold-out split (ref: G2Vec.py:219-226) ----
     rng = np.random.default_rng(seed)
@@ -294,13 +306,28 @@ def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
         y = labels[idx].astype(np.float32).reshape(-1, 1)
         n_pad = pad_to_multiple(n_rows, row_multiple)
         w = _pad_rows(np.ones((n_rows, 1), np.float32), n_pad)
-        # One zeroed buffer provides both the row and the gene padding.
-        xb = np.zeros((n_pad, n_genes_pad), dtype=bool)
-        xb[:n_rows, :n_genes] = paths[idx] != 0
-        if use_pallas:
-            packed = pm.pack_blockwise(xb)
+        # Repack row chunks into the device layout; host temp memory stays
+        # bounded (one chunk of dense bools) even at pod-scale path counts.
+        packed = np.zeros((n_pad, n_genes_pad // 8), dtype=np.uint8)
+        if (packed_genes is not None and not use_pallas
+                and paths.shape[1] == n_genes_pad // 8):
+            # Input packbits layout == device layout (single-chip XLA path):
+            # no bit round-trip at all, just a row gather.
+            packed[:n_rows] = paths[idx]
         else:
-            packed = np.packbits(xb, axis=1)
+            chunk_rows = 8192
+            for lo in range(0, n_rows, chunk_rows):
+                sel = idx[lo:lo + chunk_rows]
+                if packed_genes is not None:
+                    rows = np.unpackbits(paths[sel], axis=1)[:, :n_genes] != 0
+                else:
+                    rows = paths[sel] != 0
+                # One zeroed buffer provides the gene padding.
+                xb = np.zeros((len(sel), n_genes_pad), dtype=bool)
+                xb[:, :n_genes] = rows
+                packed[lo:lo + len(sel)] = (
+                    pm.pack_blockwise(xb) if use_pallas
+                    else np.packbits(xb, axis=1))
         y_dev = ctx.put(_pad_rows(y, n_pad), ctx.label_spec)
         w_dev = ctx.put(w, ctx.label_spec)
         if use_pallas:

@@ -143,3 +143,45 @@ def test_single_node_paths_when_no_edges():
     assert len(paths) == n       # each start is its own singleton path
     arr = unpack_paths(sorted(paths), n)
     assert (arr.sum(axis=1) == 1).all()
+
+
+def test_integrate_packed_matches_dense(rng):
+    n = 20
+    rows = [(rng.random(n) < 0.3).astype(np.uint8) for _ in range(12)]
+    good = {np.packbits(r).tobytes() for r in rows[:8]}
+    poor = {np.packbits(r).tobytes() for r in rows[5:]}   # overlap -> dropped
+    dense, lab_d = integrate_path_sets(good, poor, n)
+    packed, lab_p = integrate_path_sets(good, poor, n, packed=True)
+    assert np.array_equal(lab_d, lab_p)
+    assert packed.dtype == np.uint8 and packed.shape[1] == (n + 7) // 8
+    assert np.array_equal(np.unpackbits(packed, axis=1)[:, :n], dense)
+
+
+def test_count_gene_freq_packed_matches_dense(rng):
+    n = 37
+    genes = [f"G{i}" for i in range(n)]
+    dense = (rng.random((50, n)) < 0.2).astype(np.uint8)
+    labels = (rng.random(50) < 0.5).astype(np.int32)
+    packed = np.packbits(dense, axis=1)
+    assert count_gene_freq(packed, labels, genes, packed=True) == \
+        count_gene_freq(dense, labels, genes)
+    with pytest.raises(ValueError, match="inconsistent"):
+        count_gene_freq(packed, labels, genes + ["EXTRA"] * 30, packed=True)
+
+
+def test_trainer_accepts_packed_paths(rng):
+    from g2vec_tpu.train.trainer import train_cbow
+
+    n_paths, n_genes = 64, 90
+    dense = (rng.random((n_paths, n_genes)) < 0.2).astype(np.int8)
+    labels = (rng.random(n_paths) < 0.5).astype(np.int32)
+    packed = np.packbits(dense != 0, axis=1)
+    common = dict(hidden=16, learning_rate=0.01, max_epochs=3,
+                  compute_dtype="float32", seed=1)
+    res_d = train_cbow(dense, labels, **common)
+    res_p = train_cbow(packed, labels, packed_genes=n_genes, **common)
+    np.testing.assert_allclose(res_p.w_ih, res_d.w_ih, atol=1e-6)
+
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="packed_genes"):
+        train_cbow(packed, labels, packed_genes=n_genes + 99, **common)
