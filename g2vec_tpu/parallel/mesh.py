@@ -63,6 +63,12 @@ class MeshContext:
         """Walker state [walkers, ...]: DP over walkers."""
         return P(DATA_AXIS, None)
 
+    @property
+    def packed_batch_spec(self) -> P:
+        """Bit-packed path batch [paths, bytes]: rows over 'data', the byte
+        axis never sharded (the Pallas kernel consumes whole rows)."""
+        return P(DATA_AXIS, None)
+
     # ---- helpers ----
     def sharding(self, spec: P) -> Optional[NamedSharding]:
         if self.mesh is None:

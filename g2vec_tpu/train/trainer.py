@@ -83,8 +83,25 @@ def _make_chunk_fn(tx: optax.GradientTransformation, compute_dtype,
         # Pallas path: ``x`` is the bit-packed [rows, n_genes/8] uint8 matrix
         # in pack_blockwise layout; the fused kernel unpacks tiles in VMEM
         # (ops/packed_matmul.py) — 16x less HBM traffic than a dense bf16 X.
+        # Under a data-parallel mesh the kernel runs per row shard inside
+        # shard_map with W replicated; shard_map's transpose psums the
+        # per-shard dW cotangents over 'data' automatically.
+        def _packed_h(x, w_ih):
+            if ctx.mesh is None:
+                return pm.packed_matmul(x, w_ih, interpret)
+            from jax.sharding import PartitionSpec as P
+
+            return jax.shard_map(
+                lambda xs, w: pm.packed_matmul(xs, w, interpret),
+                mesh=ctx.mesh,
+                in_specs=(ctx.packed_batch_spec, P(None, None)),
+                out_specs=ctx.hidden_spec,
+                # pallas_call's out_shape carries no varying-axes info;
+                # the specs above are the full contract.
+                check_vma=False)(x, w_ih)
+
         def logits_fn(params, x):
-            h = pm.packed_matmul(x, params.w_ih.astype(compute_dtype), interpret)
+            h = _packed_h(x, params.w_ih.astype(compute_dtype))
             return output_logits(h, params.w_ho, compute_dtype)
     else:
         def logits_fn(params, x):
@@ -266,16 +283,18 @@ def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
     # auto-detects; True forces it (tests use interpret mode off-TPU).
     if use_pallas is None:
         use_pallas = (
-            ctx.mesh is None and compute_dtype == "bfloat16"
+            model_dim == 1 and compute_dtype == "bfloat16"
             and jax.default_backend() == "tpu"
             and pm.packed_matmul_available(
                 n_paths, pad_to_multiple(n_genes, pm.LANE_BLOCK), hidden))
     elif use_pallas:
         # Forced on (tests / power users): enforce the same preconditions the
-        # auto-detect checks, loudly — the kernel is single-chip and bf16.
-        if ctx.mesh is not None:
-            raise ValueError("use_pallas=True is single-chip only; it cannot "
-                             "be combined with a device mesh")
+        # auto-detect checks, loudly — the kernel shards rows (DP), never the
+        # gene axis, and computes in bf16.
+        if model_dim != 1:
+            raise ValueError(
+                "use_pallas=True runs per row shard (data parallel); it "
+                f"cannot gene-shard — use a Dx1 mesh, got model dim {model_dim}")
         if compute_dtype != "bfloat16":
             raise ValueError("use_pallas=True requires compute_dtype="
                              "'bfloat16' (the kernel computes in bf16)")
@@ -285,9 +304,10 @@ def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
     pallas_interpret = use_pallas and jax.default_backend() != "tpu"
 
     if use_pallas:
-        # Gene axis pads to the kernel's lane block; rows to its row tile.
+        # Gene axis pads to the kernel's lane block; rows to a full row tile
+        # on EVERY data shard.
         n_genes_pad = pad_to_multiple(n_genes, pm.LANE_BLOCK)
-        row_multiple = pm.ROW_BLOCK
+        row_multiple = pm.ROW_BLOCK * data_dim
     else:
         # Gene axis pads to a multiple of 8*model_dim so the PACKED byte
         # columns split evenly over the model axis and byte boundaries
@@ -331,7 +351,7 @@ def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
         y_dev = ctx.put(_pad_rows(y, n_pad), ctx.label_spec)
         w_dev = ctx.put(w, ctx.label_spec)
         if use_pallas:
-            return jax.device_put(packed), y_dev, w_dev
+            return ctx.put(packed, ctx.packed_batch_spec), y_dev, w_dev
         return unpack_fn(ctx.put(packed, ctx.batch_spec)), y_dev, w_dev
 
     xtr, ytr, wtr = _prep(tr_idx)

@@ -93,6 +93,12 @@ def test_trainer_pallas_parity(rng):
                   compute_dtype="bfloat16", seed=3)
     res_p = train_cbow(paths, labels, use_pallas=True, **common)
     res_x = train_cbow(paths, labels, use_pallas=False, **common)
+    # Packed input + pallas is the production TPU combination the pipeline
+    # drives (packed_genes routes through the chunked blockwise repack).
+    packed_in = np.packbits(paths != 0, axis=1)
+    res_pp = train_cbow(packed_in, labels, use_pallas=True,
+                        packed_genes=n_genes, **common)
+    np.testing.assert_array_equal(res_pp.w_ih, res_p.w_ih)
     assert res_p.w_ih.shape == res_x.w_ih.shape == (n_genes, 128)
     # Same seed, same split, same math up to bf16 rounding order: the
     # trajectories must agree closely for the first few epochs.
@@ -100,3 +106,37 @@ def test_trainer_pallas_parity(rng):
         assert abs(hp["loss"] - hx["loss"]) < 0.05
         assert abs(hp["acc_tr"] - hx["acc_tr"]) < 0.12
     np.testing.assert_allclose(res_p.w_ih, res_x.w_ih, atol=0.05)
+
+
+def test_trainer_pallas_dp_mesh_parity(rng):
+    """Packed kernel under a 4x1 data-parallel mesh (shard_map + interpret)
+    tracks the single-device pallas run."""
+    from g2vec_tpu.parallel.mesh import make_mesh_context
+    from g2vec_tpu.train.trainer import train_cbow
+
+    n_paths, n_genes = 80, 300
+    paths = (rng.random((n_paths, n_genes)) < 0.15).astype(np.int8)
+    labels = (paths[:, :30].sum(axis=1) > paths[:, 30:60].sum(axis=1)
+              ).astype(np.int32)
+    common = dict(hidden=128, learning_rate=0.01, max_epochs=4,
+                  compute_dtype="bfloat16", seed=5, use_pallas=True)
+    res_one = train_cbow(paths, labels, **common)
+    # Packed input through the DP mesh — the multi-chip production path.
+    packed_in = np.packbits(paths != 0, axis=1)
+    res_dp = train_cbow(packed_in, labels, packed_genes=n_genes,
+                        mesh_ctx=make_mesh_context((4, 1)), **common)
+    np.testing.assert_allclose(res_dp.w_ih, res_one.w_ih, atol=0.05)
+    for h1, h2 in zip(res_one.history, res_dp.history):
+        assert abs(h1["loss"] - h2["loss"]) < 0.05
+
+
+def test_trainer_pallas_rejects_gene_sharding(rng):
+    from g2vec_tpu.parallel.mesh import make_mesh_context
+    from g2vec_tpu.train.trainer import train_cbow
+
+    paths = (rng.random((16, 64)) < 0.2).astype(np.int8)
+    labels = (rng.random(16) < 0.5).astype(np.int32)
+    with pytest.raises(ValueError, match="gene-shard"):
+        train_cbow(paths, labels, hidden=128, learning_rate=0.01,
+                   max_epochs=1, compute_dtype="bfloat16", seed=0,
+                   use_pallas=True, mesh_ctx=make_mesh_context((4, 2)))

@@ -182,6 +182,5 @@ def test_trainer_accepts_packed_paths(rng):
     res_p = train_cbow(packed, labels, packed_genes=n_genes, **common)
     np.testing.assert_allclose(res_p.w_ih, res_d.w_ih, atol=1e-6)
 
-    import pytest as _pytest
-    with _pytest.raises(ValueError, match="packed_genes"):
+    with pytest.raises(ValueError, match="packed_genes"):
         train_cbow(packed, labels, packed_genes=n_genes + 99, **common)
