@@ -144,7 +144,8 @@ def random_walks_sparse(nbr_idx: jax.Array, nbr_w: jax.Array,
 
 def generate_path_set(adj, key: jax.Array, *, len_path: int, reps: int,
                       starts: Optional[np.ndarray] = None,
-                      walker_batch: int = 0) -> Set[bytes]:
+                      walker_batch: int = 0,
+                      mesh_ctx=None) -> Set[bytes]:
     """All-sources x reps walks -> set of packed multi-hot path rows.
 
     Mirrors generate_pathSet (G2Vec.py:324-352): every gene is a start node,
@@ -164,16 +165,29 @@ def generate_path_set(adj, key: jax.Array, *, len_path: int, reps: int,
     memory knob never changes which paths a given --seed produces. (It is
     NOT invariant to the dense/sparse choice — the two draw differently
     shaped Gumbel noise — but each is deterministic per seed.)
+
+    ``mesh_ctx``: walkers are embarrassingly data-parallel — with a mesh the
+    walker axis shards over 'data' (tables replicated; the compiled program
+    has zero collectives). Result-invariant vs single-device: shard padding
+    walkers are dropped host-side and each walker's PRNG stream is its own.
     """
+    from jax.sharding import PartitionSpec as P
+
+    from g2vec_tpu.parallel.mesh import (DATA_AXIS, MeshContext,
+                                         pad_to_multiple)
+
     sparse = isinstance(adj, tuple)
+    ctx = mesh_ctx if mesh_ctx is not None else MeshContext(mesh=None)
+    data_dim = 1 if ctx.mesh is None else ctx.mesh.shape[DATA_AXIS]
+    walker_spec = P(DATA_AXIS)           # 1-D walker axis, rows over 'data'
     if sparse:
         nbr_idx, nbr_w = adj
         n_genes = int(nbr_idx.shape[0])
-        table = (jax.device_put(jnp.asarray(nbr_idx, dtype=jnp.int32)),
-                 jax.device_put(jnp.asarray(nbr_w, dtype=jnp.float32)))
+        table = (ctx.put(jnp.asarray(nbr_idx, dtype=jnp.int32), P()),
+                 ctx.put(jnp.asarray(nbr_w, dtype=jnp.float32), P()))
     else:
         n_genes = int(adj.shape[0])
-        table = jax.device_put(jnp.asarray(adj, dtype=jnp.float32))
+        table = ctx.put(jnp.asarray(adj, dtype=jnp.float32), P())
     if starts is None:
         starts = np.arange(n_genes, dtype=np.int32)
     starts = np.asarray(starts, dtype=np.int32)
@@ -184,14 +198,29 @@ def generate_path_set(adj, key: jax.Array, *, len_path: int, reps: int,
         all_keys = jax.vmap(lambda i: jax.random.fold_in(rep_key, i))(
             jnp.arange(starts.size))
         for lo in range(0, starts.size, batch):
-            chunk = jnp.asarray(starts[lo:lo + batch])
+            chunk = starts[lo:lo + batch]
             chunk_keys = all_keys[lo:lo + batch]
+            n_real = chunk.size
+            # Shard-even padding: duplicate walker 0, drop its rows after.
+            n_pad = pad_to_multiple(n_real, data_dim)
+            if n_pad != n_real:
+                chunk = np.concatenate(
+                    [chunk, np.repeat(chunk[:1], n_pad - n_real)])
+                chunk_keys = jnp.concatenate(
+                    [chunk_keys,
+                     jnp.repeat(chunk_keys[:1], n_pad - n_real, axis=0)])
+            chunk = ctx.put(jnp.asarray(chunk), walker_spec)
+            chunk_keys = ctx.put(chunk_keys, walker_spec)
             if sparse:
                 visited = random_walks_sparse(table[0], table[1], chunk,
                                               chunk_keys, len_path)
             else:
                 visited = random_walks(table, chunk, chunk_keys, len_path)
-            packed = np.packbits(np.asarray(visited), axis=1)
+            # fetch_global, not np.asarray: under a multi-process mesh the
+            # visited rows span devices other processes own.
+            from g2vec_tpu.parallel.distributed import fetch_global
+
+            packed = np.packbits(fetch_global(visited)[:n_real], axis=1)
             paths.update(row.tobytes() for row in packed)
     return paths
 

@@ -142,6 +142,12 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
         console(">>> 3. Generate random paths from each group")
         console("    *** most time consuming step ***")
         key = jax.random.key(cfg.seed)
+        if cfg.distributed and cfg.mesh_shape:
+            from g2vec_tpu.parallel.distributed import make_global_mesh
+
+            mesh_ctx = make_global_mesh(cfg.mesh_shape)
+        else:
+            mesh_ctx = make_mesh_context(cfg.mesh_shape)
         path_sets = []
         with timer.stage("paths"):
             for i, group in enumerate(["g", "p"]):
@@ -153,7 +159,8 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
                 table = neighbor_table(s_k, d_k, w_k, n_genes)
                 path_sets.append(generate_path_set(
                     table, jax.random.fold_in(key, i), len_path=cfg.lenPath,
-                    reps=cfg.numRepetition, walker_batch=cfg.walker_batch))
+                    reps=cfg.numRepetition, walker_batch=cfg.walker_batch,
+                    mesh_ctx=mesh_ctx))
             # Paths stay bit-packed from the walker all the way into the
             # trainer — the dense uint8 [n_paths, n_genes] matrix never
             # materializes on the host (8x smaller at any scale).
@@ -174,12 +181,6 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
         console(">>> 4. Compute distributed representations using modified CBOW")
         console("     Start training the modified CBOW with early stopping")
         reporter = _EpochReporter(console, cfg.display_step)
-        if cfg.distributed and cfg.mesh_shape:
-            from g2vec_tpu.parallel.distributed import make_global_mesh
-
-            mesh_ctx = make_global_mesh(cfg.mesh_shape)
-        else:
-            mesh_ctx = make_mesh_context(cfg.mesh_shape)
 
         def on_epoch(step, acc_val, acc_tr, secs):
             reporter.on_epoch(step, acc_val, acc_tr, secs)

@@ -184,3 +184,26 @@ def test_trainer_accepts_packed_paths(rng):
 
     with pytest.raises(ValueError, match="packed_genes"):
         train_cbow(packed, labels, packed_genes=n_genes + 99, **common)
+
+
+def test_path_set_invariant_to_mesh(rng):
+    """Sharded walkers (4x1 mesh) produce the exact same path set as a
+    single device for the same seed — including when walker counts don't
+    divide the data axis (pad walkers are dropped)."""
+    from g2vec_tpu.ops.graph import neighbor_table
+    from g2vec_tpu.parallel.mesh import make_mesh_context
+
+    n = 30
+    src = rng.integers(0, n, 200).astype(np.int32)
+    dst = rng.integers(0, n, 200).astype(np.int32)
+    w = rng.random(200).astype(np.float32) + 0.1
+    table = neighbor_table(src, dst, w, n)
+    key = jax.random.key(11)
+    kwargs = dict(len_path=6, reps=2, starts=np.arange(n, dtype=np.int32))
+    base = generate_path_set(table, key, **kwargs)
+    meshed = generate_path_set(table, key, mesh_ctx=make_mesh_context((4, 1)),
+                               **kwargs)
+    assert base == meshed
+    batched = generate_path_set(table, key, walker_batch=7,
+                                mesh_ctx=make_mesh_context((4, 1)), **kwargs)
+    assert base == batched
