@@ -1,36 +1,56 @@
-"""Benchmark: modified-CBOW training throughput at the bundled-example scale.
+"""Benchmark: training + walker throughput at the bundled-example scale.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints TWO JSON lines (the headline first), each
+``{"metric", "value", "unit", "vs_baseline", ...}``:
 
-Workload (matched to the reference's example transcript, README.md:26-41 and
-BASELINE.md): full-batch training of the two-matmul CBOW classifier on a
-45,402 x 7,523 multi-hot path matrix, hidden=128 — each epoch is one
-fwd+bwd+Adam step over the whole 80% train split plus TWO full forward
-accuracy evals (val and train), exactly the reference's per-epoch work
-(ref: G2Vec.py:264-267).
+1. ``cbow_train_paths_per_sec_per_chip`` — full-batch training of the
+   two-matmul CBOW classifier on a 45,402 x 7,523 multi-hot path matrix,
+   hidden=128. Each epoch is one fwd+bwd+Adam step over the whole 80% train
+   split plus TWO full forward accuracy evals (val and train), exactly the
+   reference's per-epoch work (ref: G2Vec.py:264-267). Baseline: the
+   reference transcript's ~2.2 s/epoch steady state (README.md:36-40,
+   BASELINE.md) with 36,321 train paths -> ~16.5k paths/s.
+2. ``walker_walks_per_sec`` — stage 3, the reference's self-declared "most
+   time consuming step" (ref: G2Vec.py:58): weighted no-revisit random walks
+   (lenPath=80) from every gene of the REAL bundled network
+   (``/root/reference/ex_NETWORK.txt``, 9.9k genes / 299k edges; synthetic
+   scale-matched fallback when the mount is absent), sparse neighbor-table
+   walker on device. Baseline: a bounded in-process run of the reference's
+   own per-node Python/NumPy walk loop (deepcopy + np.random.choice per
+   step, ref: G2Vec.py:328-346) on this host, extrapolated to walks/s — the
+   reference publishes no walker timing, so its own algorithm on the bench
+   machine is the fairest anchor.
 
-Baseline: the reference's transcript reports ~2.2 s/epoch steady-state on
-its (unstated) CPU with 36,321 train paths -> ~16.5k paths/s. vs_baseline
-is our paths/s over that number.
-
-The data is synthetic (the bundled expression matrix is stripped from the
-mount — BASELINE.md note) with planted group structure so the accuracy
-trajectory is non-trivial; throughput does not depend on the data values.
+Robustness (round-1 postmortem, VERDICT.md): the TPU tunnel can be down or
+wedge indefinitely, and a raw crash/hang costs the round its only perf
+artifact. So this script is a thin orchestrator that never imports jax
+itself: it first PROBES the backend in a subprocess with a hard timeout
+(retrying a flaky tunnel), then runs the measurement in a second bounded
+subprocess. Every failure path prints a JSON-parseable error line and exits
+nonzero within seconds of the deadline.
 """
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
-import numpy as np
-
-# Reference transcript numbers (README.md:26-41, see BASELINE.md).
-N_PATHS = 45402
-N_GENES = 7523
-HIDDEN = 128
+# Reference transcript numbers (README.md:26-41, see BASELINE.md). The env
+# overrides exist for smoke-testing the bench plumbing at toy scale (CI /
+# CPU); driver runs use the defaults.
+N_PATHS = int(os.environ.get("G2VEC_BENCH_N_PATHS", "45402"))
+N_GENES = int(os.environ.get("G2VEC_BENCH_N_GENES", "7523"))
+HIDDEN = int(os.environ.get("G2VEC_BENCH_HIDDEN", "128"))
 VAL_FRACTION = 0.2
 BASELINE_EPOCH_SECONDS = 2.2
 BASELINE_PATHS_PER_SEC = int(N_PATHS * (1 - VAL_FRACTION)) / BASELINE_EPOCH_SECONDS
+
+# Walker workload: every gene of the real network, reference CLI defaults.
+LEN_PATH = int(os.environ.get("G2VEC_BENCH_LEN_PATH", "80"))
+WALKER_REPS = int(os.environ.get("G2VEC_BENCH_WALKER_REPS", "10"))
+REFERENCE_NETWORK = "/root/reference/ex_NETWORK.txt"
 
 # The trainer runs epochs in device-resident chunks of DEFAULT_CHUNK (=64)
 # epochs per dispatch; per-epoch times inside a chunk are uniform. The first
@@ -38,13 +58,87 @@ BASELINE_PATHS_PER_SEC = int(N_PATHS * (1 - VAL_FRACTION)) / BASELINE_EPOCH_SECO
 # matrix, so steady state is read from the chunks after it. A separate
 # warmup call compiles the chunk program (the jit cache is shared across
 # train_cbow calls).
-WARMUP_EPOCHS = 64
-MEASURE_EPOCHS = 192
+WARMUP_EPOCHS = int(os.environ.get("G2VEC_BENCH_WARMUP_EPOCHS", "64"))
+MEASURE_EPOCHS = int(os.environ.get("G2VEC_BENCH_MEASURE_EPOCHS", "192"))
+
+PROBE_TIMEOUT = int(os.environ.get("G2VEC_BENCH_PROBE_TIMEOUT", "75"))
+PROBE_ATTEMPTS = 3
+MEASURE_TIMEOUT = int(os.environ.get("G2VEC_BENCH_TIMEOUT", "420"))
+# Hard wall for the whole script: stay under the driver's ~560s kill so a
+# wedge ALWAYS yields a JSON line, never an rc=124 with empty output.
+TOTAL_BUDGET = int(os.environ.get("G2VEC_BENCH_TOTAL_BUDGET", "520"))
+
+# Peak bf16 matmul throughput per chip, for the MFU estimate.
+_PEAK_FLOPS = {"v4": 275e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12}
 
 
-def make_paths(rng: np.random.Generator, n_paths: int, n_genes: int):
+def _fail(stage: str, detail: str, code: int = 2) -> "NoReturn":  # noqa: F821
+    print(json.dumps({
+        "metric": "cbow_train_paths_per_sec_per_chip", "value": None,
+        "unit": "paths/s", "vs_baseline": None,
+        "error": f"{stage}: {detail}"[:500],
+    }))
+    sys.exit(code)
+
+
+# --------------------------------------------------------------------------
+# Parent orchestrator (no jax import in this process, ever).
+# --------------------------------------------------------------------------
+
+def main() -> None:
+    deadline = time.time() + TOTAL_BUDGET
+    last_err = "?"
+    for attempt in range(PROBE_ATTEMPTS):
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--_probe"],
+                capture_output=True, text=True, timeout=PROBE_TIMEOUT)
+        except subprocess.TimeoutExpired:
+            last_err = f"probe timed out after {PROBE_TIMEOUT}s"
+            continue
+        if proc.returncode == 0 and proc.stdout.strip():
+            info = json.loads(proc.stdout.strip().splitlines()[-1])
+            print(f"# backend probe ok: {info}", file=sys.stderr)
+            break
+        last_err = (proc.stderr or proc.stdout or "")[-300:]
+        time.sleep(5)
+    else:
+        _fail("backend-probe", f"no usable jax backend after "
+              f"{PROBE_ATTEMPTS} attempts: {last_err}")
+
+    budget = max(60, min(MEASURE_TIMEOUT, int(deadline - time.time())))
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--_measure"],
+            capture_output=True, text=True, timeout=budget)
+    except subprocess.TimeoutExpired:
+        _fail("measure", f"measurement exceeded {budget}s")
+    sys.stderr.write(proc.stderr or "")
+    if proc.returncode != 0:
+        _fail("measure", f"rc={proc.returncode}: "
+              + (proc.stderr or "")[-300:])
+    sys.stdout.write(proc.stdout)
+
+
+def _probe() -> None:
+    """Child: bounded backend initialization check."""
+    import jax
+
+    devs = jax.devices()
+    print(json.dumps({"platform": jax.default_backend(),
+                      "n_devices": len(devs),
+                      "device0": str(devs[0])}))
+
+
+# --------------------------------------------------------------------------
+# Measurement child (runs only after the probe proved the backend alive).
+# --------------------------------------------------------------------------
+
+def make_paths(rng, n_paths: int, n_genes: int):
     """Multi-hot paths with planted good/poor gene blocks (~40 genes/path,
     matching the reference's mean path occupancy at lenPath=80)."""
+    import numpy as np
+
     labels = (rng.random(n_paths) < 0.5).astype(np.int32)
     paths = np.zeros((n_paths, n_genes), dtype=np.int8)
     half = n_genes // 2
@@ -55,8 +149,10 @@ def make_paths(rng: np.random.Generator, n_paths: int, n_genes: int):
     return paths, labels
 
 
-def main() -> None:
-    from g2vec_tpu.train.trainer import train_cbow
+def _bench_train() -> dict:
+    import numpy as np
+
+    from g2vec_tpu.train.trainer import DEFAULT_CHUNK, train_cbow
 
     rng = np.random.default_rng(0)
     paths, labels = make_paths(rng, N_PATHS, N_GENES)
@@ -66,11 +162,7 @@ def main() -> None:
     # Warmup call: compiles the chunk program (one chunk's worth of epochs).
     train_cbow(paths, labels, max_epochs=WARMUP_EPOCHS, **common)
 
-    t0 = time.time()
     res = train_cbow(paths, labels, max_epochs=MEASURE_EPOCHS, **common)
-    total = time.time() - t0
-
-    from g2vec_tpu.train.trainer import DEFAULT_CHUNK
 
     epoch_secs = [h["secs"] for h in res.history]
     steady = epoch_secs[DEFAULT_CHUNK:]   # first chunk absorbs the transfer
@@ -80,16 +172,173 @@ def main() -> None:
     train_paths = int(N_PATHS * (1 - VAL_FRACTION))
     paths_per_sec = train_paths / sec_per_epoch
 
-    print(json.dumps({
+    # MFU: matmul FLOPs per epoch. fwd X@W_ih (2*M*G*H) + dW = X^T@dH
+    # (2*M*G*H) on the train split, one eval fwd each on train and val;
+    # the [_, H] @ [H, 1] output matmuls are negligible.
+    m_tr, m_val = train_paths, N_PATHS - train_paths
+    flops = 2 * N_GENES * HIDDEN * (3 * m_tr + m_val)
+    peak = _PEAK_FLOPS.get(os.environ.get("PALLAS_AXON_TPU_GEN", "v5e"), 197e12)
+    mfu = flops / sec_per_epoch / peak
+
+    print(f"# train: sec/epoch={sec_per_epoch:.4f} (baseline "
+          f"{BASELINE_EPOCH_SECONDS}) epochs={len(epoch_secs)} "
+          f"mfu={mfu:.4f}", file=sys.stderr)
+    return {
         "metric": "cbow_train_paths_per_sec_per_chip",
         "value": round(paths_per_sec, 1),
         "unit": "paths/s",
         "vs_baseline": round(paths_per_sec / BASELINE_PATHS_PER_SEC, 2),
-    }))
-    import sys
-    print(f"# sec/epoch={sec_per_epoch:.4f} (baseline {BASELINE_EPOCH_SECONDS}) "
-          f"epochs={len(epoch_secs)} total={total:.1f}s", file=sys.stderr)
+        "sec_per_epoch": round(sec_per_epoch, 5),
+        "mfu": round(mfu, 4),
+    }
+
+
+def _load_bench_network():
+    """(nbr_idx, nbr_w, n_genes): the real bundled network with synthetic
+    |PCC| weights on a survivor subset, or a scale-matched fallback."""
+    import numpy as np
+
+    from g2vec_tpu.ops.graph import neighbor_table
+
+    rng = np.random.default_rng(42)
+    if os.path.exists(REFERENCE_NETWORK):
+        src_names, dst_names = [], []
+        with open(REFERENCE_NETWORK) as f:
+            next(f)
+            for line in f:
+                parts = line.rstrip().split("\t")
+                if len(parts) == 2:
+                    src_names.append(parts[0])
+                    dst_names.append(parts[1])
+        genes = sorted(set(src_names) | set(dst_names))
+        g2i = {g: i for i, g in enumerate(genes)}
+        src = np.fromiter((g2i[g] for g in src_names), np.int32)
+        dst = np.fromiter((g2i[g] for g in dst_names), np.int32)
+        # The transcript reports 216,540 of 298,799 edges surviving the
+        # |PCC| > 0.5 filter (README.md:28): keep the same fraction.
+        keep = rng.random(src.size) < (216540 / 298799)
+        src, dst = src[keep], dst[keep]
+        n_genes = len(genes)
+    else:
+        # Fallback: same scale, power-law-ish out-degrees.
+        n_genes, n_edges = 9904, 216540
+        src = rng.choice(n_genes, size=n_edges,
+                         p=_powerlaw_probs(np, n_genes))
+        dst = rng.integers(0, n_genes, size=n_edges).astype(np.int32)
+        src = src.astype(np.int32)
+    w = rng.uniform(0.5001, 1.0, size=src.size).astype(np.float32)
+    nbr_idx, nbr_w = neighbor_table(src, dst, w, n_genes)
+    return nbr_idx, nbr_w, n_genes
+
+
+def _powerlaw_probs(np, n):
+    p = (1.0 / np.arange(1, n + 1)) ** 0.8
+    return p / p.sum()
+
+
+def _reference_walk_baseline(nbr_idx, nbr_w, n_genes: int,
+                             budget_s: float = 8.0) -> float:
+    """Walks/s of the reference's own algorithm on this host.
+
+    A faithful re-creation of generate_randomPath's per-step work
+    (ref: G2Vec.py:328-346): copy the current node's dense transition row,
+    zero the visited entries, renormalize, np.random.choice. Run on a
+    walker sample within a time budget and extrapolate.
+    """
+    import numpy as np
+
+    # Dense rows are what the reference indexes (adjMat[currentNode]).
+    dense_rows = {}
+
+    def row(i):
+        r = dense_rows.get(i)
+        if r is None:
+            r = np.zeros(n_genes, dtype=np.float64)
+            mask = nbr_w[i] > 0
+            r[nbr_idx[i][mask]] = nbr_w[i][mask]
+            dense_rows[i] = r
+        return r
+
+    rng = np.random.default_rng(7)
+    starts = rng.permutation(n_genes)
+    t0 = time.time()
+    done = 0
+    for s in starts:
+        path = [int(s)]
+        current = int(s)
+        for _ in range(LEN_PATH - 1):
+            prob = row(current).copy()          # the reference's deepcopy
+            prob[path] = 0.0
+            total = prob.sum()
+            if total <= 0.0:
+                break
+            current = int(rng.choice(n_genes, p=prob / total))
+            path.append(current)
+        done += 1
+        if time.time() - t0 > budget_s and done >= 20:
+            break
+    return done / (time.time() - t0)
+
+
+def _bench_walker() -> dict:
+    import jax
+    import numpy as np
+
+    from g2vec_tpu.ops.walker import generate_path_set
+
+    nbr_idx, nbr_w, n_genes = _load_bench_network()
+    print(f"# walker network: {n_genes} genes, "
+          f"{int((nbr_w > 0).sum())} edges, D={nbr_idx.shape[1]}",
+          file=sys.stderr)
+
+    key = jax.random.key(0)
+    # Tables go to device HERE so the timed window measures the walk, not
+    # the host->device upload (generate_path_set's device_put is a no-op on
+    # already-committed arrays). Warmup compiles the walk program.
+    import jax.numpy as jnp
+
+    table = (jax.device_put(jnp.asarray(nbr_idx, jnp.int32)),
+             jax.device_put(jnp.asarray(nbr_w, jnp.float32)))
+    generate_path_set(table, key, len_path=LEN_PATH, reps=1)
+
+    t0 = time.time()
+    paths = generate_path_set(table, key,
+                              len_path=LEN_PATH, reps=WALKER_REPS)
+    elapsed = time.time() - t0
+    walks = n_genes * WALKER_REPS
+    walks_per_sec = walks / elapsed
+
+    baseline = _reference_walk_baseline(nbr_idx, nbr_w, n_genes)
+    print(f"# walker: {walks} walks in {elapsed:.2f}s -> "
+          f"{walks_per_sec:.0f} walks/s; {len(paths)} unique paths; "
+          f"host reference loop: {baseline:.1f} walks/s", file=sys.stderr)
+    return {
+        "metric": "walker_walks_per_sec",
+        "value": round(walks_per_sec, 1),
+        "unit": "walks/s",
+        "vs_baseline": round(walks_per_sec / baseline, 2),
+        "unique_paths": len(paths),
+        "baseline_host_walks_per_sec": round(baseline, 2),
+    }
+
+
+def _measure() -> None:
+    # The headline metric prints the moment it exists: a walker-stage crash
+    # must never cost the round its training number.
+    print(json.dumps(_bench_train()), flush=True)
+    try:
+        walker_line = _bench_walker()
+    except Exception as e:  # noqa: BLE001 — degrade to an error line
+        walker_line = {"metric": "walker_walks_per_sec", "value": None,
+                       "unit": "walks/s", "vs_baseline": None,
+                       "error": f"{type(e).__name__}: {e}"[:500]}
+    print(json.dumps(walker_line), flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    if "--_probe" in sys.argv:
+        _probe()
+    elif "--_measure" in sys.argv:
+        _measure()
+    else:
+        main()

@@ -52,6 +52,21 @@ from g2vec_tpu.parallel.mesh import MeshContext, make_mesh_context
 DEFAULT_CHUNK = 64
 
 
+def _default_backend() -> str:
+    """``jax.default_backend()`` that degrades instead of raising.
+
+    With a dead TPU tunnel the backend query itself raises RuntimeError
+    (round-1 postmortem: bench.py died here before any useful error). The
+    caller only uses this to pick the Pallas fast path, so "unknown" simply
+    means "not tpu" — the subsequent device use will produce the real error
+    with full context if the backend is truly gone.
+    """
+    try:
+        return jax.default_backend()
+    except RuntimeError:
+        return "unknown"
+
+
 @dataclasses.dataclass
 class TrainResult:
     w_ih: np.ndarray            # [n_genes, hidden] float32 — the embeddings
@@ -284,7 +299,7 @@ def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
     if use_pallas is None:
         use_pallas = (
             model_dim == 1 and compute_dtype == "bfloat16"
-            and jax.default_backend() == "tpu"
+            and _default_backend() == "tpu"
             and pm.packed_matmul_available(
                 n_paths, pad_to_multiple(n_genes, pm.LANE_BLOCK), hidden))
     elif use_pallas:
@@ -301,7 +316,7 @@ def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
         if hidden % 128:
             raise ValueError(f"use_pallas=True requires hidden % 128 == 0, "
                              f"got {hidden}")
-    pallas_interpret = use_pallas and jax.default_backend() != "tpu"
+    pallas_interpret = use_pallas and _default_backend() != "tpu"
 
     if use_pallas:
         # Gene axis pads to the kernel's lane block; rows to a full row tile
