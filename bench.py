@@ -72,6 +72,13 @@ TOTAL_BUDGET = int(os.environ.get("G2VEC_BENCH_TOTAL_BUDGET", "520"))
 _PEAK_FLOPS = {"v4": 275e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12}
 
 
+def _as_text(data) -> str:
+    """TimeoutExpired captures may be bytes or str depending on the runner."""
+    if data is None:
+        return ""
+    return data.decode(errors="replace") if isinstance(data, bytes) else data
+
+
 def _fail(stage: str, detail: str, code: int = 2) -> "NoReturn":  # noqa: F821
     print(json.dumps({
         "metric": "cbow_train_paths_per_sec_per_chip", "value": None,
@@ -111,13 +118,25 @@ def main() -> None:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--_measure"],
             capture_output=True, text=True, timeout=budget)
-    except subprocess.TimeoutExpired:
-        _fail("measure", f"measurement exceeded {budget}s")
-    sys.stderr.write(proc.stderr or "")
-    if proc.returncode != 0:
-        _fail("measure", f"rc={proc.returncode}: "
-              + (proc.stderr or "")[-300:])
-    sys.stdout.write(proc.stdout)
+        out, err, fail = proc.stdout or "", proc.stderr or "", (
+            f"rc={proc.returncode}" if proc.returncode != 0 else None)
+    except subprocess.TimeoutExpired as e:
+        out, err = _as_text(e.stdout), _as_text(e.stderr)
+        fail = f"measurement exceeded {budget}s"
+    sys.stderr.write(err)
+    # Relay whatever metric lines the child DID produce before dying — the
+    # headline train line prints the moment it exists, so a walker-stage
+    # wedge must not cost the round the training number.
+    sys.stdout.write(out)
+    if fail is not None:
+        if '"metric"' in out:
+            # Partial success: headline survived; record the stage failure
+            # under a non-colliding metric name.
+            print(json.dumps({"metric": "bench_stage_error", "value": None,
+                              "unit": "", "vs_baseline": None,
+                              "error": f"measure: {fail}: {err[-300:]}"[:500]}))
+        else:
+            _fail("measure", f"{fail}: {err[-300:]}")
 
 
 def _probe() -> None:

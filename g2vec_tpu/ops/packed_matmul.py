@@ -192,12 +192,19 @@ def _check_aligned(packed, w) -> None:
 
 
 def _pm_fwd(packed, w, interpret):
-    return _fwd_call(packed, w, interpret), packed
+    # The zero-size array carries w's dtype through the residuals so the
+    # bwd cotangent can match the primal exactly (strict custom_vjp dtype
+    # checking on newer JAX); a bare np.dtype is not a valid pytree leaf.
+    return _fwd_call(packed, w, interpret), (packed, jnp.empty((0,), w.dtype))
 
 
-def _pm_bwd(interpret, packed, g):
+def _pm_bwd(interpret, res, g):
+    packed, w_proto = res
     dw = _bwd_call(packed, g.astype(jnp.bfloat16), interpret)
-    return None, dw.astype(jnp.float32)
+    # float0 is THE cotangent type for integer primals; the packed bits are
+    # data, not parameters (ref: G2Vec.py:264 — X is fed, never trained).
+    d_packed = np.zeros(packed.shape, dtype=jax.dtypes.float0)
+    return d_packed, dw.astype(w_proto.dtype)
 
 
 packed_matmul.defvjp(_pm_fwd, _pm_bwd)

@@ -73,7 +73,18 @@ def initialize(coordinator: Optional[str] = None,
             raise
         # Off-TPU with nothing specified there is no cluster auto-detection;
         # bootstrap a single-process "cluster" on localhost so --distributed
-        # is a no-op rather than an error (useful for smoke tests).
+        # is a no-op rather than an error (useful for smoke tests). LOUD:
+        # on a misconfigured fleet launch every process would land here
+        # believing it is process 0 and write the same outputs (ADVICE.md
+        # round 1) — the warning is the only visible symptom.
+        import sys
+
+        print("g2vec_tpu: WARNING: --distributed found no coordinator "
+              "(no TPU metadata, no G2VEC_COORDINATOR/PROCESS_ID/"
+              "NUM_PROCESSES); bootstrapping a SINGLE-process localhost "
+              "runtime. If this is one process of a multi-host launch, "
+              "its peers were NOT found — check the launch flags.",
+              file=sys.stderr)
         import socket
 
         with socket.socket() as s:

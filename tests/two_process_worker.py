@@ -1,0 +1,83 @@
+"""Worker for the TRUE 2-process distributed test (not pytest-collected).
+
+Launched twice by tests/test_multiprocess.py with G2VEC_COORDINATOR /
+G2VEC_PROCESS_ID / G2VEC_NUM_PROCESSES in the env — the same plumbing a real
+multi-host fleet launch uses (parallel/distributed.py). Each process gets a
+PRIVATE scratch dir: the checkpoint is written only by process 0 into ITS
+dir, so the resume on process 1 can only succeed through the
+coordinator-broadcast restore path (train/checkpoint.py) — exactly the
+silent-divergence hazard ADVICE.md round 1 flagged.
+
+Prints one JSON line with cross-process-comparable digests; the parent test
+asserts they are bit-identical between the two processes.
+"""
+import hashlib
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def _data(rng, n_paths=120, n_genes=40):
+    """Planted-signal dataset (same shape as tests/test_checkpoint.py) so
+    training converges instead of tripping the early stop."""
+    labels = (rng.random(n_paths) < 0.5).astype(np.int32)
+    paths = np.zeros((n_paths, n_genes), dtype=np.int8)
+    half = n_genes // 2
+    for i, lab in enumerate(labels):
+        idx = rng.choice(half, size=5, replace=False) + (0 if lab == 0 else half)
+        paths[i, idx] = 1
+    return paths, labels
+
+
+def _digest(arr) -> str:
+    return hashlib.sha256(np.ascontiguousarray(arr).tobytes()).hexdigest()
+
+
+def main() -> None:
+    out_dir = sys.argv[1]          # PRIVATE per-process scratch dir
+    from g2vec_tpu.parallel import distributed as dist
+
+    dist.initialize()
+    import jax
+
+    assert jax.process_count() == 2, jax.process_count()
+    ctx = dist.make_global_mesh((2, 2))
+
+    from g2vec_tpu.train.trainer import train_cbow
+
+    paths, labels = _data(np.random.default_rng(0))
+    common = dict(hidden=8, learning_rate=0.05, compute_dtype="float32",
+                  seed=0, mesh_ctx=ctx)
+
+    ref = train_cbow(paths, labels, max_epochs=12, **common)
+
+    ckpt = os.path.join(out_dir, "ck")   # NOT shared across processes
+    train_cbow(paths, labels, max_epochs=6, checkpoint_dir=ckpt,
+               checkpoint_every=3, **common)
+    resumed = train_cbow(paths, labels, max_epochs=12, checkpoint_dir=ckpt,
+                         resume=True, checkpoint_every=3, **common)
+
+    assert not ref.stopped_early and not resumed.stopped_early
+    # Only the coordinator's private dir may contain the file.
+    has_file = os.path.exists(os.path.join(ckpt, "cbow_state.npz"))
+    assert has_file == (jax.process_index() == 0), (
+        f"process {jax.process_index()} checkpoint-file presence: {has_file}")
+    np.testing.assert_allclose(resumed.w_ih, ref.w_ih, rtol=1e-5, atol=1e-7)
+
+    # fetch_global's cross-process branch: the model-sharded embedding table
+    # spans devices owned by BOTH processes; pull it whole on each.
+    w_full = dist.fetch_global(resumed.params.w_ih)
+
+    print(json.dumps({
+        "process": jax.process_index(),
+        "n_global_devices": len(jax.devices()),
+        "resumed_digest": _digest(resumed.w_ih),
+        "sharded_fetch_digest": _digest(w_full),
+        "acc_val": resumed.acc_val,
+    }))
+
+
+if __name__ == "__main__":
+    main()
