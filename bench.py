@@ -129,7 +129,9 @@ def main() -> None:
     # wedge must not cost the round the training number.
     sys.stdout.write(out)
     if fail is not None:
-        if '"metric"' in out:
+        if out and not out.endswith("\n"):
+            print()     # a killed child may leave a partial line behind
+        if _has_real_metric(out):
             # Partial success: headline survived; record the stage failure
             # under a non-colliding metric name.
             print(json.dumps({"metric": "bench_stage_error", "value": None,
@@ -137,6 +139,20 @@ def main() -> None:
                               "error": f"measure: {fail}: {err[-300:]}"[:500]}))
         else:
             _fail("measure", f"{fail}: {err[-300:]}")
+
+
+def _has_real_metric(out: str) -> bool:
+    """True iff a complete metric line with a non-null value was relayed."""
+    for line in out.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                d = json.loads(line)
+            except ValueError:
+                continue
+            if d.get("metric") and d.get("value") is not None:
+                return True
+    return False
 
 
 def _probe() -> None:

@@ -54,15 +54,20 @@ def test_two_process_cluster(tmp_path):
             env=_worker_env(port, i), cwd=_REPO,
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
     results = []
-    for i, p in enumerate(procs):
-        try:
-            out, err = p.communicate(timeout=300)
-        except subprocess.TimeoutExpired:
-            for q in procs:
+    try:
+        for i, p in enumerate(procs):
+            try:
+                out, err = p.communicate(timeout=300)
+            except subprocess.TimeoutExpired:
+                pytest.fail(f"process {i} timed out")
+            assert p.returncode == 0, f"process {i} failed:\n{err[-3000:]}"
+            results.append(json.loads(out.strip().splitlines()[-1]))
+    finally:
+        # One worker failing must not leave its sibling blocked forever in
+        # a distributed collective holding the port.
+        for q in procs:
+            if q.poll() is None:
                 q.kill()
-            pytest.fail(f"process {i} timed out")
-        assert p.returncode == 0, f"process {i} failed:\n{err[-3000:]}"
-        results.append(json.loads(out.strip().splitlines()[-1]))
 
     assert all(r["n_global_devices"] == 4 for r in results), results
     assert {r["process"] for r in results} == {0, 1}
