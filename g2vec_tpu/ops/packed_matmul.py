@@ -75,13 +75,14 @@ def _vmem_step_bytes(gb: int, h: int, rb: int) -> int:
     """Worst-direction VMEM working set of one grid step (bytes).
 
     Counts, per the kernel bodies below: the resident f32 tile (fwd output /
-    bwd dW), double-buffered streamed tiles (W bf16 in fwd; g_out f32 + its
-    bf16 copy in bwd), double-buffered packed tiles, the per-slab dot output
-    (bwd), the separate f32 acc (fwd), and the unpack temporaries
+    bwd dW), double-buffered streamed tiles (W bf16 in fwd; g_out bf16 in
+    bwd — _pm_bwd casts the cotangent BEFORE the call, so the kernel's
+    astype is a no-op), double-buffered packed tiles, the per-slab dot
+    output (bwd), the separate f32 acc (fwd), and the unpack temporaries
     (rep int32 + hoisted shift int32 + x bf16 = 10 bytes/element)."""
     unpack = rb * LANE_BLOCK * 10
     p_tiles = 2 * rb * (gb // 8)
-    bwd = (gb * h * 4 + 2 * rb * h * 4 + rb * h * 2
+    bwd = (gb * h * 4 + 2 * rb * h * 2
            + LANE_BLOCK * h * 4 + p_tiles + unpack)
     fwd = 2 * gb * h * 2 + 2 * rb * h * 4 + p_tiles + unpack
     return max(bwd, fwd)

@@ -1,0 +1,68 @@
+"""Acceptance on the REAL bundled network + clinical files.
+
+Round-1 gap (VERDICT.md missing #3): the repo only ever tested on fully
+synthetic ring+chord graphs whose degree distribution is nothing like the
+real scale-free network. Here the full pipeline runs over
+``/root/reference/ex_NETWORK.txt`` (298,799 edges, 9,904 genes — hubs of
+degree 889) and ``ex_CLINICAL.txt`` (135 samples, 77/58) with a
+statistically matched synthetic expression matrix
+(g2vec_tpu/data/realistic.py), validating walker behavior (dead ends, hub
+fan-out, neighbor-table padding) and accuracy at the reference's own
+topology and CLI defaults (reps=10, lenPath=80). The committed artifact
+from this config is REAL_ACCEPTANCE.json (n_paths=38,603, path genes
+3,862, ACC[val]=0.915 vs the transcript's 45,402 / 3,773 / 0.8837 —
+README.md:26-41). NOTE: fewer repetitions make the first-val-dip early
+stop (reference quirk (c)) brittle — reps=2 stops at ACC~0.74 — so this
+test pays the ~5 min for the real configuration; deselect with
+``-m "not slow"``.
+"""
+import os
+
+import numpy as np
+import pytest
+
+NET = "/root/reference/ex_NETWORK.txt"
+CLIN = "/root/reference/ex_CLINICAL.txt"
+
+needs_reference = pytest.mark.skipif(
+    not (os.path.exists(NET) and os.path.exists(CLIN)),
+    reason="reference data mount not present")
+
+
+@pytest.mark.slow
+@needs_reference
+def test_real_network_pipeline(tmp_path):
+    from g2vec_tpu.config import G2VecConfig
+    from g2vec_tpu.data.realistic import write_real_expression_tsv
+    from g2vec_tpu.pipeline import run
+
+    expr_path = str(tmp_path / "real_EXPRESSION.txt")
+    info = write_real_expression_tsv(NET, CLIN, expr_path)
+    cfg = G2VecConfig(expression_file=expr_path, clinical_file=CLIN,
+                      network_file=NET,
+                      result_name=str(tmp_path / "real"),
+                      seed=0)
+    res = run(cfg, console=lambda s: None)
+
+    # Transcript-scale invariants (README.md:26-32).
+    assert res.n_samples == 135
+    assert res.n_genes == 7523
+    assert abs(res.n_edges - 216540) < 0.01 * 216540
+    # Path genes ~ the planted active modules; the transcript's 3,773 is the
+    # calibration target.
+    assert 3200 <= res.n_path_genes <= 4500
+    # Transcript: 45,402 paths at the same reps/lenPath.
+    assert abs(res.n_paths - 45402) < 0.2 * 45402
+
+    # The BASELINE north star: val-ACC >= 0.88 at the bundled-example scale.
+    assert res.acc_val >= 0.88, res.acc_val
+
+    # Biomarkers should be drawn from the planted modules (they carry both
+    # the embedding-norm and the t-score signal).
+    active = set(info["active_good"]) | set(info["active_poor"])
+    hits = sum(1 for b in res.biomarkers if b in active)
+    assert hits / len(res.biomarkers) > 0.9, f"{hits}/{len(res.biomarkers)}"
+
+    # Output files exist and carry every gene.
+    lg = open(res.output_files[1]).read().splitlines()
+    assert len(lg) == 1 + res.n_genes
