@@ -142,10 +142,81 @@ def random_walks_sparse(nbr_idx: jax.Array, nbr_w: jax.Array,
     return _walk(nbr_idx.shape[0], candidates, starts, key, len_path)
 
 
+# shard_map walk programs are built per (mesh, shapes) — cache them or every
+# repetition re-traces the whole scan (the jit cache keys on fn identity).
+_SHARDED_WALK_CACHE: dict = {}
+
+
+def _sharded_sparse_walk_fn(mesh, n_genes: int, len_path: int):
+    """Sparse walk with the neighbor tables ROW-SHARDED over 'model'.
+
+    Round-1 gap (VERDICT.md #9): under a mesh the 2*G*D tables were
+    replicated per device, defeating the model axis at 40k+-gene scale.
+    Here each model shard stores only its table rows; the per-step row
+    gather becomes an ownership-masked local gather + psum over 'model'
+    (each row has exactly one owner, so the sum reconstructs exactly
+    ``nbr_idx[current]`` / ``nbr_w[current]`` in the same slot order — the
+    Gumbel draws, and therefore the sampled paths, are bit-identical to the
+    unsharded walker for the same keys). Walkers stay DP over 'data';
+    model shards duplicate the (cheap) per-walker sampling compute and
+    carry identical visited state.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from g2vec_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+    def walk(nbr_idx_local, nbr_w_local, starts, keys):
+        rows_per_shard = nbr_idx_local.shape[0]
+        base = jax.lax.axis_index(MODEL_AXIS) * rows_per_shard
+
+        def candidates(current, visited):
+            local = current - base
+            own = (local >= 0) & (local < rows_per_shard)
+            safe = jnp.clip(local, 0, rows_per_shard - 1)
+            cand = jnp.where(own[:, None], nbr_idx_local[safe], 0)
+            w = jnp.where(own[:, None], nbr_w_local[safe], 0.0)
+            cand = jax.lax.psum(cand, MODEL_AXIS)
+            w = jax.lax.psum(w, MODEL_AXIS)
+            seen = jnp.take_along_axis(visited, cand, axis=1)
+            return jnp.where(seen, 0.0, w), cand
+
+        return _walk(n_genes, candidates, starts, keys, len_path)
+
+    sharded = jax.shard_map(
+        walk, mesh=mesh,
+        in_specs=(P(MODEL_AXIS, None), P(MODEL_AXIS, None),
+                  P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=P(DATA_AXIS, None),
+        # The scan carry mixes constants (alive mask init) with
+        # data-varying state; the VMA check rejects that mix even though
+        # the program is correct (same pattern as the trainer's
+        # pallas-under-shard_map call).
+        check_vma=False)
+    return jax.jit(sharded)
+
+
+# Replicating the neighbor tables is FASTER (zero collectives per step)
+# whenever they fit comfortably: shard only past this per-device size, where
+# the memory win pays for the two per-step [W, D] psums over 'model'.
+SHARD_TABLE_BYTES = 128 * 1024 * 1024
+
+
+def _get_sharded_walk_fn(mesh, n_genes: int, len_path: int):
+    key = (mesh, n_genes, len_path)
+    fn = _SHARDED_WALK_CACHE.get(key)
+    if fn is None:
+        fn = _sharded_sparse_walk_fn(mesh, n_genes, len_path)
+        while len(_SHARDED_WALK_CACHE) >= 8:
+            _SHARDED_WALK_CACHE.pop(next(iter(_SHARDED_WALK_CACHE)))
+        _SHARDED_WALK_CACHE[key] = fn
+    return fn
+
+
 def generate_path_set(adj, key: jax.Array, *, len_path: int, reps: int,
                       starts: Optional[np.ndarray] = None,
                       walker_batch: int = 0,
-                      mesh_ctx=None) -> Set[bytes]:
+                      mesh_ctx=None,
+                      shard_tables: Optional[bool] = None) -> Set[bytes]:
     """All-sources x reps walks -> set of packed multi-hot path rows.
 
     Mirrors generate_pathSet (G2Vec.py:324-352): every gene is a start node,
@@ -166,25 +237,50 @@ def generate_path_set(adj, key: jax.Array, *, len_path: int, reps: int,
     NOT invariant to the dense/sparse choice — the two draw differently
     shaped Gumbel noise — but each is deterministic per seed.)
 
-    ``mesh_ctx``: walkers are embarrassingly data-parallel — with a mesh the
-    walker axis shards over 'data' (tables replicated; the compiled program
-    has zero collectives). Result-invariant vs single-device: shard padding
-    walkers are dropped host-side and each walker's PRNG stream is its own.
+    ``mesh_ctx``: walkers are embarrassingly data-parallel — the walker axis
+    shards over 'data'. Sparse tables additionally ROW-SHARD over 'model'
+    when the mesh has one AND they are big enough to matter
+    (``shard_tables``: None = auto at SHARD_TABLE_BYTES, or force with
+    True/False). Each shard then stores 2*G*D/M values; the per-step gather
+    becomes an ownership-masked local gather + psum that reconstructs the
+    exact unsharded candidate rows (:func:`_sharded_sparse_walk_fn`), so
+    the path set stays bit-identical. Small tables replicate — the walk
+    compiles to zero collectives. Result-invariant vs single-device either
+    way: shard padding walkers are dropped host-side and each walker's PRNG
+    stream is its own.
     """
     from jax.sharding import PartitionSpec as P
 
-    from g2vec_tpu.parallel.mesh import (DATA_AXIS, MeshContext,
+    from g2vec_tpu.parallel.mesh import (DATA_AXIS, MODEL_AXIS, MeshContext,
                                          pad_to_multiple)
 
     sparse = isinstance(adj, tuple)
     ctx = mesh_ctx if mesh_ctx is not None else MeshContext(mesh=None)
     data_dim = 1 if ctx.mesh is None else ctx.mesh.shape[DATA_AXIS]
+    model_dim = 1 if ctx.mesh is None else ctx.mesh.shape[MODEL_AXIS]
     walker_spec = P(DATA_AXIS)           # 1-D walker axis, rows over 'data'
     if sparse:
         nbr_idx, nbr_w = adj
         n_genes = int(nbr_idx.shape[0])
-        table = (ctx.put(jnp.asarray(nbr_idx, dtype=jnp.int32), P()),
-                 ctx.put(jnp.asarray(nbr_w, dtype=jnp.float32), P()))
+        if shard_tables is None:
+            # Auto: replicate small tables (collective-free walk); shard
+            # once they are big enough that the memory win matters.
+            shard_tables = (model_dim > 1
+                            and nbr_idx.size * 8 > SHARD_TABLE_BYTES)
+        if shard_tables and model_dim > 1:
+            # Row-shard the tables over 'model' (zero-padded to split
+            # evenly; pad rows are unreachable — nothing points at gene
+            # ids >= n_genes, and their own weights are 0).
+            g_pad = pad_to_multiple(n_genes, model_dim)
+            nbr_idx = np.pad(np.asarray(nbr_idx),
+                             ((0, g_pad - n_genes), (0, 0)))
+            nbr_w = np.pad(np.asarray(nbr_w),
+                           ((0, g_pad - n_genes), (0, 0)))
+            table_spec = P(MODEL_AXIS, None)
+        else:
+            table_spec = P()
+        table = (ctx.put(jnp.asarray(nbr_idx, dtype=jnp.int32), table_spec),
+                 ctx.put(jnp.asarray(nbr_w, dtype=jnp.float32), table_spec))
     else:
         n_genes = int(adj.shape[0])
         table = ctx.put(jnp.asarray(adj, dtype=jnp.float32), P())
@@ -211,7 +307,10 @@ def generate_path_set(adj, key: jax.Array, *, len_path: int, reps: int,
                      jnp.repeat(chunk_keys[:1], n_pad - n_real, axis=0)])
             chunk = ctx.put(jnp.asarray(chunk), walker_spec)
             chunk_keys = ctx.put(chunk_keys, walker_spec)
-            if sparse:
+            if sparse and shard_tables and model_dim > 1:
+                fn = _get_sharded_walk_fn(ctx.mesh, n_genes, len_path)
+                visited = fn(table[0], table[1], chunk, chunk_keys)
+            elif sparse:
                 visited = random_walks_sparse(table[0], table[1], chunk,
                                               chunk_keys, len_path)
             else:

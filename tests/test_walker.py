@@ -207,3 +207,17 @@ def test_path_set_invariant_to_mesh(rng):
     batched = generate_path_set(table, key, walker_batch=7,
                                 mesh_ctx=make_mesh_context((4, 1)), **kwargs)
     assert base == batched
+    # 2x2 mesh with FORCED table sharding (auto would replicate this tiny
+    # table): rows shard over 'model' (n=30 pads to 32) and the
+    # ownership-psum gather must reconstruct the exact same candidate
+    # rows — the path set is bit-identical to single-device.
+    sharded = generate_path_set(table, key, mesh_ctx=make_mesh_context((2, 2)),
+                                shard_tables=True, **kwargs)
+    assert base == sharded
+    sharded_b = generate_path_set(table, key, walker_batch=7, shard_tables=True,
+                                  mesh_ctx=make_mesh_context((2, 2)), **kwargs)
+    assert base == sharded_b
+    # Auto policy on a small table: replicated, still identical.
+    auto = generate_path_set(table, key, mesh_ctx=make_mesh_context((2, 2)),
+                             **kwargs)
+    assert base == auto
