@@ -65,6 +65,10 @@ class G2VecConfig:
     profile_dir: Optional[str] = None
     checkpoint_dir: Optional[str] = None
     resume: bool = False
+    # "single": one gathered npz (process-0 write, broadcast restore; dir
+    # need not be shared). "sharded": orbax OCDBT per-process shards (no
+    # full-state gather ever; dir MUST be shared across hosts).
+    checkpoint_layout: str = "single"
     metrics_jsonl: Optional[str] = None
     use_native_io: bool = True       # use the C++ TSV reader when available
     debug_nans: bool = False
@@ -152,6 +156,11 @@ def build_parser() -> argparse.ArgumentParser:
                         help="Write a jax.profiler trace of the run here.")
     parser.add_argument("--checkpoint-dir", type=str, default=None)
     parser.add_argument("--resume", action="store_true")
+    parser.add_argument("--checkpoint-layout", type=str, default="single",
+                        choices=("single", "sharded"),
+                        help="single: one gathered npz (dir per host OK); "
+                             "sharded: orbax per-process shards, no "
+                             "full-state gather (dir must be shared).")
     parser.add_argument("--metrics-jsonl", type=str, default=None,
                         help="Write structured per-stage/per-epoch metrics here.")
     parser.add_argument("--no-native-io", action="store_true",
@@ -204,6 +213,7 @@ def config_from_args(argv=None) -> G2VecConfig:
         profile_dir=args.profile_dir,
         checkpoint_dir=args.checkpoint_dir,
         resume=args.resume,
+        checkpoint_layout=args.checkpoint_layout,
         metrics_jsonl=args.metrics_jsonl,
         use_native_io=not args.no_native_io,
         debug_nans=args.debug_nans,

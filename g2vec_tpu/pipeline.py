@@ -194,7 +194,8 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
                 decision_threshold=cfg.decision_threshold,
                 compute_dtype=cfg.compute_dtype, param_dtype=cfg.param_dtype,
                 seed=cfg.seed, mesh_ctx=mesh_ctx, on_epoch=on_epoch,
-                checkpoint_dir=cfg.checkpoint_dir, resume=cfg.resume)
+                checkpoint_dir=cfg.checkpoint_dir, resume=cfg.resume,
+                checkpoint_layout=cfg.checkpoint_layout)
         if result.stopped_early:
             reporter.on_stop(result.stop_epoch, result.acc_val, result.acc_tr)
         console("    Optimization Finish")

@@ -3,15 +3,25 @@
 The reference has no checkpointing at all — training state lives only inside
 the TF session and dies with the process (SURVEY.md §5 "Checkpoint/resume").
 Here the full trainer state — params, Adam state, the early-stopping
-snapshot/accuracy pair, and the epoch counter — round-trips through a single
-``.npz`` so an interrupted run resumes mid-epoch-loop with identical
-numerics (full-batch training has no data-order state to restore).
+snapshot/accuracy pair, and the epoch counter — round-trips so an
+interrupted run resumes mid-epoch-loop with identical numerics (full-batch
+training has no data-order state to restore). Two layouts:
 
-Format: pytree leaves flattened in deterministic order and keyed by index,
-plus a scalar metadata array. Restoring unflattens against a freshly
-initialized state's treedef, so the format never hard-codes optax internals.
-Writes are atomic (tmp file + ``os.replace``) so a crash mid-write can't
-corrupt the latest checkpoint.
+- ``layout="single"`` (default): one atomic ``.npz``. The save gathers the
+  full state (a collective) and process 0 writes; the restore is
+  coordinator-read + broadcast, so ``checkpoint_dir`` need NOT be shared
+  across hosts. Right for example-scale states (a few hundred MB).
+- ``layout="sharded"``: orbax/tensorstore OCDBT — every process writes only
+  its own addressable shards (``ocdbt.process_N`` files) and restores only
+  what its devices need, with shardings preserved; the full state NEVER
+  materializes on any single host (round-1 verdict #7: at pod scale the
+  gather is multi-GB of host traffic per save). Requires a SHARED
+  checkpoint_dir across processes, like any sharded checkpoint format.
+
+Both layouts store pytree leaves flattened in deterministic order and keyed
+by index, plus a scalar metadata array — the format never hard-codes optax
+internals. Writes are atomic in both (tmp + rename; orbax does its own
+finalize-rename dance).
 """
 from __future__ import annotations
 
@@ -22,6 +32,7 @@ import jax
 import numpy as np
 
 CKPT_NAME = "cbow_state.npz"
+SHARDED_NAME = "cbow_state_ocdbt"
 
 
 # ``done`` codes in the meta record: the trainer refuses to continue a
@@ -34,18 +45,23 @@ RUN_EARLY_STOPPED = 2  # first val-accuracy dip
 
 def save_state(directory: str, params: Any, opt_state: Any, snapshot: Any,
                epoch: int, before_val: float, before_tr: float,
-               done: int = RUN_IN_PROGRESS) -> str:
+               done: int = RUN_IN_PROGRESS, layout: str = "single") -> str:
     """Atomically write the full trainer state under ``directory``.
 
-    Multi-host safe: gathering the (possibly cross-process-sharded) leaves
-    is a collective every process performs; only process 0 touches the
-    filesystem, so N hosts on a shared checkpoint_dir never race.
+    Collective: every process must call it. ``layout="single"`` gathers and
+    process 0 writes one npz; ``layout="sharded"`` writes per-process orbax
+    shards and never gathers (see module docstring for the trade-off).
     """
+    meta = np.array([float(epoch), before_val, before_tr, float(done)])
+    if layout == "sharded":
+        return _save_sharded(directory, (params, opt_state, snapshot), meta)
+    if layout != "single":
+        raise ValueError(f"unknown checkpoint layout {layout!r}")
     from g2vec_tpu.parallel.distributed import fetch_global
 
     leaves, _ = jax.tree_util.tree_flatten((params, opt_state, snapshot))
     arrays = {f"leaf_{i}": fetch_global(leaf) for i, leaf in enumerate(leaves)}
-    arrays["meta"] = np.array([float(epoch), before_val, before_tr, float(done)])
+    arrays["meta"] = meta
     path = os.path.join(directory, CKPT_NAME)
     if jax.process_index() != 0:
         return path
@@ -55,6 +71,97 @@ def save_state(directory: str, params: Any, opt_state: Any, snapshot: Any,
     # np.savez appends .npz to names without it.
     os.replace(tmp + ".npz" if os.path.exists(tmp + ".npz") else tmp, path)
     return path
+
+
+def _leaf_dict(tree: Any, meta: Optional[np.ndarray] = None) -> dict:
+    """Index-keyed flat dict — names custom pytree nodes (NamedTuples,
+    optax states) out of the storage format entirely."""
+    leaves, _ = jax.tree_util.tree_flatten(tree)
+    d = {f"leaf_{i}": leaf for i, leaf in enumerate(leaves)}
+    if meta is not None:
+        d["meta"] = meta
+    return d
+
+
+_LATEST_NAME = SHARDED_NAME + ".LATEST"
+
+
+def _save_sharded(directory: str, state: Any, meta: np.ndarray) -> str:
+    """Keep-previous atomic save: each save goes to a FRESH numbered dir,
+    then the LATEST pointer file flips atomically and process 0 prunes the
+    older dirs. A crash mid-save leaves the previous checkpoint fully
+    intact (orbax's force=True would rmtree it BEFORE committing the new
+    one — the exact window checkpointing exists to survive)."""
+    import orbax.checkpoint as ocp
+
+    base = os.path.abspath(directory)
+    os.makedirs(base, exist_ok=True)
+    # Every process lists the same shared dir BEFORE the collective save
+    # creates anything, so all agree on the next index (orphans from an
+    # earlier crash only push the index up, never collide).
+    existing = [int(n.rsplit(".", 1)[1]) for n in os.listdir(base)
+                if n.startswith(SHARDED_NAME + ".")
+                and n.rsplit(".", 1)[1].isdigit()]
+    name = f"{SHARDED_NAME}.{max(existing, default=-1) + 1}"
+    path = os.path.join(base, name)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(path, args=ocp.args.PyTreeSave(_leaf_dict(state, meta)))
+    if jax.process_index() == 0:
+        tmp = os.path.join(base, _LATEST_NAME + ".tmp")
+        with open(tmp, "w") as f:
+            f.write(name)
+        os.replace(tmp, os.path.join(base, _LATEST_NAME))
+        for idx in existing:
+            import shutil
+
+            shutil.rmtree(os.path.join(base, f"{SHARDED_NAME}.{idx}"),
+                          ignore_errors=True)
+    return path
+
+
+def _latest_sharded_dir(directory: str) -> Optional[str]:
+    pointer = os.path.join(os.path.abspath(directory), _LATEST_NAME)
+    if not os.path.exists(pointer):
+        return None
+    with open(pointer) as f:
+        name = f.read().strip()
+    path = os.path.join(os.path.abspath(directory), name)
+    return path if os.path.isdir(path) else None
+
+
+def _load_sharded(directory: str, like_leaves
+                  ) -> Optional[Tuple[list, np.ndarray]]:
+    """Restore per-process shards with the LIKE tree's shardings preserved.
+
+    ``like_leaves`` must be device arrays (a freshly initialized, correctly
+    sharded state) — orbax restores each leaf directly onto those shardings,
+    so every process reads only its own devices' slices.
+    """
+    import orbax.checkpoint as ocp
+
+    path = _latest_sharded_dir(directory)
+    if path is None:
+        return None
+    like = _leaf_dict(like_leaves, np.zeros(4, np.float64))
+    with ocp.PyTreeCheckpointer() as ckptr:
+        # Validate shapes against the stored metadata FIRST, so a config
+        # change surfaces as the same clear error the single layout raises
+        # instead of an obscure tensorstore chunk mismatch.
+        stored = ckptr.metadata(path).item_metadata.tree
+        for i, want in enumerate(like_leaves):
+            got = stored.get(f"leaf_{i}")
+            got_shape = tuple(getattr(got, "shape", ()) or ())
+            if (hasattr(want, "shape")
+                    and got_shape != tuple(np.shape(want))):
+                raise ValueError(
+                    f"checkpoint {path}: leaf {i} has shape {got_shape}, "
+                    f"current model expects {np.shape(want)} — was the "
+                    "config changed between save and resume?")
+        restore_args = ocp.checkpoint_utils.construct_restore_args(like)
+        out = ckptr.restore(path, args=ocp.args.PyTreeRestore(
+            item=like, restore_args=restore_args))
+    leaves = [out[f"leaf_{i}"] for i in range(len(like_leaves))]
+    return leaves, np.asarray(out["meta"])
 
 
 def _read_leaves(path: str, like_leaves) -> Optional[Tuple[list, np.ndarray]]:
@@ -88,29 +195,48 @@ def _leaf_dtype(want) -> np.dtype:
         np.asarray(want).dtype
 
 
-def load_state(directory: str, params_like: Any, opt_state_like: Any
+def load_state(directory: str, params_like: Any, opt_state_like: Any,
+               layout: str = "single"
                ) -> Optional[Tuple[Any, Any, Any, int, float, float, int]]:
     """Restore (params, opt_state, snapshot, epoch, before_val, before_tr, done).
 
     ``params_like`` / ``opt_state_like`` supply the treedefs (from a fresh
-    init at the same shapes). Returns None when no checkpoint exists; raises
-    with a clear message on a shape mismatch (e.g. resuming with a different
+    init at the same shapes; for ``layout="sharded"`` they must be the
+    correctly sharded device arrays — restored leaves land straight on
+    those shardings). Returns None when no checkpoint exists; raises with a
+    clear message on a shape mismatch (e.g. resuming with a different
     ``--sizeHiddenlayer``).
 
-    Multi-host safe on BOTH sides (ADVICE.md round 1): only process 0 reads
-    the file, then the state is broadcast — so ``checkpoint_dir`` need not
-    be a shared filesystem, and a stale worker copy can never produce
-    silently divergent parameters. This is a collective: every process must
-    call it.
+    Multi-host safe on BOTH sides (ADVICE.md round 1): the single layout is
+    coordinator-read + broadcast (checkpoint_dir need not be shared); the
+    sharded layout reads per-process slices of one shared dir. Collective
+    either way: every process must call it.
     """
     path = os.path.join(directory, CKPT_NAME)
     like = (params_like, opt_state_like, params_like)
     like_leaves, treedef = jax.tree_util.tree_flatten(like)
-    if jax.process_count() > 1:
+    if layout == "sharded":
+        loaded = _load_sharded(directory, like_leaves)
+    elif layout != "single":
+        raise ValueError(f"unknown checkpoint layout {layout!r}")
+    elif jax.process_count() > 1:
         loaded = _broadcast_from_coordinator(path, like_leaves)
     else:
         loaded = _read_leaves(path, like_leaves)
     if loaded is None:
+        # A resume that silently starts over because the OTHER layout's
+        # artifact sits in the directory would bypass the terminal
+        # done-state guard — fail loudly instead.
+        if layout == "single" and _latest_sharded_dir(directory) is not None:
+            raise ValueError(
+                f"{directory} holds a 'sharded' checkpoint but the resume "
+                "asked for layout 'single' — pass --checkpoint-layout "
+                "sharded (or the matching checkpoint_layout argument)")
+        if layout == "sharded" and os.path.exists(path):
+            raise ValueError(
+                f"{directory} holds a 'single' checkpoint but the resume "
+                "asked for layout 'sharded' — pass --checkpoint-layout "
+                "single (or the matching checkpoint_layout argument)")
         return None
     leaves, meta = loaded
     params, opt_state, snapshot = jax.tree_util.tree_unflatten(treedef, leaves)

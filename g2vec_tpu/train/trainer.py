@@ -242,6 +242,7 @@ def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
                checkpoint_dir: Optional[str] = None, resume: bool = False,
                checkpoint_every: int = 25, use_pallas: Optional[bool] = None,
                packed_genes: Optional[int] = None,
+               checkpoint_layout: str = "single",
                ) -> TrainResult:
     """Train the modified CBOW; returns the embedding table and history.
 
@@ -382,6 +383,19 @@ def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
     # builds an identical transformation from the same hyperparameters.
     tx = optax.adam(learning_rate, b1=0.9, b2=0.999, eps=1e-8)
     opt_state = tx.init(params)
+    if ctx.mesh is not None:
+        # Adam's mu/nu inherit the params' shardings through tree_map, but
+        # the step-count scalar lands on the default device. Replicate it
+        # over the mesh NOW: jit would do so transparently, but a sharded
+        # checkpoint restore uses this state as its sharding template, and
+        # a single-device template forces an (unsupported on multi-host
+        # CPU) cross-host transfer at resume.
+        from jax.sharding import PartitionSpec as P
+
+        opt_state = jax.tree.map(
+            lambda sub: (sub if isinstance(sub, CBOWParams)
+                         else ctx.put(sub, P())),
+            opt_state, is_leaf=lambda x: isinstance(x, CBOWParams))
     # Epochs per device dispatch: align to the checkpoint cadence when
     # checkpointing (a chunk boundary is a save point), else amortize the
     # host round trip over DEFAULT_CHUNK epochs.
@@ -402,30 +416,36 @@ def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
         from g2vec_tpu.train.checkpoint import (RUN_EARLY_STOPPED,
                                                 RUN_IN_PROGRESS, load_state)
 
-        restored = load_state(checkpoint_dir, params, opt_state)
+        restored = load_state(checkpoint_dir, params, opt_state,
+                              layout=checkpoint_layout)
         if restored is not None:
             (params, opt_state, snapshot, last_epoch,
              before_val, before_tr, done) = restored
             if ctx.mesh is not None:
-                # Restored leaves are host arrays; re-apply the DP/TP
-                # shardings the fresh-init path declares, or the resumed
-                # program compiles with replicated (possibly OOM-ing) params.
-                # Classification is by tree position (CBOWParams containers
-                # inside params/opt_state/snapshot), never by shape — shapes
-                # are ambiguous when hidden == n_genes_pad.
+                # Re-apply the DP/TP shardings the fresh-init path declares,
+                # or the resumed program compiles with replicated (possibly
+                # OOM-ing) params. Single layout hands back host arrays;
+                # sharded layout hands back device arrays already on the
+                # right shardings for the big leaves (device_put is then a
+                # no-op) but its scalar leaves (Adam count) restore onto
+                # the fresh init's single-device placement and must be
+                # re-replicated over the mesh. Classification is by tree
+                # position (CBOWParams containers inside
+                # params/opt_state/snapshot), never by shape — shapes are
+                # ambiguous when hidden == n_genes_pad.
                 from jax.sharding import PartitionSpec as P
 
                 def _reshard_params(p: CBOWParams) -> CBOWParams:
                     return CBOWParams(
-                        w_ih=ctx.put(np.asarray(p.w_ih), ctx.w_ih_spec),
-                        w_ho=ctx.put(np.asarray(p.w_ho), ctx.w_ho_spec))
+                        w_ih=ctx.put(p.w_ih, ctx.w_ih_spec),
+                        w_ho=ctx.put(p.w_ho, ctx.w_ho_spec))
 
                 params = _reshard_params(params)
                 snapshot = _reshard_params(snapshot)
                 opt_state = jax.tree.map(
                     lambda sub: (_reshard_params(sub)
                                  if isinstance(sub, CBOWParams)
-                                 else ctx.put(np.asarray(sub), P())),
+                                 else ctx.put(sub, P())),
                     opt_state,
                     is_leaf=lambda x: isinstance(x, CBOWParams))
             if (done == RUN_EARLY_STOPPED
@@ -470,7 +490,8 @@ def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
             from g2vec_tpu.train.checkpoint import save_state
 
             save_state(checkpoint_dir, params, opt_state, snapshot,
-                       step - 1, before_val, before_tr)
+                       step - 1, before_val, before_tr,
+                       layout=checkpoint_layout)
 
     if checkpoint_dir:
         from g2vec_tpu.train.checkpoint import (RUN_COMPLETED,
@@ -479,7 +500,8 @@ def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
         save_state(checkpoint_dir, params, opt_state, snapshot,
                    stop_epoch if stopped_early else max_epochs - 1,
                    before_val, before_tr,
-                   done=RUN_EARLY_STOPPED if stopped_early else RUN_COMPLETED)
+                   done=RUN_EARLY_STOPPED if stopped_early else RUN_COMPLETED,
+                   layout=checkpoint_layout)
     from g2vec_tpu.parallel.distributed import fetch_global
 
     w_ih = fetch_global(snapshot.w_ih).astype(np.float32)[:n_genes]

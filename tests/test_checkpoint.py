@@ -75,3 +75,88 @@ def test_resume_rejects_shape_mismatch(rng, tmp_path):
         train_cbow(paths, labels, hidden=16, learning_rate=0.05, max_epochs=3,
                    compute_dtype="float32", seed=0, checkpoint_dir=ckpt,
                    resume=True)
+
+
+def test_sharded_layout_resume_matches_uninterrupted(rng, tmp_path):
+    """Orbax OCDBT layout under a (4, 2) DP x TP mesh: per-shard save +
+    sharding-preserving restore, bit-compatible with an uninterrupted run
+    (VERDICT round-1 #7 — no full-state gather on save)."""
+    import os
+
+    from g2vec_tpu.parallel.mesh import make_mesh_context
+
+    paths, labels = _data(rng)
+    ctx = make_mesh_context((4, 2))
+    kwargs = dict(hidden=8, learning_rate=0.05, compute_dtype="float32",
+                  seed=0, mesh_ctx=ctx)
+
+    full = train_cbow(paths, labels, max_epochs=12, **kwargs)
+
+    ckpt = str(tmp_path / "ck")
+    common = dict(checkpoint_dir=ckpt, checkpoint_every=3,
+                  checkpoint_layout="sharded", **kwargs)
+    train_cbow(paths, labels, max_epochs=6, **common)
+    # The orbax OCDBT layout is on disk (per-process shard files) at the
+    # dir the LATEST pointer names.
+    from g2vec_tpu.train.checkpoint import _latest_sharded_dir
+
+    layout_dir = _latest_sharded_dir(ckpt)
+    assert layout_dir is not None and os.path.isdir(layout_dir)
+    assert any(n.startswith("ocdbt.process_") for n in os.listdir(layout_dir))
+    resumed = train_cbow(paths, labels, max_epochs=12, resume=True, **common)
+
+    assert not full.stopped_early and not resumed.stopped_early
+    np.testing.assert_allclose(resumed.w_ih, full.w_ih, rtol=1e-5, atol=1e-7)
+    assert resumed.acc_val == pytest.approx(full.acc_val)
+
+
+def test_sharded_layout_terminal_state(rng, tmp_path):
+    """Early-stopped sharded checkpoints are terminal on resume, exactly
+    like the single layout."""
+    paths, labels = _data(rng, flip=0.3)
+    ckpt = str(tmp_path / "ck")
+    kwargs = dict(hidden=8, learning_rate=0.05, compute_dtype="float32",
+                  seed=3, max_epochs=200, checkpoint_dir=ckpt,
+                  checkpoint_layout="sharded")
+    first = train_cbow(paths, labels, **kwargs)
+    assert first.stopped_early
+    again = train_cbow(paths, labels, resume=True, **kwargs)
+    assert again.stopped_early
+    assert again.stop_epoch == first.stop_epoch
+    assert again.history == []
+    np.testing.assert_array_equal(again.w_ih, first.w_ih)
+
+
+def test_sharded_layout_shape_mismatch_and_cross_layout(rng, tmp_path):
+    paths, labels = _data(rng)
+    ckpt = str(tmp_path / "ck")
+    kwargs = dict(learning_rate=0.05, compute_dtype="float32", seed=0,
+                  max_epochs=3, checkpoint_dir=ckpt)
+    train_cbow(paths, labels, hidden=8, checkpoint_layout="sharded", **kwargs)
+    # Same clear error as the single layout on a config change.
+    with pytest.raises(ValueError, match="shape"):
+        train_cbow(paths, labels, hidden=16, checkpoint_layout="sharded",
+                   resume=True, **kwargs)
+    # Resuming with the WRONG layout must fail loudly, not retrain.
+    with pytest.raises(ValueError, match="checkpoint-layout"):
+        train_cbow(paths, labels, hidden=8, checkpoint_layout="single",
+                   resume=True, **kwargs)
+
+
+def test_sharded_layout_keeps_previous_until_commit(rng, tmp_path):
+    """Each save lands in a fresh numbered dir + atomic LATEST flip; after
+    two saves only the newest remains and LATEST points at it."""
+    import os
+
+    paths, labels = _data(rng)
+    ckpt = str(tmp_path / "ck")
+    kwargs = dict(hidden=8, learning_rate=0.05, compute_dtype="float32",
+                  seed=0, checkpoint_dir=ckpt, checkpoint_every=2,
+                  checkpoint_layout="sharded")
+    train_cbow(paths, labels, max_epochs=4, **kwargs)
+    names = sorted(n for n in os.listdir(ckpt)
+                   if n.startswith("cbow_state_ocdbt."))
+    dirs = [n for n in names if not n.endswith(".LATEST")]
+    assert len(dirs) == 1, names                 # older saves pruned
+    with open(os.path.join(ckpt, "cbow_state_ocdbt.LATEST")) as f:
+        assert f.read().strip() == dirs[0]

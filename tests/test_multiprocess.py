@@ -45,12 +45,14 @@ def _worker_env(port: int, process_id: int) -> dict:
 @pytest.mark.slow
 def test_two_process_cluster(tmp_path):
     port = _free_port()
+    shared = tmp_path / "shared_ck"     # the sharded-layout phase needs it
+    shared.mkdir()
     procs = []
     for i in range(2):
         scratch = tmp_path / f"p{i}"
         scratch.mkdir()
         procs.append(subprocess.Popen(
-            [sys.executable, _WORKER, str(scratch)],
+            [sys.executable, _WORKER, str(scratch), str(shared)],
             env=_worker_env(port, i), cwd=_REPO,
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
     results = []
@@ -75,4 +77,6 @@ def test_two_process_cluster(tmp_path):
     assert results[0]["resumed_digest"] == results[1]["resumed_digest"]
     assert (results[0]["sharded_fetch_digest"]
             == results[1]["sharded_fetch_digest"])
+    assert (results[0]["sharded_layout_digest"]
+            == results[1]["sharded_layout_digest"])
     assert results[0]["acc_val"] == pytest.approx(results[1]["acc_val"])

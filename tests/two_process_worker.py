@@ -70,11 +70,30 @@ def main() -> None:
     # spans devices owned by BOTH processes; pull it whole on each.
     w_full = dist.fetch_global(resumed.params.w_ih)
 
+    # --- sharded (orbax OCDBT) layout: SHARED dir, per-process shard
+    # files, no full-state gather (VERDICT round-1 #7) ---
+    shared_ckpt = sys.argv[2]
+    common_sharded = dict(common, checkpoint_dir=shared_ckpt,
+                          checkpoint_every=3, checkpoint_layout="sharded")
+    train_cbow(paths, labels, max_epochs=6, **common_sharded)
+    from g2vec_tpu.train.checkpoint import _latest_sharded_dir
+
+    layout_dir = _latest_sharded_dir(shared_ckpt)
+    names = os.listdir(layout_dir)
+    assert any(n == "ocdbt.process_0" for n in names), names
+    assert any(n == "ocdbt.process_1" for n in names), names
+    resumed_sh = train_cbow(paths, labels, max_epochs=12, resume=True,
+                            **common_sharded)
+    assert not resumed_sh.stopped_early
+    np.testing.assert_allclose(resumed_sh.w_ih, ref.w_ih,
+                               rtol=1e-5, atol=1e-7)
+
     print(json.dumps({
         "process": jax.process_index(),
         "n_global_devices": len(jax.devices()),
         "resumed_digest": _digest(resumed.w_ih),
         "sharded_fetch_digest": _digest(w_full),
+        "sharded_layout_digest": _digest(resumed_sh.w_ih),
         "acc_val": resumed.acc_val,
     }))
 
