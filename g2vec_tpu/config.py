@@ -59,7 +59,11 @@ class G2VecConfig:
     compat_lgroup_tiebreak: bool = False
     compute_dtype: str = "bfloat16"  # matmul dtype on TPU ("float32" for parity tests)
     param_dtype: str = "float32"
-    walker_batch: int = 0            # 0 = one repetition (n_genes walkers) per device batch
+    walker_batch: int = 0            # walkers per device launch; 0 = auto-sized
+                                     # by the HBM working-set model
+                                     # (ops.walker.auto_walker_batch)
+    walker_hbm_budget: int = 0       # device bytes the auto-sizer may plan for;
+                                     # 0 = ops.walker.WALKER_HBM_BUDGET (4 GiB)
     mesh_shape: Optional[Tuple[int, int]] = None  # (data, model); None = single device
     platform: Optional[str] = None   # force jax platform (e.g. "cpu")
     profile_dir: Optional[str] = None
@@ -94,6 +98,9 @@ class G2VecConfig:
             raise ValueError(f"numBiomarker must be >= 1, got {self.numBiomarker}")
         if self.walker_batch < 0:
             raise ValueError(f"walker_batch must be >= 0, got {self.walker_batch}")
+        if self.walker_hbm_budget < 0:
+            raise ValueError(
+                f"walker_hbm_budget must be >= 0, got {self.walker_hbm_budget}")
         if self.mesh_shape is not None and any(d < 1 for d in self.mesh_shape):
             raise ValueError(f"mesh axes must be >= 1, got {self.mesh_shape}")
         if self.n_lgroups < 3:
@@ -110,6 +117,8 @@ class G2VecConfig:
             raise ValueError(f"pcc_threshold must be in [0,1), got {self.pcc_threshold}")
         if self.compute_dtype not in ("bfloat16", "float32"):
             raise ValueError(f"compute_dtype must be bfloat16|float32, got {self.compute_dtype}")
+        if self.param_dtype not in ("bfloat16", "float32"):
+            raise ValueError(f"param_dtype must be bfloat16|float32, got {self.param_dtype}")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -147,7 +156,12 @@ def build_parser() -> argparse.ArgumentParser:
                         help="Reproduce the reference's degenerate L-group vote.")
     parser.add_argument("--compute-dtype", type=str, default="bfloat16",
                         choices=["bfloat16", "float32"])
-    parser.add_argument("--walker-batch", type=int, default=0)
+    parser.add_argument("--walker-batch", type=int, default=0,
+                        help="Walkers per device launch (0 = auto-sized "
+                             "against --walker-hbm-budget).")
+    parser.add_argument("--walker-hbm-budget", type=int, default=0,
+                        help="Device bytes the walker auto-sizer may plan "
+                             "for (0 = 4 GiB default).")
     parser.add_argument("--mesh", type=str, default=None, metavar="DATAxMODEL",
                         help="Device mesh shape, e.g. 4x2 (data x model).")
     parser.add_argument("--platform", type=str, default=None,
@@ -208,6 +222,7 @@ def config_from_args(argv=None) -> G2VecConfig:
         compat_lgroup_tiebreak=args.compat_lgroup_tiebreak,
         compute_dtype=args.compute_dtype,
         walker_batch=args.walker_batch,
+        walker_hbm_budget=args.walker_hbm_budget,
         mesh_shape=parse_mesh(args.mesh),
         platform=args.platform,
         profile_dir=args.profile_dir,

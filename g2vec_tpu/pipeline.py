@@ -160,6 +160,7 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
                 path_sets.append(generate_path_set(
                     table, jax.random.fold_in(key, i), len_path=cfg.lenPath,
                     reps=cfg.numRepetition, walker_batch=cfg.walker_batch,
+                    walker_hbm_budget=cfg.walker_hbm_budget,
                     mesh_ctx=mesh_ctx))
             # Paths stay bit-packed from the walker all the way into the
             # trainer — the dense uint8 [n_paths, n_genes] matrix never

@@ -256,8 +256,15 @@ def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
     if paths.shape[0] < 2:
         raise ValueError(f"need at least 2 paths to split, got {paths.shape[0]}")
     ctx = mesh_ctx if mesh_ctx is not None else make_mesh_context(None)
-    cdtype = jnp.bfloat16 if compute_dtype == "bfloat16" else jnp.float32
-    pdtype = jnp.float32 if param_dtype == "float32" else jnp.bfloat16
+    _DTYPES = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}
+    if compute_dtype not in _DTYPES:
+        raise ValueError(
+            f"compute_dtype must be one of {sorted(_DTYPES)}, got {compute_dtype!r}")
+    if param_dtype not in _DTYPES:
+        raise ValueError(
+            f"param_dtype must be one of {sorted(_DTYPES)}, got {param_dtype!r}")
+    cdtype = _DTYPES[compute_dtype]
+    pdtype = _DTYPES[param_dtype]
     if packed_genes is not None:
         n_paths, nb_in = paths.shape
         n_genes = packed_genes

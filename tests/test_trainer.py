@@ -105,3 +105,24 @@ def test_one_step_matches_numpy_adam(rng):
         vhat = grad * grad
         ref = w - lr * mhat / (np.sqrt(vhat) + 1e-8)
         np.testing.assert_allclose(np.asarray(ours), ref, rtol=2e-4, atol=2e-6)
+
+
+def test_unknown_dtypes_rejected(rng):
+    paths, labels = _separable_paths(rng, n_paths=8)
+    with pytest.raises(ValueError, match="param_dtype"):
+        train_cbow(paths, labels, hidden=4, learning_rate=0.01, max_epochs=1,
+                   compute_dtype="float32", param_dtype="float16")
+    with pytest.raises(ValueError, match="compute_dtype"):
+        train_cbow(paths, labels, hidden=4, learning_rate=0.01, max_epochs=1,
+                   compute_dtype="fp8")
+
+
+def test_config_validates_param_dtype():
+    from g2vec_tpu.config import G2VecConfig
+
+    cfg = G2VecConfig(param_dtype="float16", epoch=1)
+    with pytest.raises(ValueError, match="param_dtype"):
+        cfg.validate()
+    cfg2 = G2VecConfig(walker_hbm_budget=-1)
+    with pytest.raises(ValueError, match="walker_hbm_budget"):
+        cfg2.validate()

@@ -221,3 +221,48 @@ def test_path_set_invariant_to_mesh(rng):
     auto = generate_path_set(table, key, mesh_ctx=make_mesh_context((2, 2)),
                              **kwargs)
     assert base == auto
+
+
+def test_auto_walker_batch_model_respects_budget():
+    from g2vec_tpu.ops.walker import auto_walker_batch, walker_working_set
+
+    # 45k-gene scale (BASELINE configs #3-#5): the chosen batch must fit the
+    # stated budget and still make progress.
+    g, d, L = 45000, 4096, 80
+    total = 10 * g
+    budget = 4 * 1024**3
+    fixed = g * d * 8
+    batch = auto_walker_batch(g, d, L, total, dense=False,
+                              hbm_budget=budget, fixed_bytes=fixed)
+    per = walker_working_set(g, d, L, dense=False)
+    assert batch >= 1
+    assert batch * per <= budget - fixed
+    # A bundled-scale walk fits in ONE launch under the default budget.
+    b2 = auto_walker_batch(9904, 1024, 80, 99040, dense=False,
+                           fixed_bytes=9904 * 1024 * 8)
+    assert b2 == 99040
+    # A budget smaller than one walker still yields a working batch of 1.
+    assert auto_walker_batch(g, d, L, total, dense=False, hbm_budget=1) == 1
+
+
+def test_path_set_invariant_to_hbm_budget(rng):
+    # Tiny budget -> many small launches; result must equal one big launch.
+    from g2vec_tpu.ops.graph import neighbor_table
+
+    n = 16
+    src = rng.integers(0, n, 80).astype(np.int32)
+    dst = rng.integers(0, n, 80).astype(np.int32)
+    w = rng.random(80).astype(np.float32) + 0.1
+    table = neighbor_table(src, dst, w, n)
+    key = jax.random.key(9)
+    full = generate_path_set(table, key, len_path=5, reps=3)
+    tiny = generate_path_set(table, key, len_path=5, reps=3,
+                             walker_hbm_budget=walker_working_set_bytes(n))
+    assert full == tiny
+
+
+def walker_working_set_bytes(n):
+    from g2vec_tpu.ops.walker import walker_working_set
+
+    # budget covering ~5 walkers -> forces ceil(48/5) = 10 launches
+    return 5 * walker_working_set(n, 8, 5, dense=False)

@@ -1,0 +1,193 @@
+"""Time each candidate per-step op of the walker in isolation.
+
+Small jitted programs (fast compiles through the remote-compile tunnel, one
+op per program) at bench scale: W=G=9904 walkers, D=1024 neighbor slots.
+Each op is run in a 20-iteration lax.scan so per-op dispatch overhead does
+not drown sub-millisecond kernels. All inputs are generated ON DEVICE —
+host->device uploads through the tunnel are far slower than the ops being
+measured.
+
+Run: python tools/profile_ops.py [op ...]
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+G = 9904
+W = 9904
+D = 1024
+ITERS = 20
+COMPILE_TIMEOUT = int(os.environ.get("PROFILE_COMPILE_TIMEOUT", "150"))
+T0 = time.time()
+
+
+def note(msg):
+    print(f"[{time.time() - T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+def bench(name, fn, *args):
+    import signal
+
+    import jax
+
+    run = jax.jit(fn)
+
+    def _alarm(signum, frame):
+        raise TimeoutError(f"compile/run exceeded {COMPILE_TIMEOUT}s")
+
+    old = signal.signal(signal.SIGALRM, _alarm)
+    try:
+        try:
+            signal.alarm(COMPILE_TIMEOUT)
+            jax.block_until_ready(run(*args))
+            signal.alarm(0)
+        except TimeoutError as e:
+            signal.alarm(0)
+            note(f"{name}: TIMEOUT {e}")
+            return {"error": str(e)}
+        except Exception as e:  # noqa: BLE001
+            signal.alarm(0)
+            note(f"{name}: compile error {str(e)[:120]}")
+            return {"error": str(e)[:200]}
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+    t0 = time.time()
+    jax.block_until_ready(run(*args))
+    dt = (time.time() - t0) / ITERS * 1e3
+    note(f"{name:24s} {dt:8.3f} ms/iter")
+    return round(dt, 4)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    note(f"backend={jax.default_backend()}")
+
+    @jax.jit
+    def make_inputs(key):
+        ks = jax.random.split(key, 8)
+        nbr_idx = jax.random.randint(ks[0], (G, D), 0, G, dtype=jnp.int32)
+        nbr_w = jax.random.uniform(ks[1], (G, D))
+        visited = jax.random.uniform(ks[2], (W, G)) < 0.005
+        visited_u32 = jax.random.randint(
+            ks[3], (W, (G + 31) // 32), 0, 1 << 30, dtype=jnp.int32
+        ).astype(jnp.uint32)
+        cand0 = jax.random.randint(ks[4], (W, D), 0, G, dtype=jnp.int32)
+        w0 = jax.random.uniform(ks[5], (W, D))
+        u0 = jax.random.uniform(ks[6], (W,))
+        gumb = jax.random.gumbel(ks[7], (W, D))
+        return nbr_idx, nbr_w, visited, visited_u32, cand0, w0, u0, gumb
+
+    key = jax.random.key(0)
+    (nbr_idx, nbr_w, visited, visited_u32, cand0, w0, u0, gumb
+     ) = jax.block_until_ready(make_inputs(key))
+    walker_keys = jax.block_until_ready(
+        jax.jit(jax.vmap(lambda i: jax.random.fold_in(key, i)))(jnp.arange(W)))
+    note("inputs ready on device")
+
+    def scan20(body):
+        def fn(x):
+            def step(c, _):
+                return body(c), None
+            out, _ = jax.lax.scan(step, x, None, length=ITERS)
+            return out
+        return fn
+
+    ops = {}
+
+    # Row gather from the [G, D] tables (both tables, as the walker does).
+    ops["row_gather"] = (scan20(
+        lambda c: (nbr_idx[c[:, 0] % G][:, :1] +
+                   nbr_w[c[:, 0] % G][:, :1].astype(jnp.int32) + c) % G), cand0)
+
+    # Visited-bit gather: [W, D] take_along_axis from [W, G] bool.
+    ops["visited_gather_bool"] = (scan20(
+        lambda c: (c + jnp.take_along_axis(visited, c % G, axis=1)) % G), cand0)
+
+    # Path-list compare: seen[w,d] = any_l(path[w,l] == cand[w,d]), L=80.
+    path_list = (cand0[:, :80] % G).astype(jnp.int32)
+
+    def seen_compare(c):
+        seen = jnp.any(c[:, :, None] % G == path_list[:, None, :], axis=2)
+        return (c + seen) % G
+    ops["seen_compare_L80"] = (scan20(seen_compare), cand0)
+
+    # PRNG, shipping form: per-walker fold_in + gumbel (D,) under vmap.
+    def prng_vmap(c):
+        g = jax.vmap(lambda k: jax.random.gumbel(
+            jax.random.fold_in(k, c[0, 0]), (D,)))(walker_keys)
+        return (c + g[:, :1].astype(jnp.int32)) % G
+    ops["prng_vmap_WxD"] = (scan20(prng_vmap), cand0)
+
+    # PRNG, single-key [W, D] gumbel (what a per-step fold would cost).
+    def prng_flat(c):
+        g = jax.random.gumbel(jax.random.fold_in(key, c[0, 0]), (W, D))
+        return (c + g[:, :1].astype(jnp.int32)) % G
+    ops["prng_flat_WxD"] = (scan20(prng_flat), cand0)
+
+    # PRNG, one uniform per walker (inverse-CDF needs only this per step).
+    def prng_W(c):
+        u = jax.random.uniform(jax.random.fold_in(key, c[0, 0]), (W,))
+        return (c + u[:, None].astype(jnp.int32)) % G
+    ops["prng_W_only"] = (scan20(prng_W), cand0)
+
+    # Masked log + gumbel-argmax sample over D slots (no PRNG).
+    def gumbel_argmax(c):
+        w = jnp.where(c % 2 == 0, w0, 0.0)
+        logits = jnp.where(w > 0, jnp.log(jnp.where(w > 0, w, 1.0)), -1e30)
+        slot = jnp.argmax(logits + gumb, axis=1)
+        return (c + slot[:, None]) % G
+    ops["mask_log_argmax"] = (scan20(gumbel_argmax), cand0)
+
+    # Inverse-CDF sample over D slots: cumsum + count + masked-reduce pick.
+    def invcdf(c):
+        w = jnp.where(c % 2 == 0, w0, 0.0)
+        cum = jnp.cumsum(w, axis=1)
+        total = cum[:, -1]
+        slot = jnp.sum(cum <= (u0 * total)[:, None], axis=1).astype(jnp.int32)
+        slot = jnp.minimum(slot, D - 1)
+        sel = jnp.arange(D)[None, :] == slot[:, None]
+        nxt = jnp.sum(jnp.where(sel, c % G, 0), axis=1)
+        return (c + nxt[:, None]) % G
+    ops["invcdf_sample"] = (scan20(invcdf), cand0)
+
+    # Visited update, shipping form: one_hot [W, G] + OR.
+    def onehot_or(v):
+        nxt = v[:, 0].astype(jnp.int32) % G
+        moved = jax.nn.one_hot(nxt, G, dtype=jnp.bool_)
+        return v | moved
+    ops["visited_onehot_or"] = (scan20(onehot_or), visited)
+
+    # Visited update, scatter form.
+    def scatter_set(v):
+        nxt = v[:, 0].astype(jnp.int32) % G
+        return v.at[jnp.arange(W), nxt].set(True)
+    ops["visited_scatter"] = (scan20(scatter_set), visited)
+
+    # Path-list update: dynamic_update_slice one column (static step index
+    # inside the 20-iteration scan is the realistic pattern: index = carry).
+    def pathlist_update(c):
+        col = (c[:, :1] + 1) % G
+        out = jax.lax.dynamic_update_slice(c, col, (0, c[0, 0] % jnp.int32(D)))
+        return out
+    ops["pathlist_update"] = (scan20(pathlist_update), cand0)
+
+    only = sys.argv[1:] or list(ops)
+    results = {}
+    for name, (fn, arg) in ops.items():
+        if name not in only:
+            continue
+        results[name] = bench(name, fn, arg)
+    print(json.dumps({"backend": jax.default_backend(), "W": W, "G": G,
+                      "D": D, "ms_per_iter": results}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,210 @@
+"""Decompose the walker's per-step cost on the real backend.
+
+Times isolated variants of the sparse walk step at bench scale (the real
+bundled network: 9,904 genes, ~216k surviving edges, D=max out-degree) so the
+optimization targets measured numbers, not guesses (VERDICT r2 weak #1:
+"Nothing has been profiled").
+
+Variants (each a full scan over len_path-1 steps, W = n_genes walkers):
+  full            — the shipping _walk step (fold_in+gumbel per walker/step)
+  no_prng         — same step but a constant gumbel tensor (isolates PRNG)
+  no_visited      — PRNG + gather + sample, but no visited mask bookkeeping
+  gather_only     — just the [W, D] neighbor-table row gathers
+  invcdf          — candidate redesign: precomputed per-walker uniforms
+                    (one per step, drawn outside the scan) + masked cumsum
+                    inverse-CDF sampling + index-scatter visited
+
+Run:  python tools/profile_walker.py            (real backend)
+      JAX_PLATFORMS=cpu python tools/profile_walker.py   (host sanity)
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+from functools import partial
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+LEN_PATH = 80
+NEG_INF = -1e30
+
+
+def load_network():
+    from g2vec_tpu.ops.graph import neighbor_table
+    rng = np.random.default_rng(42)
+    src_names, dst_names = [], []
+    with open("/root/reference/ex_NETWORK.txt") as f:
+        next(f)
+        for line in f:
+            parts = line.rstrip().split("\t")
+            if len(parts) == 2:
+                src_names.append(parts[0])
+                dst_names.append(parts[1])
+    genes = sorted(set(src_names) | set(dst_names))
+    g2i = {g: i for i, g in enumerate(genes)}
+    src = np.fromiter((g2i[g] for g in src_names), np.int32)
+    dst = np.fromiter((g2i[g] for g in dst_names), np.int32)
+    keep = rng.random(src.size) < (216540 / 298799)
+    src, dst = src[keep], dst[keep]
+    w = rng.uniform(0.5001, 1.0, size=src.size).astype(np.float32)
+    return neighbor_table(src, dst, w, len(genes)), len(genes)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    (nbr_idx, nbr_w), n_genes = load_network()
+    D = nbr_idx.shape[1]
+    W = n_genes
+    print(f"# backend={jax.default_backend()} G={n_genes} D={D} W={W} "
+          f"steps={LEN_PATH - 1}", file=sys.stderr)
+
+    nbr_idx = jax.device_put(jnp.asarray(nbr_idx, jnp.int32))
+    nbr_w = jax.device_put(jnp.asarray(nbr_w, jnp.float32))
+    starts = jnp.arange(W, dtype=jnp.int32)
+    key = jax.random.key(0)
+    walker_keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(jnp.arange(W))
+
+    def scan_over(step_fn, init_extra=None):
+        visited0 = jax.nn.one_hot(starts, n_genes, dtype=jnp.bool_)
+        state0 = (visited0, starts, jnp.ones((W,), dtype=jnp.bool_))
+        if init_extra is not None:
+            state0 = state0 + init_extra
+
+        def run():
+            state, _ = jax.lax.scan(step_fn, state0, jnp.arange(LEN_PATH - 1))
+            return state[0]
+        return run
+
+    # --- full: the shipping step ------------------------------------------
+    def step_full(state, step_idx):
+        visited, current, alive = state
+        cand = nbr_idx[current]
+        seen = jnp.take_along_axis(visited, cand, axis=1)
+        w = jnp.where(seen, 0.0, nbr_w[current])
+        can_move = alive & (w.sum(axis=1) > 0.0)
+        logits = jnp.where(w > 0.0, jnp.log(jnp.where(w > 0.0, w, 1.0)), NEG_INF)
+        gumbel = jax.vmap(lambda k: jax.random.gumbel(
+            jax.random.fold_in(k, step_idx), (D,)))(walker_keys)
+        slot = jnp.argmax(logits + gumbel, axis=1)
+        nxt = jnp.take_along_axis(cand, slot[:, None], axis=1)[:, 0]
+        current = jnp.where(can_move, nxt, current)
+        moved = jax.nn.one_hot(nxt, n_genes, dtype=jnp.bool_) & can_move[:, None]
+        visited = visited | moved
+        return (visited, current, can_move), None
+
+    # --- no_prng: constant "gumbel" ---------------------------------------
+    const_gumbel = jax.random.gumbel(key, (W, D))
+
+    def step_no_prng(state, step_idx):
+        visited, current, alive = state
+        cand = nbr_idx[current]
+        seen = jnp.take_along_axis(visited, cand, axis=1)
+        w = jnp.where(seen, 0.0, nbr_w[current])
+        can_move = alive & (w.sum(axis=1) > 0.0)
+        logits = jnp.where(w > 0.0, jnp.log(jnp.where(w > 0.0, w, 1.0)), NEG_INF)
+        slot = jnp.argmax(logits + const_gumbel, axis=1)
+        nxt = jnp.take_along_axis(cand, slot[:, None], axis=1)[:, 0]
+        current = jnp.where(can_move, nxt, current)
+        moved = jax.nn.one_hot(nxt, n_genes, dtype=jnp.bool_) & can_move[:, None]
+        visited = visited | moved
+        return (visited, current, can_move), None
+
+    # --- no_visited: PRNG + gather + sample, no mask upkeep ---------------
+    def step_no_visited(state, step_idx):
+        visited, current, alive = state
+        cand = nbr_idx[current]
+        w = nbr_w[current]
+        can_move = alive & (w.sum(axis=1) > 0.0)
+        logits = jnp.where(w > 0.0, jnp.log(jnp.where(w > 0.0, w, 1.0)), NEG_INF)
+        gumbel = jax.vmap(lambda k: jax.random.gumbel(
+            jax.random.fold_in(k, step_idx), (D,)))(walker_keys)
+        slot = jnp.argmax(logits + gumbel, axis=1)
+        nxt = jnp.take_along_axis(cand, slot[:, None], axis=1)[:, 0]
+        current = jnp.where(can_move, nxt, current)
+        return (visited, current, can_move), None
+
+    # --- gather_only ------------------------------------------------------
+    def step_gather(state, step_idx):
+        visited, current, alive = state
+        cand = nbr_idx[current]
+        w = nbr_w[current]
+        current = (current + cand[:, 0] + w[:, 0].astype(jnp.int32)) % n_genes
+        return (visited, current, alive), None
+
+    # --- invcdf: candidate redesign ---------------------------------------
+    # One uniform per (walker, step), drawn OUTSIDE the scan from the
+    # per-walker key (keeps walker_batch invariance); visited updated by
+    # index scatter, not one_hot OR.
+    uniforms = jax.vmap(
+        lambda k: jax.random.uniform(k, (LEN_PATH - 1,)))(walker_keys)  # [W, S]
+    uniforms = uniforms.T  # [S, W]
+
+    def step_invcdf(state, per_step):
+        step_idx = per_step if not isinstance(per_step, tuple) else per_step[0]
+        visited, current, alive = state
+        u = uniforms[step_idx]
+        cand = nbr_idx[current]
+        seen = jnp.take_along_axis(visited, cand, axis=1)
+        w = jnp.where(seen, 0.0, nbr_w[current])
+        cum = jnp.cumsum(w, axis=1)
+        total = cum[:, -1]
+        can_move = alive & (total > 0.0)
+        target = u * total
+        slot = jnp.sum(cum <= target[:, None], axis=1).astype(jnp.int32)
+        slot = jnp.minimum(slot, D - 1)
+        nxt = jnp.take_along_axis(cand, slot[:, None], axis=1)[:, 0]
+        current = jnp.where(can_move, nxt, current)
+        visited = visited.at[jnp.arange(W), nxt].max(can_move)
+        return (visited, current, can_move), None
+
+    variants = {
+        "full": step_full,
+        "no_prng": step_no_prng,
+        "no_visited": step_no_visited,
+        "gather_only": step_gather,
+        "invcdf": step_invcdf,
+    }
+    only = sys.argv[1:] or list(variants)
+    results = {}
+    for name, fn in variants.items():
+        if name not in only:
+            continue
+        run = jax.jit(scan_over(fn))
+        for attempt in range(3):             # compile (tunnel can flake)
+            try:
+                run().block_until_ready()
+                break
+            except Exception as e:  # noqa: BLE001
+                print(f"# {name}: compile attempt {attempt} failed: "
+                      f"{str(e)[:120]}", file=sys.stderr)
+                time.sleep(5)
+        else:
+            results[name] = {"error": "compile failed"}
+            continue
+        t0 = time.time()
+        run().block_until_ready()
+        first = time.time() - t0
+        reps = 1 if first > 3.0 else 3
+        t0 = time.time()
+        for _ in range(reps):
+            out = run()
+        out.block_until_ready()
+        dt = (time.time() - t0) / reps
+        per_step_ms = dt / (LEN_PATH - 1) * 1e3
+        walks_per_sec = W / dt
+        results[name] = {"launch_s": round(dt, 4),
+                         "per_step_ms": round(per_step_ms, 3),
+                         "walks_per_sec": round(walks_per_sec, 1)}
+        print(f"{name:12s} launch={dt:.4f}s  step={per_step_ms:.3f}ms  "
+              f"{walks_per_sec:.0f} walks/s", file=sys.stderr)
+    print(json.dumps({"backend": jax.default_backend(), "G": n_genes,
+                      "D": int(D), "W": W, "variants": results}))
+
+
+if __name__ == "__main__":
+    main()
