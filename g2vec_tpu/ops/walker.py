@@ -76,14 +76,19 @@ def _per_walker_uniforms(key: jax.Array, n_walkers: int, n_steps: int
                          ) -> jax.Array:
     """[n_steps, W] uniforms; walker w's column depends only on its key.
 
-    ``key`` is one PRNG key (walker keys derived by position) or a [W] key
-    array (the batch-invariant path: keys bound to global walker identity).
-    Drawn once per launch — the scan body consumes a row per step and does
-    zero PRNG work.
+    ``key`` is one PRNG key (walker keys derived by position), a [W] key
+    array, or a [W, 2] uint32 key-DATA array (jax.random.key_data form —
+    what :func:`generate_path_set` ships host->device: a committed typed-key
+    array cannot be device_put onto a cross-process sharding, raw uint32
+    can). Either [W] form is the batch-invariant path: keys bound to global
+    walker identity. Drawn once per launch — the scan body consumes a row
+    per step and does zero PRNG work.
     """
     if key.ndim == 0:
         keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
             jnp.arange(n_walkers))
+    elif key.ndim == 2:
+        keys = jax.random.wrap_key_data(key)
     else:
         keys = key
     u = jax.vmap(lambda k: jax.random.uniform(
@@ -308,7 +313,7 @@ def _sharded_sparse_walk_fn(mesh, n_genes: int, len_path: int):
     sharded = jax.shard_map(
         walk, mesh=mesh,
         in_specs=(P(MODEL_AXIS, None), P(MODEL_AXIS, None),
-                  P(DATA_AXIS), P(DATA_AXIS)),
+                  P(DATA_AXIS), P(DATA_AXIS, None)),
         out_specs=P(DATA_AXIS, None),
         # The scan carry mixes constants (alive mask init) with
         # data-varying state; the VMA check rejects that mix even though
@@ -464,11 +469,14 @@ def generate_path_set(adj, key: jax.Array, *, len_path: int, reps: int,
 
     # One flat walker axis over all repetitions. Stream identity: walker
     # (rep r, index i) draws from fold_in(split(key, reps)[r], i) — the
-    # same derivation regardless of how launches slice the axis.
+    # same derivation regardless of how launches slice the axis. Keys
+    # travel as raw uint32 key DATA: numpy crosses host->global-sharding
+    # fine, a committed typed-key array does not.
     rep_keys = jax.random.split(key, reps)
-    all_keys = jax.vmap(lambda rk: jax.vmap(
+    all_keys = np.asarray(jax.random.key_data(jax.vmap(lambda rk: jax.vmap(
         lambda i: jax.random.fold_in(rk, i))(jnp.arange(starts.size))
-    )(rep_keys).reshape(reps * starts.size)
+    )(rep_keys)))
+    all_keys = all_keys.reshape(reps * starts.size, -1)
     all_starts = np.tile(starts, reps)
     total = all_starts.size
     if walker_batch > 0:
@@ -491,11 +499,11 @@ def generate_path_set(adj, key: jax.Array, *, len_path: int, reps: int,
         if n_pad != n_real:
             chunk = np.concatenate(
                 [chunk, np.repeat(chunk[:1], n_pad - n_real)])
-            chunk_keys = jnp.concatenate(
+            chunk_keys = np.concatenate(
                 [chunk_keys,
-                 jnp.repeat(chunk_keys[:1], n_pad - n_real, axis=0)])
+                 np.repeat(chunk_keys[:1], n_pad - n_real, axis=0)])
         chunk = ctx.put(jnp.asarray(chunk), walker_spec)
-        chunk_keys = ctx.put(chunk_keys, walker_spec)
+        chunk_keys = ctx.put(chunk_keys, P(DATA_AXIS, None))
         if sparse and shard_tables and model_dim > 1:
             fn = _get_sharded_walk_fn(ctx.mesh, n_genes, len_path)
             packed_dev = fn(table[0], table[1], chunk, chunk_keys)

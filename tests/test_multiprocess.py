@@ -79,4 +79,8 @@ def test_two_process_cluster(tmp_path):
             == results[1]["sharded_fetch_digest"])
     assert (results[0]["sharded_layout_digest"]
             == results[1]["sharded_layout_digest"])
+    # Sharded-table walk across the process boundary: both processes must
+    # see the same path set, equal to their single-process local run (the
+    # worker asserts the local equality; this pins cross-process equality).
+    assert results[0]["walker_digest"] == results[1]["walker_digest"]
     assert results[0]["acc_val"] == pytest.approx(results[1]["acc_val"])

@@ -257,12 +257,15 @@ def test_path_set_invariant_to_hbm_budget(rng):
     key = jax.random.key(9)
     full = generate_path_set(table, key, len_path=5, reps=3)
     tiny = generate_path_set(table, key, len_path=5, reps=3,
-                             walker_hbm_budget=walker_working_set_bytes(n))
+                             walker_hbm_budget=walker_budget_for(table, n, 5))
     assert full == tiny
 
 
-def walker_working_set_bytes(n):
+def walker_budget_for(table, n, walkers):
+    """Budget covering the tables plus ~``walkers`` walkers, so the run
+    splits into ceil(total/walkers) launches."""
     from g2vec_tpu.ops.walker import walker_working_set
 
-    # budget covering ~5 walkers -> forces ceil(48/5) = 10 launches
-    return 5 * walker_working_set(n, 8, 5, dense=False)
+    fixed = table[0].size * 8
+    return fixed + walkers * walker_working_set(n, table[0].shape[1], 5,
+                                                dense=False)

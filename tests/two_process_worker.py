@@ -88,12 +88,35 @@ def main() -> None:
     np.testing.assert_allclose(resumed_sh.w_ih, ref.w_ih,
                                rtol=1e-5, atol=1e-7)
 
+    # --- sharded walker across the true 2-process mesh (VERDICT r2 #6):
+    # tables row-sharded over 'model', walkers DP over 'data', and the
+    # packed path rows span devices BOTH processes own — the
+    # fetch_global packed-mask path crossing a real process boundary.
+    from g2vec_tpu.ops.graph import neighbor_table
+    from g2vec_tpu.ops.walker import generate_path_set
+
+    wrng = np.random.default_rng(3)
+    n = 24
+    src = wrng.integers(0, n, 140).astype(np.int32)
+    dst = wrng.integers(0, n, 140).astype(np.int32)
+    wts = wrng.random(140).astype(np.float32) + 0.1
+    table = neighbor_table(src, dst, wts, n)
+    wkey = jax.random.key(17)
+    local = generate_path_set(table, wkey, len_path=5, reps=2)  # no mesh
+    sharded = generate_path_set(table, wkey, len_path=5, reps=2,
+                                mesh_ctx=ctx, shard_tables=True)
+    assert sharded == local, (
+        f"cross-process sharded walk diverged: {len(sharded)} vs "
+        f"{len(local)} paths")
+    walker_digest = hashlib.sha256(b"".join(sorted(sharded))).hexdigest()
+
     print(json.dumps({
         "process": jax.process_index(),
         "n_global_devices": len(jax.devices()),
         "resumed_digest": _digest(resumed.w_ih),
         "sharded_fetch_digest": _digest(w_full),
         "sharded_layout_digest": _digest(resumed_sh.w_ih),
+        "walker_digest": walker_digest,
         "acc_val": resumed.acc_val,
     }))
 

@@ -20,23 +20,26 @@ Run:  python tools/profile_walker.py            (real backend)
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 from functools import partial
 
 import numpy as np
 
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 LEN_PATH = 80
 NEG_INF = -1e30
+NETWORK = os.environ.get("G2VEC_PROFILE_NETWORK",
+                         "/root/reference/ex_NETWORK.txt")
 
 
 def load_network():
     from g2vec_tpu.ops.graph import neighbor_table
     rng = np.random.default_rng(42)
     src_names, dst_names = [], []
-    with open("/root/reference/ex_NETWORK.txt") as f:
+    with open(NETWORK) as f:
         next(f)
         for line in f:
             parts = line.rstrip().split("\t")
