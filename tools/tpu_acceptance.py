@@ -1,0 +1,93 @@
+"""End-to-end pipeline acceptance ON THE REAL TPU -> TPU_ACCEPTANCE.json.
+
+VERDICT r2 missing #1: the only full seven-stage artifact on record
+(REAL_ACCEPTANCE.json) ran on CPU virtual devices. This runs the exact
+tests/test_acceptance_real.py configuration — the real bundled network
+(298,799 edges, 9,904 genes) + real clinical file (135 samples) + the
+statistically matched expression matrix (g2vec_tpu/data/realistic.py),
+reference CLI defaults (reps=10, lenPath=80, hidden=128) — on the real
+chip, and records per-stage seconds, path counts, and ACC[val] next to the
+reference transcript's numbers (/root/reference/README.md:26-41: ~63 s of
+training alone plus self-declared minutes of walking on its CPU).
+
+Run (ambient axon env, no platform override):  python tools/tpu_acceptance.py
+Writes TPU_ACCEPTANCE.json at the repo root.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+NET = os.environ.get("G2VEC_ACCEPT_NETWORK", "/root/reference/ex_NETWORK.txt")
+CLIN = os.environ.get("G2VEC_ACCEPT_CLINICAL",
+                      "/root/reference/ex_CLINICAL.txt")
+OUT = os.path.join(REPO, "TPU_ACCEPTANCE.json")
+
+
+def main() -> None:
+    t_start = time.time()
+    import jax
+
+    backend = jax.default_backend()
+    device = str(jax.devices()[0])
+    print(f"# backend={backend} device={device}", file=sys.stderr)
+
+    from g2vec_tpu.config import G2VecConfig
+    from g2vec_tpu.data.realistic import write_real_expression_tsv
+    from g2vec_tpu.pipeline import run
+
+    with tempfile.TemporaryDirectory() as tmp:
+        expr_path = os.path.join(tmp, "real_EXPRESSION.txt")
+        t0 = time.time()
+        write_real_expression_tsv(NET, CLIN, expr_path)
+        gen_secs = time.time() - t0
+        cfg = G2VecConfig(expression_file=expr_path, clinical_file=CLIN,
+                          network_file=NET,
+                          result_name=os.path.join(tmp, "real"), seed=0)
+        t0 = time.time()
+        res = run(cfg, console=lambda s: print(f"# {s}", file=sys.stderr))
+        total = time.time() - t0
+
+    artifact = {
+        "platform": backend,
+        "device": device,
+        "config": "real ex_NETWORK + ex_CLINICAL + realistic expression, "
+                  "CLI defaults (reps=10, lenPath=80, hidden=128), seed=0",
+        "n_samples": res.n_samples,
+        "n_genes": res.n_genes,
+        "n_edges": res.n_edges,
+        "n_paths": res.n_paths,
+        "n_path_genes": res.n_path_genes,
+        "acc_val": round(res.acc_val, 4),
+        "stage_seconds": {k: round(v, 2)
+                          for k, v in res.stage_seconds.items()},
+        "pipeline_wall_seconds": round(total, 2),
+        "expression_gen_seconds": round(gen_secs, 2),
+        "script_wall_seconds": round(time.time() - t_start, 2),
+        "reference_transcript": {
+            "n_paths": 45402, "n_path_genes": 3773, "acc_val": 0.8837,
+            "train_wall_seconds": 63,
+            "walk_wall": "unreported; self-declared 'most time consuming "
+                         "step' (G2Vec.py:58)",
+            "source": "/root/reference/README.md:26-41",
+        },
+    }
+    with open(OUT, "w") as f:
+        json.dump(artifact, f, indent=2)
+        f.write("\n")
+    print(json.dumps(artifact))
+    ok = backend == "tpu" and res.acc_val >= 0.88
+    print(f"# {'OK' if ok else 'NOT-OK'}: backend={backend} "
+          f"acc_val={res.acc_val:.4f} total={total:.1f}s "
+          f"stages={artifact['stage_seconds']}", file=sys.stderr)
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()
