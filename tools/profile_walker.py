@@ -1,38 +1,44 @@
-"""Decompose the walker's per-step cost on the real backend.
+"""Before/after walker profile on the real backend.
 
-Times isolated variants of the sparse walk step at bench scale (the real
-bundled network: 9,904 genes, ~216k surviving edges, D=max out-degree) so the
-optimization targets measured numbers, not guesses (VERDICT r2 weak #1:
-"Nothing has been profiled").
+Times, at bench scale (the real bundled network: 9,904 genes, ~216k
+surviving edges, neighbor-table D = max out-degree rounded to pow2):
 
-Variants (each a full scan over len_path-1 steps, W = n_genes walkers):
-  full            — the shipping _walk step (fold_in+gumbel per walker/step)
-  no_prng         — same step but a constant gumbel tensor (isolates PRNG)
-  no_visited      — PRNG + gather + sample, but no visited mask bookkeeping
-  gather_only     — just the [W, D] neighbor-table row gathers
-  invcdf          — candidate redesign: precomputed per-walker uniforms
-                    (one per step, drawn outside the scan) + masked cumsum
-                    inverse-CDF sampling + index-scatter visited
+  r2_step   — an inline reproduction of the ROUND-2 walk step (per-walker
+              fold_in + [W, D] gumbel each step, visited take_along_axis
+              + one_hot OR; what BENCH_r02 measured at 578.9 walks/s);
+  new_1rep  — the shipping walker (ops/walker.py random_walks_sparse +
+              device packbits) at W = n_genes (one repetition);
+  new_full  — the shipping walker at W = reps*n_genes = the single fused
+              launch generate_path_set now dispatches.
 
-Run:  python tools/profile_walker.py            (real backend)
-      JAX_PLATFORMS=cpu python tools/profile_walker.py   (host sanity)
+Results feed PROFILE.md's before/after table.
+
+Run:  python tools/profile_walker.py [variant ...]   (real backend)
+      G2VEC_PROFILE_NETWORK=... to point at another edge list.
 """
 from __future__ import annotations
 
 import json
 import os
+import signal
 import sys
 import time
-from functools import partial
 
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 LEN_PATH = 80
+REPS = 10
 NEG_INF = -1e30
 NETWORK = os.environ.get("G2VEC_PROFILE_NETWORK",
                          "/root/reference/ex_NETWORK.txt")
+COMPILE_TIMEOUT = int(os.environ.get("PROFILE_COMPILE_TIMEOUT", "240"))
+T0 = time.time()
+
+
+def note(msg):
+    print(f"[{time.time() - T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
 
 
 def load_network():
@@ -56,157 +62,108 @@ def load_network():
     return neighbor_table(src, dst, w, len(genes)), len(genes)
 
 
+def timed(name, fn, n_walks):
+    """Compile (alarm-bounded), then time; returns a result dict."""
+    import jax
+
+    def _alarm(signum, frame):
+        raise TimeoutError(f"compile exceeded {COMPILE_TIMEOUT}s")
+
+    old = signal.signal(signal.SIGALRM, _alarm)
+    try:
+        signal.alarm(COMPILE_TIMEOUT)
+        t0 = time.time()
+        jax.block_until_ready(fn())
+        compile_s = time.time() - t0
+        signal.alarm(0)
+    except TimeoutError as e:
+        note(f"{name}: {e}")
+        return {"error": str(e)}
+    except Exception as e:  # noqa: BLE001
+        note(f"{name}: {str(e)[:160]}")
+        return {"error": str(e)[:300]}
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+    t0 = time.time()
+    jax.block_until_ready(fn())
+    dt = time.time() - t0
+    res = {"launch_s": round(dt, 3),
+           "per_step_ms": round(dt / (LEN_PATH - 1) * 1e3, 3),
+           "walks_per_sec": round(n_walks / dt, 1),
+           "first_call_s": round(compile_s, 1)}
+    note(f"{name}: {res}")
+    return res
+
+
 def main():
     import jax
     import jax.numpy as jnp
 
-    (nbr_idx, nbr_w), n_genes = load_network()
-    D = nbr_idx.shape[1]
-    W = n_genes
-    print(f"# backend={jax.default_backend()} G={n_genes} D={D} W={W} "
-          f"steps={LEN_PATH - 1}", file=sys.stderr)
+    (nbr_idx_np, nbr_w_np), n_genes = load_network()
+    D = nbr_idx_np.shape[1]
+    note(f"backend={jax.default_backend()} G={n_genes} D={D} "
+         f"steps={LEN_PATH - 1}")
 
-    nbr_idx = jax.device_put(jnp.asarray(nbr_idx, jnp.int32))
-    nbr_w = jax.device_put(jnp.asarray(nbr_w, jnp.float32))
-    starts = jnp.arange(W, dtype=jnp.int32)
+    nbr_idx = jax.device_put(jnp.asarray(nbr_idx_np, jnp.int32))
+    nbr_w = jax.device_put(jnp.asarray(nbr_w_np, jnp.float32))
     key = jax.random.key(0)
-    walker_keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(jnp.arange(W))
 
-    def scan_over(step_fn, init_extra=None):
+    # ---- r2_step: the round-2 walk, reproduced inline ----
+    def r2_walk(starts):
+        W = starts.shape[0]
+        walker_keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
+            jnp.arange(W))
         visited0 = jax.nn.one_hot(starts, n_genes, dtype=jnp.bool_)
-        state0 = (visited0, starts, jnp.ones((W,), dtype=jnp.bool_))
-        if init_extra is not None:
-            state0 = state0 + init_extra
+        state0 = (visited0, starts.astype(jnp.int32),
+                  jnp.ones((W,), dtype=jnp.bool_))
 
-        def run():
-            state, _ = jax.lax.scan(step_fn, state0, jnp.arange(LEN_PATH - 1))
-            return state[0]
-        return run
+        def step(state, step_idx):
+            visited, current, alive = state
+            cand = nbr_idx[current]
+            seen = jnp.take_along_axis(visited, cand, axis=1)
+            w = jnp.where(seen, 0.0, nbr_w[current])
+            can_move = alive & (w.sum(axis=1) > 0.0)
+            logits = jnp.where(w > 0.0, jnp.log(jnp.where(w > 0.0, w, 1.0)),
+                               NEG_INF)
+            gumbel = jax.vmap(lambda k: jax.random.gumbel(
+                jax.random.fold_in(k, step_idx), (D,)))(walker_keys)
+            slot = jnp.argmax(logits + gumbel, axis=1)
+            nxt = jnp.take_along_axis(cand, slot[:, None], axis=1)[:, 0]
+            current = jnp.where(can_move, nxt, current)
+            moved = (jax.nn.one_hot(nxt, n_genes, dtype=jnp.bool_)
+                     & can_move[:, None])
+            return (visited | moved, current, can_move), None
 
-    # --- full: the shipping step ------------------------------------------
-    def step_full(state, step_idx):
-        visited, current, alive = state
-        cand = nbr_idx[current]
-        seen = jnp.take_along_axis(visited, cand, axis=1)
-        w = jnp.where(seen, 0.0, nbr_w[current])
-        can_move = alive & (w.sum(axis=1) > 0.0)
-        logits = jnp.where(w > 0.0, jnp.log(jnp.where(w > 0.0, w, 1.0)), NEG_INF)
-        gumbel = jax.vmap(lambda k: jax.random.gumbel(
-            jax.random.fold_in(k, step_idx), (D,)))(walker_keys)
-        slot = jnp.argmax(logits + gumbel, axis=1)
-        nxt = jnp.take_along_axis(cand, slot[:, None], axis=1)[:, 0]
-        current = jnp.where(can_move, nxt, current)
-        moved = jax.nn.one_hot(nxt, n_genes, dtype=jnp.bool_) & can_move[:, None]
-        visited = visited | moved
-        return (visited, current, can_move), None
+        (visited, _, _), _ = jax.lax.scan(
+            step, state0, jnp.arange(LEN_PATH - 1))
+        return visited
 
-    # --- no_prng: constant "gumbel" ---------------------------------------
-    const_gumbel = jax.random.gumbel(key, (W, D))
+    r2_jit = jax.jit(r2_walk)
 
-    def step_no_prng(state, step_idx):
-        visited, current, alive = state
-        cand = nbr_idx[current]
-        seen = jnp.take_along_axis(visited, cand, axis=1)
-        w = jnp.where(seen, 0.0, nbr_w[current])
-        can_move = alive & (w.sum(axis=1) > 0.0)
-        logits = jnp.where(w > 0.0, jnp.log(jnp.where(w > 0.0, w, 1.0)), NEG_INF)
-        slot = jnp.argmax(logits + const_gumbel, axis=1)
-        nxt = jnp.take_along_axis(cand, slot[:, None], axis=1)[:, 0]
-        current = jnp.where(can_move, nxt, current)
-        moved = jax.nn.one_hot(nxt, n_genes, dtype=jnp.bool_) & can_move[:, None]
-        visited = visited | moved
-        return (visited, current, can_move), None
+    from g2vec_tpu.ops.walker import _packed_walk_sparse
 
-    # --- no_visited: PRNG + gather + sample, no mask upkeep ---------------
-    def step_no_visited(state, step_idx):
-        visited, current, alive = state
-        cand = nbr_idx[current]
-        w = nbr_w[current]
-        can_move = alive & (w.sum(axis=1) > 0.0)
-        logits = jnp.where(w > 0.0, jnp.log(jnp.where(w > 0.0, w, 1.0)), NEG_INF)
-        gumbel = jax.vmap(lambda k: jax.random.gumbel(
-            jax.random.fold_in(k, step_idx), (D,)))(walker_keys)
-        slot = jnp.argmax(logits + gumbel, axis=1)
-        nxt = jnp.take_along_axis(cand, slot[:, None], axis=1)[:, 0]
-        current = jnp.where(can_move, nxt, current)
-        return (visited, current, can_move), None
-
-    # --- gather_only ------------------------------------------------------
-    def step_gather(state, step_idx):
-        visited, current, alive = state
-        cand = nbr_idx[current]
-        w = nbr_w[current]
-        current = (current + cand[:, 0] + w[:, 0].astype(jnp.int32)) % n_genes
-        return (visited, current, alive), None
-
-    # --- invcdf: candidate redesign ---------------------------------------
-    # One uniform per (walker, step), drawn OUTSIDE the scan from the
-    # per-walker key (keeps walker_batch invariance); visited updated by
-    # index scatter, not one_hot OR.
-    uniforms = jax.vmap(
-        lambda k: jax.random.uniform(k, (LEN_PATH - 1,)))(walker_keys)  # [W, S]
-    uniforms = uniforms.T  # [S, W]
-
-    def step_invcdf(state, per_step):
-        step_idx = per_step if not isinstance(per_step, tuple) else per_step[0]
-        visited, current, alive = state
-        u = uniforms[step_idx]
-        cand = nbr_idx[current]
-        seen = jnp.take_along_axis(visited, cand, axis=1)
-        w = jnp.where(seen, 0.0, nbr_w[current])
-        cum = jnp.cumsum(w, axis=1)
-        total = cum[:, -1]
-        can_move = alive & (total > 0.0)
-        target = u * total
-        slot = jnp.sum(cum <= target[:, None], axis=1).astype(jnp.int32)
-        slot = jnp.minimum(slot, D - 1)
-        nxt = jnp.take_along_axis(cand, slot[:, None], axis=1)[:, 0]
-        current = jnp.where(can_move, nxt, current)
-        visited = visited.at[jnp.arange(W), nxt].max(can_move)
-        return (visited, current, can_move), None
+    starts_1 = jnp.arange(n_genes, dtype=jnp.int32)
+    keys_1 = jax.vmap(lambda i: jax.random.fold_in(key, i))(
+        jnp.arange(n_genes))
+    starts_n = jnp.tile(starts_1, REPS)
+    keys_n = jax.vmap(lambda i: jax.random.fold_in(key, i))(
+        jnp.arange(n_genes * REPS))
 
     variants = {
-        "full": step_full,
-        "no_prng": step_no_prng,
-        "no_visited": step_no_visited,
-        "gather_only": step_gather,
-        "invcdf": step_invcdf,
+        "r2_step": (lambda: r2_jit(starts_1), n_genes),
+        "new_1rep": (lambda: _packed_walk_sparse(
+            nbr_idx, nbr_w, starts_1, keys_1, LEN_PATH), n_genes),
+        "new_full": (lambda: _packed_walk_sparse(
+            nbr_idx, nbr_w, starts_n, keys_n, LEN_PATH), n_genes * REPS),
     }
     only = sys.argv[1:] or list(variants)
     results = {}
-    for name, fn in variants.items():
-        if name not in only:
-            continue
-        run = jax.jit(scan_over(fn))
-        for attempt in range(3):             # compile (tunnel can flake)
-            try:
-                run().block_until_ready()
-                break
-            except Exception as e:  # noqa: BLE001
-                print(f"# {name}: compile attempt {attempt} failed: "
-                      f"{str(e)[:120]}", file=sys.stderr)
-                time.sleep(5)
-        else:
-            results[name] = {"error": "compile failed"}
-            continue
-        t0 = time.time()
-        run().block_until_ready()
-        first = time.time() - t0
-        reps = 1 if first > 3.0 else 3
-        t0 = time.time()
-        for _ in range(reps):
-            out = run()
-        out.block_until_ready()
-        dt = (time.time() - t0) / reps
-        per_step_ms = dt / (LEN_PATH - 1) * 1e3
-        walks_per_sec = W / dt
-        results[name] = {"launch_s": round(dt, 4),
-                         "per_step_ms": round(per_step_ms, 3),
-                         "walks_per_sec": round(walks_per_sec, 1)}
-        print(f"{name:12s} launch={dt:.4f}s  step={per_step_ms:.3f}ms  "
-              f"{walks_per_sec:.0f} walks/s", file=sys.stderr)
+    for name, (fn, n_walks) in variants.items():
+        if name in only:
+            results[name] = timed(name, fn, n_walks)
     print(json.dumps({"backend": jax.default_backend(), "G": n_genes,
-                      "D": int(D), "W": W, "variants": results}))
+                      "D": int(D), "len_path": LEN_PATH, "variants": results}))
 
 
 if __name__ == "__main__":

@@ -11,7 +11,10 @@ reference transcript's numbers (/root/reference/README.md:26-41: ~63 s of
 training alone plus self-declared minutes of walking on its CPU).
 
 Run (ambient axon env, no platform override):  python tools/tpu_acceptance.py
-Writes TPU_ACCEPTANCE.json at the repo root.
+Writes TPU_ACCEPTANCE.json at the repo root. With
+``G2VEC_ACCEPT_PLATFORM=cpu`` (set in-process — see bench.py's
+_apply_platform_override for why not env JAX_PLATFORMS) it instead
+refreshes REAL_ACCEPTANCE.json, the CPU-virtual-mesh twin.
 """
 from __future__ import annotations
 
@@ -27,12 +30,24 @@ sys.path.insert(0, REPO)
 NET = os.environ.get("G2VEC_ACCEPT_NETWORK", "/root/reference/ex_NETWORK.txt")
 CLIN = os.environ.get("G2VEC_ACCEPT_CLINICAL",
                       "/root/reference/ex_CLINICAL.txt")
-OUT = os.path.join(REPO, "TPU_ACCEPTANCE.json")
 
 
 def main() -> None:
     t_start = time.time()
+    plat = os.environ.get("G2VEC_ACCEPT_PLATFORM")
+    if plat:
+        os.environ["JAX_PLATFORMS"] = plat
+        if plat == "cpu" and "host_platform_device_count" not in os.environ.get(
+                "XLA_FLAGS", ""):
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=8")
     import jax
+
+    if plat:
+        jax.config.update("jax_platforms", plat)
+    out = os.path.join(
+        REPO, "REAL_ACCEPTANCE.json" if plat == "cpu" else "TPU_ACCEPTANCE.json")
 
     backend = jax.default_backend()
     device = str(jax.devices()[0])
@@ -78,11 +93,11 @@ def main() -> None:
             "source": "/root/reference/README.md:26-41",
         },
     }
-    with open(OUT, "w") as f:
+    with open(out, "w") as f:
         json.dump(artifact, f, indent=2)
         f.write("\n")
     print(json.dumps(artifact))
-    ok = backend == "tpu" and res.acc_val >= 0.88
+    ok = res.acc_val >= 0.88 and (backend == "tpu" or plat == "cpu")
     print(f"# {'OK' if ok else 'NOT-OK'}: backend={backend} "
           f"acc_val={res.acc_val:.4f} total={total:.1f}s "
           f"stages={artifact['stage_seconds']}", file=sys.stderr)
