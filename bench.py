@@ -360,18 +360,30 @@ def _bench_walker(table, n_genes: int, len_path: int, reps: int) -> dict:
 
     key = jax.random.key(0)
     total = n_genes * reps
-    # Warmup at the REAL launch shape: with fused reps + auto-batching the
-    # timed run is one [total]-walker dispatch; warming up with reps=1 and
-    # walker_batch=total pads to that exact shape, so the compile (and one
-    # full-size execution) happen outside the timed window.
-    generate_path_set(table, key, len_path=len_path, reps=1,
-                      walker_batch=total)
 
-    t0 = time.time()
-    paths = generate_path_set(table, key, len_path=len_path, reps=reps)
-    elapsed = time.time() - t0
-    return {"walks": total, "elapsed": elapsed,
-            "walks_per_sec": total / elapsed, "unique_paths": len(paths)}
+    def run(batch: int) -> dict:
+        # Warmup at the REAL launch shape: the timed run dispatches
+        # [batch]-walker programs; a reps=1 warmup at walker_batch=batch
+        # pads to that exact shape, so the compile (and one full-size
+        # execution) happen outside the timed window.
+        generate_path_set(table, key, len_path=len_path, reps=1,
+                          walker_batch=batch)
+        t0 = time.time()
+        paths = generate_path_set(table, key, len_path=len_path, reps=reps,
+                                  walker_batch=batch)
+        elapsed = time.time() - t0
+        return {"walks": total, "elapsed": elapsed, "batch": batch,
+                "walks_per_sec": total / elapsed, "unique_paths": len(paths)}
+
+    try:
+        return run(total)          # one fused launch (the auto-size choice)
+    except Exception as e:  # noqa: BLE001 — OOM/compile trouble at [total]
+        print(f"# walker fused launch failed ({type(e).__name__}: "
+              f"{str(e)[:200]}); retrying at batch={n_genes}",
+              file=sys.stderr, flush=True)
+        out = run(n_genes)         # r2-shaped sequential launches
+        out["fused_launch_error"] = f"{type(e).__name__}: {e}"[:300]
+        return out
 
 
 def _bench_kernel_ab(hidden: int) -> dict:
