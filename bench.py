@@ -540,14 +540,18 @@ def _measure() -> None:
              f"{res['walks_per_sec']:.0f} walks/s; {res['unique_paths']} "
              f"unique paths; host loop {baseline:.1f} walks/s "
              f"({n_base} stratified walks)")
-        emit({"metric": "walker_walks_per_sec",
-              "value": round(res["walks_per_sec"], 1), "unit": "walks/s",
-              "vs_baseline": round(res["walks_per_sec"] / baseline, 2),
-              "unique_paths": res["unique_paths"],
-              "baseline_host_walks_per_sec": round(baseline, 2),
-              "n_genes": n_genes, "len_path": LEN_PATH, "reps": WALKER_REPS,
-              "scale_note": "full bundled network (9,904 genes), not the "
-                            "7,523-gene stage-3 restriction"})
+        line = {"metric": "walker_walks_per_sec",
+                "value": round(res["walks_per_sec"], 1), "unit": "walks/s",
+                "vs_baseline": round(res["walks_per_sec"] / baseline, 2),
+                "unique_paths": res["unique_paths"],
+                "baseline_host_walks_per_sec": round(baseline, 2),
+                "n_genes": n_genes, "len_path": LEN_PATH,
+                "reps": WALKER_REPS, "walker_batch": res["batch"],
+                "scale_note": "full bundled network (9,904 genes), not the "
+                              "7,523-gene stage-3 restriction"}
+        if "fused_launch_error" in res:
+            line["fused_launch_error"] = res["fused_launch_error"]
+        emit(line)
     except Exception as e:  # noqa: BLE001 — degrade to an error line
         walker_err = f"{type(e).__name__}: {e}"[:500]
         emit({"metric": "walker_walks_per_sec", "value": None,
@@ -604,10 +608,14 @@ def _measure() -> None:
         res2 = _bench_walker(table, n_genes, 160, WALKER_REPS)
         note(f"config2 walker (lenPath=160): {res2['walks_per_sec']:.0f} "
              f"walks/s")
-        emit({"metric": "config2_walker_walks_per_sec",
-              "value": round(res2["walks_per_sec"], 1), "unit": "walks/s",
-              "vs_baseline": None, "len_path": 160,
-              "unique_paths": res2["unique_paths"], "n_genes": n_genes})
+        line2 = {"metric": "config2_walker_walks_per_sec",
+                 "value": round(res2["walks_per_sec"], 1), "unit": "walks/s",
+                 "vs_baseline": None, "len_path": 160,
+                 "unique_paths": res2["unique_paths"], "n_genes": n_genes,
+                 "walker_batch": res2["batch"]}
+        if "fused_launch_error" in res2:
+            line2["fused_launch_error"] = res2["fused_launch_error"]
+        emit(line2)
 
     guarded("packed_matmul_vs_xla_dense", 60, kernel_ab)
     guarded("cbow_epoch_breakdown", 60, breakdown)

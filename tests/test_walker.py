@@ -269,3 +269,48 @@ def walker_budget_for(table, n, walkers):
     fixed = table[0].size * 8
     return fixed + walkers * walker_working_set(n, table[0].shape[1], 5,
                                                 dense=False)
+
+
+def test_packbits_rows_matches_numpy(rng):
+    from g2vec_tpu.ops.walker import _packbits_rows
+
+    for n in (8, 13, 64, 9904):
+        rows = rng.random((7, n)) < 0.3
+        got = np.asarray(_packbits_rows(jax.numpy.asarray(rows)))
+        np.testing.assert_array_equal(got, np.packbits(rows, axis=1))
+
+
+def test_sample_slots_is_exactly_categorical():
+    # Inverse-CDF on a dense u grid: the selected-slot frequencies must
+    # equal the normalized weights to grid resolution, and zero-weight
+    # slots (leading, interior, trailing/padding) must NEVER be chosen.
+    import jax.numpy as jnp
+
+    from g2vec_tpu.ops.walker import _sample_slots
+
+    w_row = np.array([0.0, 2.0, 0.0, 3.0, 5.0, 0.0, 0.0], dtype=np.float32)
+    n = 20000
+    u = (np.arange(n) + 0.5) / n
+    w = jnp.asarray(np.tile(w_row, (n, 1)))
+    slot, total = _sample_slots(w, jnp.asarray(u, jnp.float32))
+    slot = np.asarray(slot)
+    np.testing.assert_allclose(np.asarray(total), w_row.sum(), rtol=1e-6)
+    counts = np.bincount(slot, minlength=7)
+    assert counts[0] == counts[2] == counts[5] == counts[6] == 0
+    np.testing.assert_allclose(counts[[1, 3, 4]] / n,
+                               w_row[[1, 3, 4]] / w_row.sum(), atol=1e-3)
+    # All-zero weights (dead end): total must be 0 so the caller freezes.
+    _, total0 = _sample_slots(jnp.zeros((4, 7)), jnp.asarray(u[:4], jnp.float32))
+    assert (np.asarray(total0) == 0).all()
+
+
+def test_visited_from_path_list_ignores_sentinels():
+    import jax.numpy as jnp
+
+    from g2vec_tpu.ops.walker import _visited_from_path_list
+
+    path = jnp.asarray(np.array([[3, 1, -1, -1], [0, 2, 2, -1]], np.int32))
+    visited = np.asarray(_visited_from_path_list(path, 5))
+    np.testing.assert_array_equal(visited, [
+        [False, True, False, True, False],
+        [True, False, True, False, False]])
