@@ -17,7 +17,12 @@ gumbel-max sampler produced 38,603 and round 3's inverse-CDF sampler
 38,571 on the same inputs — two independent samplers agreeing to 0.1%
 while both trailing the transcript means the synthetic |PCC| weight
 distribution dedups slightly more walks than the (unpublished) real
-expression did. NOTE: fewer repetitions make the first-val-dip early
+expression did. Growing the planted modules does not close it cleanly:
+n_active_per_group 1,940 -> 2,060 (+6.2%) moved n_paths only +3.6%
+(38,571 -> 39,945) while pushing path genes +6.2% past their
+near-exact match (3,858 -> 4,099 vs target 3,773) — the real modules
+are denser per gene than a BFS ball of the same size, which is a
+structural property of the missing expression file, not a spec knob. NOTE: fewer repetitions make the first-val-dip early
 stop (reference quirk (c)) brittle — reps=2 stops at ACC~0.74 — so this
 test pays the ~5 min for the real configuration; deselect with
 ``-m "not slow"``.

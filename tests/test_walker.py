@@ -94,7 +94,7 @@ def test_generate_path_set_dedups():
 
 
 def test_walker_batching_equivalence(rng):
-    # STOCHASTIC graph: batch size must not change which Gumbel stream each
+    # STOCHASTIC graph: batch size must not change which uniform stream each
     # walker draws (per-walker keys are bound to global walker identity).
     n = 10
     adj = rng.random((n, n)).astype(np.float32)
