@@ -256,11 +256,64 @@ def _packbits_rows(visited: jax.Array) -> jax.Array:
     return jnp.sum(bits * weights, axis=2, dtype=jnp.uint8)
 
 
+_PACK_SLAB = 8   # path slots folded per pass in _packed_from_path_list
+
+
+def _packed_from_path_list(path_list: jax.Array, n_genes: int) -> jax.Array:
+    """[W, L] node lists (-1 = empty) -> packed [W, ceil(G/8)] uint8 directly.
+
+    np.packbits layout without ever materializing the [W, G] bool mask
+    (≈1 GB at a full bundled-scale launch): byte j of walker w ORs the bit
+    of every path node whose gene id lives in byte j. Path nodes are unique
+    (no-revisit), so the bits are distinct and a SUM equals the OR; -1
+    sentinels contribute bit 0 and match no byte (arithmetic shift keeps
+    them negative).
+
+    The compare runs in slabs of _PACK_SLAB path slots (a fori_loop over
+    L/8 passes): XLA is expected to fuse each [W, nb, 8] broadcast-compare
+    straight into its reduce, but the slab bounds the worst case if it ever
+    does not — a whole-L pass would be a [W, nb, L] intermediate (~10 GB at
+    full bundled-launch scale), a slab is G-bytes-per-walker at most (and
+    :func:`walker_working_set` budgets exactly that; a scatter-add would
+    avoid the question but in-scan 2D scatters are the one construct that
+    wedged XLA:TPU compilation outright, PROFILE.md).
+    """
+    nb = (n_genes + 7) // 8
+    n_slots = path_list.shape[1]
+    pad = (-n_slots) % _PACK_SLAB
+    if pad:
+        path_list = jnp.pad(path_list, ((0, 0), (0, pad)), constant_values=-1)
+    byte_idx = path_list >> 3                              # [W, L']
+    bit = jnp.where(path_list >= 0,
+                    jnp.uint8(128) >> (path_list & 7).astype(jnp.uint8),
+                    jnp.uint8(0))
+    bytes_ax = jnp.arange(nb)[None, :, None]
+
+    def body(k, acc):
+        b_idx = jax.lax.dynamic_slice_in_dim(byte_idx, k * _PACK_SLAB,
+                                             _PACK_SLAB, axis=1)
+        b_bit = jax.lax.dynamic_slice_in_dim(bit, k * _PACK_SLAB,
+                                             _PACK_SLAB, axis=1)
+        match = b_idx[:, None, :] == bytes_ax              # [W, nb, SLAB]
+        return acc + jnp.sum(
+            jnp.where(match, b_bit[:, None, :], jnp.uint8(0)),
+            axis=2, dtype=jnp.uint8)
+
+    acc0 = jnp.zeros((path_list.shape[0], nb), dtype=jnp.uint8)
+    return jax.lax.fori_loop(0, path_list.shape[1] // _PACK_SLAB, body, acc0)
+
+
 @partial(jax.jit, static_argnames=("len_path",))
 def _packed_walk_sparse(nbr_idx, nbr_w, starts, keys, len_path: int):
-    """Sparse walk returning bit-packed rows (device-side packbits)."""
-    visited = random_walks_sparse(nbr_idx, nbr_w, starts, keys, len_path)
-    return _packbits_rows(visited)
+    """Sparse walk returning bit-packed rows, no [W, G] intermediate."""
+    n_steps = max(len_path - 1, 0)
+    uniforms = _per_walker_uniforms(keys, starts.shape[0], n_steps)
+
+    def nbr_rows(current):
+        return nbr_idx[current], nbr_w[current]
+
+    path_list = _sparse_path_scan(nbr_rows, starts, uniforms, len_path)
+    return _packed_from_path_list(path_list, nbr_idx.shape[0])
 
 
 @partial(jax.jit, static_argnames=("len_path",))
@@ -308,7 +361,7 @@ def _sharded_sparse_walk_fn(mesh, n_genes: int, len_path: int):
                     jax.lax.psum(w, MODEL_AXIS))
 
         path_list = _sparse_path_scan(nbr_rows, starts, uniforms, len_path)
-        return _packbits_rows(_visited_from_path_list(path_list, n_genes))
+        return _packed_from_path_list(path_list, n_genes)
 
     sharded = jax.shard_map(
         walk, mesh=mesh,
@@ -356,15 +409,20 @@ def walker_working_set(n_genes: int, d_slots: int, len_path: int,
     """Per-walker device bytes of one walk launch (model, not measurement).
 
     Sparse step: [D]-wide candidate/weight/cumsum temporaries (~4 f32/i32
-    arrays live at once), the [L] int32 path list, [S] uniforms, the final
-    [G] bool visited row plus its packed form. Dense step: the [G]-wide row
-    is the candidate buffer AND the visited row.
+    arrays live at once), the [L] int32 path list, [S] uniforms, and the
+    packed-row encode (no [W, G] bool intermediate — the packed bytes come
+    straight from the path list; budgeted at the WORST-case unfused
+    [nb, _PACK_SLAB] compare slab plus accumulator/output, ~10 bytes per
+    output byte, see _packed_from_path_list). Dense step: the [G]-wide row
+    is the candidate buffer AND the visited row, and the bool mask is
+    packed afterward.
     """
     if dense:
         per_step = 4 * 4 * n_genes           # adj row + masked + cumsum + sel
+        encode = n_genes + (n_genes + 7) // 8   # visited bool + packed bits
     else:
         per_step = 4 * 4 * d_slots + 4 * len_path
-    encode = n_genes + (n_genes + 7) // 8    # visited bool + packed bits
+        encode = (_PACK_SLAB + 2) * ((n_genes + 7) // 8)
     return per_step + 4 * max(len_path - 1, 1) + encode + 64
 
 

@@ -314,3 +314,22 @@ def test_visited_from_path_list_ignores_sentinels():
     np.testing.assert_array_equal(visited, [
         [False, True, False, True, False],
         [True, False, True, False, False]])
+
+
+def test_packed_from_path_list_matches_bool_route(rng):
+    import jax.numpy as jnp
+
+    from g2vec_tpu.ops.walker import (_packbits_rows, _packed_from_path_list,
+                                      _visited_from_path_list)
+
+    for n in (9, 16, 40):
+        # Unique nodes per row (the walk's no-revisit guarantee), -1 padded.
+        rows = []
+        for _ in range(6):
+            k = rng.integers(1, min(n, 7))
+            ids = rng.choice(n, size=k, replace=False).astype(np.int32)
+            rows.append(np.pad(ids, (0, 7 - k), constant_values=-1))
+        path = jnp.asarray(np.stack(rows))
+        direct = np.asarray(_packed_from_path_list(path, n))
+        via_bool = np.asarray(_packbits_rows(_visited_from_path_list(path, n)))
+        np.testing.assert_array_equal(direct, via_bool)

@@ -108,8 +108,8 @@ def test_sparse_batching_invariance(rng):
 
 def test_sparse_dense_distributional_agreement(rng):
     # Same stochastic graph, many walks: visit frequencies per gene should
-    # agree between implementations (they draw different Gumbel streams, so
-    # compare statistics, not sets).
+    # agree between implementations (their inverse-CDF slot orders differ —
+    # gene ids vs neighbor-list position — so compare statistics, not sets).
     n = 8
     adj = (rng.random((n, n)) * (rng.random((n, n)) < 0.5)).astype(np.float32)
     np.fill_diagonal(adj, 0.0)
