@@ -69,13 +69,16 @@ LEN_PATH = int(os.environ.get("G2VEC_BENCH_LEN_PATH", "80"))
 WALKER_REPS = int(os.environ.get("G2VEC_BENCH_WALKER_REPS", "10"))
 REFERENCE_NETWORK = "/root/reference/ex_NETWORK.txt"
 
-# The trainer runs epochs in device-resident chunks of DEFAULT_CHUNK (=64)
+# The trainer runs epochs in device-resident chunks of DEFAULT_CHUNK (=128)
 # epochs per dispatch; per-epoch times inside a chunk are uniform. The first
 # measured chunk absorbs the host->device transfer of the (bit-packed) path
 # matrix, so steady state is read from the chunks after it. A separate
 # warmup call compiles the chunk program (the jit cache is shared across
 # train_cbow calls).
-WARMUP_EPOCHS = int(os.environ.get("G2VEC_BENCH_WARMUP_EPOCHS", "64"))
+# Warmup 0 = exactly one DEFAULT_CHUNK of epochs: the chunk program's shape
+# depends on min(DEFAULT_CHUNK, max_epochs), so a shorter warmup would
+# compile a different program than the measured run uses.
+WARMUP_EPOCHS = int(os.environ.get("G2VEC_BENCH_WARMUP_EPOCHS", "0"))
 MEASURE_EPOCHS = int(os.environ.get("G2VEC_BENCH_MEASURE_EPOCHS", "192"))
 
 PROBE_TIMEOUT = int(os.environ.get("G2VEC_BENCH_PROBE_TIMEOUT", "75"))
@@ -250,8 +253,10 @@ def _bench_train(paths, labels, hidden: int, measure_epochs: int,
                   val_fraction=VAL_FRACTION, compute_dtype="bfloat16", seed=0,
                   use_pallas=use_pallas)
 
-    # Warmup call: compiles the chunk program (one chunk's worth of epochs).
-    train_cbow(paths, labels, max_epochs=WARMUP_EPOCHS, **common)
+    # Warmup call: compiles the chunk program (one chunk's worth of epochs —
+    # shorter would compile a different-shaped program than the timed run).
+    train_cbow(paths, labels, max_epochs=WARMUP_EPOCHS or DEFAULT_CHUNK,
+               **common)
     res = train_cbow(paths, labels, max_epochs=measure_epochs, **common)
 
     epoch_secs = [h["secs"] for h in res.history]
@@ -586,9 +591,14 @@ def _measure() -> None:
         emit({"metric": "cbow_epoch_breakdown", "value": bd["epoch_ms"],
               "unit": "ms", "vs_baseline": None, **bd})
 
+    # Control/config2 runs measure one chunk past the transfer-absorbing
+    # first chunk (the steady-state filter needs epochs beyond DEFAULT_CHUNK).
+    from g2vec_tpu.train.trainer import DEFAULT_CHUNK
+    control_epochs = DEFAULT_CHUNK + max(32, DEFAULT_CHUNK // 2)
+
     def xla_control():
         sec_d, mfu_d = _bench_train(paths, labels, HIDDEN,
-                                    WARMUP_EPOCHS * 2, use_pallas=False)
+                                    control_epochs, use_pallas=False)
         note(f"xla-dense control: sec/epoch={sec_d:.4f} mfu={mfu_d:.4f}")
         emit({"metric": "cbow_train_xla_dense_sec_per_epoch", "value":
               round(sec_d, 5), "unit": "s", "vs_baseline": None,
@@ -596,7 +606,7 @@ def _measure() -> None:
               "pallas_speedup": round(sec_d / sec_per_epoch, 2)})
 
     def config2_train():
-        sec2, mfu2 = _bench_train(paths, labels, 512, WARMUP_EPOCHS * 2)
+        sec2, mfu2 = _bench_train(paths, labels, 512, control_epochs)
         tp = int(N_PATHS * (1 - VAL_FRACTION))
         note(f"config2 train (hidden=512): sec/epoch={sec2:.4f} mfu={mfu2:.4f}")
         emit({"metric": "config2_train_paths_per_sec_per_chip",

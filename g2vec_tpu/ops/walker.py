@@ -218,6 +218,22 @@ def _sparse_path_scan(nbr_rows, starts: jax.Array, uniforms: jax.Array,
     return path_list
 
 
+def _sparse_path_list(nbr_idx, nbr_w, starts, key, len_path: int):
+    """Replicated-table sparse walk -> [W, len_path] path lists.
+
+    The single place that binds the uniform streams to the replicated
+    neighbor-table layout; both public encodings (bool visited, packed
+    bytes) consume it so they cannot drift.
+    """
+    n_steps = max(len_path - 1, 0)
+    uniforms = _per_walker_uniforms(key, starts.shape[0], n_steps)
+
+    def nbr_rows(current):
+        return nbr_idx[current], nbr_w[current]
+
+    return _sparse_path_scan(nbr_rows, starts, uniforms, len_path)
+
+
 @partial(jax.jit, static_argnames=("len_path",))
 def random_walks_sparse(nbr_idx: jax.Array, nbr_w: jax.Array,
                         starts: jax.Array, key: jax.Array,
@@ -230,13 +246,7 @@ def random_walks_sparse(nbr_idx: jax.Array, nbr_w: jax.Array,
     step touches no [W, G] state at all (see module docstring). Returns
     visited [W, G] bool — identical encoding to the dense path.
     """
-    n_steps = max(len_path - 1, 0)
-    uniforms = _per_walker_uniforms(key, starts.shape[0], n_steps)
-
-    def nbr_rows(current):
-        return nbr_idx[current], nbr_w[current]
-
-    path_list = _sparse_path_scan(nbr_rows, starts, uniforms, len_path)
+    path_list = _sparse_path_list(nbr_idx, nbr_w, starts, key, len_path)
     return _visited_from_path_list(path_list, nbr_idx.shape[0])
 
 
@@ -306,13 +316,7 @@ def _packed_from_path_list(path_list: jax.Array, n_genes: int) -> jax.Array:
 @partial(jax.jit, static_argnames=("len_path",))
 def _packed_walk_sparse(nbr_idx, nbr_w, starts, keys, len_path: int):
     """Sparse walk returning bit-packed rows, no [W, G] intermediate."""
-    n_steps = max(len_path - 1, 0)
-    uniforms = _per_walker_uniforms(keys, starts.shape[0], n_steps)
-
-    def nbr_rows(current):
-        return nbr_idx[current], nbr_w[current]
-
-    path_list = _sparse_path_scan(nbr_rows, starts, uniforms, len_path)
+    path_list = _sparse_path_list(nbr_idx, nbr_w, starts, keys, len_path)
     return _packed_from_path_list(path_list, nbr_idx.shape[0])
 
 

@@ -47,9 +47,13 @@ from g2vec_tpu.parallel.mesh import MeshContext, make_mesh_context
 
 
 # Epochs executed per device dispatch when not checkpointing. The host round
-# trip to a tunneled TPU is ~90 ms; the epoch math at example scale is ~15 ms,
-# so syncing every epoch would be 6x overhead. 64 amortizes it to ~2%.
-DEFAULT_CHUNK = 64
+# trip to a tunneled TPU is ~90 ms; the epoch math at example scale is ~7 ms
+# (BENCH_r02), so syncing every epoch would be >10x overhead and even 64
+# epochs/chunk left ~1.4 ms/epoch of sync in the measured steady state. 128
+# amortizes the round trip to ~0.7 ms/epoch; the early stop still exits ON
+# the dip (the device while_loop tests it every epoch), so a bigger chunk
+# wastes no compute — it only coarsens the history delivery cadence.
+DEFAULT_CHUNK = 128
 
 
 def _default_backend() -> str:
