@@ -253,9 +253,11 @@ def _bench_train(paths, labels, hidden: int, measure_epochs: int,
                   val_fraction=VAL_FRACTION, compute_dtype="bfloat16", seed=0,
                   use_pallas=use_pallas)
 
-    # Warmup call: compiles the chunk program (one chunk's worth of epochs —
-    # shorter would compile a different-shaped program than the timed run).
-    train_cbow(paths, labels, max_epochs=WARMUP_EPOCHS or DEFAULT_CHUNK,
+    # Warmup call: compiles the chunk program. The timed run's program
+    # shape is min(DEFAULT_CHUNK, measure_epochs) — warm up with exactly
+    # that, or the measured first chunk would contain a fresh compile.
+    train_cbow(paths, labels,
+               max_epochs=WARMUP_EPOCHS or min(DEFAULT_CHUNK, measure_epochs),
                **common)
     res = train_cbow(paths, labels, max_epochs=measure_epochs, **common)
 

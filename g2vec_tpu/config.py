@@ -62,7 +62,9 @@ class G2VecConfig:
     walker_batch: int = 0            # walkers per device launch; 0 = auto-sized
                                      # by the HBM working-set model
                                      # (ops.walker.auto_walker_batch)
-    walker_hbm_budget: int = 0       # device bytes the auto-sizer may plan for;
+    walker_hbm_budget: int = 0       # device bytes of per-walker state the
+                                     # auto-sizer may plan for (tables are
+                                     # separate, launch-invariant residents);
                                      # 0 = ops.walker.WALKER_HBM_BUDGET (4 GiB)
     mesh_shape: Optional[Tuple[int, int]] = None  # (data, model); None = single device
     platform: Optional[str] = None   # force jax platform (e.g. "cpu")

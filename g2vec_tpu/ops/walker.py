@@ -432,19 +432,23 @@ def walker_working_set(n_genes: int, d_slots: int, len_path: int,
 
 def auto_walker_batch(n_genes: int, d_slots: int, len_path: int,
                       n_walkers_total: int, dense: bool,
-                      hbm_budget: int = 0, fixed_bytes: int = 0) -> int:
+                      hbm_budget: int = 0) -> int:
     """Walkers per launch under ``hbm_budget`` (0 = WALKER_HBM_BUDGET).
 
-    ``fixed_bytes``: launch-independent residents (the transition tables).
-    Answers VERDICT r2 #4: the reference dies on dense [G, G] memory at
-    40k+ genes (ref: G2Vec.py:377) and round 2's walker made the batch a
-    manual knob; this sizes it from a stated working-set model the same way
-    the Pallas kernel sizes its tiles (ops/packed_matmul.py).
+    The budget governs the MARGINAL per-walker state only — transition
+    tables are launch-invariant residents that batching cannot shrink
+    (their lever is 'model'-axis sharding, SHARD_TABLE_BYTES), so they are
+    deliberately outside this subtraction: dividing them out once drove
+    the batch to 1 on a scale-free 45k-gene graph whose padded table
+    alone exceeded the budget, turning one walk into 45k single-walker
+    dispatches. Answers VERDICT r2 #4: the reference dies on dense [G, G]
+    memory at 40k+ genes (ref: G2Vec.py:377) and round 2's walker made the
+    batch a manual knob; this sizes it from a stated working-set model the
+    same way the Pallas kernel sizes its tiles (ops/packed_matmul.py).
     """
     budget = hbm_budget if hbm_budget > 0 else WALKER_HBM_BUDGET
     per_walker = walker_working_set(n_genes, d_slots, len_path, dense)
-    avail = max(budget - fixed_bytes, per_walker)
-    return int(max(1, min(n_walkers_total, avail // per_walker)))
+    return int(max(1, min(n_walkers_total, budget // per_walker)))
 
 
 def generate_path_set(adj, key: jax.Array, *, len_path: int, reps: int,
@@ -519,12 +523,10 @@ def generate_path_set(adj, key: jax.Array, *, len_path: int, reps: int,
             table_spec = P()
         table = (ctx.put(jnp.asarray(nbr_idx, dtype=jnp.int32), table_spec),
                  ctx.put(jnp.asarray(nbr_w, dtype=jnp.float32), table_spec))
-        fixed_bytes = int(nbr_idx.size) * 8
     else:
         n_genes = int(adj.shape[0])
         d_slots = n_genes
         table = ctx.put(jnp.asarray(adj, dtype=jnp.float32), P())
-        fixed_bytes = n_genes * n_genes * 4
     if starts is None:
         starts = np.arange(n_genes, dtype=np.int32)
     starts = np.asarray(starts, dtype=np.int32)
@@ -546,8 +548,7 @@ def generate_path_set(adj, key: jax.Array, *, len_path: int, reps: int,
     else:
         batch = auto_walker_batch(n_genes, d_slots, len_path, total,
                                   dense=not sparse,
-                                  hbm_budget=walker_hbm_budget,
-                                  fixed_bytes=fixed_bytes)
+                                  hbm_budget=walker_hbm_budget)
 
     # Every launch pads to the SAME [n_pad] walker shape (duplicate walker
     # 0, rows dropped after): one compiled program serves the whole run —
