@@ -629,6 +629,64 @@ def _measure() -> None:
             line2["fused_launch_error"] = res2["fused_launch_error"]
         emit(line2)
 
+    # ---- opportunistic TPU acceptance (VERDICT r2 #2) ----
+    # If this process is on the real chip and the round has no
+    # TPU_ACCEPTANCE.json yet (e.g. the tunnel was down for the whole
+    # interactive session, as in round 3), produce it HERE: it outranks the
+    # optional control stages and the artifact lands in the repo for the
+    # end-of-round commit. The trainer chunk program is shared with the
+    # headline stage (same shapes), so the extra cost is the acceptance
+    # walker/kmeans compiles plus the run itself.
+    def tpu_acceptance():
+        import signal
+
+        import jax
+
+        from tools.tpu_acceptance import _git_head, run_acceptance
+
+        repo = os.path.dirname(os.path.abspath(__file__))
+        out_path = os.path.join(repo, "TPU_ACCEPTANCE.json")
+        if jax.default_backend() != "tpu":
+            emit({"metric": "tpu_acceptance_acc_val", "value": None,
+                  "unit": "", "vs_baseline": None,
+                  "skipped": f"backend is {jax.default_backend()}, not tpu"})
+            return
+        if os.path.exists(out_path):
+            # Fresh only if recorded against THIS code state; an artifact
+            # committed by a previous round must not stand in for it.
+            try:
+                recorded = json.load(open(out_path)).get("git_head")
+            except ValueError:
+                recorded = None
+            if recorded and recorded == _git_head():
+                emit({"metric": "tpu_acceptance_acc_val", "value": None,
+                      "unit": "", "vs_baseline": None,
+                      "skipped": "already recorded at this git head"})
+                return
+
+        # Abort cleanly if the run outlives the remaining budget: later
+        # stages still get their skip/error lines and the parent's kill
+        # window is never hit mid-pipeline.
+        def _alarm(signum, frame):
+            raise TimeoutError("acceptance run exceeded the stage budget")
+
+        old = signal.signal(signal.SIGALRM, _alarm)
+        signal.alarm(max(30, int(remaining() - 25)))
+        try:
+            art = run_acceptance(out_path)
+        finally:
+            signal.alarm(0)
+            signal.signal(signal.SIGALRM, old)
+        ref_acc = art["reference_transcript"]["acc_val"]
+        emit({"metric": "tpu_acceptance_acc_val",
+              "value": round(art["acc_val"], 4),
+              "unit": "ACC[val]",
+              "vs_baseline": round(art["acc_val"] / ref_acc, 3),
+              "n_paths": art["n_paths"],
+              "stage_seconds": art["stage_seconds"],
+              "pipeline_wall_seconds": art["pipeline_wall_seconds"]})
+
+    guarded("tpu_acceptance_acc_val", 180, tpu_acceptance)
     guarded("packed_matmul_vs_xla_dense", 60, kernel_ab)
     guarded("cbow_epoch_breakdown", 60, breakdown)
     guarded("cbow_train_xla_dense_sec_per_epoch", 60, xla_control)
