@@ -33,13 +33,30 @@ CLIN = os.environ.get("G2VEC_ACCEPT_CLINICAL",
 
 
 def _git_head() -> str:
-    """Current commit hash, or "" — the artifact's freshness key (a bench
-    run skips regeneration only when the recorded head matches its own)."""
+    """Current commit hash, or "" — provenance only (see :func:`_code_key`)."""
     import subprocess
     try:
         return subprocess.run(["git", "rev-parse", "HEAD"], cwd=REPO,
                               capture_output=True, text=True,
                               timeout=10).stdout.strip()
+    except Exception:  # noqa: BLE001
+        return ""
+
+
+def _code_key() -> str:
+    """Hash of the source trees the acceptance run depends on — the
+    artifact's freshness key. Deliberately NOT the commit hash: committing
+    TPU_ACCEPTANCE.json itself creates a new HEAD, so a HEAD-based key
+    self-invalidates the moment the artifact lands and every later bench
+    re-burns the ~180s acceptance stage on identical code. The g2vec_tpu/
+    tree hash changes only when the measured pipeline code does (harness
+    edits don't retroactively change what was measured)."""
+    import subprocess
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD:g2vec_tpu"], cwd=REPO,
+            capture_output=True, text=True, timeout=10)
+        return out.stdout.strip()
     except Exception:  # noqa: BLE001
         return ""
 
@@ -84,6 +101,7 @@ def run_acceptance(out_path: str) -> dict:
         "acc_val": res.acc_val,     # full precision: the >= 0.88 gate and
                                     # vs_baseline must not see rounding
         "git_head": _git_head(),
+        "code_key": _code_key(),
         "stage_seconds": {k: round(v, 2)
                           for k, v in res.stage_seconds.items()},
         "pipeline_wall_seconds": round(total, 2),
