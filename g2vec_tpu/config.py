@@ -69,6 +69,9 @@ class G2VecConfig:
     mesh_shape: Optional[Tuple[int, int]] = None  # (data, model); None = single device
     platform: Optional[str] = None   # force jax platform (e.g. "cpu")
     profile_dir: Optional[str] = None
+    compilation_cache: Optional[str] = None  # persistent XLA cache dir: repeat
+                                     # runs skip the ~20-40s TPU compiles that
+                                     # dominate a cold pipeline's wall clock
     checkpoint_dir: Optional[str] = None
     resume: bool = False
     # "single": one gathered npz (process-0 write, broadcast restore; dir
@@ -168,6 +171,10 @@ def build_parser() -> argparse.ArgumentParser:
                         help="Device mesh shape, e.g. 4x2 (data x model).")
     parser.add_argument("--platform", type=str, default=None,
                         help="Force a jax platform (e.g. cpu).")
+    parser.add_argument("--compilation-cache", type=str, default=None,
+                        metavar="DIR",
+                        help="Persistent XLA compilation cache directory; "
+                             "repeat runs at the same shapes skip compiles.")
     parser.add_argument("--profile-dir", type=str, default=None,
                         help="Write a jax.profiler trace of the run here.")
     parser.add_argument("--checkpoint-dir", type=str, default=None)
@@ -228,6 +235,7 @@ def config_from_args(argv=None) -> G2VecConfig:
         mesh_shape=parse_mesh(args.mesh),
         platform=args.platform,
         profile_dir=args.profile_dir,
+        compilation_cache=args.compilation_cache,
         checkpoint_dir=args.checkpoint_dir,
         resume=args.resume,
         checkpoint_layout=args.checkpoint_layout,

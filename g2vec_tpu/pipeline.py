@@ -89,6 +89,12 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
     cfg.validate()
     if cfg.debug_nans:
         jax.config.update("jax_debug_nans", True)
+    if cfg.compilation_cache:
+        # Persistent XLA cache: a warm repeat run skips the compiles that
+        # dominate a cold pipeline's wall (the TPU acceptance run spends
+        # most of its train/lgroups/biomarkers stage time compiling).
+        jax.config.update("jax_compilation_cache_dir", cfg.compilation_cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     if cfg.distributed:
         # Worker processes compute shards but neither narrate nor write:
         # transcript, metrics stream, profiler trace, and the three outputs
