@@ -324,8 +324,9 @@ def _bench_train(paths, labels, hidden: int, measure_epochs: int,
 
 
 def _load_bench_network():
-    """(table_on_device, nbr_idx, nbr_w, n_genes): the real bundled network
-    with synthetic |PCC| weights, or a scale-matched fallback."""
+    """(table_on_device, nbr_idx, nbr_w, n_genes, edges): the real bundled
+    network with synthetic |PCC| weights, or a scale-matched fallback.
+    ``edges`` is the raw (src, dst, w) triple for the native CSR sampler."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -361,7 +362,7 @@ def _load_bench_network():
     nbr_idx, nbr_w = neighbor_table(src, dst, w, n_genes)
     table = (jax.device_put(jnp.asarray(nbr_idx, jnp.int32)),
              jax.device_put(jnp.asarray(nbr_w, jnp.float32)))
-    return table, nbr_idx, nbr_w, n_genes
+    return table, nbr_idx, nbr_w, n_genes, (src, dst, w)
 
 
 def _reference_walk_baseline(nbr_idx, nbr_w, n_genes: int, len_path: int,
@@ -588,8 +589,10 @@ def _measure() -> None:
 
     # ---- 2. headline walker (always runs; errors degrade to a line) ----
     walker_err = None
+    baseline = None
+    edges = None
     try:
-        table, nbr_idx, nbr_w, n_genes = _load_bench_network()
+        table, nbr_idx, nbr_w, n_genes, edges = _load_bench_network()
         note(f"walker network: {n_genes} genes, "
              f"{int((nbr_w > 0).sum())} edges, D={nbr_idx.shape[1]}")
         res = _bench_walker(table, n_genes, LEN_PATH, WALKER_REPS)
@@ -615,6 +618,38 @@ def _measure() -> None:
         walker_err = f"{type(e).__name__}: {e}"[:500]
         emit({"metric": "walker_walks_per_sec", "value": None,
               "unit": "walks/s", "vs_baseline": None, "error": walker_err})
+
+    # ---- 2b. native CPU walker (host-only; the fast no-accelerator path) ----
+    try:
+        if edges is None:
+            raise RuntimeError(
+                f"bench network unavailable (walker stage: {walker_err})")
+        from g2vec_tpu.native.walker_bindings import load as load_native
+        from g2vec_tpu.ops.host_walker import generate_path_set_native
+
+        load_native()          # one-time g++ compile outside the timed region
+        if baseline is None:
+            baseline, n_base = _reference_walk_baseline(
+                nbr_idx, nbr_w, n_genes, LEN_PATH)
+        t0 = time.time()
+        npaths = generate_path_set_native(
+            edges[0], edges[1], edges[2], n_genes, len_path=LEN_PATH,
+            reps=WALKER_REPS, seed=0)
+        el = time.time() - t0
+        total_n = n_genes * WALKER_REPS
+        note(f"native walker: {total_n} walks in {el:.2f}s -> "
+             f"{total_n / el:.0f} walks/s; {len(npaths)} unique paths")
+        emit({"metric": "walker_native_walks_per_sec",
+              "value": round(total_n / el, 1), "unit": "walks/s",
+              "vs_baseline": round(total_n / el / baseline, 2),
+              "unique_paths": len(npaths), "n_genes": n_genes,
+              "len_path": LEN_PATH, "reps": WALKER_REPS,
+              "note": "threaded C++ CSR sampler (ops/host_walker.py) on the "
+                      "bench host; the single-host no-accelerator path"})
+    except Exception as e:  # noqa: BLE001
+        emit({"metric": "walker_native_walks_per_sec", "value": None,
+              "unit": "walks/s", "vs_baseline": None,
+              "error": f"{type(e).__name__}: {e}"[:400]})
 
     # ---- optional stages, each budget-guarded ----
     def guarded(name, est_sec, fn):

@@ -66,6 +66,12 @@ class G2VecConfig:
                                      # auto-sizer may plan for (tables are
                                      # separate, launch-invariant residents);
                                      # 0 = ops.walker.WALKER_HBM_BUDGET (4 GiB)
+    walker_backend: str = "device"   # "device" (JAX lockstep walker) or
+                                     # "native" (threaded C++ CSR sampler —
+                                     # the fast host path when no
+                                     # accelerator is attached; per-seed
+                                     # deterministic, but a different PRNG
+                                     # family than the device walker)
     mesh_shape: Optional[Tuple[int, int]] = None  # (data, model); None = single device
     platform: Optional[str] = None   # force jax platform (e.g. "cpu")
     profile_dir: Optional[str] = None
@@ -124,6 +130,14 @@ class G2VecConfig:
             raise ValueError(f"compute_dtype must be bfloat16|float32, got {self.compute_dtype}")
         if self.param_dtype not in ("bfloat16", "float32"):
             raise ValueError(f"param_dtype must be bfloat16|float32, got {self.param_dtype}")
+        if self.walker_backend not in ("device", "native"):
+            raise ValueError(
+                f"walker_backend must be device|native, got {self.walker_backend}")
+        if self.walker_backend == "native" and (self.mesh_shape
+                                                or self.distributed):
+            raise ValueError(
+                "walker_backend=native is a single-host CPU sampler; it does "
+                "not combine with --mesh or --distributed")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -164,6 +178,12 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--walker-batch", type=int, default=0,
                         help="Walkers per device launch (0 = auto-sized "
                              "against --walker-hbm-budget).")
+    parser.add_argument("--walker-backend", type=str, default="device",
+                        choices=("device", "native"),
+                        help="Path sampler: 'device' = the JAX lockstep "
+                             "walker; 'native' = the threaded C++ CSR "
+                             "sampler (fast host fallback when no "
+                             "accelerator is attached).")
     parser.add_argument("--walker-hbm-budget", type=int, default=0,
                         help="Device bytes the walker auto-sizer may plan "
                              "for (0 = 4 GiB default).")
@@ -232,6 +252,7 @@ def config_from_args(argv=None) -> G2VecConfig:
         compute_dtype=args.compute_dtype,
         walker_batch=args.walker_batch,
         walker_hbm_budget=args.walker_hbm_budget,
+        walker_backend=args.walker_backend,
         mesh_shape=parse_mesh(args.mesh),
         platform=args.platform,
         profile_dir=args.profile_dir,

@@ -1,0 +1,68 @@
+"""Shared on-demand build/load scaffolding for the native components.
+
+One contract for every .cpp in this package (tsv_reader, walker): compile
+once per checkout with ``g++ -O3 -shared -fPIC`` next to the source,
+rebuild when the source is newer than the .so, remember a build/load
+failure so it raises exactly once per process (as RuntimeError — callers
+treat that one type as "native unavailable" and fall back), and serialize
+everything behind a per-target lock.
+
+The .so is written to a temp name and os.replace()d in, so two processes
+racing on a cold checkout can never dlopen a half-written library.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Callable, Dict, List, Optional
+
+
+class _Target:
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.lib: Optional[ctypes.CDLL] = None
+        self.error: Optional[str] = None
+
+
+_targets: Dict[str, _Target] = {}
+_registry_lock = threading.Lock()
+
+
+def build_and_load(src: str, so: str, extra_flags: List[str],
+                   configure: Callable[[ctypes.CDLL], None]) -> ctypes.CDLL:
+    """Load (building if stale/missing) ``so`` from ``src``.
+
+    ``configure`` sets restype/argtypes on first load. Raises RuntimeError
+    (memoized) when the toolchain is missing or the build/load fails.
+    """
+    with _registry_lock:
+        target = _targets.setdefault(so, _Target())
+    with target.lock:
+        if target.lib is not None:
+            return target.lib
+        if target.error is not None:
+            raise RuntimeError(target.error)
+        try:
+            if (not os.path.exists(so)
+                    or os.path.getmtime(so) < os.path.getmtime(src)):
+                tmp = f"{so}.{os.getpid()}.tmp"
+                cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                       *extra_flags, "-o", tmp, src]
+                proc = subprocess.run(cmd, capture_output=True, text=True,
+                                      timeout=120)
+                if proc.returncode != 0:
+                    raise RuntimeError(
+                        f"native build failed: {' '.join(cmd)}\n{proc.stderr}")
+                os.replace(tmp, so)
+            lib = ctypes.CDLL(so)
+            configure(lib)
+        except Exception as e:  # remember, so we don't rebuild per call
+            target.error = str(e)
+            # Normalize to RuntimeError so callers have ONE "unavailable"
+            # exception type regardless of how the build died (missing
+            # g++, compiler timeout, dlopen failure, ...).
+            raise RuntimeError(target.error) from e
+        target.lib = lib
+        return lib

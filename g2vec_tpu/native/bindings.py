@@ -4,71 +4,46 @@
 None when the native library cannot be built/loaded — callers
 (g2vec_tpu.io.readers.load_expression) fall back to the Python parser.
 
-The shared object is compiled once per checkout (``g++ -O3 -shared
--fPIC``) and cached as ``_tsv_reader.so`` beside the sources; a stale .so
-(older than the .cpp) is rebuilt.
+Build contract shared with the walker bindings (_build.py): compiled once
+per checkout (``g++ -O3 -shared -fPIC``) and cached as ``_tsv_reader.so``
+beside the sources; a stale .so (older than the .cpp) is rebuilt.
 """
 from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
-import threading
-from typing import Optional, Tuple
+from typing import Tuple
 
 import numpy as np
+
+from g2vec_tpu.native._build import build_and_load
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "tsv_reader.cpp")
 _SO = os.path.join(_HERE, "_tsv_reader.so")
-_lock = threading.Lock()
-_lib: Optional[ctypes.CDLL] = None
-_build_error: Optional[str] = None
 
 
-def _build() -> None:
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", _SO, _SRC]
-    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
-    if proc.returncode != 0:
-        raise RuntimeError(f"native build failed: {' '.join(cmd)}\n{proc.stderr}")
+def _configure(lib: ctypes.CDLL) -> None:
+    lib.g2v_expr_read.restype = ctypes.c_void_p
+    lib.g2v_expr_read.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                  ctypes.c_int]
+    lib.g2v_expr_nsamples.restype = ctypes.c_int
+    lib.g2v_expr_nsamples.argtypes = [ctypes.c_void_p]
+    lib.g2v_expr_ngenes.restype = ctypes.c_int
+    lib.g2v_expr_ngenes.argtypes = [ctypes.c_void_p]
+    lib.g2v_expr_sample.restype = ctypes.c_char_p
+    lib.g2v_expr_sample.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.g2v_expr_gene.restype = ctypes.c_char_p
+    lib.g2v_expr_gene.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.g2v_expr_copy.restype = None
+    lib.g2v_expr_copy.argtypes = [ctypes.c_void_p,
+                                  ctypes.POINTER(ctypes.c_float)]
+    lib.g2v_expr_free.restype = None
+    lib.g2v_expr_free.argtypes = [ctypes.c_void_p]
 
 
 def _load() -> ctypes.CDLL:
-    global _lib, _build_error
-    with _lock:
-        if _lib is not None:
-            return _lib
-        if _build_error is not None:
-            raise RuntimeError(_build_error)
-        try:
-            if (not os.path.exists(_SO)
-                    or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
-                _build()
-            lib = ctypes.CDLL(_SO)
-        except Exception as e:  # remember, so we don't rebuild per call
-            _build_error = str(e)
-            # Normalize to RuntimeError so callers have ONE "unavailable"
-            # exception type regardless of how the build died (missing g++,
-            # compiler timeout, dlopen failure, ...).
-            raise RuntimeError(_build_error) from e
-        lib.g2v_expr_read.restype = ctypes.c_void_p
-        lib.g2v_expr_read.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
-                                      ctypes.c_int]
-        lib.g2v_expr_nsamples.restype = ctypes.c_int
-        lib.g2v_expr_nsamples.argtypes = [ctypes.c_void_p]
-        lib.g2v_expr_ngenes.restype = ctypes.c_int
-        lib.g2v_expr_ngenes.argtypes = [ctypes.c_void_p]
-        lib.g2v_expr_sample.restype = ctypes.c_char_p
-        lib.g2v_expr_sample.argtypes = [ctypes.c_void_p, ctypes.c_int]
-        lib.g2v_expr_gene.restype = ctypes.c_char_p
-        lib.g2v_expr_gene.argtypes = [ctypes.c_void_p, ctypes.c_int]
-        lib.g2v_expr_copy.restype = None
-        lib.g2v_expr_copy.argtypes = [ctypes.c_void_p,
-                                      ctypes.POINTER(ctypes.c_float)]
-        lib.g2v_expr_free.restype = None
-        lib.g2v_expr_free.argtypes = [ctypes.c_void_p]
-        _lib = lib
-        return lib
+    return build_and_load(_SRC, _SO, [], _configure)
 
 
 def read_expression(path: str) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:

@@ -1,0 +1,118 @@
+// Native CPU random-walk sampler — the host-side twin of ops/walker.py.
+//
+// Reference semantics (generate_randomPath, ref: G2Vec.py:328-346):
+// weighted no-revisit walks of at most len_path nodes, Categorical over the
+// current node's positive out-edge weights restricted to unvisited targets,
+// early stop at dead ends. The reference pays an O(n_genes) dense-row
+// deepcopy per step; this walks CSR rows with an O(out_degree) two-pass
+// scan (mass total, then inverse-CDF pick) and an O(1)-membership visited
+// byte mask that is wiped by path replay after each walk, so cost per step
+// is O(D + L) instead of O(G).
+//
+// Threading: walkers split into contiguous ranges over n_threads OS
+// threads. Every walker draws from its own splitmix64 stream keyed by
+// (seed, stream_id) — results are bit-identical for any thread count.
+//
+// Exposed flat-C so ctypes can load it (no pybind11 in this image).
+
+#include <algorithm>
+#include <cstdint>
+#include <thread>
+#include <vector>
+
+namespace {
+
+inline uint64_t splitmix64(uint64_t& s) {
+    uint64_t z = (s += 0x9e3779b97f4a7c15ULL);
+    z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+    z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+    return z ^ (z >> 31);
+}
+
+// 53-bit mantissa uniform in [0, 1).
+inline double uniform01(uint64_t& s) {
+    return static_cast<double>(splitmix64(s) >> 11) * 0x1.0p-53;
+}
+
+void walk_range(const int32_t* indptr, const int32_t* indices,
+                const float* weights, int32_t n_genes, const int32_t* starts,
+                const uint64_t* stream_ids, int32_t len_path, uint64_t seed,
+                int32_t* out, int64_t lo, int64_t hi) {
+    std::vector<uint8_t> visited(static_cast<size_t>(n_genes), 0);
+    for (int64_t w = lo; w < hi; ++w) {
+        int32_t* path = out + w * len_path;
+        std::fill(path, path + len_path, -1);
+        uint64_t st = seed ^ (stream_ids[w] * 0x9e3779b97f4a7c15ULL);
+        splitmix64(st);  // decorrelate nearby stream ids
+        int32_t cur = starts[w];
+        path[0] = cur;
+        visited[cur] = 1;
+        int32_t plen = 1;
+        for (int32_t step = 1; step < len_path; ++step) {
+            const int32_t b = indptr[cur], e = indptr[cur + 1];
+            double total = 0.0;
+            for (int32_t k = b; k < e; ++k)
+                if (!visited[indices[k]] && weights[k] > 0.0f)
+                    total += weights[k];
+            if (total <= 0.0) break;  // dead end (ref: G2Vec.py:343-344)
+            const double target = uniform01(st) * total;
+            double cum = 0.0;
+            int32_t nxt = -1;
+            for (int32_t k = b; k < e; ++k) {
+                if (visited[indices[k]] || weights[k] <= 0.0f) continue;
+                cum += weights[k];
+                if (target < cum) { nxt = indices[k]; break; }
+            }
+            if (nxt < 0) {
+                // target == total after rounding: take the last eligible.
+                for (int32_t k = e - 1; k >= b; --k)
+                    if (!visited[indices[k]] && weights[k] > 0.0f) {
+                        nxt = indices[k];
+                        break;
+                    }
+            }
+            if (nxt < 0) break;
+            path[plen++] = nxt;
+            visited[nxt] = 1;
+            cur = nxt;
+        }
+        for (int32_t i = 0; i < plen; ++i) visited[path[i]] = 0;
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+// out must hold n_walkers * len_path int32; filled with node ids, -1 pads.
+void g2v_walk(const int32_t* indptr, const int32_t* indices,
+              const float* weights, int32_t n_genes, const int32_t* starts,
+              const uint64_t* stream_ids, int64_t n_walkers,
+              int32_t len_path, uint64_t seed, int32_t n_threads,
+              int32_t* out) {
+    if (len_path <= 0 || n_walkers <= 0) return;
+    if (n_threads <= 0) {
+        unsigned hw = std::thread::hardware_concurrency();
+        n_threads = hw ? static_cast<int32_t>(hw) : 1;
+    }
+    n_threads = static_cast<int32_t>(
+        std::min<int64_t>(n_threads, n_walkers));
+    if (n_threads == 1) {
+        walk_range(indptr, indices, weights, n_genes, starts, stream_ids,
+                   len_path, seed, out, 0, n_walkers);
+        return;
+    }
+    std::vector<std::thread> pool;
+    pool.reserve(n_threads);
+    const int64_t chunk = (n_walkers + n_threads - 1) / n_threads;
+    for (int32_t t = 0; t < n_threads; ++t) {
+        const int64_t lo = t * chunk;
+        const int64_t hi = std::min<int64_t>(lo + chunk, n_walkers);
+        if (lo >= hi) break;
+        pool.emplace_back(walk_range, indptr, indices, weights, n_genes,
+                          starts, stream_ids, len_path, seed, out, lo, hi);
+    }
+    for (auto& th : pool) th.join();
+}
+
+}  // extern "C"

@@ -1,0 +1,75 @@
+"""ctypes bindings for the native CPU walk sampler (walker.cpp).
+
+Same build contract as the TSV reader (shared scaffolding in _build.py):
+compiled once per checkout to ``_walker.so`` beside the sources, rebuilt
+when the .cpp is newer, and a build/load failure raises RuntimeError
+exactly once — callers (ops/host_walker.py) surface it as "native walker
+unavailable".
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+from g2vec_tpu.native._build import build_and_load
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "walker.cpp")
+_SO = os.path.join(_HERE, "_walker.so")
+
+
+def _configure(lib: ctypes.CDLL) -> None:
+    i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    lib.g2v_walk.restype = None
+    lib.g2v_walk.argtypes = [
+        i32p,                                          # indptr [G+1]
+        i32p,                                          # indices [E]
+        np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),  # w [E]
+        ctypes.c_int32,                                # n_genes
+        i32p,                                          # starts [W]
+        np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS"),   # ids [W]
+        ctypes.c_int64,                                # n_walkers
+        ctypes.c_int32,                                # len_path
+        ctypes.c_uint64,                               # seed
+        ctypes.c_int32,                                # n_threads
+        i32p,                                          # out [W, len_path]
+    ]
+
+
+def load() -> ctypes.CDLL:
+    """Build/load the library (RuntimeError when unavailable). Public so
+    benchmarks can warm the one-time compile outside their timed region."""
+    return build_and_load(_SRC, _SO, ["-pthread"], _configure)
+
+
+def walk_paths(indptr: np.ndarray, indices: np.ndarray, weights: np.ndarray,
+               n_genes: int, starts: np.ndarray, stream_ids: np.ndarray,
+               len_path: int, seed: int, n_threads: int = 0) -> np.ndarray:
+    """Run the native sampler; returns [n_walkers, len_path] int32 paths.
+
+    Node ids with -1 padding past each walk's end. Raises RuntimeError when
+    the native library is unavailable (no toolchain / build failure).
+    """
+    lib = load()
+    indptr = np.ascontiguousarray(indptr, dtype=np.int32)
+    indices = np.ascontiguousarray(indices, dtype=np.int32)
+    weights = np.ascontiguousarray(weights, dtype=np.float32)
+    starts = np.ascontiguousarray(starts, dtype=np.int32)
+    stream_ids = np.ascontiguousarray(stream_ids, dtype=np.uint64)
+    n_walkers = starts.shape[0]
+    if stream_ids.shape[0] != n_walkers:
+        raise ValueError(
+            f"stream_ids has {stream_ids.shape[0]} entries for "
+            f"{n_walkers} walkers")
+    if indptr.shape[0] != n_genes + 1:
+        raise ValueError(
+            f"indptr has {indptr.shape[0]} entries for {n_genes} genes "
+            f"(want n_genes+1)")
+    out = np.empty((n_walkers, len_path), dtype=np.int32)
+    lib.g2v_walk(indptr, indices, weights, np.int32(n_genes), starts,
+                 stream_ids, np.int64(n_walkers), np.int32(len_path),
+                 np.uint64(seed & 0xFFFFFFFFFFFFFFFF), np.int32(n_threads),
+                 out)
+    return out

@@ -1,0 +1,92 @@
+"""Native-CPU path generation — the host fallback twin of ops/walker.py.
+
+SURVEY.md §2 names two optional native components for this framework; the
+C++ TSV reader is one, this sampler is the other: on a host with no
+accelerator the JAX lockstep walker pays XLA-on-CPU overheads it was never
+designed for, while the reference's own per-node loop costs O(G) per step
+(the dense-row deepcopy at ref: G2Vec.py:334). The native sampler walks
+CSR rows at O(out_degree + path_len) per step across OS threads
+(native/walker.cpp) and reaches throughput the chip path only beats once
+real TPU hardware is attached.
+
+Same output contract as :func:`g2vec_tpu.ops.walker.generate_path_set`:
+a set of np.packbits-encoded multi-hot rows over the sorted gene order —
+dedup and the downstream integrate/count/train stages cannot tell the
+backends apart. Same walk SEMANTICS (no revisit, weight-proportional
+sampling, dead-end stop, every gene a start node reps times,
+ref: G2Vec.py:324-352); per-seed deterministic for any thread count
+(streams are keyed by (seed, repetition*n_genes+start) identity, mirroring
+the device walker's stream-identity scheme). The two backends draw from
+different PRNG families, so their path sets differ for the same seed —
+each is individually deterministic, exactly the documented dense/sparse
+caveat in generate_path_set.
+"""
+from __future__ import annotations
+
+from typing import Optional, Set
+
+import numpy as np
+
+
+def edges_to_csr(src: np.ndarray, dst: np.ndarray, w: np.ndarray,
+                 n_genes: int):
+    """(src, dst, w) edge lists -> CSR (indptr [G+1], indices [E], w [E]).
+
+    Directed, duplicate edges kept — identical multiset semantics to the
+    padded neighbor_table (ops/graph.py), just without the max-degree
+    padding that a CPU scan does not need.
+    """
+    order = np.argsort(src, kind="stable")
+    indices = np.ascontiguousarray(dst[order], dtype=np.int32)
+    weights = np.ascontiguousarray(w[order], dtype=np.float32)
+    counts = np.bincount(src, minlength=n_genes)
+    indptr = np.zeros(n_genes + 1, dtype=np.int32)
+    np.cumsum(counts, out=indptr[1:])
+    return indptr, indices, weights
+
+
+_PACK_CHUNK = 8192   # walkers expanded to [chunk, G] bool per packbits pass
+
+
+def generate_path_set_native(src: np.ndarray, dst: np.ndarray, w: np.ndarray,
+                             n_genes: int, *, len_path: int, reps: int,
+                             seed: int, starts: Optional[np.ndarray] = None,
+                             n_threads: int = 0) -> Set[bytes]:
+    """All-sources x reps native walks -> set of packed multi-hot rows.
+
+    Mirrors generate_pathSet (ref: G2Vec.py:324-352) on the host: every
+    gene a start node, ``reps`` times, results set-deduplicated. Raises
+    RuntimeError when the native library cannot be built (no C++
+    toolchain) — the pipeline surfaces that as a config error rather than
+    silently changing backends (the device walker's seeded outputs are a
+    byte-golden contract).
+    """
+    from g2vec_tpu.native.walker_bindings import walk_paths
+
+    if starts is None:
+        starts = np.arange(n_genes, dtype=np.int32)
+    starts = np.asarray(starts, dtype=np.int32)
+    n_starts = starts.shape[0]
+    all_starts = np.tile(starts, reps)
+    # Stream identity = (repetition, start index) — the same flat
+    # rep*n_genes + i identity the device walker keys its PRNG streams by,
+    # so adding repetitions extends (never reshuffles) the stream family.
+    stream_ids = (np.arange(reps, dtype=np.uint64)[:, None] * np.uint64(n_starts)
+                  + np.arange(n_starts, dtype=np.uint64)[None, :]).ravel()
+
+    indptr, indices, weights = edges_to_csr(src, dst, w, n_genes)
+    paths = walk_paths(indptr, indices, weights, n_genes, all_starts,
+                       stream_ids, len_path, seed, n_threads)
+
+    nb = (n_genes + 7) // 8
+    out: Set[bytes] = set()
+    for lo in range(0, paths.shape[0], _PACK_CHUNK):
+        block = paths[lo:lo + _PACK_CHUNK]
+        rows = np.zeros((block.shape[0], n_genes), dtype=bool)
+        real = block >= 0
+        rows[np.nonzero(real)[0], block[real]] = True
+        packed = np.packbits(rows, axis=1)
+        if packed.shape[1] != nb:    # packbits pads to ceil(G/8) already
+            raise AssertionError("packbits width drifted from the contract")
+        out.update(row.tobytes() for row in packed)
+    return out

@@ -162,6 +162,19 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
                 # O(W*G), and no dense G^2 matrix in HBM (ops/graph.py).
                 s_k, d_k, w_k = thresholded_edges(expr_group, src, dst,
                                                   threshold=cfg.pcc_threshold)
+                if cfg.walker_backend == "native":
+                    # Threaded C++ CSR sampler (ops/host_walker.py): the
+                    # fast host path when no accelerator is attached. Same
+                    # packed-row contract; its own deterministic PRNG
+                    # family (documented in the module docstring).
+                    from g2vec_tpu.ops.host_walker import \
+                        generate_path_set_native
+
+                    path_sets.append(generate_path_set_native(
+                        s_k, d_k, w_k, n_genes, len_path=cfg.lenPath,
+                        reps=cfg.numRepetition,
+                        seed=(cfg.seed << 1) | i))
+                    continue
                 table = neighbor_table(s_k, d_k, w_k, n_genes)
                 path_sets.append(generate_path_set(
                     table, jax.random.fold_in(key, i), len_path=cfg.lenPath,

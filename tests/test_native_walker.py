@@ -1,0 +1,149 @@
+"""Native C++ walk sampler: reference walk invariants, determinism,
+thread-count invariance, the packed-row output contract, and pipeline
+integration via --walker-backend native."""
+import shutil
+
+import numpy as np
+import pytest
+
+g_plus_plus = shutil.which("g++")
+pytestmark = pytest.mark.skipif(g_plus_plus is None,
+                                reason="no C++ toolchain in this environment")
+
+
+def _chain_plus_hub():
+    """0->1->2->3 chain plus a hub 0->{4,5,6} with skewed weights."""
+    src = np.array([0, 1, 2, 0, 0, 0], dtype=np.int32)
+    dst = np.array([1, 2, 3, 4, 5, 6], dtype=np.int32)
+    w = np.array([1.0, 1.0, 1.0, 0.5, 1.5, 2.0], dtype=np.float32)
+    return src, dst, w, 7
+
+
+def _raw_paths(src, dst, w, n, starts, len_path, seed, reps=1, n_threads=0):
+    from g2vec_tpu.native.walker_bindings import walk_paths
+    from g2vec_tpu.ops.host_walker import edges_to_csr
+
+    indptr, indices, weights = edges_to_csr(src, dst, w, n)
+    all_starts = np.tile(starts, reps).astype(np.int32)
+    ids = np.arange(all_starts.size, dtype=np.uint64)
+    return walk_paths(indptr, indices, weights, n, all_starts, ids,
+                      len_path, seed, n_threads)
+
+
+def test_walk_invariants():
+    src, dst, w, n = _chain_plus_hub()
+    edge_set = set(zip(src.tolist(), dst.tolist()))
+    paths = _raw_paths(src, dst, w, n, np.arange(n, dtype=np.int32),
+                       len_path=5, seed=7, reps=50)
+    for row in paths:
+        nodes = row[row >= 0]
+        assert nodes.size >= 1
+        assert len(set(nodes.tolist())) == nodes.size      # no revisit
+        for a, b in zip(nodes[:-1], nodes[1:]):
+            assert (int(a), int(b)) in edge_set            # real edges only
+        # -1 padding is a strict suffix
+        assert np.all(row[nodes.size:] == -1)
+    # starts preserved in order
+    np.testing.assert_array_equal(paths[:n, 0], np.arange(n))
+
+
+def test_dead_end_and_length_cap():
+    src, dst, w, n = _chain_plus_hub()
+    paths = _raw_paths(src, dst, w, n, np.array([3], dtype=np.int32),
+                       len_path=5, seed=0)
+    np.testing.assert_array_equal(paths[0], [3, -1, -1, -1, -1])  # no out-edges
+    long_chain = _raw_paths(src, dst, w, n, np.array([0], dtype=np.int32),
+                            len_path=3, seed=1, reps=20)
+    assert np.all((long_chain >= -1) & (long_chain < n))
+    assert long_chain.shape == (20, 3)                      # capped
+
+
+def test_deterministic_and_thread_invariant():
+    src, dst, w, n = _chain_plus_hub()
+    starts = np.arange(n, dtype=np.int32)
+    a = _raw_paths(src, dst, w, n, starts, 5, seed=42, reps=64, n_threads=1)
+    b = _raw_paths(src, dst, w, n, starts, 5, seed=42, reps=64, n_threads=4)
+    np.testing.assert_array_equal(a, b)
+    c = _raw_paths(src, dst, w, n, starts, 5, seed=43, reps=64)
+    assert not np.array_equal(a, c)
+
+
+def test_weighted_sampling_distribution():
+    # From node 0 the hub edges carry weights 1(->1), .5(->4), 1.5(->5),
+    # 2(->6): first-step frequencies must track w/sum(w) = .2/.1/.3/.4.
+    src, dst, w, n = _chain_plus_hub()
+    reps = 4000
+    paths = _raw_paths(src, dst, w, n, np.array([0], dtype=np.int32),
+                       len_path=2, seed=9, reps=reps)
+    first = paths[:, 1]
+    freq = {t: float((first == t).sum()) / reps for t in (1, 4, 5, 6)}
+    total_w = 5.0
+    for t, wt in ((1, 1.0), (4, 0.5), (5, 1.5), (6, 2.0)):
+        assert abs(freq[t] - wt / total_w) < 0.03, (t, freq)
+
+
+def test_packed_row_contract():
+    from g2vec_tpu.ops.host_walker import generate_path_set_native
+
+    src, dst, w, n = _chain_plus_hub()
+    paths = generate_path_set_native(src, dst, w, n, len_path=4, reps=8,
+                                     seed=0)
+    assert paths and all(isinstance(p, bytes) and len(p) == (n + 7) // 8
+                         for p in paths)
+    rows = np.unpackbits(
+        np.frombuffer(b"".join(sorted(paths)), dtype=np.uint8).reshape(
+            len(paths), -1), axis=1)[:, :n]
+    # every row is a non-empty node set; node 3's singleton path must exist
+    assert rows.sum(axis=1).min() >= 1
+    singleton_3 = np.zeros(n, dtype=np.uint8)
+    singleton_3[3] = 1
+    assert any(np.array_equal(r, singleton_3) for r in rows)
+
+
+def test_pipeline_native_backend(tmp_path):
+    from g2vec_tpu.config import G2VecConfig
+    from g2vec_tpu.data.synthetic import SyntheticSpec, write_synthetic_tsv
+    from g2vec_tpu.pipeline import run
+
+    spec = SyntheticSpec(n_good=14, n_poor=10, module_size=10,
+                         n_background=10, n_expr_only=2, n_net_only=2,
+                         module_chords=2, background_edges=16, seed=3)
+    files = write_synthetic_tsv(spec, str(tmp_path))
+    cfg = G2VecConfig(expression_file=files["expression"],
+                      clinical_file=files["clinical"],
+                      network_file=files["network"],
+                      result_name=str(tmp_path / "nat"),
+                      lenPath=6, numRepetition=4, sizeHiddenlayer=16,
+                      epoch=3, walker_backend="native", seed=0)
+    res1 = run(cfg, console=lambda s: None)
+    assert res1.n_paths >= 2
+    # per-seed deterministic end to end
+    cfg2 = G2VecConfig(**{**cfg.__dict__, "result_name": str(tmp_path / "nat2")})
+    res2 = run(cfg2, console=lambda s: None)
+    assert res2.n_paths == res1.n_paths
+    assert (tmp_path / "nat_biomarkers.txt").read_text() \
+        == (tmp_path / "nat2_biomarkers.txt").read_text()
+
+
+def test_negative_seed_accepted():
+    # The device backend accepts any int --seed (jax.random.key); the
+    # native path masks to uint64 instead of letting NumPy 2 raise
+    # OverflowError on negative values.
+    from g2vec_tpu.ops.host_walker import generate_path_set_native
+
+    src, dst, w, n = _chain_plus_hub()
+    a = generate_path_set_native(src, dst, w, n, len_path=4, reps=2, seed=-1)
+    b = generate_path_set_native(src, dst, w, n, len_path=4, reps=2, seed=-1)
+    assert a == b and a
+
+
+def test_config_validation():
+    from g2vec_tpu.config import G2VecConfig
+
+    base = dict(expression_file="e", clinical_file="c", network_file="n",
+                result_name="r")
+    with pytest.raises(ValueError, match="walker_backend"):
+        G2VecConfig(**base, walker_backend="gpu").validate()
+    with pytest.raises(ValueError, match="single-host"):
+        G2VecConfig(**base, walker_backend="native",
+                    mesh_shape=(2, 4)).validate()
