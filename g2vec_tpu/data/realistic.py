@@ -12,14 +12,20 @@ n_genes=7523, n_edges=216540, n_paths=45402, path genes 3773):
   top-degree core plus a random fill where the core size is bisected until
   the induced edge count matches ``target_edges`` — reproducing the
   restricted-network scale of the transcript (README.md:28).
-- **Active modules**: two disjoint BFS-grown connected regions of the real
-  graph, A_good and A_poor. A_good genes share one latent factor over the
-  GOOD samples only (pairwise PCC ~ rho > 0.5, so their real edges survive
-  the |PCC| threshold in the good-group graph and walks traverse real
+- **Active modules**: three disjoint connected regions of the real graph
+  (BFS balls by default; ``module_growth="dense"`` grows by greedy
+  max-connectivity). A_good genes share one latent factor over the GOOD
+  samples only (pairwise PCC ~ rho > 0.5, so their real edges survive the
+  |PCC| threshold in the good-group graph and walks traverse real
   topology); over poor samples they are iid noise. Symmetric for A_poor.
-  Everything else is noise everywhere, so background edges die at the
-  threshold — matching the transcript's sparse path-gene count (3,773 of
-  7,523 genes ever appear in a path, README.md:32).
+  A third ``n_shared``-gene module correlates within BOTH groups (its own
+  factor per group, no differential shift) — it walks in both group
+  graphs, which is what pushes unique-path yield toward the transcript's
+  12 paths/gene (see tools/calibrate_real.py and the tradeoff account in
+  tests/test_acceptance_real.py). Everything else is noise everywhere, so
+  background edges die at the threshold — matching the transcript's
+  sparse path-gene count (3,773 of 7,523 genes ever appear in a path,
+  README.md:32).
 - **Differential shift** on active genes in their group lights up the
   t-scores the biomarker stage mixes in (ref: G2Vec.py:96-102).
 """
@@ -38,10 +44,26 @@ from g2vec_tpu.io.readers import ExpressionData, load_clinical, load_network
 class RealExampleSpec:
     n_common: int = 7523        # transcript: n_genes (README.md:27)
     target_edges: int = 216540  # transcript: n_edges (README.md:28)
-    n_active_per_group: int = 1940   # sized so path genes land near 3,773
+    n_active_per_group: int = 1880   # with n_shared: path genes ~ 3,773
+    n_shared: int = 120         # genes of a module correlated within BOTH
+                                # groups (separate latent factor per group,
+                                # no differential shift). Why it exists:
+                                # with disjoint modules, n_paths maxes out
+                                # near reps*path_genes + singletons, but
+                                # the transcript shows 12.03 paths/gene at
+                                # reps=10 — only reachable if the groups'
+                                # active regions OVERLAP (a core whose
+                                # edges survive in both graphs walks in
+                                # both, and each group's dead-elsewhere
+                                # genes add surviving singletons). See
+                                # tools/calibrate_real.py.
     rho: float = 0.72           # in-module PCC; P(sample PCC < 0.5) ~ 1e-4
     shift: float = 1.0          # differential expression of active genes
     seed: int = 0
+    module_growth: str = "bfs"  # "bfs" = breadth-first ball; "dense" =
+                                # greedy max-connectivity growth (more
+                                # internal edges per gene -> branchier
+                                # walks; see tools/calibrate_real.py)
 
 
 def _select_common(deg: np.ndarray, src: np.ndarray, dst: np.ndarray,
@@ -89,6 +111,41 @@ def _bfs_region(adj: Dict[int, list], seeds, size: int, allowed: np.ndarray
     return np.fromiter(member, dtype=np.int64)
 
 
+def _dense_region(adj: Dict[int, list], seeds, size: int,
+                  allowed: np.ndarray) -> np.ndarray:
+    """Greedy max-connectivity growth: always add the frontier gene with the
+    most edges into the current member set.
+
+    A BFS ball reaches ``size`` with a large tree-ish fringe (every fringe
+    gene touches the module through ~1 edge), so walks entering the fringe
+    branch little and collapse onto few distinct gene sets. Picking the
+    best-connected candidate instead maximizes internal degree — walks
+    branch more, and the unique-path yield per path-gene rises toward the
+    real transcript's (tools/calibrate_real.py measures exactly this).
+    """
+    import heapq
+
+    member: set = set()
+    # Max-heap by connections-into-member; lazy counts (re-push on change).
+    conn: Dict[int, int] = {}
+    heap: list = []
+    for s in seeds:
+        if allowed[s]:
+            conn[int(s)] = 0
+            heapq.heappush(heap, (0, int(s)))
+    while heap and len(member) < size:
+        neg, u = heapq.heappop(heap)
+        if u in member or -neg != conn.get(u, 0):
+            continue        # stale entry
+        member.add(u)
+        for v in adj.get(u, ()):
+            v = int(v)
+            if allowed[v] and v not in member:
+                conn[v] = conn.get(v, 0) + 1
+                heapq.heappush(heap, (-conn[v], v))
+    return np.fromiter(member, dtype=np.int64)
+
+
 def make_real_expression(network_path: str, clinical_path: str,
                          spec: RealExampleSpec
                          ) -> Tuple[ExpressionData, Dict[str, np.ndarray]]:
@@ -96,6 +153,9 @@ def make_real_expression(network_path: str, clinical_path: str,
 
     ``info``: {"active_good", "active_poor"}: gene-NAME arrays of the two
     planted modules (for test assertions)."""
+    if spec.module_growth not in ("bfs", "dense"):
+        raise ValueError(
+            f"module_growth must be bfs|dense, got {spec.module_growth!r}")
     rng = np.random.default_rng(spec.seed)
     clinical = load_clinical(clinical_path)
     network = load_network(network_path)
@@ -119,11 +179,18 @@ def make_real_expression(network_path: str, clinical_path: str,
 
     by_degree = np.argsort(-deg)
     hubs = [int(i) for i in by_degree if common_mask[i]]
-    a_good = _bfs_region(adj, hubs[:1], spec.n_active_per_group, common_mask)
+    grow = _dense_region if spec.module_growth == "dense" else _bfs_region
+    a_good = grow(adj, hubs[:1], spec.n_active_per_group, common_mask)
     remaining = common_mask.copy()
     remaining[a_good] = False
     seeds = [h for h in hubs if remaining[h]]
-    a_poor = _bfs_region(adj, seeds[:1], spec.n_active_per_group, remaining)
+    a_poor = grow(adj, seeds[:1], spec.n_active_per_group, remaining)
+    remaining[a_poor] = False
+    if spec.n_shared > 0:
+        seeds = [h for h in hubs if remaining[h]]
+        a_shared = grow(adj, seeds[:1], spec.n_shared, remaining)
+    else:
+        a_shared = np.empty(0, dtype=np.int64)
 
     samples = np.array(list(clinical.keys()))
     labels = np.array([clinical[s] for s in samples], dtype=np.int32)
@@ -132,8 +199,15 @@ def make_real_expression(network_path: str, clinical_path: str,
 
     common_ids = np.flatnonzero(common_mask)
     good_set, poor_set = set(a_good.tolist()), set(a_poor.tolist())
+    shared_set = set(a_shared.tolist())
     z_good = rng.standard_normal(n)
     z_poor = rng.standard_normal(n)
+    # The shared module correlates within EACH group via its own factor —
+    # its edges survive both group graphs — but carries no shift (no label
+    # signal; its walks are label-ambiguous, as real housekeeping
+    # correlation structure is).
+    z_sh_g = rng.standard_normal(n)
+    z_sh_p = rng.standard_normal(n)
     w_sig = np.sqrt(spec.rho)
     w_eps = np.sqrt(1.0 - spec.rho)
 
@@ -145,6 +219,9 @@ def make_real_expression(network_path: str, clinical_path: str,
         elif gid in poor_set:
             expr[~good, j] = (w_sig * z_poor[~good]
                               + w_eps * expr[~good, j] + spec.shift)
+        elif gid in shared_set:
+            expr[good, j] = w_sig * z_sh_g[good] + w_eps * expr[good, j]
+            expr[~good, j] = w_sig * z_sh_p[~good] + w_eps * expr[~good, j]
 
     gene_names = np.array([genes[i] for i in common_ids])
     order = rng.permutation(gene_names.size)   # file order != sorted order

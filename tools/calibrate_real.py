@@ -1,0 +1,103 @@
+"""Fast path-count calibration for data/realistic.py via the native sampler.
+
+VERDICT r2 weak #4: the realistic stand-in yields ~15% fewer unique paths
+than the reference transcript (38.6k vs 45,402) at a near-exact path-GENE
+match (3,858 vs 3,773) — i.e. 10.0 paths/gene vs the transcript's 12.03,
+pointing at planted-module branching density, not module size. Sweeping
+that with the device walker costs ~5 min per trial on this 1-core host;
+the native C++ sampler (ops/host_walker.py) has identical walk semantics
+and runs a full two-group, reps=10, lenPath=80 trial in ~20 s, so it is
+the calibration surrogate. (Path-count statistics transfer between the
+backends to within a few percent — same graphs, same walk law, different
+PRNG family.)
+
+Run:  python tools/calibrate_real.py ['name=<RealExampleSpec kwargs>' ...]
+e.g.  python tools/calibrate_real.py 'shared=n_active_per_group=1500, n_shared=760'
+Always runs the default spec first ("baseline"); prints one JSON line per
+spec with n_paths / n_path_genes vs the transcript.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+NET = "/root/reference/ex_NETWORK.txt"
+CLIN = "/root/reference/ex_CLINICAL.txt"
+TRANSCRIPT = {"n_paths": 45402, "n_path_genes": 3773}
+
+
+def run_trial(spec) -> dict:
+    import numpy as np
+
+    from g2vec_tpu.data.realistic import make_real_expression
+    from g2vec_tpu.io.readers import ExpressionData, load_clinical, load_network
+    from g2vec_tpu.ops.graph import thresholded_edges
+    from g2vec_tpu.ops.host_walker import generate_path_set_native
+    from g2vec_tpu.ops.walker import count_gene_freq, integrate_path_sets
+    from g2vec_tpu.preprocess import (edges_to_indices, find_common_genes,
+                                      make_gene2idx, match_labels,
+                                      restrict_data, restrict_network)
+
+    t0 = time.time()
+    expression, _ = make_real_expression(NET, CLIN, spec)
+    clinical = load_clinical(CLIN)
+    network = load_network(NET)
+    label = match_labels(clinical, expression.sample)
+    common = find_common_genes(network.genes, expression.gene)
+    network = restrict_network(network, common)
+    data = restrict_data(
+        ExpressionData(sample=expression.sample, gene=expression.gene,
+                       expr=expression.expr), common)
+    gene2idx = make_gene2idx(data.gene)
+    src, dst = edges_to_indices(network, gene2idx)
+    n_genes = data.expr.shape[1]
+
+    sets = []
+    for i in (0, 1):
+        expr_group = data.expr[label == i]
+        s_k, d_k, w_k = thresholded_edges(expr_group, src, dst, threshold=0.5)
+        sets.append(generate_path_set_native(
+            np.asarray(s_k), np.asarray(d_k), np.asarray(w_k), n_genes,
+            len_path=80, reps=10, seed=i))
+    paths, labels_arr = integrate_path_sets(sets[0], sets[1], n_genes,
+                                            packed=True)
+    freq = count_gene_freq(paths, labels_arr, list(data.gene), packed=True)
+    return {"n_paths": int(paths.shape[0]), "n_path_genes": len(freq),
+            "paths_per_gene": round(paths.shape[0] / max(len(freq), 1), 2),
+            "vs_transcript_paths": round(
+                paths.shape[0] / TRANSCRIPT["n_paths"], 3),
+            "vs_transcript_genes": round(
+                len(freq) / TRANSCRIPT["n_path_genes"], 3),
+            "secs": round(time.time() - t0, 1)}
+
+
+def main() -> None:
+    # Env alone is NOT enough: the tunnel sitecustomize pins jax_platforms
+    # at interpreter startup, which outranks the variable — re-force the
+    # config or the einsum below dials the (possibly wedged) TPU.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from g2vec_tpu.data.realistic import RealExampleSpec
+
+    specs = {
+        "baseline": RealExampleSpec(),
+    }
+    for field in sys.argv[1:]:
+        name, expr = field.split("=", 1)
+        specs[name] = eval(  # noqa: S307 — operator-supplied sweep points
+            f"RealExampleSpec({expr})", {"RealExampleSpec": RealExampleSpec})
+    for name, spec in specs.items():
+        out = run_trial(spec)
+        print(json.dumps({"spec": name, **out,
+                          "transcript": TRANSCRIPT}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
