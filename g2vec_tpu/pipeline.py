@@ -94,7 +94,9 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
         # dominate a cold pipeline's wall (the TPU acceptance run spends
         # most of its train/lgroups/biomarkers stage time compiling).
         jax.config.update("jax_compilation_cache_dir", cfg.compilation_cache)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        # Persist every program: a pipeline run compiles a bounded set of
+        # programs, so cache-write cost is trivial next to ANY compile.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     if cfg.distributed:
         # Worker processes compute shards but neither narrate nor write:
         # transcript, metrics stream, profiler trace, and the three outputs

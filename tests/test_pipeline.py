@@ -89,6 +89,25 @@ def test_pipeline_is_deterministic_per_seed(tsv_paths, tmp_path):
             assert a.read() == b.read(), f"{f1} differs from {f2}"
 
 
+def test_compilation_cache_populates(tsv_paths, tmp_path):
+    """--compilation-cache points jax at a persistent XLA cache dir; a run
+    must create and write it (the warm-run speedup itself is a TPU
+    property; here we pin the plumbing)."""
+    import os as _os
+
+    from g2vec_tpu.pipeline import run
+
+    cache = str(tmp_path / "xla-cache")
+    # Shapes unseen by earlier tests in this process: the in-memory jit
+    # caches would otherwise satisfy every program and nothing would
+    # compile (or persist).
+    run(_cfg(tsv_paths, tmp_path, compilation_cache=cache,
+             sizeHiddenlayer=24, lenPath=9),
+        console=lambda s: None)
+    assert _os.path.isdir(cache) and _os.listdir(cache), (
+        "compilation cache dir missing or empty after a cached run")
+
+
 def test_pipeline_recovers_planted_modules(tsv_paths, tmp_path):
     """The planted good/poor modules should dominate the biomarker list."""
     from g2vec_tpu.pipeline import run
