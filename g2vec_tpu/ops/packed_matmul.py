@@ -5,9 +5,11 @@ The trainer's hot op is ``X @ W_ih`` where X is a 0/1 multi-hot path matrix
 ~550 MB of HBM at example scale and every epoch re-reads it four times
 (train fwd, dW, train eval, val eval). This kernel keeps X **bit-packed**
 (uint8, 8 genes/byte — 16x smaller) in HBM and unpacks tiles on the fly in
-VMEM, fused into the MXU matmul, so the HBM traffic for X drops 16x and the
-op runs at the matmul roofline (~0.34 ms vs ~2.7 ms for the XLA dense dot at
-36864 x 8192 x 128 on a v5e chip).
+VMEM, fused into the MXU matmul, so the HBM traffic for X drops 16x. The
+packed-vs-XLA-dense speedup at the trainer's exact fwd shape is a MEASURED
+bench metric, not a docstring number: ``packed_matmul_vs_xla_dense`` in the
+driver's BENCH_r{N}.json (bench.py stage 3; interactive spot checks on a
+v5e chip saw ~0.34 ms vs ~2.7 ms at 36864 x 8192 x 128).
 
 Layout: genes are packed **blockwise** (`pack_blockwise`): within each
 ``LANE_BLOCK``-gene block, gene offset ``j = c + k*(LANE_BLOCK//8)`` lives in
