@@ -66,6 +66,14 @@ def generate_path_set_native(src: np.ndarray, dst: np.ndarray, w: np.ndarray,
     if starts is None:
         starts = np.arange(n_genes, dtype=np.int32)
     starts = np.asarray(starts, dtype=np.int32)
+    # The C++ side indexes visited[] and indptr[] with these without
+    # checks — bound them here, once, at the language boundary.
+    for name, arr in (("starts", starts), ("dst", dst)):
+        if arr.size and (arr.min() < 0 or arr.max() >= n_genes):
+            raise ValueError(
+                f"{name} contains node ids outside [0, {n_genes})")
+    if src.size and (src.min() < 0 or src.max() >= n_genes):
+        raise ValueError(f"src contains node ids outside [0, {n_genes})")
     n_starts = starts.shape[0]
     all_starts = np.tile(starts, reps)
     # Stream identity = (repetition, start index) — the same flat

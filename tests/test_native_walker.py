@@ -125,6 +125,19 @@ def test_pipeline_native_backend(tmp_path):
         == (tmp_path / "nat2_biomarkers.txt").read_text()
 
 
+def test_out_of_range_nodes_rejected():
+    from g2vec_tpu.ops.host_walker import generate_path_set_native
+
+    src, dst, w, n = _chain_plus_hub()
+    with pytest.raises(ValueError, match="starts"):
+        generate_path_set_native(src, dst, w, n, len_path=4, reps=1, seed=0,
+                                 starts=np.array([n], dtype=np.int32))
+    with pytest.raises(ValueError, match="dst"):
+        generate_path_set_native(src, np.array([0, 1, 2, 4, 5, 99],
+                                               dtype=np.int32),
+                                 w, n, len_path=4, reps=1, seed=0)
+
+
 def test_negative_seed_accepted():
     # The device backend accepts any int --seed (jax.random.key); the
     # native path masks to uint64 instead of letting NumPy 2 raise
