@@ -8,18 +8,30 @@ The reference (/root/reference/G2Vec.py) is a single-file CPU NumPy/TF1 tool.
 This package re-designs the same seven-stage pipeline TPU-first:
 
 - L0 config/CLI           -> :mod:`g2vec_tpu.config`
-- L1 data IO              -> :mod:`g2vec_tpu.io`
+- L1 data IO              -> :mod:`g2vec_tpu.io` (+ native C++ in
+  :mod:`g2vec_tpu.native`)
 - L2 preprocess           -> :mod:`g2vec_tpu.preprocess`
-- L3 graph + random walks -> :mod:`g2vec_tpu.ops.pcc`, :mod:`g2vec_tpu.ops.walks`
+- L3 graph + random walks -> :mod:`g2vec_tpu.ops.graph`,
+  :mod:`g2vec_tpu.ops.walker` (native CPU twin:
+  :mod:`g2vec_tpu.ops.host_walker`)
 - L4 trainer (CBOW)       -> :mod:`g2vec_tpu.models.cbow`, :mod:`g2vec_tpu.train`
 - L5 analysis             -> :mod:`g2vec_tpu.ops.stats`, :mod:`g2vec_tpu.ops.kmeans`
 - L6 output writers       -> :mod:`g2vec_tpu.io.writers`
 - parallelism             -> :mod:`g2vec_tpu.parallel`
 
 This module intentionally avoids importing jax at package-import time so that
-callers (CLI, tests) can configure platform/env first.
+callers (CLI, tests) can configure platform/env first; ``g2vec_tpu.run`` is
+therefore a lazy attribute (it resolves to :func:`g2vec_tpu.pipeline.run`
+on first access, which is when jax loads).
 """
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"
 
 from g2vec_tpu.config import G2VecConfig  # noqa: F401  (jax-free)
+
+
+def __getattr__(name: str):
+    if name == "run":
+        from g2vec_tpu.pipeline import run
+        return run
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
