@@ -140,6 +140,11 @@ class G2VecConfig:
                 "not combine with --mesh or --distributed")
 
 
+def _version() -> str:
+    from g2vec_tpu import __version__
+    return __version__
+
+
 def build_parser() -> argparse.ArgumentParser:
     """CLI mirroring the reference parser (ref: G2Vec.py:505-518) + new flags."""
     parser = argparse.ArgumentParser(
@@ -167,6 +172,8 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("-l", "--learningRate", type=float, default=0.005)
     parser.add_argument("-n", "--numBiomarker", type=int, default=50)
     # framework flags
+    parser.add_argument("--version", action="version",
+                        version=f"%(prog)s {_version()}")
     parser.add_argument("--seed", type=int, default=0,
                         help="Global PRNG seed (the reference is unseeded).")
     parser.add_argument("--pcc-threshold", type=float, default=0.5)
