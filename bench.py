@@ -117,6 +117,7 @@ def _fail(stage: str, detail: str, code: int = 2) -> "NoReturn":  # noqa: F821
 def main() -> None:
     deadline = time.time() + TOTAL_BUDGET
     last_err = "?"
+    probe_platform = ""
     for attempt in range(PROBE_ATTEMPTS):
         try:
             proc = subprocess.run(
@@ -128,6 +129,7 @@ def main() -> None:
         if proc.returncode == 0 and proc.stdout.strip():
             info = json.loads(proc.stdout.strip().splitlines()[-1])
             print(f"# backend probe ok: {info}", file=sys.stderr)
+            probe_platform = info.get("platform", "")
             break
         last_err = (proc.stderr or proc.stdout or "")[-300:]
         time.sleep(5)
@@ -145,7 +147,12 @@ def main() -> None:
         child_env = dict(os.environ,
                          G2VEC_BENCH_CHILD_BUDGET=str(
                              min(CHILD_BUDGET, max(30, budget - 20))))
-        out, err, fail = _run_measure_child(budget, child_env)
+        # The pre-metric wedge cutoff calibrates to the TPU path (train's
+        # first metric lands within ~90s there). On other backends the
+        # same stage can legitimately run past it — a CPU headline train
+        # takes minutes — so only the budget kill applies.
+        cutoff = FIRST_METRIC_TIMEOUT if probe_platform == "tpu" else budget
+        out, err, fail = _run_measure_child(budget, child_env, cutoff)
         sys.stderr.write(err)
         # Retry only the produced-nothing wedge (transient tunnel death
         # between probe and measure): a child that got ANY metric out is
@@ -172,13 +179,15 @@ def main() -> None:
             _fail("measure", f"{fail}: {err[-300:]}")
 
 
-def _run_measure_child(budget: int, child_env: dict) -> tuple:
+def _run_measure_child(budget: int, child_env: dict,
+                       first_metric_cutoff: int) -> tuple:
     """Run the measure child, watching its stdout as it streams.
 
     Returns (stdout, stderr, fail) where fail is None on rc=0. Beyond the
     plain ``budget`` kill, a child that has emitted no metric line by
-    FIRST_METRIC_TIMEOUT is killed early — it is wedged on a dead backend,
-    and the saved window funds the caller's one retry.
+    ``first_metric_cutoff`` is killed early — it is wedged on a dead
+    backend, and the saved window funds the caller's one retry. Callers
+    pass cutoff == budget to disable the early kill (non-TPU backends).
     """
     import tempfile
 
@@ -208,12 +217,12 @@ def _run_measure_child(budget: int, child_env: dict) -> tuple:
                 proc.wait()
                 fail = f"measurement exceeded {budget}s"
                 break
-            if not metric_seen and elapsed > FIRST_METRIC_TIMEOUT:
+            if not metric_seen and elapsed > first_metric_cutoff:
                 metric_seen = _has_real_metric(snapshot(fo))
                 if not metric_seen:
                     proc.kill()
                     proc.wait()
-                    fail = (f"no metric after {FIRST_METRIC_TIMEOUT}s "
+                    fail = (f"no metric after {first_metric_cutoff}s "
                             f"(backend wedged)")
                     break
             time.sleep(2)

@@ -50,12 +50,19 @@ def build_and_load(src: str, so: str, extra_flags: List[str],
                 tmp = f"{so}.{os.getpid()}.tmp"
                 cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
                        *extra_flags, "-o", tmp, src]
-                proc = subprocess.run(cmd, capture_output=True, text=True,
-                                      timeout=120)
-                if proc.returncode != 0:
-                    raise RuntimeError(
-                        f"native build failed: {' '.join(cmd)}\n{proc.stderr}")
-                os.replace(tmp, so)
+                try:
+                    proc = subprocess.run(cmd, capture_output=True,
+                                          text=True, timeout=120)
+                    if proc.returncode != 0:
+                        raise RuntimeError(
+                            f"native build failed: {' '.join(cmd)}\n"
+                            f"{proc.stderr}")
+                    os.replace(tmp, so)
+                finally:
+                    # A failed/timed-out compile must not leave its partial
+                    # output orphaned in the package directory.
+                    if os.path.exists(tmp):
+                        os.unlink(tmp)
             lib = ctypes.CDLL(so)
             configure(lib)
         except Exception as e:  # remember, so we don't rebuild per call

@@ -67,6 +67,16 @@ def walk_paths(indptr: np.ndarray, indices: np.ndarray, weights: np.ndarray,
         raise ValueError(
             f"indptr has {indptr.shape[0]} entries for {n_genes} genes "
             f"(want n_genes+1)")
+    # The C++ side indexes visited[]/indptr[] with these unchecked — this
+    # function IS the language boundary, so the range checks live here
+    # (out-of-range ids would be heap corruption, not an exception).
+    for name, arr in (("starts", starts), ("indices", indices)):
+        if arr.size and (arr.min() < 0 or arr.max() >= n_genes):
+            raise ValueError(
+                f"{name} contains node ids outside [0, {n_genes})")
+    if indptr[0] != 0 or indptr[-1] != indices.shape[0] \
+            or np.any(np.diff(indptr) < 0):
+        raise ValueError("indptr is not a valid CSR row-pointer array")
     out = np.empty((n_walkers, len_path), dtype=np.int32)
     lib.g2v_walk(indptr, indices, weights, np.int32(n_genes), starts,
                  stream_ids, np.int64(n_walkers), np.int32(len_path),

@@ -59,15 +59,22 @@ def _make_graph(rng, n_genes: int, n_edges: int):
     """Truncated-power-law out-degree synthetic stand-in at this scale."""
     import numpy as np
 
+    assert n_edges <= n_genes * MAX_DEGREE, "cap infeasible at this density"
     p = (1.0 / np.arange(1, n_genes + 1)) ** 0.8
     src = rng.choice(n_genes, size=n_edges, p=p / p.sum()).astype(np.int32)
-    # Re-home every edge beyond a hub's MAX_DEGREE cap to a uniform source:
-    # keeps n_edges exact while bounding D.
-    counts = np.bincount(src, minlength=n_genes)
-    over = np.flatnonzero(counts > MAX_DEGREE)
-    for g in over:
-        idx = np.flatnonzero(src == g)[MAX_DEGREE:]
-        src[idx] = rng.integers(0, n_genes, size=idx.size)
+    # Re-home every edge beyond a hub's MAX_DEGREE cap to a uniform source,
+    # iterating until the cap actually holds (a single pass can push other
+    # genes a few edges over, and neighbor_table's pow2 rounding would then
+    # DOUBLE D — the exact blowup the cap exists to prevent). Keeps n_edges
+    # exact; terminates because total overflow shrinks geometrically.
+    while True:
+        counts = np.bincount(src, minlength=n_genes)
+        over = np.flatnonzero(counts > MAX_DEGREE)
+        if over.size == 0:
+            break
+        for g in over:
+            idx = np.flatnonzero(src == g)[MAX_DEGREE:]
+            src[idx] = rng.integers(0, n_genes, size=idx.size)
     dst = rng.integers(0, n_genes, size=n_edges).astype(np.int32)
     w = rng.uniform(0.5001, 1.0, size=n_edges).astype(np.float32)
     return src, dst, w

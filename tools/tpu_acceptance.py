@@ -56,7 +56,25 @@ def _code_key() -> str:
         out = subprocess.run(
             ["git", "rev-parse", "HEAD:g2vec_tpu"], cwd=REPO,
             capture_output=True, text=True, timeout=10)
-        return out.stdout.strip()
+        key = out.stdout.strip()
+        # Uncommitted g2vec_tpu/ edits mean HEAD's tree does not describe
+        # the code actually measured; suffix a hash of the working-tree
+        # diff so the key tracks exactly what ran (clean vs any dirt, and
+        # one dirt state vs another, never collide).
+        diff = subprocess.run(
+            ["git", "diff", "HEAD", "--", "g2vec_tpu"], cwd=REPO,
+            capture_output=True, text=True, timeout=10).stdout
+        # status --porcelain additionally catches untracked new modules,
+        # which `git diff HEAD` does not show (by name — untracked CONTENT
+        # changes collide, acceptable for a freshness key).
+        status = subprocess.run(
+            ["git", "status", "--porcelain", "g2vec_tpu"], cwd=REPO,
+            capture_output=True, text=True, timeout=10).stdout
+        if diff or status:
+            import hashlib
+            key += "-dirty-" + hashlib.sha256(
+                (status + diff).encode()).hexdigest()[:12]
+        return key
     except Exception:  # noqa: BLE001
         return ""
 
