@@ -229,7 +229,8 @@ def make_real_expression(network_path: str, clinical_path: str,
         sample=samples, gene=gene_names[order],
         expr=expr[:, order].astype(np.float32))
     info = {"active_good": np.array([genes[i] for i in a_good]),
-            "active_poor": np.array([genes[i] for i in a_poor])}
+            "active_poor": np.array([genes[i] for i in a_poor]),
+            "active_shared": np.array([genes[i] for i in a_shared])}
     return expression, info
 
 

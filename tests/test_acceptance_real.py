@@ -50,6 +50,54 @@ needs_reference = pytest.mark.skipif(
     reason="reference data mount not present")
 
 
+def test_dense_region_prefers_connectivity():
+    """Greedy max-connectivity growth picks the clique over the pendant
+    chain a BFS ball would sweep up."""
+    from g2vec_tpu.data.realistic import _bfs_region, _dense_region
+
+    # Node 0 seeds; 1-2-3-4 form a clique with 0; 5-6-7 a chain off 0.
+    edges = [(0, 1), (0, 2), (0, 3), (0, 4), (1, 2), (1, 3), (1, 4),
+             (2, 3), (2, 4), (3, 4), (0, 5), (5, 6), (6, 7)]
+    adj = {}
+    for a, b in edges:
+        adj.setdefault(a, []).append(b)
+        adj.setdefault(b, []).append(a)
+    allowed = np.ones(8, dtype=bool)
+    dense = set(_dense_region(adj, [0], 5, allowed).tolist())
+    assert dense == {0, 1, 2, 3, 4}, dense
+    # BFS from 0 visits in queue order and may take 5 before the clique
+    # closes; both must respect size and connectivity.
+    bfs = set(_bfs_region(adj, [0], 5, allowed).tolist())
+    assert len(bfs) == 5 and 0 in bfs
+
+
+def test_shared_module_correlates_in_both_groups():
+    """n_shared genes must carry > threshold |PCC| within EACH group (their
+    edges survive both graphs) and no differential shift."""
+    from g2vec_tpu.data.realistic import RealExampleSpec, make_real_expression
+
+    if not (os.path.exists(NET) and os.path.exists(CLIN)):
+        pytest.skip("reference data mount not present")
+    spec = RealExampleSpec(n_active_per_group=50, n_shared=40)
+    expression, info = make_real_expression(NET, CLIN, spec)
+    # Reconstruct labels in expression sample order.
+    from g2vec_tpu.io.readers import load_clinical
+    clin = load_clinical(CLIN)
+    labels = np.array([clin[s] for s in expression.sample])
+    g2col = {g: j for j, g in enumerate(expression.gene)}
+    shared = [g for g in info["active_shared"]][:10]
+    cols = [g2col[g] for g in shared if g in g2col]
+    for grp in (0, 1):
+        x = expression.expr[labels == grp][:, cols]
+        c = np.corrcoef(x.T)
+        off = c[np.triu_indices_from(c, k=1)]
+        assert np.abs(off).mean() > 0.5, (grp, np.abs(off).mean())
+    # No differential shift on shared genes.
+    mg = expression.expr[labels == 0][:, cols].mean()
+    mp = expression.expr[labels == 1][:, cols].mean()
+    assert abs(mg - mp) < 0.3, (mg, mp)
+
+
 @pytest.mark.slow
 @needs_reference
 def test_real_network_pipeline(tmp_path):
