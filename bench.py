@@ -22,6 +22,10 @@ Prints JSON metric lines (one object per line, ``{"metric", "value",
    np.random.choice per step, ref: G2Vec.py:328-346) on this host,
    extrapolated to walks/s — the reference publishes no walker timing, so
    its own algorithm on the bench machine is the fairest anchor.
+2b. ``walker_native_walks_per_sec`` — the same workload through the
+   threaded C++ CSR sampler (ops/host_walker.py): the single-host
+   no-accelerator path, and a walker number the round still gets if the
+   TPU walker stage fails.
 3. ``packed_matmul_vs_xla_dense`` — driver-verified kernel claim
    (packed_matmul.py docstring): the fused bit-packed Pallas matmul vs the
    XLA dense bf16 dot at the trainer's exact fwd shape; value = speedup.
