@@ -9,14 +9,63 @@ everything behind a per-target lock.
 
 The .so is written to a temp name and os.replace()d in, so two processes
 racing on a cold checkout can never dlopen a half-written library.
+
+When the package directory is not writable (non-editable install into a
+read-only site-packages), the build falls back to a per-user cache
+(``$XDG_CACHE_HOME``/``~/.cache`` ``/g2vec_tpu/<source-hash>.so``) so the
+native components stay available — the sources ship in the wheel
+specifically for this on-demand build.
 """
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
+import platform
 import subprocess
+import sys
 import threading
 from typing import Callable, Dict, List, Optional
+
+
+def _cache_path(src: str, extra_flags: List[str]) -> str:
+    """Per-user cache location for ``src``'s .so, keyed by source content
+    AND build flags (the hash in the name doubles as the staleness check
+    across versions — a flags-only release change must miss the cache)."""
+    h = hashlib.sha256()
+    with open(src, "rb") as f:
+        h.update(f.read())
+    h.update(b"\0".join(f.encode() for f in extra_flags))
+    # Platform identity: a $HOME shared across heterogeneous hosts must not
+    # serve host A's ELF to host B.
+    h.update(f"{sys.platform}\0{platform.machine()}\0"
+             f"{'-'.join(platform.libc_ver())}".encode())
+    digest = h.hexdigest()[:16]
+    root = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache")
+    cache_dir = os.path.join(root, "g2vec_tpu")
+    os.makedirs(cache_dir, exist_ok=True)
+    base = os.path.splitext(os.path.basename(src))[0]
+    return os.path.join(cache_dir, f"{base}-{digest}.so")
+
+
+def _compile(src: str, so: str, extra_flags: List[str]) -> None:
+    """g++-compile ``src`` to ``so`` atomically (tmp + os.replace)."""
+    tmp = f"{so}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+           *extra_flags, "-o", tmp, src]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=120)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"native build failed: {' '.join(cmd)}\n{proc.stderr}")
+        os.replace(tmp, so)
+    finally:
+        # A failed/timed-out compile must not leave its partial output
+        # orphaned next to the target.
+        if os.path.exists(tmp):
+            os.unlink(tmp)
 
 
 class _Target:
@@ -45,25 +94,39 @@ def build_and_load(src: str, so: str, extra_flags: List[str],
         if target.error is not None:
             raise RuntimeError(target.error)
         try:
+            cache_so = None
             if (not os.path.exists(so)
                     or os.path.getmtime(so) < os.path.getmtime(src)):
-                tmp = f"{so}.{os.getpid()}.tmp"
-                cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-                       *extra_flags, "-o", tmp, src]
                 try:
-                    proc = subprocess.run(cmd, capture_output=True,
-                                          text=True, timeout=120)
-                    if proc.returncode != 0:
-                        raise RuntimeError(
-                            f"native build failed: {' '.join(cmd)}\n"
-                            f"{proc.stderr}")
-                    os.replace(tmp, so)
-                finally:
-                    # A failed/timed-out compile must not leave its partial
-                    # output orphaned in the package directory.
-                    if os.path.exists(tmp):
-                        os.unlink(tmp)
-            lib = ctypes.CDLL(so)
+                    if not os.access(os.path.dirname(so) or ".", os.W_OK):
+                        raise OSError(
+                            f"package directory not writable: "
+                            f"{os.path.dirname(so)}")
+                    _compile(src, so, extra_flags)
+                except Exception:
+                    # Read-only install (or an access() lie — root-squash
+                    # NFS reports W_OK and then fails the actual write):
+                    # build into the per-user cache instead, memoized
+                    # under the ORIGINAL so key above so later calls
+                    # still short-circuit. The cache name is keyed by
+                    # (source, flags, platform) content, so an existing
+                    # file is current. A genuinely broken source/toolchain
+                    # fails here too and raises from the fallback compile.
+                    cache_so = _cache_path(src, extra_flags)
+                    if not os.path.exists(cache_so):
+                        _compile(src, cache_so, extra_flags)
+                    so = cache_so
+            try:
+                lib = ctypes.CDLL(so)
+            except OSError:
+                if cache_so is None:
+                    raise
+                # A pre-existing cache .so that will not dlopen (e.g. left
+                # by an older key scheme, or corrupted): rebuild it once
+                # rather than memoizing the failure forever.
+                os.unlink(cache_so)
+                _compile(src, cache_so, extra_flags)
+                lib = ctypes.CDLL(cache_so)
             configure(lib)
         except Exception as e:  # remember, so we don't rebuild per call
             target.error = str(e)

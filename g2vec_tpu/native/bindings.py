@@ -6,7 +6,8 @@ None when the native library cannot be built/loaded — callers
 
 Build contract shared with the walker bindings (_build.py): compiled once
 per checkout (``g++ -O3 -shared -fPIC``) and cached as ``_tsv_reader.so``
-beside the sources; a stale .so (older than the .cpp) is rebuilt.
+beside the sources — or in ``$XDG_CACHE_HOME/g2vec_tpu/`` when the package
+directory is read-only; a stale .so (older than the .cpp) is rebuilt.
 """
 from __future__ import annotations
 

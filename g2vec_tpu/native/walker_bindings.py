@@ -1,10 +1,11 @@
 """ctypes bindings for the native CPU walk sampler (walker.cpp).
 
 Same build contract as the TSV reader (shared scaffolding in _build.py):
-compiled once per checkout to ``_walker.so`` beside the sources, rebuilt
-when the .cpp is newer, and a build/load failure raises RuntimeError
-exactly once — callers (ops/host_walker.py) surface it as "native walker
-unavailable".
+compiled once per checkout to ``_walker.so`` beside the sources (or into
+``$XDG_CACHE_HOME/g2vec_tpu/`` when the package directory is read-only —
+non-editable installs), rebuilt when the .cpp is newer, and a build/load
+failure raises RuntimeError exactly once — callers (ops/host_walker.py)
+surface it as "native walker unavailable".
 """
 from __future__ import annotations
 
@@ -67,6 +68,10 @@ def walk_paths(indptr: np.ndarray, indices: np.ndarray, weights: np.ndarray,
         raise ValueError(
             f"indptr has {indptr.shape[0]} entries for {n_genes} genes "
             f"(want n_genes+1)")
+    if weights.shape[0] != indices.shape[0]:
+        raise ValueError(
+            f"weights has {weights.shape[0]} entries for "
+            f"{indices.shape[0]} edges")
     # The C++ side indexes visited[]/indptr[] with these unchecked — this
     # function IS the language boundary, so the range checks live here
     # (out-of-range ids would be heap corruption, not an exception).

@@ -15,9 +15,10 @@ dedup and the downstream integrate/count/train stages cannot tell the
 backends apart. Same walk SEMANTICS (no revisit, weight-proportional
 sampling, dead-end stop, every gene a start node reps times,
 ref: G2Vec.py:324-352); per-seed deterministic for any thread count
-(streams are keyed by (seed, repetition*n_genes+start) identity, mirroring
-the device walker's stream-identity scheme). The two backends draw from
-different PRNG families, so their path sets differ for the same seed —
+(streams are keyed by (seed, repetition, start-index) within this
+backend's own counter-based PRNG family). The two backends draw from
+different PRNG families — the device walker derives its streams via
+jax.random split/fold_in — so their path sets differ for the same seed;
 each is individually deterministic, exactly the documented dense/sparse
 caveat in generate_path_set.
 """
@@ -76,9 +77,11 @@ def generate_path_set_native(src: np.ndarray, dst: np.ndarray, w: np.ndarray,
         raise ValueError(f"src contains node ids outside [0, {n_genes})")
     n_starts = starts.shape[0]
     all_starts = np.tile(starts, reps)
-    # Stream identity = (repetition, start index) — the same flat
-    # rep*n_genes + i identity the device walker keys its PRNG streams by,
-    # so adding repetitions extends (never reshuffles) the stream family.
+    # Stream identity = rep * n_starts + i, i.e. (repetition, start-index)
+    # within THIS backend's counter-based PRNG family: adding repetitions
+    # extends (never reshuffles) the stream family. The device walker keys
+    # its own streams differently (split(key, reps) + fold_in), so the two
+    # backends are each deterministic but not cross-identical.
     stream_ids = (np.arange(reps, dtype=np.uint64)[:, None] * np.uint64(n_starts)
                   + np.arange(n_starts, dtype=np.uint64)[None, :]).ravel()
 

@@ -160,3 +160,53 @@ def test_config_validation():
     with pytest.raises(ValueError, match="single-host"):
         G2VecConfig(**base, walker_backend="native",
                     mesh_shape=(2, 4)).validate()
+
+
+def test_mismatched_weights_length_rejected():
+    # The language boundary must catch a weights array shorter than the
+    # edge list (the C++ reads weights[k] for k < indptr[-1]).
+    from g2vec_tpu.native.walker_bindings import walk_paths
+    from g2vec_tpu.ops.host_walker import edges_to_csr
+
+    src, dst, w, n = _chain_plus_hub()
+    indptr, indices, weights = edges_to_csr(src, dst, w, n)
+    starts = np.arange(n, dtype=np.int32)
+    ids = np.arange(n, dtype=np.uint64)
+    with pytest.raises(ValueError, match="weights"):
+        walk_paths(indptr, indices, weights[:-1], n, starts, ids, 4, 0)
+
+
+def test_readonly_package_dir_builds_into_cache(tmp_path, monkeypatch):
+    # Non-editable install into read-only site-packages: the on-demand
+    # build must land in the per-user cache instead of failing forever.
+    import os as _os
+    import shutil as _shutil
+    import g2vec_tpu.native._build as _build
+    from g2vec_tpu.native import walker_bindings
+
+    pkg = tmp_path / "ro_pkg"
+    pkg.mkdir()
+    src = pkg / "walker.cpp"
+    _shutil.copyfile(walker_bindings._SRC, src)
+    so = pkg / "_walker.so"
+    cache_home = tmp_path / "cache"
+    monkeypatch.setenv("XDG_CACHE_HOME", str(cache_home))
+    # os.access(W_OK) is unreliable under root, so simulate the read-only
+    # directory at the check itself.
+    real_access = _os.access
+
+    def fake_access(path, mode):
+        if _os.path.abspath(str(path)) == str(pkg) and mode == _os.W_OK:
+            return False
+        return real_access(path, mode)
+
+    monkeypatch.setattr(_build.os, "access", fake_access)
+    lib = _build.build_and_load(str(src), str(so), ["-pthread"],
+                                walker_bindings._configure)
+    assert lib is not None
+    assert not so.exists()
+    cached = list((cache_home / "g2vec_tpu").glob("walker-*.so"))
+    assert len(cached) == 1
+    # Second call short-circuits on the memoized handle.
+    assert _build.build_and_load(str(src), str(so), ["-pthread"],
+                                 walker_bindings._configure) is lib
