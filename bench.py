@@ -49,6 +49,15 @@ itself: it first PROBES the backend in a subprocess with a hard timeout
 (retrying a flaky tunnel), then runs the measurement in a second bounded
 subprocess. Every failure path prints a JSON-parseable error line and exits
 nonzero within seconds of the deadline.
+
+Dead-tunnel rounds still record truth (round-3 postmortem: BENCH_r03 was
+rc=2/value:null — the round recorded nothing): when the probe exhausts its
+attempts, a ``--_hostonly`` child that never imports jax measures the
+native C++ sampler against the reference's own walk loop and emits a real
+``walker_native_walks_per_sec`` line (printed last — the driver parses the
+last line), after an explicit chip_free_fallback error line for the
+unmeasurable train headline. Exit code 3 marks that mode (0 = chip bench,
+2 = nothing measurable).
 """
 from __future__ import annotations
 
@@ -138,8 +147,8 @@ def main() -> None:
         last_err = (proc.stderr or proc.stdout or "")[-300:]
         time.sleep(5)
     else:
-        _fail("backend-probe", f"no usable jax backend after "
-              f"{PROBE_ATTEMPTS} attempts: {last_err}")
+        _hostonly_fallback(f"no usable jax backend after {PROBE_ATTEMPTS} "
+                           f"attempts: {last_err}", deadline)
 
     out = err = ""
     fail = None
@@ -181,6 +190,96 @@ def main() -> None:
                               "error": f"measure: {fail}: {err[-300:]}"[:500]}))
         else:
             _fail("measure", f"{fail}: {err[-300:]}")
+
+
+def _hostonly_fallback(probe_err: str, deadline: float) -> "NoReturn":  # noqa: F821
+    """Dead-tunnel round: emit the chip-free truths instead of only an
+    error object (round-3 postmortem — BENCH_r03 was rc=2/value:null and
+    the round recorded NOTHING). Runs ``--_hostonly`` in a child that
+    never imports jax: the native C++ sampler and the reference-loop
+    baseline are host work, so their numbers are true with no backend.
+    The real metric prints LAST (the driver's parsed field reads the last
+    line). Exits 3 — distinct from rc=0 (chip bench) and rc=2 (nothing) —
+    when at least one real metric landed.
+    """
+    print(f"# backend probe failed ({probe_err}); falling back to "
+          f"host-only metrics", file=sys.stderr, flush=True)
+    # The headline train metric is unmeasurable without a backend: say so
+    # first, in-band, so no reader mistakes the fallback for a chip round.
+    print(json.dumps({
+        "metric": "cbow_train_paths_per_sec_per_chip", "value": None,
+        "unit": "paths/s", "vs_baseline": None,
+        "error": f"backend-probe: {probe_err}"[:500],
+        "chip_free_fallback": True,
+    }), flush=True)
+    budget = max(30, min(180, int(deadline - time.time() - 10)))
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--_hostonly"],
+            capture_output=True, text=True, timeout=budget)
+        sys.stderr.write(proc.stderr)
+        sys.stdout.write(proc.stdout)
+        ok = _has_real_metric(proc.stdout)
+    except subprocess.TimeoutExpired as e:
+        # TimeoutExpired carries raw bytes even under text=True.
+        out = (e.stdout or b"").decode(errors="replace")
+        print(f"# host-only child exceeded {budget}s", file=sys.stderr)
+        sys.stderr.write((e.stderr or b"").decode(errors="replace"))
+        sys.stdout.write(out)
+        if out and not out.endswith("\n"):
+            print()   # a killed child may leave a partial line behind
+        ok = _has_real_metric(out)
+    sys.exit(3 if ok else 2)
+
+
+def _native_walker_line(src, dst, w, n_genes: int, baseline: float,
+                        note, extra: dict) -> dict:
+    """Time the native C++ sampler on the bench walk workload and build the
+    ``walker_native_walks_per_sec`` metric line. ONE implementation for the
+    chip-round stage 2b and the dead-tunnel host-only child, so the two
+    rounds' numbers stay comparable field-for-field. Never imports jax."""
+    from g2vec_tpu.native.walker_bindings import load as load_native
+    from g2vec_tpu.ops.host_walker import generate_path_set_native
+
+    load_native()              # one-time g++ compile outside the timed region
+    t0 = time.time()
+    npaths = generate_path_set_native(src, dst, w, n_genes,
+                                      len_path=LEN_PATH, reps=WALKER_REPS,
+                                      seed=0)
+    el = time.time() - t0
+    total_n = n_genes * WALKER_REPS
+    note(f"native walker: {total_n} walks in {el:.2f}s -> "
+         f"{total_n / el:.0f} walks/s; {len(npaths)} unique paths")
+    return {"metric": "walker_native_walks_per_sec",
+            "value": round(total_n / el, 1), "unit": "walks/s",
+            "vs_baseline": round(total_n / el / baseline, 2),
+            "unique_paths": len(npaths), "n_genes": n_genes,
+            "len_path": LEN_PATH, "reps": WALKER_REPS, **extra}
+
+
+def _hostonly() -> None:
+    """Child: chip-free metrics (native sampler vs the reference loop).
+    MUST NOT import jax — see _hostonly_fallback."""
+    from g2vec_tpu.ops.host_walker import edges_to_csr
+
+    def note(msg):
+        print(f"# {msg}", file=sys.stderr, flush=True)
+
+    src, dst, w, n_genes = _load_bench_edges()
+    csr = edges_to_csr(src, dst, w, n_genes)
+    note(f"host-only network: {n_genes} genes, {src.size} edges")
+    baseline, n_base = _reference_walk_baseline(*csr, n_genes, LEN_PATH)
+    note(f"host reference loop: {baseline:.1f} walks/s "
+         f"({n_base} stratified walks)")
+    line = _native_walker_line(
+        src, dst, w, n_genes, baseline, note,
+        {"baseline_host_walks_per_sec": round(baseline, 2),
+         "chip_free_fallback": True,
+         "note": "threaded C++ CSR sampler (ops/host_walker.py), the "
+                 "default single-host stage-3 backend; baseline = the "
+                 "reference's own walk loop on this host. Measured with NO "
+                 "usable jax backend this round."})
+    print(json.dumps(line), flush=True)
 
 
 def _run_measure_child(budget: int, child_env: dict,
@@ -336,15 +435,12 @@ def _bench_train(paths, labels, hidden: int, measure_epochs: int,
     return sec_per_epoch, mfu
 
 
-def _load_bench_network():
-    """(table_on_device, nbr_idx, nbr_w, n_genes, edges): the real bundled
-    network with synthetic |PCC| weights, or a scale-matched fallback.
-    ``edges`` is the raw (src, dst, w) triple for the native CSR sampler."""
-    import jax
-    import jax.numpy as jnp
+def _load_bench_edges():
+    """(src, dst, w, n_genes): the real bundled network with synthetic
+    |PCC| weights, or a scale-matched fallback. NumPy only — the host-only
+    fallback path must never touch jax (a wedged tunnel can hang its
+    import-time plugin registration)."""
     import numpy as np
-
-    from g2vec_tpu.ops.graph import neighbor_table
 
     rng = np.random.default_rng(42)
     if os.path.exists(REFERENCE_NETWORK):
@@ -372,15 +468,27 @@ def _load_bench_network():
         src = rng.choice(n_genes, size=n_edges, p=p / p.sum()).astype(np.int32)
         dst = rng.integers(0, n_genes, size=n_edges).astype(np.int32)
     w = rng.uniform(0.5001, 1.0, size=src.size).astype(np.float32)
+    return src, dst, w, n_genes
+
+
+def _load_bench_network():
+    """(table_on_device, nbr_idx, nbr_w, n_genes, edges): device form of
+    :func:`_load_bench_edges` for the JAX walker stages."""
+    import jax
+    import jax.numpy as jnp
+
+    from g2vec_tpu.ops.graph import neighbor_table
+
+    src, dst, w, n_genes = _load_bench_edges()
     nbr_idx, nbr_w = neighbor_table(src, dst, w, n_genes)
     table = (jax.device_put(jnp.asarray(nbr_idx, jnp.int32)),
              jax.device_put(jnp.asarray(nbr_w, jnp.float32)))
     return table, nbr_idx, nbr_w, n_genes, (src, dst, w)
 
 
-def _reference_walk_baseline(nbr_idx, nbr_w, n_genes: int, len_path: int,
-                             budget_s: float = 12.0, min_walks: int = 40
-                             ) -> tuple:
+def _reference_walk_baseline(indptr, indices, weights, n_genes: int,
+                             len_path: int, budget_s: float = 12.0,
+                             min_walks: int = 40) -> tuple:
     """(walks/s, n_sampled) of the reference's own algorithm on this host.
 
     A faithful re-creation of generate_randomPath's per-step work
@@ -389,6 +497,7 @@ def _reference_walk_baseline(nbr_idx, nbr_w, n_genes: int, len_path: int,
     DEGREE-STRATIFIED (every k-th gene of the degree-sorted order, shuffled)
     so hub and leaf walk costs are both represented — VERDICT r2 weak #7:
     a first-come sample under-weights hubs on a scale-free graph.
+    Takes the CSR form so the host-only fallback can run it without jax.
     """
     import numpy as np
 
@@ -398,13 +507,13 @@ def _reference_walk_baseline(nbr_idx, nbr_w, n_genes: int, len_path: int,
         r = dense_rows.get(i)
         if r is None:
             r = np.zeros(n_genes, dtype=np.float64)
-            mask = nbr_w[i] > 0
-            r[nbr_idx[i][mask]] = nbr_w[i][mask]
+            lo, hi = indptr[i], indptr[i + 1]
+            r[indices[lo:hi]] = weights[lo:hi]
             dense_rows[i] = r
         return r
 
     rng = np.random.default_rng(7)
-    by_degree = np.argsort((nbr_w > 0).sum(axis=1))
+    by_degree = np.argsort(np.diff(indptr))
     strata = by_degree[:: max(1, n_genes // 512)]     # ~512 across spectrum
     starts = rng.permutation(strata)
     t0 = time.time()
@@ -603,14 +712,16 @@ def _measure() -> None:
     # ---- 2. headline walker (always runs; errors degrade to a line) ----
     walker_err = None
     baseline = None
-    edges = None
+    edges = csr = None
     try:
+        from g2vec_tpu.ops.host_walker import edges_to_csr
+
         table, nbr_idx, nbr_w, n_genes, edges = _load_bench_network()
+        csr = edges_to_csr(edges[0], edges[1], edges[2], n_genes)
         note(f"walker network: {n_genes} genes, "
              f"{int((nbr_w > 0).sum())} edges, D={nbr_idx.shape[1]}")
         res = _bench_walker(table, n_genes, LEN_PATH, WALKER_REPS)
-        baseline, n_base = _reference_walk_baseline(nbr_idx, nbr_w, n_genes,
-                                                    LEN_PATH)
+        baseline, n_base = _reference_walk_baseline(*csr, n_genes, LEN_PATH)
         note(f"walker: {res['walks']} walks in {res['elapsed']:.2f}s -> "
              f"{res['walks_per_sec']:.0f} walks/s; {res['unique_paths']} "
              f"unique paths; host loop {baseline:.1f} walks/s "
@@ -637,28 +748,13 @@ def _measure() -> None:
         if edges is None:
             raise RuntimeError(
                 f"bench network unavailable (walker stage: {walker_err})")
-        from g2vec_tpu.native.walker_bindings import load as load_native
-        from g2vec_tpu.ops.host_walker import generate_path_set_native
-
-        load_native()          # one-time g++ compile outside the timed region
         if baseline is None:
-            baseline, n_base = _reference_walk_baseline(
-                nbr_idx, nbr_w, n_genes, LEN_PATH)
-        t0 = time.time()
-        npaths = generate_path_set_native(
-            edges[0], edges[1], edges[2], n_genes, len_path=LEN_PATH,
-            reps=WALKER_REPS, seed=0)
-        el = time.time() - t0
-        total_n = n_genes * WALKER_REPS
-        note(f"native walker: {total_n} walks in {el:.2f}s -> "
-             f"{total_n / el:.0f} walks/s; {len(npaths)} unique paths")
-        emit({"metric": "walker_native_walks_per_sec",
-              "value": round(total_n / el, 1), "unit": "walks/s",
-              "vs_baseline": round(total_n / el / baseline, 2),
-              "unique_paths": len(npaths), "n_genes": n_genes,
-              "len_path": LEN_PATH, "reps": WALKER_REPS,
-              "note": "threaded C++ CSR sampler (ops/host_walker.py) on the "
-                      "bench host; the single-host no-accelerator path"})
+            baseline, n_base = _reference_walk_baseline(*csr, n_genes,
+                                                        LEN_PATH)
+        emit(_native_walker_line(
+            edges[0], edges[1], edges[2], n_genes, baseline, note,
+            {"note": "threaded C++ CSR sampler (ops/host_walker.py) on the "
+                     "bench host; the default single-host stage-3 backend"}))
     except Exception as e:  # noqa: BLE001
         emit({"metric": "walker_native_walks_per_sec", "value": None,
               "unit": "walks/s", "vs_baseline": None,
@@ -806,5 +902,7 @@ if __name__ == "__main__":
         _probe()
     elif "--_measure" in sys.argv:
         _measure()
+    elif "--_hostonly" in sys.argv:
+        _hostonly()
     else:
         main()

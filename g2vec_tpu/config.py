@@ -66,12 +66,14 @@ class G2VecConfig:
                                      # auto-sizer may plan for (tables are
                                      # separate, launch-invariant residents);
                                      # 0 = ops.walker.WALKER_HBM_BUDGET (4 GiB)
-    walker_backend: str = "device"   # "device" (JAX lockstep walker) or
-                                     # "native" (threaded C++ CSR sampler —
-                                     # the fast host path when no
-                                     # accelerator is attached; per-seed
-                                     # deterministic, but a different PRNG
-                                     # family than the device walker)
+    walker_backend: str = "auto"     # "auto": host-walks-chip-trains —
+                                     # the threaded C++ CSR sampler when
+                                     # available on a single-host run, the
+                                     # JAX lockstep walker for meshed/
+                                     # distributed runs (measured basis:
+                                     # ops/backend.py). "device"/"native"
+                                     # pin a sampler; each is per-seed
+                                     # deterministic in its own PRNG family
     mesh_shape: Optional[Tuple[int, int]] = None  # (data, model); None = single device
     platform: Optional[str] = None   # force jax platform (e.g. "cpu")
     profile_dir: Optional[str] = None
@@ -130,9 +132,10 @@ class G2VecConfig:
             raise ValueError(f"compute_dtype must be bfloat16|float32, got {self.compute_dtype}")
         if self.param_dtype not in ("bfloat16", "float32"):
             raise ValueError(f"param_dtype must be bfloat16|float32, got {self.param_dtype}")
-        if self.walker_backend not in ("device", "native"):
+        if self.walker_backend not in ("auto", "device", "native"):
             raise ValueError(
-                f"walker_backend must be device|native, got {self.walker_backend}")
+                f"walker_backend must be auto|device|native, "
+                f"got {self.walker_backend}")
         if self.walker_backend == "native" and (self.mesh_shape
                                                 or self.distributed):
             raise ValueError(
@@ -185,12 +188,14 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--walker-batch", type=int, default=0,
                         help="Walkers per device launch (0 = auto-sized "
                              "against --walker-hbm-budget).")
-    parser.add_argument("--walker-backend", type=str, default="device",
-                        choices=("device", "native"),
-                        help="Path sampler: 'device' = the JAX lockstep "
-                             "walker; 'native' = the threaded C++ CSR "
-                             "sampler (fast host fallback when no "
-                             "accelerator is attached).")
+    parser.add_argument("--walker-backend", type=str, default="auto",
+                        choices=("auto", "device", "native"),
+                        help="Path sampler. 'auto' (default) routes walks "
+                             "to the threaded C++ CSR sampler on "
+                             "single-host runs and to the JAX lockstep "
+                             "walker on meshed/distributed runs "
+                             "(host-walks-chip-trains; measured basis in "
+                             "ARCHITECTURE.md). 'device'/'native' pin one.")
     parser.add_argument("--walker-hbm-budget", type=int, default=0,
                         help="Device bytes the walker auto-sizer may plan "
                              "for (0 = 4 GiB default).")

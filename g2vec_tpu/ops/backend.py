@@ -1,0 +1,65 @@
+"""Walker-backend resolution: host-walks, chip-trains by default.
+
+Stage 3 is the reference's self-declared hottest stage ("most time
+consuming step", ref: G2Vec.py:58). This framework has two samplers with
+one output contract (packed multi-hot rows):
+
+- ``native`` — the threaded C++ CSR sampler (native/walker.cpp via
+  ops/host_walker.py), O(out_degree + path_len) per step on host cores;
+- ``device`` — the JAX lockstep walker (ops/walker.py), vectorized over
+  all walkers on the accelerator, and the only one that shards its
+  neighbor tables over a mesh.
+
+Measured division of labor (PROFILE.md cross-backend table, round 3, at
+the bundled example's scale — 9.9k genes, 150k walks, lenPath=80):
+
+    native C++ sampler, ONE cpu core      ~63,600 walks/s
+    device walker on a v5e chip            >6,100 walks/s (stage bound)
+    device walker on XLA:CPU                 ~180 walks/s
+    reference's per-node Python loop         ~163 walks/s
+
+The walk step is a pointer-chase through a weighted adjacency — branchy,
+byte-sized state, no matmul anywhere — which is CPU-shaped work, while
+the trainer's fused packed-matmul epochs are MXU-shaped work. So
+``auto`` (the config default) routes walks to the host sampler whenever
+it is available and the run is single-host, and keeps training on the
+accelerator: each backend stays deterministic per seed within its own
+PRNG family (ops/host_walker.py docstring has the cross-backend caveat).
+Meshed or multi-process runs resolve to the device walker — its tables
+row-shard bit-identically over the mesh (ops/walker.py), which a
+host-local sampler cannot do.
+"""
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:  # pragma: no cover
+    from g2vec_tpu.config import G2VecConfig
+
+
+def native_walker_available() -> bool:
+    """True when the C++ sampler can be built/loaded on this host.
+
+    First call may pay a one-time ~1s g++ compile (memoized either way by
+    native/_build.py, so this is cheap to call repeatedly).
+    """
+    try:
+        from g2vec_tpu.native.walker_bindings import load
+
+        load()
+        return True
+    except RuntimeError:
+        return False
+
+
+def resolve_walker_backend(cfg: "G2VecConfig") -> str:
+    """Map ``cfg.walker_backend`` ("auto"|"device"|"native") to a concrete
+    backend for this run. Explicit choices are honored as-is ("native" on
+    a host without a toolchain stays "native" and raises at use with the
+    actionable build error rather than silently changing PRNG families).
+    """
+    if cfg.walker_backend != "auto":
+        return cfg.walker_backend
+    if cfg.mesh_shape is not None or cfg.distributed:
+        return "device"
+    return "native" if native_walker_available() else "device"

@@ -156,6 +156,12 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
             mesh_ctx = make_global_mesh(cfg.mesh_shape)
         else:
             mesh_ctx = make_mesh_context(cfg.mesh_shape)
+        # "auto" = host-walks-chip-trains: the walk step is CPU-shaped
+        # (pointer-chase, no matmul), the trainer is MXU-shaped — measured
+        # basis and resolution rules in ops/backend.py.
+        from g2vec_tpu.ops.backend import resolve_walker_backend
+
+        walker_backend = resolve_walker_backend(cfg)
         path_sets = []
         with timer.stage("paths"):
             for i, group in enumerate(["g", "p"]):
@@ -164,7 +170,7 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
                 # O(W*G), and no dense G^2 matrix in HBM (ops/graph.py).
                 s_k, d_k, w_k = thresholded_edges(expr_group, src, dst,
                                                   threshold=cfg.pcc_threshold)
-                if cfg.walker_backend == "native":
+                if walker_backend == "native":
                     # Threaded C++ CSR sampler (ops/host_walker.py): the
                     # fast host path when no accelerator is attached. Same
                     # packed-row contract; its own deterministic PRNG
@@ -198,7 +204,8 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
                 % cfg.pcc_threshold)
         console("    n_paths : %d" % n_paths)
         console("    n_genes : %d\t(genes in good or poor random paths)" % len(gene_freq))
-        metrics.emit("paths", n_paths=n_paths, n_path_genes=len(gene_freq))
+        metrics.emit("paths", n_paths=n_paths, n_path_genes=len(gene_freq),
+                     walker_backend=walker_backend)
 
         console(">>> 4. Compute distributed representations using modified CBOW")
         console("     Start training the modified CBOW with early stopping")

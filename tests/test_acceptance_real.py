@@ -9,7 +9,9 @@ statistically matched synthetic expression matrix
 (g2vec_tpu/data/realistic.py), validating walker behavior (dead ends, hub
 fan-out, neighbor-table padding) and accuracy at the reference's own
 topology and CLI defaults (reps=10, lenPath=80). The committed artifact
-from this config is REAL_ACCEPTANCE.json; the transcript's numbers are
+from this config is REAL_ACCEPTANCE.json (walker_backend=native — the
+"auto" resolution on a single host, which cut its paths stage from 435 s
+of XLA:CPU walking to ~5 s); the transcript's numbers are
 45,402 paths / 3,773 path genes / ACC[val] 0.8837 (README.md:26-41).
 
 Path-count calibration (VERDICT r2 weak #4, resolved round 3 with the
@@ -100,7 +102,13 @@ def test_shared_module_correlates_in_both_groups():
 
 @pytest.mark.slow
 @needs_reference
-def test_real_network_pipeline(tmp_path):
+@pytest.mark.parametrize("backend", ["auto", "device"])
+def test_real_network_pipeline(tmp_path, backend):
+    """``auto`` (resolves to the native sampler single-host — the
+    REAL_ACCEPTANCE.json config, ~25 s) and ``device`` (the JAX walker's
+    acceptance-scale coverage — ~7 min of XLA:CPU walking; per-backend
+    PRNG families give slightly different path counts at the same seed,
+    both inside the asserted bands)."""
     from g2vec_tpu.config import G2VecConfig
     from g2vec_tpu.data.realistic import write_real_expression_tsv
     from g2vec_tpu.pipeline import run
@@ -110,7 +118,7 @@ def test_real_network_pipeline(tmp_path):
     cfg = G2VecConfig(expression_file=expr_path, clinical_file=CLIN,
                       network_file=NET,
                       result_name=str(tmp_path / "real"),
-                      seed=0)
+                      seed=0, walker_backend=backend)
     res = run(cfg, console=lambda s: None)
 
     # Transcript-scale invariants (README.md:26-32).

@@ -1,0 +1,97 @@
+"""Walker-backend auto-resolution (ops/backend.py): host-walks-chip-trains.
+
+The "auto" default must route single-host runs to the native C++ sampler
+when it is available, meshed/distributed runs to the device walker, and
+honor explicit pins — without the user needing to know a flag exists
+(VERDICT r3 task 2)."""
+import shutil
+
+import pytest
+
+from g2vec_tpu.config import G2VecConfig
+from g2vec_tpu.ops.backend import (native_walker_available,
+                                   resolve_walker_backend)
+
+g_plus_plus = shutil.which("g++")
+
+
+def _cfg(**overrides):
+    base = dict(expression_file="e", clinical_file="c", network_file="n",
+                result_name="r")
+    base.update(overrides)
+    return G2VecConfig(**base)
+
+
+def test_default_is_auto():
+    assert _cfg().walker_backend == "auto"
+    _cfg().validate()  # auto is a valid value
+
+
+def test_explicit_pins_are_honored():
+    assert resolve_walker_backend(_cfg(walker_backend="device")) == "device"
+    assert resolve_walker_backend(_cfg(walker_backend="native")) == "native"
+
+
+def test_auto_mesh_and_distributed_resolve_to_device():
+    assert resolve_walker_backend(
+        _cfg(walker_backend="auto", mesh_shape=(4, 2))) == "device"
+    assert resolve_walker_backend(
+        _cfg(walker_backend="auto", distributed=True)) == "device"
+
+
+@pytest.mark.skipif(g_plus_plus is None, reason="no C++ toolchain")
+def test_auto_single_host_resolves_to_native():
+    assert native_walker_available()
+    assert resolve_walker_backend(_cfg(walker_backend="auto")) == "native"
+
+
+def test_auto_without_native_falls_back_to_device(monkeypatch):
+    import g2vec_tpu.ops.backend as backend
+
+    monkeypatch.setattr(backend, "native_walker_available", lambda: False)
+    assert backend.resolve_walker_backend(_cfg(walker_backend="auto")) \
+        == "device"
+
+
+def test_auto_with_mesh_passes_validation():
+    # auto+mesh is fine (resolves to device); an explicit native+mesh pin
+    # stays a config error.
+    _cfg(walker_backend="auto", mesh_shape=(2, 4)).validate()
+    with pytest.raises(ValueError, match="single-host"):
+        _cfg(walker_backend="native", mesh_shape=(2, 4)).validate()
+
+
+@pytest.mark.skipif(g_plus_plus is None, reason="no C++ toolchain")
+def test_pipeline_default_routes_to_native(tmp_path):
+    """End-to-end: a default-config single-host run reports the native
+    sampler in its metrics stream and matches an explicitly pinned native
+    run byte-for-byte (same resolved backend => same PRNG family)."""
+    import json
+    import os
+
+    from g2vec_tpu.data.synthetic import SyntheticSpec, write_synthetic_tsv
+    from g2vec_tpu.pipeline import run
+
+    spec = SyntheticSpec(n_good=16, n_poor=14, module_size=8,
+                         n_background=16, n_expr_only=2, n_net_only=2,
+                         module_chords=2, background_edges=24, seed=3)
+    paths = write_synthetic_tsv(spec, str(tmp_path))
+    common = dict(
+        expression_file=paths["expression"], clinical_file=paths["clinical"],
+        network_file=paths["network"], lenPath=8, numRepetition=2,
+        sizeHiddenlayer=16, epoch=10, compute_dtype="float32", seed=0)
+    jl = str(tmp_path / "m.jsonl")
+    r_auto = run(G2VecConfig(result_name=str(tmp_path / "auto"),
+                             metrics_jsonl=jl, **common),
+                 console=lambda s: None)
+    r_nat = run(G2VecConfig(result_name=str(tmp_path / "nat"),
+                            walker_backend="native", **common),
+                console=lambda s: None)
+    with open(jl) as f:
+        paths_rec = [json.loads(ln) for ln in f
+                     if json.loads(ln)["event"] == "paths"]
+    assert paths_rec and paths_rec[0]["walker_backend"] == "native"
+    for fa, fn in zip(r_auto.output_files, r_nat.output_files):
+        with open(fa, "rb") as a, open(fn, "rb") as b:
+            assert a.read() == b.read()
+    assert os.path.exists(r_auto.output_files[0])

@@ -99,9 +99,15 @@ def run_acceptance(out_path: str) -> dict:
         t0 = time.time()
         write_real_expression_tsv(NET, CLIN, expr_path)
         gen_secs = time.time() - t0
+        walker_backend = os.environ.get("G2VEC_ACCEPT_WALKER")  # pin, or None
         cfg = G2VecConfig(expression_file=expr_path, clinical_file=CLIN,
                           network_file=NET,
-                          result_name=os.path.join(tmp, "real"), seed=0)
+                          result_name=os.path.join(tmp, "real"), seed=0,
+                          **({"walker_backend": walker_backend}
+                             if walker_backend else {}))
+        from g2vec_tpu.ops.backend import resolve_walker_backend
+
+        resolved_backend = resolve_walker_backend(cfg)
         t0 = time.time()
         res = run(cfg, console=lambda s: print(f"# {s}", file=sys.stderr))
         total = time.time() - t0
@@ -116,6 +122,12 @@ def run_acceptance(out_path: str) -> dict:
         "n_edges": res.n_edges,
         "n_paths": res.n_paths,
         "n_path_genes": res.n_path_genes,
+        # Which stage-3 sampler ran ("auto" resolves per ops/backend.py:
+        # native on single-host). The two samplers share the output
+        # contract but draw from different PRNG families, so path counts /
+        # ACC differ slightly between backends at the same seed — artifacts
+        # are only comparable within one backend.
+        "walker_backend": resolved_backend,
         "acc_val": res.acc_val,     # full precision: the >= 0.88 gate and
                                     # vs_baseline must not see rounding
         "git_head": _git_head(),
@@ -151,8 +163,13 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", plat)
-    out = os.path.join(
-        REPO, "REAL_ACCEPTANCE.json" if plat == "cpu" else "TPU_ACCEPTANCE.json")
+    base = "REAL_ACCEPTANCE" if plat == "cpu" else "TPU_ACCEPTANCE"
+    # A pinned-backend run (e.g. G2VEC_ACCEPT_WALKER=device on the chip, to
+    # keep real-chip device-walker acceptance coverage alongside the
+    # default auto->native artifact) writes a suffixed twin, never
+    # clobbering the default-config artifact.
+    pin = os.environ.get("G2VEC_ACCEPT_WALKER")
+    out = os.path.join(REPO, f"{base}_{pin}.json" if pin else f"{base}.json")
     artifact = run_acceptance(out)
     print(json.dumps(artifact))
     ok = artifact["acc_val"] >= 0.88 and (artifact["platform"] == "tpu"
