@@ -39,6 +39,9 @@ class PipelineResult:
     train_history: List[dict] = dataclasses.field(default_factory=list)
     acc_val: float = 0.0
     stage_seconds: Dict[str, float] = dataclasses.field(default_factory=dict)
+    walker_backend: str = ""     # the RESOLVED stage-3 sampler ("device" |
+                                 # "native") — what actually ran, not the
+                                 # config value (which may be "auto")
 
 
 class _EpochReporter:
@@ -269,7 +272,7 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
             n_samples=n_samples, n_genes=n_genes, n_edges=n_edges,
             n_paths=n_paths, n_path_genes=len(gene_freq),
             train_history=result.history, acc_val=result.acc_val,
-            stage_seconds=timer.as_dict())
+            stage_seconds=timer.as_dict(), walker_backend=walker_backend)
     finally:
         if cfg.profile_dir:
             jax.profiler.stop_trace()

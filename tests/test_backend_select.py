@@ -91,6 +91,8 @@ def test_pipeline_default_routes_to_native(tmp_path):
         paths_rec = [json.loads(ln) for ln in f
                      if json.loads(ln)["event"] == "paths"]
     assert paths_rec and paths_rec[0]["walker_backend"] == "native"
+    assert r_auto.walker_backend == "native"
+    assert r_nat.walker_backend == "native"
     for fa, fn in zip(r_auto.output_files, r_nat.output_files):
         with open(fa, "rb") as a, open(fn, "rb") as b:
             assert a.read() == b.read()

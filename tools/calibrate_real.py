@@ -15,6 +15,14 @@ Run:  python tools/calibrate_real.py ['name=<RealExampleSpec kwargs>' ...]
 e.g.  python tools/calibrate_real.py 'shared=n_active_per_group=1500, n_shared=760'
 Always runs the default spec first ("baseline"); prints one JSON line per
 spec with n_paths / n_path_genes vs the transcript.
+
+``--frontier`` instead runs the COMMITTED paths-vs-ACC sweep (the
+n_shared axis at roughly constant active mass, disjoint -> full
+transcript parity), trains the CBOW at every point, and writes
+CALIBRATION.json at the repo root: the measured record behind the
+default spec's choice (VERDICT r3 task 5 — the tradeoff that justifies
+~40k paths / ACC ~0.90 over forcing 45,402-path parity at ACC ~0.80).
+tests/test_acceptance_real.py and BASELINE.md cite that artifact.
 """
 from __future__ import annotations
 
@@ -31,7 +39,7 @@ CLIN = "/root/reference/ex_CLINICAL.txt"
 TRANSCRIPT = {"n_paths": 45402, "n_path_genes": 3773}
 
 
-def run_trial(spec) -> dict:
+def run_trial(spec, train: bool = False) -> dict:
     import numpy as np
 
     from g2vec_tpu.data.realistic import make_real_expression
@@ -67,13 +75,71 @@ def run_trial(spec) -> dict:
     paths, labels_arr = integrate_path_sets(sets[0], sets[1], n_genes,
                                             packed=True)
     freq = count_gene_freq(paths, labels_arr, list(data.gene), packed=True)
-    return {"n_paths": int(paths.shape[0]), "n_path_genes": len(freq),
-            "paths_per_gene": round(paths.shape[0] / max(len(freq), 1), 2),
-            "vs_transcript_paths": round(
-                paths.shape[0] / TRANSCRIPT["n_paths"], 3),
-            "vs_transcript_genes": round(
-                len(freq) / TRANSCRIPT["n_path_genes"], 3),
-            "secs": round(time.time() - t0, 1)}
+    out = {"n_paths": int(paths.shape[0]), "n_path_genes": len(freq),
+           "paths_per_gene": round(paths.shape[0] / max(len(freq), 1), 2),
+           "vs_transcript_paths": round(
+               paths.shape[0] / TRANSCRIPT["n_paths"], 3),
+           "vs_transcript_genes": round(
+               len(freq) / TRANSCRIPT["n_path_genes"], 3)}
+    if train:
+        # The pipeline's exact training configuration (CLI defaults), so
+        # the frontier's ACC column is the number the acceptance artifact
+        # reports.
+        from g2vec_tpu.train.trainer import train_cbow
+
+        res = train_cbow(paths, labels_arr, packed_genes=n_genes,
+                         hidden=128, learning_rate=0.005, max_epochs=500,
+                         val_fraction=0.2, decision_threshold=0.5,
+                         compute_dtype="bfloat16", seed=0)
+        out["acc_val"] = round(float(res.acc_val), 4)
+        out["stop_epoch"] = int(res.stop_epoch)
+    out["secs"] = round(time.time() - t0, 1)
+    return out
+
+
+# The committed frontier: the n_shared axis at roughly constant active
+# mass. Endpoint facts the test docstring cites: disjoint caps path yield
+# near reps*path_genes+singletons; 1500/760 reaches ~99% transcript paths
+# but ~31% of walks are label-ambiguous.
+FRONTIER = [
+    ("disjoint", dict(n_active_per_group=2000, n_shared=0)),
+    ("default", dict()),                       # 1880/120 — the shipped spec
+    ("shared300", dict(n_active_per_group=1700, n_shared=300)),
+    ("shared500", dict(n_active_per_group=1600, n_shared=500)),
+    ("parity", dict(n_active_per_group=1500, n_shared=760)),
+]
+
+
+def run_frontier() -> None:
+    from g2vec_tpu.data.realistic import RealExampleSpec
+
+    points = []
+    for name, kwargs in FRONTIER:
+        spec = RealExampleSpec(**kwargs)
+        out = run_trial(spec, train=True)
+        rec = {"point": name,
+               "spec": {"n_active_per_group": spec.n_active_per_group,
+                        "n_shared": spec.n_shared}, **out}
+        print(json.dumps(rec), flush=True)
+        points.append(rec)
+    artifact = {
+        "what": "paths-vs-ACC calibration frontier for data/realistic.py "
+                "(native sampler + the pipeline's exact CBOW training, "
+                "seed=0): the measured tradeoff behind the default spec. "
+                "Transcript parity is reachable (point 'parity') but the "
+                "shared-module walks that buy it are label-ambiguous and "
+                "cost accuracy; the default keeps ACC >= 0.88 with the "
+                "calibration gain.",
+        "transcript": TRANSCRIPT,
+        "reference_acc_val": 0.8837,
+        "points": points,
+        "chosen_default": "default",
+    }
+    out_path = os.path.join(REPO, "CALIBRATION.json")
+    with open(out_path, "w") as f:
+        json.dump(artifact, f, indent=2)
+        f.write("\n")
+    print(f"# wrote {out_path}", file=sys.stderr)
 
 
 def main() -> None:
@@ -84,6 +150,9 @@ def main() -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    if "--frontier" in sys.argv:
+        run_frontier()
+        return
     from g2vec_tpu.data.realistic import RealExampleSpec
 
     specs = {

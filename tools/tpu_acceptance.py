@@ -105,9 +105,6 @@ def run_acceptance(out_path: str) -> dict:
                           result_name=os.path.join(tmp, "real"), seed=0,
                           **({"walker_backend": walker_backend}
                              if walker_backend else {}))
-        from g2vec_tpu.ops.backend import resolve_walker_backend
-
-        resolved_backend = resolve_walker_backend(cfg)
         t0 = time.time()
         res = run(cfg, console=lambda s: print(f"# {s}", file=sys.stderr))
         total = time.time() - t0
@@ -122,12 +119,13 @@ def run_acceptance(out_path: str) -> dict:
         "n_edges": res.n_edges,
         "n_paths": res.n_paths,
         "n_path_genes": res.n_path_genes,
-        # Which stage-3 sampler ran ("auto" resolves per ops/backend.py:
-        # native on single-host). The two samplers share the output
-        # contract but draw from different PRNG families, so path counts /
-        # ACC differ slightly between backends at the same seed — artifacts
-        # are only comparable within one backend.
-        "walker_backend": resolved_backend,
+        # Which stage-3 sampler the run ACTUALLY used ("auto" resolves per
+        # ops/backend.py: native on single-host; the pipeline reports its
+        # resolution). The two samplers share the output contract but draw
+        # from different PRNG families, so path counts / ACC differ
+        # slightly between backends at the same seed — artifacts are only
+        # comparable within one backend.
+        "walker_backend": res.walker_backend,
         "acc_val": res.acc_val,     # full precision: the >= 0.88 gate and
                                     # vs_baseline must not see rounding
         "git_head": _git_head(),
