@@ -126,3 +126,66 @@ def test_config_validates_param_dtype():
     cfg2 = G2VecConfig(walker_hbm_budget=-1)
     with pytest.raises(ValueError, match="walker_hbm_budget"):
         cfg2.validate()
+
+
+def test_history_acc_tr_matches_direct_eval(rng):
+    """The eval-train fold reports epoch i's train accuracy from epoch
+    i+1's grad forward (backfilled); every history row must still equal a
+    direct evaluation at that epoch's post-update weights."""
+    paths, labels = _separable_paths(rng, n_paths=120, n_genes=20)
+    n_epochs = 8
+
+    full = train_cbow(paths, labels, hidden=4, learning_rate=0.05,
+                      max_epochs=n_epochs, compute_dtype="float32", seed=0)
+    assert len(full.history) <= n_epochs
+
+    # Reconstruct the trainer's own split (same seeded permutation).
+    rng_np = np.random.default_rng(0)
+    perm = rng_np.permutation(paths.shape[0])
+    pivot = int(paths.shape[0] * 0.8)
+    xtr = paths[perm[:pivot]].astype(np.float32)
+    ytr = labels[perm[:pivot]].astype(np.float32).reshape(-1, 1)
+
+    for k, row in enumerate(full.history):
+        # Post-update weights after exactly k+1 epochs == a run capped
+        # there; its snapshot (returned w_ih, genes sliced) is the
+        # post-update table when no dip occurred.
+        partial = train_cbow(paths, labels, hidden=4, learning_rate=0.05,
+                             max_epochs=k + 1, compute_dtype="float32",
+                             seed=0)
+        if partial.stopped_early:
+            break
+        w_ho = np.asarray(partial.params.w_ho, np.float32)
+        logits = (xtr @ partial.w_ih) @ w_ho
+        acc = float(((logits > 0).astype(np.float32) == ytr).mean())
+        np.testing.assert_allclose(row["acc_tr"], acc, atol=1e-6)
+
+
+def test_history_invariant_to_chunk_size(rng, tmp_path):
+    """The fold's riskiest paths are the chunk-boundary acc_tr handoff
+    (body i==0 discards its grad-forward accuracy; the previous chunk's
+    post-loop backfill must have recorded it) and the dip-epoch backfill.
+    Chunked (checkpoint_every=3 => chunk 3) and unchunked runs must
+    produce identical per-epoch history — including an early-stop run
+    whose dip lands mid-chunk."""
+    cases = [
+        (_separable_paths(rng, n_paths=120, n_genes=20), 10, 0),
+        (_separable_paths(rng, flip=0.25), 300, 3),     # early-stops
+    ]
+    for (paths, labels), max_epochs, seed in cases:
+        one = train_cbow(paths, labels, hidden=8, learning_rate=0.05,
+                         max_epochs=max_epochs, compute_dtype="float32",
+                         seed=seed)
+        ck = str(tmp_path / f"ck{seed}")
+        many = train_cbow(paths, labels, hidden=8, learning_rate=0.05,
+                          max_epochs=max_epochs, compute_dtype="float32",
+                          seed=seed, checkpoint_dir=ck, checkpoint_every=3)
+        assert one.stopped_early == many.stopped_early
+        assert one.stop_epoch == many.stop_epoch
+        assert len(one.history) == len(many.history)
+        for ha, hb in zip(one.history, many.history):
+            assert ha["epoch"] == hb["epoch"]
+            np.testing.assert_array_equal(ha["acc_val"], hb["acc_val"])
+            np.testing.assert_array_equal(ha["acc_tr"], hb["acc_tr"])
+            np.testing.assert_array_equal(ha["loss"], hb["loss"])
+        np.testing.assert_array_equal(one.w_ih, many.w_ih)
