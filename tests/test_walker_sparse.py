@@ -121,3 +121,30 @@ def test_sparse_dense_distributional_agreement(rng):
     fd = vd.mean(axis=0)
     fs = vs.mean(axis=0)
     np.testing.assert_allclose(fd, fs, atol=0.05)
+
+
+def test_prefix_segmented_scan_matches_single_scan(rng, monkeypatch):
+    """The segmented no-revisit compare (ops/walker._SCAN_SEGMENTS) drops
+    only compares against -1 sentinel slots, so path lists must be
+    BIT-IDENTICAL to a single-scan run — on a random weighted graph whose
+    walks include dead ends and early stops, at several path lengths
+    (including ones that don't divide evenly into segments)."""
+    import g2vec_tpu.ops.walker as W
+
+    n = 40
+    adj = (rng.random((n, n)) < 0.15).astype(np.float32)
+    adj *= rng.random((n, n)).astype(np.float32)
+    np.fill_diagonal(adj, 0.0)
+    nbr_idx, nbr_w = _table_from_dense(adj)
+    starts = np.arange(n, dtype=np.int32)
+    key = jax.random.key(5)
+
+    for len_path in (1, 2, 7, 16):
+        runs = {}
+        for segs in (1, 3, 4):
+            monkeypatch.setattr(W, "_SCAN_SEGMENTS", segs)
+            runs[segs] = np.asarray(W._sparse_path_list(
+                jax.numpy.asarray(nbr_idx), jax.numpy.asarray(nbr_w),
+                jax.numpy.asarray(starts), key, len_path))
+        np.testing.assert_array_equal(runs[1], runs[4])
+        np.testing.assert_array_equal(runs[1], runs[3])
