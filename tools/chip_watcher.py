@@ -1,0 +1,185 @@
+"""Chip-window watcher: probe the TPU tunnel, fire the measurement battery.
+
+The axon tunnel wedges for hours at a time (round-3 postmortem: the only
+chip window of the session was 15 minutes, and everything not already
+scripted was lost). This watcher loops a bounded backend probe and, on the
+FIRST success, runs the full round-4 evidence agenda in priority order,
+flushing each artifact to the repo root the moment it exists so a window
+that dies mid-battery still leaves everything earlier on disk:
+
+  1. bench.py                    -> BENCH_LOCAL_r04.json  (headline debt:
+     walker, native control, kernel A/B, epoch breakdown, XLA-dense
+     control, config #2; opportunistically refreshes TPU_ACCEPTANCE.json
+     via its acceptance stage — auto backend: native walks on this host,
+     training on the chip)
+  2. tools/profile_walker.py     -> PROFILE_WALKER_r04.json (the rebuilt
+     +segmented step's isolated throughput, VERDICT r3 weak #2)
+  3. tools/profile_ops.py        -> PROFILE_OPS_r04.json
+  4. tools/tpu_acceptance.py with G2VEC_ACCEPT_WALKER=device
+                                 -> TPU_ACCEPTANCE_device.json (real-chip
+     device-walker acceptance coverage next to the default artifact)
+  5. tools/scale_demo.py         -> SCALE_DEMO_TPU_r04.json (config #3
+     chip-measured slices, VERDICT r3 task 6)
+
+Each stage runs in a subprocess with its own timeout; a hang or crash is
+recorded in the stage's artifact and the battery moves on. The watcher
+exits after one battery (rerun it for another window). Progress streams to
+stderr and to WATCHER_STATUS_r04.json.
+
+Run detached:  nohup python tools/chip_watcher.py >/tmp/chip_watcher.log 2>&1 &
+Artifacts are committed by whoever finds them (the round's rule: evidence
+lands with the commit that cites it).
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PROBE_CMD = [sys.executable, os.path.join(REPO, "bench.py"), "--_probe"]
+PROBE_TIMEOUT = int(os.environ.get("WATCHER_PROBE_TIMEOUT", "75"))
+PROBE_INTERVAL = int(os.environ.get("WATCHER_PROBE_INTERVAL", "240"))
+MAX_HOURS = float(os.environ.get("WATCHER_MAX_HOURS", "11"))
+STATUS = os.environ.get("WATCHER_STATUS_PATH",
+                        os.path.join(REPO, "WATCHER_STATUS_r04.json"))
+T0 = time.time()
+
+
+def note(msg: str) -> None:
+    print(f"[{time.time() - T0:8.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+def write_status(state: dict) -> None:
+    state["updated_unix"] = int(time.time())
+    with open(STATUS, "w") as f:
+        json.dump(state, f, indent=2)
+        f.write("\n")
+
+
+def probe() -> dict | None:
+    """One bounded backend probe; returns the probe info dict on success."""
+    try:
+        proc = subprocess.run(PROBE_CMD, capture_output=True, text=True,
+                              timeout=PROBE_TIMEOUT)
+    except subprocess.TimeoutExpired:
+        return None
+    if proc.returncode == 0 and proc.stdout.strip():
+        try:
+            info = json.loads(proc.stdout.strip().splitlines()[-1])
+        except ValueError:
+            return None
+        if info.get("platform") == "tpu":
+            return info
+    return None
+
+
+def run_stage(name: str, cmd: list, timeout: int, out_path: str | None,
+              env_extra: dict | None = None) -> dict:
+    """Run one battery stage; always returns (and optionally writes) a
+    record with whatever the stage produced before finishing/dying."""
+    note(f"stage {name}: {' '.join(os.path.basename(c) for c in cmd)} "
+         f"(timeout {timeout}s)")
+    env = dict(os.environ, **(env_extra or {}))
+    t0 = time.time()
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout, env=env, cwd=REPO)
+        rc, out, err = proc.returncode, proc.stdout, proc.stderr
+    except subprocess.TimeoutExpired as e:
+        rc = -9
+        out = (e.stdout or b"").decode(errors="replace") \
+            if isinstance(e.stdout, bytes) else (e.stdout or "")
+        err = (e.stderr or b"").decode(errors="replace") \
+            if isinstance(e.stderr, bytes) else (e.stderr or "")
+        err += f"\n[watcher] killed at {timeout}s"
+    parsed = []
+    for line in out.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                parsed.append(json.loads(line))
+            except ValueError:
+                pass
+    record = {"stage": name, "rc": rc, "wall_seconds": round(time.time() - t0, 1),
+              "lines": parsed, "stderr_tail": err[-2500:]}
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(record, f, indent=2)
+            f.write("\n")
+        note(f"stage {name}: rc={rc}, {len(parsed)} json lines -> "
+             f"{os.path.basename(out_path)}")
+    else:
+        note(f"stage {name}: rc={rc}, {len(parsed)} json lines")
+    return record
+
+
+def battery(info: dict) -> None:
+    py = sys.executable
+    stages = [
+        # (name, cmd, timeout, artifact, env)
+        ("bench", [py, os.path.join(REPO, "bench.py")], 600,
+         os.path.join(REPO, "BENCH_LOCAL_r04.json"), None),
+        ("profile_walker",
+         [py, os.path.join(REPO, "tools", "profile_walker.py")], 600,
+         os.path.join(REPO, "PROFILE_WALKER_r04.json"), None),
+        ("profile_ops",
+         [py, os.path.join(REPO, "tools", "profile_ops.py")], 420,
+         os.path.join(REPO, "PROFILE_OPS_r04.json"), None),
+        # These two tools write their own primary artifacts
+        # (TPU_ACCEPTANCE_device.json / SCALE_DEMO_TPU_r04.json); the stage
+        # record still lands on disk so a killed/hung run leaves its
+        # stderr diagnostics behind.
+        ("acceptance_device",
+         [py, os.path.join(REPO, "tools", "tpu_acceptance.py")], 420,
+         os.path.join(REPO, "WATCHER_STAGE_acceptance_device_r04.json"),
+         {"G2VEC_ACCEPT_WALKER": "device"}),
+        ("scale_demo",
+         [py, os.path.join(REPO, "tools", "scale_demo.py"),
+          "--out", os.path.join(REPO, "SCALE_DEMO_TPU_r04.json")], 600,
+         os.path.join(REPO, "WATCHER_STAGE_scale_demo_r04.json"), None),
+    ]
+    done = []
+    aborted = False
+    for name, cmd, timeout, artifact, env in stages:
+        rec = run_stage(name, cmd, timeout, artifact, env)
+        done.append({"stage": name, "rc": rec["rc"],
+                     "wall_seconds": rec["wall_seconds"]})
+        write_status({"state": "battery", "probe": info, "stages": done})
+        # Re-probe between stages: if the tunnel died, stop burning
+        # timeouts against a wedge — artifacts so far are already on disk.
+        if name != stages[-1][0] and probe() is None:
+            note("tunnel died mid-battery; stopping")
+            done.append({"stage": "abort", "reason": "tunnel died"})
+            aborted = True
+            break
+    write_status({"state": "aborted" if aborted else "done",
+                  "probe": info, "stages": done})
+    note("battery aborted mid-window — rerun the watcher for another "
+         "window" if aborted else "battery complete")
+
+
+def main() -> None:
+    write_status({"state": "probing", "since_unix": int(T0)})
+    attempt = 0
+    while time.time() - T0 < MAX_HOURS * 3600:
+        attempt += 1
+        info = probe()
+        if info is not None:
+            note(f"chip alive: {info}")
+            write_status({"state": "battery", "probe": info, "stages": []})
+            battery(info)
+            return
+        if attempt % 5 == 1:
+            note(f"probe {attempt}: tunnel dead")
+            write_status({"state": "probing", "attempts": attempt,
+                          "since_unix": int(T0)})
+        time.sleep(PROBE_INTERVAL)
+    note("gave up: max watch time reached")
+    write_status({"state": "expired", "attempts": attempt})
+
+
+if __name__ == "__main__":
+    main()
