@@ -34,14 +34,27 @@ inline double uniform01(uint64_t& s) {
     return static_cast<double>(splitmix64(s) >> 11) * 0x1.0p-53;
 }
 
+// PACKED=false writes [len_path] int32 node lists (-1 pads) to out_paths;
+// PACKED=true writes [nbytes] np.packbits-layout multi-hot rows (MSB of
+// byte 0 = gene 0) to out_packed — the path-set encoding, built here so
+// the Python side never expands a [W, G] bool matrix just to re-pack it.
+template <bool PACKED>
 void walk_range(const int32_t* indptr, const int32_t* indices,
                 const float* weights, int32_t n_genes, const int32_t* starts,
                 const uint64_t* stream_ids, int32_t len_path, uint64_t seed,
-                int32_t* out, int64_t lo, int64_t hi) {
+                int32_t* out_paths, uint8_t* out_packed, int64_t nbytes,
+                int64_t lo, int64_t hi) {
     std::vector<uint8_t> visited(static_cast<size_t>(n_genes), 0);
+    std::vector<int32_t> scratch(PACKED ? static_cast<size_t>(len_path) : 0);
     for (int64_t w = lo; w < hi; ++w) {
-        int32_t* path = out + w * len_path;
-        std::fill(path, path + len_path, -1);
+        int32_t* path;
+        if (PACKED) {
+            path = scratch.data();
+            std::fill(path, path + len_path, -1);
+        } else {
+            path = out_paths + w * len_path;
+            std::fill(path, path + len_path, -1);
+        }
         uint64_t st = seed ^ (stream_ids[w] * 0x9e3779b97f4a7c15ULL);
         splitmix64(st);  // decorrelate nearby stream ids
         int32_t cur = starts[w];
@@ -76,8 +89,50 @@ void walk_range(const int32_t* indptr, const int32_t* indices,
             visited[nxt] = 1;
             cur = nxt;
         }
+        if (PACKED) {
+            uint8_t* row = out_packed + w * nbytes;
+            std::fill(row, row + nbytes, 0);
+            for (int32_t i = 0; i < plen; ++i) {
+                const int32_t n = path[i];
+                row[n >> 3] |= static_cast<uint8_t>(0x80u >> (n & 7));
+            }
+        }
         for (int32_t i = 0; i < plen; ++i) visited[path[i]] = 0;
     }
+}
+
+template <bool PACKED>
+void walk_threaded(const int32_t* indptr, const int32_t* indices,
+                   const float* weights, int32_t n_genes,
+                   const int32_t* starts, const uint64_t* stream_ids,
+                   int64_t n_walkers, int32_t len_path, uint64_t seed,
+                   int32_t n_threads, int32_t* out_paths, uint8_t* out_packed,
+                   int64_t nbytes) {
+    if (len_path <= 0 || n_walkers <= 0) return;
+    if (n_threads <= 0) {
+        unsigned hw = std::thread::hardware_concurrency();
+        n_threads = hw ? static_cast<int32_t>(hw) : 1;
+    }
+    n_threads = static_cast<int32_t>(
+        std::min<int64_t>(n_threads, n_walkers));
+    if (n_threads == 1) {
+        walk_range<PACKED>(indptr, indices, weights, n_genes, starts,
+                           stream_ids, len_path, seed, out_paths, out_packed,
+                           nbytes, 0, n_walkers);
+        return;
+    }
+    std::vector<std::thread> pool;
+    pool.reserve(n_threads);
+    const int64_t chunk = (n_walkers + n_threads - 1) / n_threads;
+    for (int32_t t = 0; t < n_threads; ++t) {
+        const int64_t lo = t * chunk;
+        const int64_t hi = std::min<int64_t>(lo + chunk, n_walkers);
+        if (lo >= hi) break;
+        pool.emplace_back(walk_range<PACKED>, indptr, indices, weights,
+                          n_genes, starts, stream_ids, len_path, seed,
+                          out_paths, out_packed, nbytes, lo, hi);
+    }
+    for (auto& th : pool) th.join();
 }
 
 }  // namespace
@@ -90,29 +145,22 @@ void g2v_walk(const int32_t* indptr, const int32_t* indices,
               const uint64_t* stream_ids, int64_t n_walkers,
               int32_t len_path, uint64_t seed, int32_t n_threads,
               int32_t* out) {
-    if (len_path <= 0 || n_walkers <= 0) return;
-    if (n_threads <= 0) {
-        unsigned hw = std::thread::hardware_concurrency();
-        n_threads = hw ? static_cast<int32_t>(hw) : 1;
-    }
-    n_threads = static_cast<int32_t>(
-        std::min<int64_t>(n_threads, n_walkers));
-    if (n_threads == 1) {
-        walk_range(indptr, indices, weights, n_genes, starts, stream_ids,
-                   len_path, seed, out, 0, n_walkers);
-        return;
-    }
-    std::vector<std::thread> pool;
-    pool.reserve(n_threads);
-    const int64_t chunk = (n_walkers + n_threads - 1) / n_threads;
-    for (int32_t t = 0; t < n_threads; ++t) {
-        const int64_t lo = t * chunk;
-        const int64_t hi = std::min<int64_t>(lo + chunk, n_walkers);
-        if (lo >= hi) break;
-        pool.emplace_back(walk_range, indptr, indices, weights, n_genes,
-                          starts, stream_ids, len_path, seed, out, lo, hi);
-    }
-    for (auto& th : pool) th.join();
+    walk_threaded<false>(indptr, indices, weights, n_genes, starts,
+                         stream_ids, n_walkers, len_path, seed, n_threads,
+                         out, nullptr, 0);
+}
+
+// out must hold n_walkers * nbytes uint8 (nbytes = ceil(n_genes/8));
+// filled with np.packbits-layout multi-hot rows. Identical walks to
+// g2v_walk for the same inputs — only the output encoding differs.
+void g2v_walk_packed(const int32_t* indptr, const int32_t* indices,
+                     const float* weights, int32_t n_genes,
+                     const int32_t* starts, const uint64_t* stream_ids,
+                     int64_t n_walkers, int32_t len_path, uint64_t seed,
+                     int32_t n_threads, uint8_t* out, int64_t nbytes) {
+    walk_threaded<true>(indptr, indices, weights, n_genes, starts,
+                        stream_ids, n_walkers, len_path, seed, n_threads,
+                        nullptr, out, nbytes);
 }
 
 }  // extern "C"

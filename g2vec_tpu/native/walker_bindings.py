@@ -23,19 +23,26 @@ _SO = os.path.join(_HERE, "_walker.so")
 
 def _configure(lib: ctypes.CDLL) -> None:
     i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
-    lib.g2v_walk.restype = None
-    lib.g2v_walk.argtypes = [
+    f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+    u64p = np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")
+    common = [
         i32p,                                          # indptr [G+1]
         i32p,                                          # indices [E]
-        np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),  # w [E]
+        f32p,                                          # w [E]
         ctypes.c_int32,                                # n_genes
         i32p,                                          # starts [W]
-        np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS"),   # ids [W]
+        u64p,                                          # ids [W]
         ctypes.c_int64,                                # n_walkers
         ctypes.c_int32,                                # len_path
         ctypes.c_uint64,                               # seed
         ctypes.c_int32,                                # n_threads
-        i32p,                                          # out [W, len_path]
+    ]
+    lib.g2v_walk.restype = None
+    lib.g2v_walk.argtypes = common + [i32p]            # out [W, len_path]
+    lib.g2v_walk_packed.restype = None
+    lib.g2v_walk_packed.argtypes = common + [
+        np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+        ctypes.c_int64,                                # nbytes
     ]
 
 
@@ -45,15 +52,16 @@ def load() -> ctypes.CDLL:
     return build_and_load(_SRC, _SO, ["-pthread"], _configure)
 
 
-def walk_paths(indptr: np.ndarray, indices: np.ndarray, weights: np.ndarray,
-               n_genes: int, starts: np.ndarray, stream_ids: np.ndarray,
-               len_path: int, seed: int, n_threads: int = 0) -> np.ndarray:
-    """Run the native sampler; returns [n_walkers, len_path] int32 paths.
+def _validated(indptr, indices, weights, n_genes, starts, stream_ids,
+               len_path):
+    """Canonicalize dtypes and bound-check everything the C++ dereferences.
 
-    Node ids with -1 padding past each walk's end. Raises RuntimeError when
-    the native library is unavailable (no toolchain / build failure).
+    This module IS the language boundary, so the range checks live here
+    (out-of-range ids would be heap corruption, not an exception; a
+    non-positive len_path would leave np.empty output buffers unwritten).
     """
-    lib = load()
+    if len_path < 1:
+        raise ValueError(f"len_path must be >= 1, got {len_path}")
     indptr = np.ascontiguousarray(indptr, dtype=np.int32)
     indices = np.ascontiguousarray(indices, dtype=np.int32)
     weights = np.ascontiguousarray(weights, dtype=np.float32)
@@ -72,9 +80,6 @@ def walk_paths(indptr: np.ndarray, indices: np.ndarray, weights: np.ndarray,
         raise ValueError(
             f"weights has {weights.shape[0]} entries for "
             f"{indices.shape[0]} edges")
-    # The C++ side indexes visited[]/indptr[] with these unchecked — this
-    # function IS the language boundary, so the range checks live here
-    # (out-of-range ids would be heap corruption, not an exception).
     for name, arr in (("starts", starts), ("indices", indices)):
         if arr.size and (arr.min() < 0 or arr.max() >= n_genes):
             raise ValueError(
@@ -82,9 +87,46 @@ def walk_paths(indptr: np.ndarray, indices: np.ndarray, weights: np.ndarray,
     if indptr[0] != 0 or indptr[-1] != indices.shape[0] \
             or np.any(np.diff(indptr) < 0):
         raise ValueError("indptr is not a valid CSR row-pointer array")
+    return indptr, indices, weights, starts, stream_ids, n_walkers
+
+
+def walk_paths(indptr: np.ndarray, indices: np.ndarray, weights: np.ndarray,
+               n_genes: int, starts: np.ndarray, stream_ids: np.ndarray,
+               len_path: int, seed: int, n_threads: int = 0) -> np.ndarray:
+    """Run the native sampler; returns [n_walkers, len_path] int32 paths.
+
+    Node ids with -1 padding past each walk's end. Raises RuntimeError when
+    the native library is unavailable (no toolchain / build failure).
+    """
+    lib = load()
+    indptr, indices, weights, starts, stream_ids, n_walkers = _validated(
+        indptr, indices, weights, n_genes, starts, stream_ids, len_path)
     out = np.empty((n_walkers, len_path), dtype=np.int32)
     lib.g2v_walk(indptr, indices, weights, np.int32(n_genes), starts,
                  stream_ids, np.int64(n_walkers), np.int32(len_path),
                  np.uint64(seed & 0xFFFFFFFFFFFFFFFF), np.int32(n_threads),
                  out)
+    return out
+
+
+def walk_paths_packed(indptr: np.ndarray, indices: np.ndarray,
+                      weights: np.ndarray, n_genes: int, starts: np.ndarray,
+                      stream_ids: np.ndarray, len_path: int, seed: int,
+                      n_threads: int = 0) -> np.ndarray:
+    """Same walks as :func:`walk_paths`, emitted as the path-set encoding:
+    [n_walkers, ceil(n_genes/8)] uint8 np.packbits-layout multi-hot rows
+    (MSB of byte 0 = gene 0). The packing happens inside the sampler's
+    walk loop, so no [W, n_genes] dense matrix ever exists on either side
+    of the boundary.
+    """
+    lib = load()
+    indptr, indices, weights, starts, stream_ids, n_walkers = _validated(
+        indptr, indices, weights, n_genes, starts, stream_ids, len_path)
+    nbytes = (n_genes + 7) // 8
+    out = np.empty((n_walkers, nbytes), dtype=np.uint8)
+    lib.g2v_walk_packed(
+        indptr, indices, weights, np.int32(n_genes), starts, stream_ids,
+        np.int64(n_walkers), np.int32(len_path),
+        np.uint64(seed & 0xFFFFFFFFFFFFFFFF), np.int32(n_threads),
+        out, np.int64(nbytes))
     return out

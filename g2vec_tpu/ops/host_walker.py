@@ -46,9 +46,6 @@ def edges_to_csr(src: np.ndarray, dst: np.ndarray, w: np.ndarray,
     return indptr, indices, weights
 
 
-_PACK_CHUNK = 8192   # walkers expanded to [chunk, G] bool per packbits pass
-
-
 def generate_path_set_native(src: np.ndarray, dst: np.ndarray, w: np.ndarray,
                              n_genes: int, *, len_path: int, reps: int,
                              seed: int, starts: Optional[np.ndarray] = None,
@@ -62,7 +59,7 @@ def generate_path_set_native(src: np.ndarray, dst: np.ndarray, w: np.ndarray,
     silently changing backends (the device walker's seeded outputs are a
     byte-golden contract).
     """
-    from g2vec_tpu.native.walker_bindings import walk_paths
+    from g2vec_tpu.native.walker_bindings import walk_paths_packed
 
     if starts is None:
         starts = np.arange(n_genes, dtype=np.int32)
@@ -86,18 +83,11 @@ def generate_path_set_native(src: np.ndarray, dst: np.ndarray, w: np.ndarray,
                   + np.arange(n_starts, dtype=np.uint64)[None, :]).ravel()
 
     indptr, indices, weights = edges_to_csr(src, dst, w, n_genes)
-    paths = walk_paths(indptr, indices, weights, n_genes, all_starts,
-                       stream_ids, len_path, seed, n_threads)
-
-    nb = (n_genes + 7) // 8
-    out: Set[bytes] = set()
-    for lo in range(0, paths.shape[0], _PACK_CHUNK):
-        block = paths[lo:lo + _PACK_CHUNK]
-        rows = np.zeros((block.shape[0], n_genes), dtype=bool)
-        real = block >= 0
-        rows[np.nonzero(real)[0], block[real]] = True
-        packed = np.packbits(rows, axis=1)
-        if packed.shape[1] != nb:    # packbits pads to ceil(G/8) already
-            raise AssertionError("packbits width drifted from the contract")
-        out.update(row.tobytes() for row in packed)
-    return out
+    # The sampler emits np.packbits-layout multi-hot rows directly (bits
+    # set inside the C++ walk loop): no [W, n_genes] dense expansion on
+    # either side of the boundary — at bundled scale the old
+    # expand-and-packbits pass cost more than the walks themselves.
+    packed = walk_paths_packed(indptr, indices, weights, n_genes,
+                               all_starts, stream_ids, len_path, seed,
+                               n_threads)
+    return {row.tobytes() for row in packed}

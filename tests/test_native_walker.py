@@ -210,3 +210,37 @@ def test_readonly_package_dir_builds_into_cache(tmp_path, monkeypatch):
     # Second call short-circuits on the memoized handle.
     assert _build.build_and_load(str(src), str(so), ["-pthread"],
                                  walker_bindings._configure) is lib
+
+
+def test_packed_walk_matches_unpacked_packbits():
+    # g2v_walk_packed must emit exactly np.packbits(one_hot(g2v_walk)):
+    # same walks, same MSB-first byte layout.
+    from g2vec_tpu.native.walker_bindings import walk_paths, walk_paths_packed
+    from g2vec_tpu.ops.host_walker import edges_to_csr
+
+    src, dst, w, n = _chain_plus_hub()
+    indptr, indices, weights = edges_to_csr(src, dst, w, n)
+    starts = np.tile(np.arange(n, dtype=np.int32), 20)
+    ids = np.arange(starts.size, dtype=np.uint64)
+    paths = walk_paths(indptr, indices, weights, n, starts, ids, 5, 11)
+    packed = walk_paths_packed(indptr, indices, weights, n, starts, ids,
+                               5, 11)
+    rows = np.zeros((paths.shape[0], n), dtype=bool)
+    real = paths >= 0
+    rows[np.nonzero(real)[0], paths[real]] = True
+    np.testing.assert_array_equal(packed, np.packbits(rows, axis=1))
+
+
+def test_nonpositive_len_path_rejected():
+    # A len_path < 1 would leave the np.empty output buffers unwritten
+    # (the C++ early-returns); the boundary must raise instead.
+    from g2vec_tpu.native.walker_bindings import walk_paths, walk_paths_packed
+    from g2vec_tpu.ops.host_walker import edges_to_csr
+
+    src, dst, w, n = _chain_plus_hub()
+    indptr, indices, weights = edges_to_csr(src, dst, w, n)
+    starts = np.arange(n, dtype=np.int32)
+    ids = np.arange(n, dtype=np.uint64)
+    for fn in (walk_paths, walk_paths_packed):
+        with pytest.raises(ValueError, match="len_path"):
+            fn(indptr, indices, weights, n, starts, ids, 0, 0)
