@@ -10,13 +10,16 @@ one output contract (packed multi-hot rows):
   all walkers on the accelerator, and the only one that shards its
   neighbor tables over a mesh.
 
-Measured division of labor (PROFILE.md cross-backend table, round 3, at
-the bundled example's scale — 9.9k genes, 150k walks, lenPath=80):
+Measured division of labor (PROFILE.md cross-backend table, at the
+bundled example's scale — 9.9k genes, ~99k walks/group, lenPath=80; each
+rate is paired with the reference-loop baseline measured in the SAME run
+on the same host):
 
-    native C++ sampler, ONE cpu core      ~63,600 walks/s
-    device walker on a v5e chip            >6,100 walks/s (stage bound)
-    device walker on XLA:CPU                 ~180 walks/s
-    reference's per-node Python loop         ~163 walks/s
+    native C++ sampler (r4, in-loop packing) ~98,100 walks/s (~426x ref loop)
+    native C++ sampler (r3, numpy re-pack)   ~63,600 walks/s (~390x ref loop)
+    device walker on a v5e chip               >6,100 walks/s (stage bound)
+    device walker on XLA:CPU                    ~180 walks/s
+    reference's per-node Python loop        ~163-230 walks/s (host-dependent)
 
 The walk step is a pointer-chase through a weighted adjacency — branchy,
 byte-sized state, no matmul anywhere — which is CPU-shaped work, while
