@@ -2,8 +2,10 @@
 
 The trainer's hot op is ``X @ W_ih`` where X is a 0/1 multi-hot path matrix
 (ref: the CBOW input, G2Vec.py:238-239). Storing X densely in bf16 costs
-~550 MB of HBM at example scale and every epoch re-reads it four times
-(train fwd, dW, train eval, val eval). This kernel keeps X **bit-packed**
+~550 MB of HBM at example scale and every epoch re-reads it three times
+(train fwd, dW, val eval — the train eval rides the next grad forward
+after trainer.py's eval-train fold; the reference re-read it a fourth
+time). This kernel keeps X **bit-packed**
 (uint8, 8 genes/byte — 16x smaller) in HBM and unpacks tiles on the fly in
 VMEM, fused into the MXU matmul, so the HBM traffic for X drops 16x. The
 packed-vs-XLA-dense speedup at the trainer's exact fwd shape is a MEASURED
