@@ -68,9 +68,11 @@ class G2VecConfig:
                                      # 0 = ops.walker.WALKER_HBM_BUDGET (4 GiB)
     walker_backend: str = "auto"     # "auto": host-walks-chip-trains —
                                      # the threaded C++ CSR sampler when
-                                     # available on a single-host run, the
-                                     # JAX lockstep walker for meshed/
-                                     # distributed runs (measured basis:
+                                     # available (multi-process runs shard
+                                     # the walker axis across hosts and
+                                     # allgather; backend agreement is
+                                     # collective), else the JAX lockstep
+                                     # walker (measured basis:
                                      # ops/backend.py). "device"/"native"
                                      # pin a sampler; each is per-seed
                                      # deterministic in its own PRNG family
@@ -136,11 +138,6 @@ class G2VecConfig:
             raise ValueError(
                 f"walker_backend must be auto|device|native, "
                 f"got {self.walker_backend}")
-        if self.walker_backend == "native" and (self.mesh_shape
-                                                or self.distributed):
-            raise ValueError(
-                "walker_backend=native is a single-host CPU sampler; it does "
-                "not combine with --mesh or --distributed")
 
 
 def _version() -> str:
@@ -191,11 +188,13 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--walker-backend", type=str, default="auto",
                         choices=("auto", "device", "native"),
                         help="Path sampler. 'auto' (default) routes walks "
-                             "to the threaded C++ CSR sampler on "
-                             "single-host runs and to the JAX lockstep "
-                             "walker on meshed/distributed runs "
-                             "(host-walks-chip-trains; measured basis in "
-                             "ARCHITECTURE.md). 'device'/'native' pin one.")
+                             "to the threaded C++ CSR sampler whenever it "
+                             "is available — multi-process runs shard the "
+                             "walker axis across hosts and allgather the "
+                             "packed rows — and to the JAX lockstep "
+                             "walker otherwise (host-walks-chip-trains; "
+                             "measured basis in ARCHITECTURE.md). "
+                             "'device'/'native' pin one.")
     parser.add_argument("--walker-hbm-budget", type=int, default=0,
                         help="Device bytes the walker auto-sizer may plan "
                              "for (0 = 4 GiB default).")

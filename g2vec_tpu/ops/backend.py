@@ -25,12 +25,18 @@ The walk step is a pointer-chase through a weighted adjacency — branchy,
 byte-sized state, no matmul anywhere — which is CPU-shaped work, while
 the trainer's fused packed-matmul epochs are MXU-shaped work. So
 ``auto`` (the config default) routes walks to the host sampler whenever
-it is available and the run is single-host, and keeps training on the
-accelerator: each backend stays deterministic per seed within its own
-PRNG family (ops/host_walker.py docstring has the cross-backend caveat).
-Meshed or multi-process runs resolve to the device walker — its tables
-row-shard bit-identically over the mesh (ops/walker.py), which a
-host-local sampler cannot do.
+it is available, and keeps the accelerator for training: each backend
+stays deterministic per seed within its own PRNG family
+(ops/host_walker.py docstring has the cross-backend caveat). A meshed
+run changes nothing (walks are upstream of the sharded trainer); a
+multi-process run shards the walker axis across hosts and allgathers
+the packed rows (parallel/distributed.sharded_native_path_set —
+bit-identical to the single-host result), provided EVERY process can
+build the sampler (agreement-checked collectively; any host missing the
+toolchain resolves the whole job to the device walker). The device
+walker remains the explicit-pin path for graphs whose tables want to
+live sharded on the accelerators (ops/walker.py row-shards them
+bit-identically over the mesh).
 """
 from __future__ import annotations
 
@@ -60,9 +66,23 @@ def resolve_walker_backend(cfg: "G2VecConfig") -> str:
     backend for this run. Explicit choices are honored as-is ("native" on
     a host without a toolchain stays "native" and raises at use with the
     actionable build error rather than silently changing PRNG families).
+
+    In a multi-process run this is a COLLECTIVE for "auto" (all processes
+    must agree on one backend, and the availability allgather is itself a
+    synchronization point); every process calls it at the same place in
+    the pipeline.
     """
     if cfg.walker_backend != "auto":
         return cfg.walker_backend
-    if cfg.mesh_shape is not None or cfg.distributed:
-        return "device"
-    return "native" if native_walker_available() else "device"
+    avail = native_walker_available()
+    if cfg.distributed:
+        import jax
+
+        if jax.process_count() > 1:
+            import numpy as np
+            from jax.experimental import multihost_utils
+
+            flags = multihost_utils.process_allgather(
+                np.array([avail], dtype=bool))
+            avail = bool(flags.all())
+    return "native" if avail else "device"

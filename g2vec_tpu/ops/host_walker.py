@@ -46,18 +46,19 @@ def edges_to_csr(src: np.ndarray, dst: np.ndarray, w: np.ndarray,
     return indptr, indices, weights
 
 
-def generate_path_set_native(src: np.ndarray, dst: np.ndarray, w: np.ndarray,
-                             n_genes: int, *, len_path: int, reps: int,
-                             seed: int, starts: Optional[np.ndarray] = None,
-                             n_threads: int = 0) -> Set[bytes]:
-    """All-sources x reps native walks -> set of packed multi-hot rows.
+def walk_packed_rows(src: np.ndarray, dst: np.ndarray, w: np.ndarray,
+                     n_genes: int, *, len_path: int, reps: int, seed: int,
+                     starts: Optional[np.ndarray] = None,
+                     n_threads: int = 0, walker_lo: int = 0,
+                     walker_hi: Optional[int] = None) -> np.ndarray:
+    """Native walks for the walker index range [walker_lo, walker_hi) of
+    the flat (repetition x start) axis -> [n_local, ceil(G/8)] uint8
+    packed multi-hot rows (NOT deduplicated).
 
-    Mirrors generate_pathSet (ref: G2Vec.py:324-352) on the host: every
-    gene a start node, ``reps`` times, results set-deduplicated. Raises
-    RuntimeError when the native library cannot be built (no C++
-    toolchain) — the pipeline surfaces that as a config error rather than
-    silently changing backends (the device walker's seeded outputs are a
-    byte-golden contract).
+    Every walker's PRNG stream is keyed by its GLOBAL flat index, so any
+    partition of the walker axis — including a multi-process shard
+    (parallel/distributed.sharded_native_path_set) — reproduces exactly
+    the rows the full-range call produces for those walkers.
     """
     from g2vec_tpu.native.walker_bindings import walk_paths_packed
 
@@ -73,21 +74,44 @@ def generate_path_set_native(src: np.ndarray, dst: np.ndarray, w: np.ndarray,
     if src.size and (src.min() < 0 or src.max() >= n_genes):
         raise ValueError(f"src contains node ids outside [0, {n_genes})")
     n_starts = starts.shape[0]
-    all_starts = np.tile(starts, reps)
+    total = n_starts * reps
+    walker_hi = total if walker_hi is None else walker_hi
+    if not (0 <= walker_lo <= walker_hi <= total):
+        raise ValueError(
+            f"walker range [{walker_lo}, {walker_hi}) outside [0, {total}]")
+    all_starts = np.tile(starts, reps)[walker_lo:walker_hi]
     # Stream identity = rep * n_starts + i, i.e. (repetition, start-index)
     # within THIS backend's counter-based PRNG family: adding repetitions
-    # extends (never reshuffles) the stream family. The device walker keys
-    # its own streams differently (split(key, reps) + fold_in), so the two
-    # backends are each deterministic but not cross-identical.
-    stream_ids = (np.arange(reps, dtype=np.uint64)[:, None] * np.uint64(n_starts)
-                  + np.arange(n_starts, dtype=np.uint64)[None, :]).ravel()
+    # extends (never reshuffles) the stream family, and slicing the walker
+    # axis never re-keys anyone. The device walker keys its own streams
+    # differently (split(key, reps) + fold_in), so the two backends are
+    # each deterministic but not cross-identical.
+    stream_ids = np.arange(walker_lo, walker_hi, dtype=np.uint64)
 
     indptr, indices, weights = edges_to_csr(src, dst, w, n_genes)
     # The sampler emits np.packbits-layout multi-hot rows directly (bits
     # set inside the C++ walk loop): no [W, n_genes] dense expansion on
     # either side of the boundary — at bundled scale the old
     # expand-and-packbits pass cost more than the walks themselves.
-    packed = walk_paths_packed(indptr, indices, weights, n_genes,
-                               all_starts, stream_ids, len_path, seed,
-                               n_threads)
+    return walk_paths_packed(indptr, indices, weights, n_genes,
+                             all_starts, stream_ids, len_path, seed,
+                             n_threads)
+
+
+def generate_path_set_native(src: np.ndarray, dst: np.ndarray, w: np.ndarray,
+                             n_genes: int, *, len_path: int, reps: int,
+                             seed: int, starts: Optional[np.ndarray] = None,
+                             n_threads: int = 0) -> Set[bytes]:
+    """All-sources x reps native walks -> set of packed multi-hot rows.
+
+    Mirrors generate_pathSet (ref: G2Vec.py:324-352) on the host: every
+    gene a start node, ``reps`` times, results set-deduplicated. Raises
+    RuntimeError when the native library cannot be built (no C++
+    toolchain) — the pipeline surfaces that as a config error rather than
+    silently changing backends (the device walker's seeded outputs are a
+    byte-golden contract).
+    """
+    packed = walk_packed_rows(src, dst, w, n_genes, len_path=len_path,
+                              reps=reps, seed=seed, starts=starts,
+                              n_threads=n_threads)
     return {row.tobytes() for row in packed}

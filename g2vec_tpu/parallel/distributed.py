@@ -149,6 +149,68 @@ def fetch_global(arr) -> "np.ndarray":  # noqa: F821 — np imported lazily
     return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
 
 
+def sharded_native_path_set(src, dst, w, n_genes: int, *, len_path: int,
+                            reps: int, seed: int, n_threads: int = 0
+                            ) -> "Set[bytes]":  # noqa: F821
+    """Multi-process native walks: host-walks-chip-trains at fleet scale.
+
+    Each process samples a contiguous shard of the flat (repetition x
+    start) walker axis with the SAME global stream identities the
+    single-host call uses (ops/host_walker.walk_packed_rows), then the
+    packed rows are allgathered and unioned — every process returns a set
+    bit-identical to the single-host ``generate_path_set_native`` result,
+    with the walk work divided ~evenly across hosts.
+
+    COLLECTIVE: all processes must call it with identical arguments. The
+    native toolchain is availability-checked across processes FIRST, so a
+    host without g++ fails every process with one clear error instead of
+    wedging the allgather.
+    """
+    import jax
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    from g2vec_tpu.ops.backend import native_walker_available
+    from g2vec_tpu.ops.host_walker import walk_packed_rows
+
+    nproc = jax.process_count()
+    if nproc == 1:
+        from g2vec_tpu.ops.host_walker import generate_path_set_native
+
+        return generate_path_set_native(src, dst, w, n_genes,
+                                        len_path=len_path, reps=reps,
+                                        seed=seed, n_threads=n_threads)
+    avail = multihost_utils.process_allgather(
+        np.array([native_walker_available()], dtype=bool))
+    if not avail.all():
+        missing = [int(p) for p in np.nonzero(~avail.reshape(-1))[0]]
+        raise RuntimeError(
+            f"walker_backend=native needs the C++ sampler on every host; "
+            f"process(es) {missing} cannot build it (no toolchain?). "
+            f"Pin --walker-backend device, or fix those hosts.")
+
+    total = n_genes * reps
+    per = -(-total // nproc)                      # ceil
+    pid = jax.process_index()
+    lo = min(pid * per, total)
+    hi = min(lo + per, total)
+    rows = walk_packed_rows(src, dst, w, n_genes, len_path=len_path,
+                            reps=reps, seed=seed, n_threads=n_threads,
+                            walker_lo=lo, walker_hi=hi)
+    nbytes = (n_genes + 7) // 8
+    padded = np.zeros((per, nbytes), dtype=np.uint8)
+    padded[:rows.shape[0]] = rows
+    counts = multihost_utils.process_allgather(
+        np.array([rows.shape[0]], dtype=np.int64))          # [nproc, 1]
+    gathered = multihost_utils.process_allgather(padded)    # [nproc, per, nb]
+    counts = counts.reshape(-1)
+    out: set = set()
+    for p in range(nproc):
+        shard = gathered[p, : int(counts[p])]
+        out.update(row.tobytes() for row in shard)
+    return out
+
+
 def process_info() -> dict:
     """Who am I in the job — for logs and the metrics stream."""
     import jax

@@ -175,9 +175,24 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
                                                   threshold=cfg.pcc_threshold)
                 if walker_backend == "native":
                     # Threaded C++ CSR sampler (ops/host_walker.py): the
-                    # fast host path when no accelerator is attached. Same
-                    # packed-row contract; its own deterministic PRNG
-                    # family (documented in the module docstring).
+                    # default host path (ops/backend.py has the measured
+                    # rationale). Same packed-row contract; its own
+                    # deterministic PRNG family (module docstring). In a
+                    # multi-process run each host walks its shard of the
+                    # walker axis and the packed rows are allgathered —
+                    # bit-identical to the single-host set.
+                    if cfg.distributed:
+                        # Collective; falls back to the plain single-host
+                        # call itself when process_count == 1.
+                        from g2vec_tpu.parallel.distributed import \
+                            sharded_native_path_set
+
+                        path_sets.append(sharded_native_path_set(
+                            np.asarray(s_k), np.asarray(d_k),
+                            np.asarray(w_k), n_genes,
+                            len_path=cfg.lenPath, reps=cfg.numRepetition,
+                            seed=(cfg.seed << 1) | i))
+                        continue
                     from g2vec_tpu.ops.host_walker import \
                         generate_path_set_native
 

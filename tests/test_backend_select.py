@@ -32,11 +32,23 @@ def test_explicit_pins_are_honored():
     assert resolve_walker_backend(_cfg(walker_backend="native")) == "native"
 
 
-def test_auto_mesh_and_distributed_resolve_to_device():
+@pytest.mark.skipif(g_plus_plus is None, reason="no C++ toolchain")
+def test_auto_mesh_and_single_process_distributed_resolve_to_native():
+    # Walks are upstream of the sharded trainer, so a mesh changes
+    # nothing; a single-process --distributed run likewise. (The true
+    # multi-process agreement path is covered by the 2-process test.)
     assert resolve_walker_backend(
+        _cfg(walker_backend="auto", mesh_shape=(4, 2))) == "native"
+    assert resolve_walker_backend(
+        _cfg(walker_backend="auto", distributed=True)) == "native"
+
+
+def test_auto_mesh_without_native_resolves_to_device(monkeypatch):
+    import g2vec_tpu.ops.backend as backend
+
+    monkeypatch.setattr(backend, "native_walker_available", lambda: False)
+    assert backend.resolve_walker_backend(
         _cfg(walker_backend="auto", mesh_shape=(4, 2))) == "device"
-    assert resolve_walker_backend(
-        _cfg(walker_backend="auto", distributed=True)) == "device"
 
 
 @pytest.mark.skipif(g_plus_plus is None, reason="no C++ toolchain")
@@ -53,12 +65,13 @@ def test_auto_without_native_falls_back_to_device(monkeypatch):
         == "device"
 
 
-def test_auto_with_mesh_passes_validation():
-    # auto+mesh is fine (resolves to device); an explicit native+mesh pin
-    # stays a config error.
+def test_native_with_mesh_and_distributed_validates():
+    # native walks are upstream of the sharded trainer (and shard across
+    # processes under --distributed), so neither combination is an error
+    # anymore.
+    _cfg(walker_backend="native", mesh_shape=(2, 4)).validate()
+    _cfg(walker_backend="native", distributed=True).validate()
     _cfg(walker_backend="auto", mesh_shape=(2, 4)).validate()
-    with pytest.raises(ValueError, match="single-host"):
-        _cfg(walker_backend="native", mesh_shape=(2, 4)).validate()
 
 
 @pytest.mark.skipif(g_plus_plus is None, reason="no C++ toolchain")

@@ -11,6 +11,7 @@ component; this is its integration test.
 """
 import json
 import os
+import shutil
 import socket
 import subprocess
 import sys
@@ -83,4 +84,12 @@ def test_two_process_cluster(tmp_path):
     # see the same path set, equal to their single-process local run (the
     # worker asserts the local equality; this pins cross-process equality).
     assert results[0]["walker_digest"] == results[1]["walker_digest"]
+    # Sharded NATIVE walks (each process samples a walker-axis shard with
+    # the C++ sampler, rows allgathered): same set on both processes, and
+    # the worker asserts equality with the single-host native result.
+    assert (results[0]["native_walker_digest"]
+            == results[1]["native_walker_digest"])
+    if shutil.which("g++"):
+        # Not vacuous: with a toolchain present the section must have run.
+        assert results[0]["native_walker_digest"] != "native-unavailable"
     assert results[0]["acc_val"] == pytest.approx(results[1]["acc_val"])

@@ -157,9 +157,9 @@ def test_config_validation():
                 result_name="r")
     with pytest.raises(ValueError, match="walker_backend"):
         G2VecConfig(**base, walker_backend="gpu").validate()
-    with pytest.raises(ValueError, match="single-host"):
-        G2VecConfig(**base, walker_backend="native",
-                    mesh_shape=(2, 4)).validate()
+    # native + mesh/distributed is supported (host walks are upstream of
+    # the sharded trainer; multi-process runs shard the walker axis).
+    G2VecConfig(**base, walker_backend="native", mesh_shape=(2, 4)).validate()
 
 
 def test_mismatched_weights_length_rejected():
@@ -244,3 +244,24 @@ def test_nonpositive_len_path_rejected():
     for fn in (walk_paths, walk_paths_packed):
         with pytest.raises(ValueError, match="len_path"):
             fn(indptr, indices, weights, n, starts, ids, 0, 0)
+
+
+def test_walker_axis_slices_reproduce_full_run():
+    # Any partition of the flat (repetition x start) walker axis must
+    # reproduce exactly the full run's rows for those walkers — streams
+    # are keyed by global flat index (the multi-process sharding
+    # contract, parallel/distributed.sharded_native_path_set).
+    from g2vec_tpu.ops.host_walker import walk_packed_rows
+
+    src, dst, w, n = _chain_plus_hub()
+    kwargs = dict(len_path=5, reps=3, seed=21)
+    full = walk_packed_rows(src, dst, w, n, **kwargs)
+    total = n * 3
+    cuts = [0, 5, 6, 14, total]
+    pieces = [walk_packed_rows(src, dst, w, n, walker_lo=lo, walker_hi=hi,
+                               **kwargs)
+              for lo, hi in zip(cuts[:-1], cuts[1:])]
+    np.testing.assert_array_equal(full, np.concatenate(pieces, axis=0))
+    with pytest.raises(ValueError, match="walker range"):
+        walk_packed_rows(src, dst, w, n, walker_lo=2, walker_hi=total + 1,
+                         **kwargs)

@@ -110,6 +110,27 @@ def main() -> None:
         f"{len(local)} paths")
     walker_digest = hashlib.sha256(b"".join(sorted(sharded))).hexdigest()
 
+    # --- sharded NATIVE walks (round 4): each process samples its shard
+    # of the walker axis with the C++ sampler, rows are allgathered; the
+    # union must be bit-identical to the single-host native result on
+    # every process. NO per-process availability gate here — the sharded
+    # call's own collective agreement check raises the SAME RuntimeError
+    # on every process when any host lacks the toolchain (a local gate
+    # could desynchronize the collectives), and we call it FIRST so the
+    # local single-host call can never be reached on one process only.
+    try:
+        both = dist.sharded_native_path_set(src, dst, wts, n, len_path=5,
+                                            reps=2, seed=9)
+        from g2vec_tpu.ops.host_walker import generate_path_set_native
+
+        single = generate_path_set_native(src, dst, wts, n, len_path=5,
+                                          reps=2, seed=9)
+        assert both == single, (
+            f"sharded native walk diverged: {len(both)} vs {len(single)}")
+        native_digest = hashlib.sha256(b"".join(sorted(both))).hexdigest()
+    except RuntimeError:
+        native_digest = "native-unavailable"
+
     print(json.dumps({
         "process": jax.process_index(),
         "n_global_devices": len(jax.devices()),
@@ -117,6 +138,7 @@ def main() -> None:
         "sharded_fetch_digest": _digest(w_full),
         "sharded_layout_digest": _digest(resumed_sh.w_ih),
         "walker_digest": walker_digest,
+        "native_walker_digest": native_digest,
         "acc_val": resumed.acc_val,
     }))
 
