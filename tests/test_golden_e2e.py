@@ -1,4 +1,4 @@
-"""Committed byte-golden end-to-end fixture.
+"""Committed byte-golden end-to-end fixtures, one per walker backend.
 
 Round-1 gap (VERDICT.md missing #5): run-vs-run determinism tests cannot
 catch a silent behavior-changing regression that shifts both runs together.
@@ -8,11 +8,18 @@ fixtures committed under tests/golden/ (format spec:
 G2Vec.py:127-131,159-165,203-215). Any numerics drift in any stage —
 graph, walker, trainer, k-means, scoring, writers — breaks the bytes.
 
+Both samplers carry their own golden: the device walker's jax.random
+streams AND the native sampler's splitmix64 streams are seeded contracts
+(round 4 moved the native sampler's bit-packing into C++ — a change that
+was only provably walk-preserving because the streams are pinned; this
+fixture makes that proof automatic for the next such change).
+
 Regenerate intentionally with:
     G2VEC_REGEN_GOLDEN=1 python -m pytest tests/test_golden_e2e.py
 and review the diff before committing.
 """
 import os
+import shutil
 
 import pytest
 
@@ -20,7 +27,7 @@ GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "golden")
 SUFFIXES = ("biomarkers", "lgroups", "vectors")
 
 
-def _run_pipeline(tmp_path):
+def _run_pipeline(tmp_path, backend):
     from g2vec_tpu.config import G2VecConfig
     from g2vec_tpu.data.synthetic import SyntheticSpec, write_synthetic_tsv
     from g2vec_tpu.pipeline import run
@@ -38,32 +45,38 @@ def _run_pipeline(tmp_path):
         result_name=str(tmp_path / "golden"),
         lenPath=20, numRepetition=3, sizeHiddenlayer=16,
         epoch=30, numBiomarker=10, seed=11,
-        # The committed goldens are a DEVICE-walker byte contract; the
-        # "auto" default would route this host run to the native sampler's
-        # (deterministic, but different) PRNG family.
-        walker_backend="device",
+        # Pinned explicitly: each backend's PRNG family is its own byte
+        # contract ("auto" would pick whatever this host supports).
+        walker_backend=backend,
     )
     res = run(cfg, console=lambda s: None)
     return {s: f for s, f in zip(SUFFIXES, res.output_files)}
 
 
-def test_outputs_match_committed_golden(tmp_path):
-    outputs = _run_pipeline(tmp_path)
+@pytest.mark.parametrize("backend", [
+    "device",
+    pytest.param("native", marks=pytest.mark.skipif(
+        shutil.which("g++") is None, reason="no C++ toolchain")),
+])
+def test_outputs_match_committed_golden(tmp_path, backend):
+    outputs = _run_pipeline(tmp_path, backend)
+    prefix = "golden" if backend == "device" else f"golden_{backend}"
     if os.environ.get("G2VEC_REGEN_GOLDEN") == "1":
         os.makedirs(GOLDEN_DIR, exist_ok=True)
         for suffix, path in outputs.items():
             with open(path, "rb") as f:
                 data = f.read()
-            with open(os.path.join(GOLDEN_DIR, f"golden_{suffix}.txt"), "wb") as f:
+            with open(os.path.join(GOLDEN_DIR,
+                                   f"{prefix}_{suffix}.txt"), "wb") as f:
                 f.write(data)
         pytest.skip("golden fixtures regenerated — review and commit the diff")
     for suffix, path in outputs.items():
-        golden = os.path.join(GOLDEN_DIR, f"golden_{suffix}.txt")
+        golden = os.path.join(GOLDEN_DIR, f"{prefix}_{suffix}.txt")
         assert os.path.exists(golden), (
             f"missing fixture {golden}; regenerate with G2VEC_REGEN_GOLDEN=1")
         with open(path, "rb") as got, open(golden, "rb") as want:
             got_b, want_b = got.read(), want.read()
         assert got_b == want_b, (
-            f"{suffix} output drifted from the committed golden fixture "
-            f"({len(got_b)} vs {len(want_b)} bytes) — if the change is "
-            "intentional, regenerate with G2VEC_REGEN_GOLDEN=1 and commit")
+            f"{suffix} output drifted from the committed {backend} golden "
+            f"fixture ({len(got_b)} vs {len(want_b)} bytes) — if the change "
+            "is intentional, regenerate with G2VEC_REGEN_GOLDEN=1 and commit")
