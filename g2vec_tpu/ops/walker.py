@@ -190,7 +190,8 @@ _SCAN_SEGMENTS = 4
 
 
 def _sparse_path_scan(nbr_rows, starts: jax.Array, uniforms: jax.Array,
-                      len_path: int) -> jax.Array:
+                      len_path: int,
+                      n_segments: Optional[int] = None) -> jax.Array:
     """Shared sparse-walk scaffold; returns the [W, len_path] path lists.
 
     ``nbr_rows(current) -> (cand [W, D], w [W, D])`` gathers the current
@@ -198,6 +199,8 @@ def _sparse_path_scan(nbr_rows, starts: jax.Array, uniforms: jax.Array,
     replicated and the model-sharded table layouts, so the two cannot drift
     semantically. -1 entries are empty path slots; the compare-based
     no-revisit test and the fixed trip count live only here.
+    ``n_segments`` overrides _SCAN_SEGMENTS (profiling A/Bs; results are
+    bit-identical for any value).
     """
     n_walkers = starts.shape[0]
     starts = starts.astype(jnp.int32)
@@ -231,7 +234,9 @@ def _sparse_path_scan(nbr_rows, starts: jax.Array, uniforms: jax.Array,
     n_steps = uniforms.shape[0]
     # Equal segments; during steps [lo, hi) at most ``hi`` slots are
     # filled at compare time (step s compares slots 0..s, s <= hi-1).
-    n_segments = min(_SCAN_SEGMENTS, n_steps) or 1
+    if n_segments is None:
+        n_segments = _SCAN_SEGMENTS
+    n_segments = min(n_segments, n_steps) or 1
     state = state0
     lo = 0
     for k in range(n_segments):
@@ -244,7 +249,8 @@ def _sparse_path_scan(nbr_rows, starts: jax.Array, uniforms: jax.Array,
     return state[0]
 
 
-def _sparse_path_list(nbr_idx, nbr_w, starts, key, len_path: int):
+def _sparse_path_list(nbr_idx, nbr_w, starts, key, len_path: int,
+                      n_segments: Optional[int] = None):
     """Replicated-table sparse walk -> [W, len_path] path lists.
 
     The single place that binds the uniform streams to the replicated
@@ -257,7 +263,8 @@ def _sparse_path_list(nbr_idx, nbr_w, starts, key, len_path: int):
     def nbr_rows(current):
         return nbr_idx[current], nbr_w[current]
 
-    return _sparse_path_scan(nbr_rows, starts, uniforms, len_path)
+    return _sparse_path_scan(nbr_rows, starts, uniforms, len_path,
+                             n_segments)
 
 
 @partial(jax.jit, static_argnames=("len_path",))

@@ -123,12 +123,13 @@ def test_sparse_dense_distributional_agreement(rng):
     np.testing.assert_allclose(fd, fs, atol=0.05)
 
 
-def test_prefix_segmented_scan_matches_single_scan(rng, monkeypatch):
-    """The segmented no-revisit compare (ops/walker._SCAN_SEGMENTS) drops
-    only compares against -1 sentinel slots, so path lists must be
-    BIT-IDENTICAL to a single-scan run — on a random weighted graph whose
-    walks include dead ends and early stops, at several path lengths
-    (including ones that don't divide evenly into segments)."""
+def test_prefix_segmented_scan_matches_single_scan(rng):
+    """The segmented no-revisit compare (ops/walker._SCAN_SEGMENTS,
+    overridable via the n_segments parameter) drops only compares against
+    -1 sentinel slots, so path lists must be BIT-IDENTICAL to a
+    single-scan run — on a random weighted graph whose walks include dead
+    ends and early stops, at several path lengths (including ones that
+    don't divide evenly into segments)."""
     import g2vec_tpu.ops.walker as W
 
     n = 40
@@ -141,10 +142,11 @@ def test_prefix_segmented_scan_matches_single_scan(rng, monkeypatch):
 
     for len_path in (1, 2, 7, 16):
         runs = {}
-        for segs in (1, 3, 4):
-            monkeypatch.setattr(W, "_SCAN_SEGMENTS", segs)
+        for segs in (1, 3, 4, None):      # None = the module default
             runs[segs] = np.asarray(W._sparse_path_list(
                 jax.numpy.asarray(nbr_idx), jax.numpy.asarray(nbr_w),
-                jax.numpy.asarray(starts), key, len_path))
+                jax.numpy.asarray(starts), key, len_path,
+                n_segments=segs))
         np.testing.assert_array_equal(runs[1], runs[4])
         np.testing.assert_array_equal(runs[1], runs[3])
+        np.testing.assert_array_equal(runs[1], runs[None])

@@ -9,7 +9,10 @@ surviving edges, neighbor-table D = max out-degree rounded to pow2):
   new_1rep  — the shipping walker (ops/walker.py random_walks_sparse +
               device packbits) at W = n_genes (one repetition);
   new_full  — the shipping walker at W = reps*n_genes = the single fused
-              launch generate_path_set now dispatches.
+              launch generate_path_set now dispatches;
+  seg1_full — new_full with the r4 prefix-segmented no-revisit compare
+              disabled (n_segments=1): the A/B isolating the
+              segmentation gain, bit-identical outputs.
 
 Results feed PROFILE.md's before/after table.
 
@@ -150,12 +153,25 @@ def main():
     keys_n = jax.vmap(lambda i: jax.random.fold_in(key, i))(
         jnp.arange(n_genes * REPS))
 
+    # seg1_full: the shipping step with the prefix-segmented no-revisit
+    # compare DISABLED (n_segments=1 — one scan over the full [W, L]
+    # buffer): the r4 A/B that isolates the segmentation gain vs new_full.
+    # Bit-identical outputs by construction (tests pin this).
+    import g2vec_tpu.ops.walker as W
+
+    seg1_jit = jax.jit(
+        lambda a, b, s, k: W._packed_from_path_list(
+            W._sparse_path_list(a, b, s, k, LEN_PATH, n_segments=1),
+            n_genes))
+
     variants = {
         "r2_step": (lambda: r2_jit(starts_1), n_genes),
         "new_1rep": (lambda: _packed_walk_sparse(
             nbr_idx, nbr_w, starts_1, keys_1, LEN_PATH), n_genes),
         "new_full": (lambda: _packed_walk_sparse(
             nbr_idx, nbr_w, starts_n, keys_n, LEN_PATH), n_genes * REPS),
+        "seg1_full": (lambda: seg1_jit(nbr_idx, nbr_w, starts_n, keys_n),
+                      n_genes * REPS),
     }
     only = sys.argv[1:] or list(variants)
     results = {}
