@@ -265,3 +265,28 @@ def test_walker_axis_slices_reproduce_full_run():
     with pytest.raises(ValueError, match="walker range"):
         walk_packed_rows(src, dst, w, n, walker_lo=2, walker_hi=total + 1,
                          **kwargs)
+
+
+def test_duplicate_edges_exceeding_n_genes_degree():
+    # Duplicate edges are legal (multiset semantics) and can push one
+    # row's degree past n_genes; each duplicate carries its own mass and
+    # the compaction buffers must be sized by MAX ROW DEGREE, not
+    # n_genes (a heap-overflow regression guard).
+    n = 4
+    reps = 6      # node 0 -> {1,2,3} repeated 6x: degree 18 > n_genes
+    src = np.tile(np.array([0, 0, 0], dtype=np.int32), reps)
+    dst = np.tile(np.array([1, 2, 3], dtype=np.int32), reps)
+    w = np.tile(np.array([1.0, 2.0, 3.0], dtype=np.float32), reps)
+    paths = _raw_paths(src, dst, w, n, np.array([0], dtype=np.int32),
+                       len_path=4, seed=5, reps=200)
+    # Walks are valid: start at 0, visit distinct real targets only.
+    for row in paths:
+        nodes = row[row >= 0]
+        assert nodes[0] == 0
+        assert set(nodes[1:].tolist()) <= {1, 2, 3}
+        assert len(set(nodes.tolist())) == nodes.size
+    # Duplicate mass keeps the 1:2:3 first-step ratio.
+    first = paths[:, 1]
+    freq = {t: (first == t).mean() for t in (1, 2, 3)}
+    for t, expect in ((1, 1 / 6), (2, 2 / 6), (3, 3 / 6)):
+        assert abs(freq[t] - expect) < 0.1, freq
