@@ -60,3 +60,22 @@ def test_probe_failure_falls_back_and_exits_3():
     assert "backend-probe" in lines[0]["error"]
     assert lines[-1]["metric"] == "walker_native_walks_per_sec"
     assert lines[-1]["value"] > 0
+
+
+def test_ambient_nontpu_backend_routes_to_hostonly():
+    # Tunnel gone but jax healthy on CPU (no explicit platform override):
+    # the full-scale CPU train would burn the budget for nothing, so the
+    # bench must record the chip-free truths instead, rc=3. (If the
+    # ambient env makes the probe hang instead, that IS the probe-failure
+    # path — same fallback, same rc.)
+    env = {**os.environ, **_TOY,
+           "JAX_PLATFORMS": "cpu",
+           "G2VEC_BENCH_PROBE_TIMEOUT": "20",
+           "G2VEC_BENCH_TOTAL_BUDGET": "200"}
+    env.pop("G2VEC_BENCH_PLATFORM", None)
+    proc = subprocess.run([sys.executable, BENCH], capture_output=True,
+                          text=True, timeout=340, env=env)
+    assert proc.returncode == 3, (proc.returncode, proc.stderr[-800:])
+    last = _last_metric(proc.stdout)
+    assert last["metric"] == "walker_native_walks_per_sec"
+    assert last["value"] > 0
