@@ -1,9 +1,10 @@
 """Walker-backend auto-resolution (ops/backend.py): host-walks-chip-trains.
 
-The "auto" default must route single-host runs to the native C++ sampler
-when it is available, meshed/distributed runs to the device walker, and
-honor explicit pins — without the user needing to know a flag exists
-(VERDICT r3 task 2)."""
+The "auto" default must route walks to the native C++ sampler whenever it
+is available (meshes change nothing; multi-process runs shard the walker
+axis — the 2-process test covers the collective path), fall back to the
+device walker without it, and honor explicit pins — all without the user
+needing to know a flag exists (VERDICT r3 task 2)."""
 import shutil
 
 import pytest
@@ -110,3 +111,22 @@ def test_pipeline_default_routes_to_native(tmp_path):
         with open(fa, "rb") as a, open(fn, "rb") as b:
             assert a.read() == b.read()
     assert os.path.exists(r_auto.output_files[0])
+
+
+@pytest.mark.skipif(g_plus_plus is None, reason="no C++ toolchain")
+def test_sharded_native_single_process_fallback():
+    """With one process, sharded_native_path_set must return exactly the
+    single-host set (no collectives involved)."""
+    import numpy as np
+
+    from g2vec_tpu.ops.host_walker import generate_path_set_native
+    from g2vec_tpu.parallel.distributed import sharded_native_path_set
+
+    rng = np.random.default_rng(2)
+    n = 30
+    src = rng.integers(0, n, 150).astype(np.int32)
+    dst = rng.integers(0, n, 150).astype(np.int32)
+    w = rng.random(150).astype(np.float32) + 0.1
+    kwargs = dict(len_path=6, reps=3, seed=4)
+    assert sharded_native_path_set(src, dst, w, n, **kwargs) \
+        == generate_path_set_native(src, dst, w, n, **kwargs)
