@@ -119,6 +119,16 @@ def main():
         return (c + seen) % G
     ops["seen_compare_L80"] = (scan20(seen_compare), cand0)
 
+    # Prefix-bounded compare (r4 segmentation, ops/walker._SCAN_SEGMENTS):
+    # the same op against a 20-slot prefix — the first-segment cost; with
+    # seen_compare_L80 it brackets the 0.625x average-work model.
+    prefix20 = path_list[:, :20]
+
+    def seen_compare_prefix(c):
+        seen = jnp.any(c[:, :, None] % G == prefix20[:, None, :], axis=2)
+        return (c + seen) % G
+    ops["seen_compare_L20"] = (scan20(seen_compare_prefix), cand0)
+
     # PRNG, shipping form: per-walker fold_in + gumbel (D,) under vmap.
     def prng_vmap(c):
         g = jax.vmap(lambda k: jax.random.gumbel(
