@@ -3,28 +3,31 @@
 The axon tunnel wedges for hours at a time (round-3 postmortem: the only
 chip window of the session was 15 minutes, and everything not already
 scripted was lost). This watcher loops a bounded backend probe and, on the
-FIRST success, runs the full round-4 evidence agenda in priority order,
+FIRST success, runs the full round evidence agenda in priority order,
 flushing each artifact to the repo root the moment it exists so a window
-that dies mid-battery still leaves everything earlier on disk:
+that dies mid-battery still leaves everything earlier on disk (ROUND below
+is WATCHER_ROUND, default r05):
 
-  1. bench.py                    -> BENCH_LOCAL_r04.json  (headline debt:
-     walker, native control, kernel A/B, epoch breakdown, XLA-dense
-     control, config #2; opportunistically refreshes TPU_ACCEPTANCE.json
-     via its acceptance stage — auto backend: native walks on this host,
-     training on the chip)
-  2. tools/profile_walker.py     -> PROFILE_WALKER_r04.json (the rebuilt
-     +segmented step's isolated throughput, VERDICT r3 weak #2)
-  3. tools/profile_ops.py        -> PROFILE_OPS_r04.json
+  1. bench.py                    -> BENCH_LOCAL_{ROUND}.json  (headline
+     debt: walker, native control, kernel A/B, epoch breakdown, XLA-dense
+     control, config #2, epochs-to-0.88; opportunistically refreshes
+     TPU_ACCEPTANCE.json via its acceptance stage — auto backend: native
+     walks on this host, training on the chip)
+  2. tools/profile_walker.py     -> PROFILE_WALKER_{ROUND}.json (the
+     rebuilt+segmented step's isolated throughput incl. the seg1_full A/B,
+     VERDICT r4 task 3)
+  3. tools/profile_ops.py        -> PROFILE_OPS_{ROUND}.json
   4. tools/tpu_acceptance.py with G2VEC_ACCEPT_WALKER=device
                                  -> TPU_ACCEPTANCE_device.json (real-chip
      device-walker acceptance coverage next to the default artifact)
-  5. tools/scale_demo.py         -> SCALE_DEMO_TPU_r04.json (config #3
-     chip-measured slices, VERDICT r3 task 6)
+  5. tools/scale_demo.py         -> SCALE_DEMO_TPU_{ROUND}.json (config #3
+     chip trainer sec/epoch + config #5 TP trainer step, VERDICT r4
+     task 5)
 
 Each stage runs in a subprocess with its own timeout; a hang or crash is
 recorded in the stage's artifact and the battery moves on. The watcher
 exits after one battery (rerun it for another window). Progress streams to
-stderr and to WATCHER_STATUS_r04.json.
+stderr and to WATCHER_STATUS_{ROUND}.json.
 
 Run detached:  nohup python tools/chip_watcher.py >/tmp/chip_watcher.log 2>&1 &
 Artifacts are committed by whoever finds them (the round's rule: evidence
@@ -43,8 +46,9 @@ PROBE_CMD = [sys.executable, os.path.join(REPO, "bench.py"), "--_probe"]
 PROBE_TIMEOUT = int(os.environ.get("WATCHER_PROBE_TIMEOUT", "75"))
 PROBE_INTERVAL = int(os.environ.get("WATCHER_PROBE_INTERVAL", "240"))
 MAX_HOURS = float(os.environ.get("WATCHER_MAX_HOURS", "11"))
+ROUND = os.environ.get("WATCHER_ROUND", "r05")
 STATUS = os.environ.get("WATCHER_STATUS_PATH",
-                        os.path.join(REPO, "WATCHER_STATUS_r04.json"))
+                        os.path.join(REPO, f"WATCHER_STATUS_{ROUND}.json"))
 T0 = time.time()
 
 
@@ -121,20 +125,20 @@ def battery(info: dict) -> None:
     stages = [
         # (name, cmd, timeout, artifact, env)
         ("bench", [py, os.path.join(REPO, "bench.py")], 600,
-         os.path.join(REPO, "BENCH_LOCAL_r04.json"), None),
+         os.path.join(REPO, f"BENCH_LOCAL_{ROUND}.json"), None),
         ("profile_walker",
          [py, os.path.join(REPO, "tools", "profile_walker.py")], 600,
-         os.path.join(REPO, "PROFILE_WALKER_r04.json"), None),
+         os.path.join(REPO, f"PROFILE_WALKER_{ROUND}.json"), None),
         ("profile_ops",
          [py, os.path.join(REPO, "tools", "profile_ops.py")], 420,
-         os.path.join(REPO, "PROFILE_OPS_r04.json"), None),
+         os.path.join(REPO, f"PROFILE_OPS_{ROUND}.json"), None),
         # These two tools write their own primary artifacts
-        # (TPU_ACCEPTANCE_device.json / SCALE_DEMO_TPU_r04.json); the stage
-        # record still lands on disk so a killed/hung run leaves its
+        # (TPU_ACCEPTANCE_device.json / SCALE_DEMO_TPU_{ROUND}.json); the
+        # stage record still lands on disk so a killed/hung run leaves its
         # stderr diagnostics behind.
         ("acceptance_device",
          [py, os.path.join(REPO, "tools", "tpu_acceptance.py")], 420,
-         os.path.join(REPO, "WATCHER_STAGE_acceptance_device_r04.json"),
+         os.path.join(REPO, f"WATCHER_STAGE_acceptance_device_{ROUND}.json"),
          # Cached twin: its XLA compiles persist across watcher reruns /
          # later windows, so a repeat battery pays the ~7-stage compile
          # bill once (recorded in the artifact as compilation_cache_used;
@@ -143,8 +147,8 @@ def battery(info: dict) -> None:
           "G2VEC_ACCEPT_COMPILE_CACHE": "/tmp/g2vec-accept-xla-cache"}),
         ("scale_demo",
          [py, os.path.join(REPO, "tools", "scale_demo.py"),
-          "--out", os.path.join(REPO, "SCALE_DEMO_TPU_r04.json")], 600,
-         os.path.join(REPO, "WATCHER_STAGE_scale_demo_r04.json"), None),
+          "--out", os.path.join(REPO, f"SCALE_DEMO_TPU_{ROUND}.json")], 600,
+         os.path.join(REPO, f"WATCHER_STAGE_scale_demo_{ROUND}.json"), None),
     ]
     done = []
     aborted = False
