@@ -68,6 +68,20 @@ def _compile(src: str, so: str, extra_flags: List[str]) -> None:
             os.unlink(tmp)
 
 
+def _probe_writable(dirname: str) -> None:
+    """Raise OSError unless ``dirname`` accepts an actual file create.
+
+    Deliberately not os.access(): root-squash NFS (and plain root
+    processes) report W_OK and then fail the real write — which would
+    otherwise surface later as a g++ "cannot open output file"
+    RuntimeError, indistinguishable from a broken source.
+    """
+    probe = os.path.join(dirname or ".", f".wprobe.{os.getpid()}")
+    with open(probe, "w"):
+        pass
+    os.unlink(probe)
+
+
 class _Target:
     def __init__(self) -> None:
         self.lock = threading.Lock()
@@ -98,20 +112,20 @@ def build_and_load(src: str, so: str, extra_flags: List[str],
             if (not os.path.exists(so)
                     or os.path.getmtime(so) < os.path.getmtime(src)):
                 try:
-                    if not os.access(os.path.dirname(so) or ".", os.W_OK):
-                        raise OSError(
-                            f"package directory not writable: "
-                            f"{os.path.dirname(so)}")
+                    _probe_writable(os.path.dirname(so))
                     _compile(src, so, extra_flags)
-                except Exception:
-                    # Read-only install (or an access() lie — root-squash
-                    # NFS reports W_OK and then fails the actual write):
-                    # build into the per-user cache instead, memoized
-                    # under the ORIGINAL so key above so later calls
-                    # still short-circuit. The cache name is keyed by
+                except OSError:
+                    # Read-only install (the write probe failed): build
+                    # into the per-user cache instead, memoized under the
+                    # ORIGINAL so key above so later calls still
+                    # short-circuit. The cache name is keyed by
                     # (source, flags, platform) content, so an existing
-                    # file is current. A genuinely broken source/toolchain
-                    # fails here too and raises from the fallback compile.
+                    # file is current. A genuinely failed g++ invocation
+                    # (RuntimeError, incl. compiler timeout) on a WRITABLE
+                    # dir is NOT a writability problem — it propagates
+                    # directly rather than re-running the failed compile
+                    # against the cache and misattributing the error to
+                    # the cache path (ADVICE r4).
                     cache_so = _cache_path(src, extra_flags)
                     if not os.path.exists(cache_so):
                         _compile(src, cache_so, extra_flags)

@@ -35,8 +35,13 @@ are exactly the next epoch's entry weights, so that forward is recomputed
 verbatim by the next epoch's gradient pass. The chunk body reads the
 previous epoch's ACC[tr] out of its own grad forward (``has_aux``) and a
 single per-chunk eval backfills the last epoch's; per-epoch train-split
-matmul passes drop 3 -> 2 (~31% of epoch FLOPs at the 80/20 split) with
-bit-identical history.
+matmul passes drop 3 -> 2 (~31% of epoch FLOPs at the 80/20 split). The
+history is the same computation at the same params/inputs as the unfused
+3-pass epoch — bitwise so in float32 (test-pinned); under bfloat16 XLA may
+compile the grad-forward and the standalone eval to different programs, so
+the chunk-boundary backfill can differ from the in-chunk value in low bits
+(accuracies stay correct and the early stop reads only acc_val, so
+training behavior is unaffected).
 """
 from __future__ import annotations
 

@@ -191,16 +191,17 @@ def test_readonly_package_dir_builds_into_cache(tmp_path, monkeypatch):
     so = pkg / "_walker.so"
     cache_home = tmp_path / "cache"
     monkeypatch.setenv("XDG_CACHE_HOME", str(cache_home))
-    # os.access(W_OK) is unreliable under root, so simulate the read-only
-    # directory at the check itself.
-    real_access = _os.access
+    # chmod is a no-op under root, so simulate the read-only directory at
+    # the write probe itself (production raises OSError from the failed
+    # create there — e.g. root-squash NFS).
+    real_probe = _build._probe_writable
 
-    def fake_access(path, mode):
-        if _os.path.abspath(str(path)) == str(pkg) and mode == _os.W_OK:
-            return False
-        return real_access(path, mode)
+    def fake_probe(dirname):
+        if _os.path.abspath(str(dirname)) == str(pkg):
+            raise OSError(f"simulated read-only dir: {dirname}")
+        return real_probe(dirname)
 
-    monkeypatch.setattr(_build.os, "access", fake_access)
+    monkeypatch.setattr(_build, "_probe_writable", fake_probe)
     lib = _build.build_and_load(str(src), str(so), ["-pthread"],
                                 walker_bindings._configure)
     assert lib is not None
@@ -210,6 +211,32 @@ def test_readonly_package_dir_builds_into_cache(tmp_path, monkeypatch):
     # Second call short-circuits on the memoized handle.
     assert _build.build_and_load(str(src), str(so), ["-pthread"],
                                  walker_bindings._configure) is lib
+
+
+def test_broken_source_fails_without_cache_retry(tmp_path, monkeypatch):
+    # A genuine compile error on a WRITABLE checkout must raise once,
+    # against the package path — not re-run the failed compile into the
+    # per-user cache and report the error against the cache path.
+    import g2vec_tpu.native._build as _build
+
+    src = tmp_path / "broken.cpp"
+    src.write_text("this is not C++\n")
+    so = tmp_path / "_broken.so"
+    cache_home = tmp_path / "cache"
+    monkeypatch.setenv("XDG_CACHE_HOME", str(cache_home))
+    compiles = []
+    real_compile = _build._compile
+
+    def counting_compile(s, out, flags):
+        compiles.append(out)
+        return real_compile(s, out, flags)
+
+    monkeypatch.setattr(_build, "_compile", counting_compile)
+    with pytest.raises(RuntimeError, match="native build failed"):
+        _build.build_and_load(str(src), str(so), [], lambda lib: None)
+    assert compiles == [str(so)]  # one attempt, at the package path
+    assert not (cache_home / "g2vec_tpu").exists() or not list(
+        (cache_home / "g2vec_tpu").glob("broken-*.so"))
 
 
 def test_packed_walk_matches_unpacked_packbits():

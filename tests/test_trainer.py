@@ -167,25 +167,45 @@ def test_history_invariant_to_chunk_size(rng, tmp_path):
     post-loop backfill must have recorded it) and the dip-epoch backfill.
     Chunked (checkpoint_every=3 => chunk 3) and unchunked runs must
     produce identical per-epoch history — including an early-stop run
-    whose dip lands mid-chunk."""
+    whose dip lands mid-chunk.
+
+    float32: bitwise equal. bfloat16: the backfill's standalone eval
+    forward and the chunk body's grad forward are distinct XLA programs
+    that may round differently in low bits, so a borderline logit can
+    flip one sample's prediction. Accuracies quantize at 1/n_rows
+    (1/96 train, 1/24 val here), so the acc tolerance allows ONE flipped
+    sample per split (atol 0.05) — a real handoff bug (wrong epoch's
+    value) shifts accuracies by whole learning-curve steps, far above
+    that. Loss is continuous: atol 1e-3. Stop bookkeeping must match
+    exactly."""
     cases = [
-        (_separable_paths(rng, n_paths=120, n_genes=20), 10, 0),
-        (_separable_paths(rng, flip=0.25), 300, 3),     # early-stops
+        (_separable_paths(rng, n_paths=120, n_genes=20), 10, 0, "float32"),
+        (_separable_paths(rng, flip=0.25), 300, 3, "float32"),  # early-stops
+        (_separable_paths(rng, n_paths=120, n_genes=20), 10, 0, "bfloat16"),
     ]
-    for (paths, labels), max_epochs, seed in cases:
+    for (paths, labels), max_epochs, seed, dtype in cases:
         one = train_cbow(paths, labels, hidden=8, learning_rate=0.05,
-                         max_epochs=max_epochs, compute_dtype="float32",
+                         max_epochs=max_epochs, compute_dtype=dtype,
                          seed=seed)
-        ck = str(tmp_path / f"ck{seed}")
+        ck = str(tmp_path / f"ck{seed}-{dtype}")
         many = train_cbow(paths, labels, hidden=8, learning_rate=0.05,
-                          max_epochs=max_epochs, compute_dtype="float32",
+                          max_epochs=max_epochs, compute_dtype=dtype,
                           seed=seed, checkpoint_dir=ck, checkpoint_every=3)
         assert one.stopped_early == many.stopped_early
         assert one.stop_epoch == many.stop_epoch
         assert len(one.history) == len(many.history)
+        exact = dtype == "float32"
         for ha, hb in zip(one.history, many.history):
             assert ha["epoch"] == hb["epoch"]
-            np.testing.assert_array_equal(ha["acc_val"], hb["acc_val"])
-            np.testing.assert_array_equal(ha["acc_tr"], hb["acc_tr"])
-            np.testing.assert_array_equal(ha["loss"], hb["loss"])
-        np.testing.assert_array_equal(one.w_ih, many.w_ih)
+            if exact:
+                np.testing.assert_array_equal(ha["acc_val"], hb["acc_val"])
+                np.testing.assert_array_equal(ha["acc_tr"], hb["acc_tr"])
+                np.testing.assert_array_equal(ha["loss"], hb["loss"])
+            else:
+                np.testing.assert_allclose(ha["acc_val"], hb["acc_val"], atol=0.05)
+                np.testing.assert_allclose(ha["acc_tr"], hb["acc_tr"], atol=0.05)
+                np.testing.assert_allclose(ha["loss"], hb["loss"], atol=1e-3)
+        if exact:
+            np.testing.assert_array_equal(one.w_ih, many.w_ih)
+        else:
+            np.testing.assert_allclose(one.w_ih, many.w_ih, atol=1e-3)
