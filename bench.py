@@ -116,6 +116,11 @@ CHILD_BUDGET = int(os.environ.get("G2VEC_BENCH_CHILD_BUDGET", "400"))
 
 # Peak bf16 matmul throughput per chip, for the MFU estimate.
 _PEAK_FLOPS = {"v4": 275e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12}
+# HBM bandwidth per chip (bytes/s): the roofline's other axis. This
+# workload's matmuls are skinny (h=128 lanes), so the breakdown stage
+# reports each piece's implied bandwidth against this peak to show where
+# sec/epoch actually caps (VERDICT r4 task 2).
+_PEAK_HBM = {"v4": 1228e9, "v5e": 819e9, "v5p": 2765e9, "v6e": 1638e9}
 
 
 def _fail(stage: str, detail: str, code: int = 2) -> "NoReturn":  # noqa: F821
@@ -230,7 +235,15 @@ def _hostonly_fallback(probe_err: str, deadline: float) -> "NoReturn":  # noqa: 
         "error": f"backend-probe: {probe_err}"[:500],
         "chip_free_fallback": True,
     }), flush=True)
-    budget = max(30, min(180, int(deadline - time.time() - 10)))
+    remaining = int(deadline - time.time() - 10)
+    if remaining <= 0:
+        # Probe retries already ate the driver's budget: a >=30s child here
+        # would overrun the deadline and risk an external kill that loses
+        # the partial-line cleanup below. Bail with the error line only.
+        print(f"# no budget left for the host-only child "
+              f"({remaining}s past safe margin)", file=sys.stderr)
+        sys.exit(2)
+    budget = min(180, remaining)   # floor is the remaining time, never past it
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--_hostonly"],
@@ -275,6 +288,69 @@ def _native_walker_line(src, dst, w, n_genes: int, baseline: float,
             "len_path": LEN_PATH, "reps": WALKER_REPS, **extra}
 
 
+def _current_code_key(repo_dir: str) -> "str | None":
+    """Tree hash of HEAD:g2vec_tpu (the acceptance artifacts' freshness
+    key, tools/tpu_acceptance._code_key without the dirty suffix)."""
+    try:
+        out = subprocess.run(["git", "rev-parse", "HEAD:g2vec_tpu"],
+                             cwd=repo_dir, capture_output=True, text=True,
+                             timeout=10)
+        return out.stdout.strip() or None
+    except Exception:  # noqa: BLE001 — freshness ranking is best-effort
+        return None
+
+
+def _epochs_to_088_line(artifact_dir: "str | None" = None) -> dict:
+    """BASELINE.json's second target metric — epochs to val-ACC >= 0.88 —
+    read from the best acceptance artifact that recorded a training
+    history (tools/tpu_acceptance.py writes ``epochs_to_acc_088``).
+    Ranking: artifacts whose code_key matches the CURRENT HEAD:g2vec_tpu
+    tree outrank stale ones (a weeks-old chip artifact must not shadow a
+    freshly regenerated CPU twin); within a freshness class, TPU
+    outranks CPU. The reference transcript crosses at epoch 25 with
+    0.8812 (/root/reference/README.md:35-41), so vs_baseline > 1 means
+    we converge in FEWER epochs. No jax anywhere: safe for the host-only
+    child."""
+    ref_epochs = 25
+    here = artifact_dir or os.path.dirname(os.path.abspath(__file__))
+    current_key = _current_code_key(here)
+    candidates = []
+    for rank, name in enumerate(("TPU_ACCEPTANCE.json",
+                                 "REAL_ACCEPTANCE.json")):
+        path = os.path.join(here, name)
+        if not os.path.exists(path):
+            continue
+        try:
+            with open(path) as f:
+                art = json.load(f)
+        except ValueError:
+            continue
+        if "epochs_to_acc_088" not in art:
+            continue    # pre-r5 artifact without a history record
+        fresh = bool(current_key) and art.get("code_key") == current_key
+        candidates.append((0 if fresh else 1, rank, name, art, fresh))
+    if not candidates:
+        return {"metric": "epochs_to_acc_0.88", "value": None,
+                "unit": "epochs", "vs_baseline": None,
+                "error": "no acceptance artifact records a training history"}
+    _, _, name, art, fresh = min(candidates)
+    epochs = art["epochs_to_acc_088"]
+    line = {"metric": "epochs_to_acc_0.88", "value": epochs,
+            "unit": "epochs", "baseline_epochs": ref_epochs,
+            "platform": art.get("platform"),
+            "acc_val": round(art.get("acc_val", 0.0), 4),
+            "n_epochs_run": art.get("n_epochs_run"),
+            "source_artifact": name,
+            "source_git_head": (art.get("git_head") or "")[:12],
+            "code_fresh": fresh}
+    if epochs is None:
+        line["vs_baseline"] = None
+        line["error"] = "run never reached ACC[val] >= 0.88"
+    else:
+        line["vs_baseline"] = round(ref_epochs / max(epochs, 1), 2)
+    return line
+
+
 def _hostonly() -> None:
     """Child: chip-free metrics (native sampler vs the reference loop).
     MUST NOT import jax — see _hostonly_fallback."""
@@ -282,6 +358,10 @@ def _hostonly() -> None:
 
     def note(msg):
         print(f"# {msg}", file=sys.stderr, flush=True)
+
+    # Chip-free but real: the convergence metric is a property of the
+    # committed acceptance history, not of this host's backend.
+    print(json.dumps(_epochs_to_088_line()), flush=True)
 
     src, dst, w, n_genes = _load_bench_edges()
     csr = edges_to_csr(src, dst, w, n_genes)
@@ -413,6 +493,10 @@ def make_paths(rng, n_paths: int, n_genes: int):
 
 def _peak_flops() -> float:
     return _PEAK_FLOPS.get(os.environ.get("PALLAS_AXON_TPU_GEN", "v5e"), 197e12)
+
+
+def _peak_hbm_bytes_per_sec() -> float:
+    return _PEAK_HBM.get(os.environ.get("PALLAS_AXON_TPU_GEN", "v5e"), 819e9)
 
 
 def _epoch_flops(n_paths: int, n_genes: int, hidden: int) -> int:
@@ -627,8 +711,8 @@ def _bench_kernel_ab(hidden: int) -> dict:
             "speedup": round(t_dense / t_packed, 2)}
 
 
-def _bench_epoch_breakdown(paths, labels, hidden: int, epoch_sec: float
-                           ) -> dict:
+def _bench_epoch_breakdown(paths, labels, hidden: int, epoch_sec: float,
+                           interpret: bool = False) -> dict:
     """One epoch's pieces as standalone jitted programs (trainer shapes).
 
     grad+update = value_and_grad over the train split + Adam apply;
@@ -670,7 +754,7 @@ def _bench_epoch_breakdown(paths, labels, hidden: int, epoch_sec: float
     opt_state = tx.init(params)
 
     def logits_fn(p, x):
-        h = pm.packed_matmul(x, p.w_ih.astype(jnp.bfloat16))
+        h = pm.packed_matmul(x, p.w_ih.astype(jnp.bfloat16), interpret)
         return output_logits(h, p.w_ho, jnp.bfloat16)
 
     def loss(p, x, y):
@@ -703,12 +787,51 @@ def _bench_epoch_breakdown(paths, labels, hidden: int, epoch_sec: float
     # Steady-state epoch = grad_update + eval_val; the train eval is one
     # per-chunk backfill (the eval-train fold, trainer.py).
     pieces = t_grad + t_eval_val + t_eval_tr / DEFAULT_CHUNK
+
+    # Roofline account (VERDICT r4 task 2): per piece, the MINIMUM HBM
+    # traffic the computation admits, and the bandwidth the measured time
+    # implies against it. With h=128 output lanes the X@W matmul does only
+    # ~2*h FLOPs per packed-X byte, so if implied bandwidth sits near the
+    # chip peak the stage is bandwidth-bound and the MFU ceiling is
+    # bytes/s * (FLOPs/byte) / peak_FLOPs — not a kernel inefficiency.
+    m_tr, m_val = xtr.shape[0], xval.shape[0]
+    xtr_bytes = m_tr * g // 8          # packed multi-hot, uint8
+    xval_bytes = m_val * g // 8
+    wih_bytes = g * hidden * 2         # bf16 compute copy
+    adam_bytes = 7 * g * hidden * 4    # fp32: read p,m,v,grad; write p,m,v
+    h_act_bytes = m_tr * hidden * 2    # bf16 activations, write fwd + read bwd
+    grad_min_bytes = (2 * xtr_bytes        # X read fwd + bwd (dW = X^T dH)
+                      + 2 * wih_bytes      # W read fwd + bwd (dH = dO W^T)
+                      + 2 * h_act_bytes
+                      + adam_bytes)
+    eval_val_min_bytes = xval_bytes + wih_bytes
+    eval_tr_min_bytes = xtr_bytes + wih_bytes
+    peak_bw = _peak_hbm_bytes_per_sec()
+
+    def gbps(nbytes, ms):
+        return round(nbytes / (ms * 1e-3) / 1e9, 1) if ms > 0 else None
+
+    roofline = {
+        "hbm_peak_gbps": round(peak_bw / 1e9, 1),
+        "grad_min_bytes": grad_min_bytes,
+        "grad_implied_gbps": gbps(grad_min_bytes, t_grad),
+        "eval_val_min_bytes": eval_val_min_bytes,
+        "eval_val_implied_gbps": gbps(eval_val_min_bytes, t_eval_val),
+        "eval_tr_min_bytes": eval_tr_min_bytes,
+        "eval_tr_implied_gbps": gbps(eval_tr_min_bytes, t_eval_tr),
+        "epoch_min_bytes": grad_min_bytes + eval_val_min_bytes
+                           + eval_tr_min_bytes // DEFAULT_CHUNK,
+        "bandwidth_bound_epoch_ms_floor": round(
+            (grad_min_bytes + eval_val_min_bytes
+             + eval_tr_min_bytes // DEFAULT_CHUNK) / peak_bw * 1e3, 3),
+    }
     return {"grad_update_ms": round(t_grad, 3),
             "eval_val_ms": round(t_eval_val, 3),
             "eval_tr_ms": round(t_eval_tr, 3),
             "eval_tr_amortized_ms": round(t_eval_tr / DEFAULT_CHUNK, 4),
             "epoch_ms": round(epoch_sec * 1e3, 3),
-            "residual_ms": round(epoch_sec * 1e3 - pieces, 3)}
+            "residual_ms": round(epoch_sec * 1e3 - pieces, 3),
+            "roofline": roofline}
 
 
 def _measure() -> None:
@@ -916,6 +1039,9 @@ def _measure() -> None:
               "pipeline_wall_seconds": art["pipeline_wall_seconds"]})
 
     guarded("tpu_acceptance_acc_val", 180, tpu_acceptance)
+    # After the acceptance stage so a just-written TPU_ACCEPTANCE.json (with
+    # its history record) is what the convergence metric reads.
+    emit(_epochs_to_088_line())
     guarded("packed_matmul_vs_xla_dense", 60, kernel_ab)
     guarded("cbow_epoch_breakdown", 60, breakdown)
     guarded("cbow_train_xla_dense_sec_per_epoch", 60, xla_control)

@@ -1,0 +1,57 @@
+"""The epoch-breakdown bench stage (bench._bench_epoch_breakdown) is
+chip-gated in production; ``interpret=True`` runs its exact program
+(Pallas packed matmul in interpreter mode) on CPU so the stage's shape
+handling and the roofline arithmetic stay pinned between chip windows."""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def bench_mod():
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.remove(REPO)
+    return bench
+
+
+def test_breakdown_pieces_and_roofline(bench_mod):
+    rng = np.random.default_rng(0)
+    paths, labels = bench_mod.make_paths(rng, 96, 256)
+    bd = bench_mod._bench_epoch_breakdown(paths, labels, 16, 0.01,
+                                          interpret=True)
+    for k in ("grad_update_ms", "eval_val_ms", "eval_tr_ms",
+              "eval_tr_amortized_ms", "epoch_ms", "residual_ms"):
+        assert isinstance(bd[k], float), k
+    assert bd["grad_update_ms"] > 0 and bd["eval_val_ms"] > 0
+
+    rl = bd["roofline"]
+    assert rl["hbm_peak_gbps"] == bench_mod._peak_hbm_bytes_per_sec() / 1e9
+    # Min-traffic model at these shapes: padded rows/lanes from the
+    # Pallas block sizes, packed X at 1 bit/gene.
+    from g2vec_tpu.ops import packed_matmul as pm
+    from g2vec_tpu.parallel.mesh import pad_to_multiple
+
+    g = pad_to_multiple(256, pm.LANE_BLOCK)
+    m_tr = pad_to_multiple(int(96 * (1 - bench_mod.VAL_FRACTION)),
+                           pm.ROW_BLOCK)
+    m_val = pad_to_multiple(96 - int(96 * (1 - bench_mod.VAL_FRACTION)),
+                            pm.ROW_BLOCK)
+    hidden = 16
+    assert rl["eval_val_min_bytes"] == m_val * g // 8 + g * hidden * 2
+    assert rl["eval_tr_min_bytes"] == m_tr * g // 8 + g * hidden * 2
+    expect_grad = (2 * (m_tr * g // 8) + 2 * (g * hidden * 2)
+                   + 2 * (m_tr * hidden * 2) + 7 * g * hidden * 4)
+    assert rl["grad_min_bytes"] == expect_grad
+    # The bandwidth floor is epoch_min_bytes at peak bandwidth, in ms.
+    assert rl["bandwidth_bound_epoch_ms_floor"] == pytest.approx(
+        rl["epoch_min_bytes"] / bench_mod._peak_hbm_bytes_per_sec() * 1e3,
+        abs=1e-3)
+    # Implied bandwidths exist whenever the piece was timed.
+    assert rl["grad_implied_gbps"] is not None

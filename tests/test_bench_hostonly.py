@@ -62,6 +62,96 @@ def test_probe_failure_falls_back_and_exits_3():
     assert lines[-1]["value"] > 0
 
 
+def test_epochs_to_088_line_reads_freshest_artifact(tmp_path):
+    # BASELINE's second target metric comes from the acceptance artifact's
+    # history record; TPU artifact outranks the CPU twin; artifacts
+    # without the field (pre-r5) are skipped, not misread.
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.remove(REPO)
+
+    # No artifacts at all -> honest null.
+    line = bench._epochs_to_088_line(str(tmp_path))
+    assert line["value"] is None and "error" in line
+
+    # CPU twin with a history record.
+    (tmp_path / "REAL_ACCEPTANCE.json").write_text(json.dumps(
+        {"platform": "cpu", "acc_val": 0.8948, "epochs_to_acc_088": 12,
+         "n_epochs_run": 30}))
+    line = bench._epochs_to_088_line(str(tmp_path))
+    assert line["value"] == 12 and line["platform"] == "cpu"
+    assert line["vs_baseline"] == round(25 / 12, 2)
+
+    # Stale TPU artifact WITHOUT the field must not shadow the CPU twin.
+    (tmp_path / "TPU_ACCEPTANCE.json").write_text(json.dumps(
+        {"platform": "tpu", "acc_val": 0.89}))
+    assert bench._epochs_to_088_line(str(tmp_path))["platform"] == "cpu"
+
+    # Fresh TPU artifact with the field outranks it.
+    (tmp_path / "TPU_ACCEPTANCE.json").write_text(json.dumps(
+        {"platform": "tpu", "acc_val": 0.891, "epochs_to_acc_088": 14,
+         "n_epochs_run": 40}))
+    line = bench._epochs_to_088_line(str(tmp_path))
+    assert line["value"] == 14 and line["platform"] == "tpu"
+
+    # A run that never crossed the gate: value null, explicit error.
+    (tmp_path / "TPU_ACCEPTANCE.json").write_text(json.dumps(
+        {"platform": "tpu", "acc_val": 0.71, "epochs_to_acc_088": None,
+         "n_epochs_run": 500}))
+    line = bench._epochs_to_088_line(str(tmp_path))
+    assert line["value"] is None and "never reached" in line["error"]
+
+
+def test_epochs_to_088_freshness_outranks_platform(tmp_path, monkeypatch):
+    # A stale chip artifact (code_key from an old tree) must not shadow a
+    # CPU twin regenerated at the current tree.
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.remove(REPO)
+
+    (tmp_path / "TPU_ACCEPTANCE.json").write_text(json.dumps(
+        {"platform": "tpu", "acc_val": 0.891, "epochs_to_acc_088": 14,
+         "code_key": "old-tree"}))
+    (tmp_path / "REAL_ACCEPTANCE.json").write_text(json.dumps(
+        {"platform": "cpu", "acc_val": 0.8948, "epochs_to_acc_088": 12,
+         "code_key": "current-tree", "git_head": "abcdef0123456789"}))
+    monkeypatch.setattr(bench, "_current_code_key",
+                        lambda _d: "current-tree")
+    line = bench._epochs_to_088_line(str(tmp_path))
+    assert line["platform"] == "cpu" and line["value"] == 12
+    assert line["code_fresh"] is True
+    assert line["source_git_head"] == "abcdef012345"
+    # Both fresh -> the chip artifact wins again.
+    (tmp_path / "TPU_ACCEPTANCE.json").write_text(json.dumps(
+        {"platform": "tpu", "acc_val": 0.891, "epochs_to_acc_088": 14,
+         "code_key": "current-tree"}))
+    assert bench._epochs_to_088_line(str(tmp_path))["platform"] == "tpu"
+
+
+def test_exhausted_budget_skips_hostonly_child():
+    # Probe retries that already consumed the driver's whole budget must
+    # NOT spawn a >=30s host-only child past the deadline (an external
+    # kill there would lose the partial-line cleanup): the fallback bails
+    # with the headline error line only, rc=2.
+    proc = subprocess.run(
+        [sys.executable, BENCH],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, **_TOY,
+             "G2VEC_BENCH_PLATFORM": "no_such_platform",
+             "G2VEC_BENCH_PROBE_TIMEOUT": "10",
+             "G2VEC_BENCH_TOTAL_BUDGET": "5"})
+    assert proc.returncode == 2, (proc.returncode, proc.stderr[-800:])
+    lines = [json.loads(ln) for ln in proc.stdout.splitlines()
+             if ln.strip().startswith("{")]
+    assert lines and lines[0]["metric"] == "cbow_train_paths_per_sec_per_chip"
+    assert lines[0]["value"] is None
+    assert "no budget left" in proc.stderr
+
+
 def test_ambient_nontpu_backend_routes_to_hostonly():
     # Tunnel gone but jax healthy on CPU (no explicit platform override):
     # the full-scale CPU train would burn the budget for nothing, so the
