@@ -17,8 +17,9 @@ from g2vec_tpu.data.make_example import SCALES
 from g2vec_tpu.data.synthetic import write_synthetic_tsv
 
 
-@pytest.mark.slow
 def test_pipeline_reaches_baseline_accuracy(tmp_path):
+    # ~25 s: cheap enough to stay in the default suite (the full-scale
+    # real-network gate is test_acceptance_real.py's auto variant).
     from g2vec_tpu.pipeline import run
 
     paths = write_synthetic_tsv(SCALES["medium"], str(tmp_path))

@@ -103,15 +103,20 @@ def test_shared_module_correlates_in_both_groups():
     assert abs(mg - mp) < 0.3, (mg, mp)
 
 
-@pytest.mark.slow
 @needs_reference
-@pytest.mark.parametrize("backend", ["auto", "device"])
+@pytest.mark.parametrize("backend", [
+    "auto",
+    pytest.param("device", marks=pytest.mark.slow),
+])
 def test_real_network_pipeline(tmp_path, backend):
     """``auto`` (resolves to the native sampler single-host — the
-    REAL_ACCEPTANCE.json config, ~25 s) and ``device`` (the JAX walker's
-    acceptance-scale coverage — ~7 min of XLA:CPU walking; per-backend
-    PRNG families give slightly different path counts at the same seed,
-    both inside the asserted bands)."""
+    REAL_ACCEPTANCE.json config, ~25 s — the default full-scale gate) and
+    ``device`` (the JAX walker's acceptance-scale coverage — ~7 min of
+    XLA:CPU walking, so it is slow/opt-in: run with ``-m slow``; the chip
+    watcher's acceptance_device battery stage covers the same
+    configuration on real hardware). Per-backend PRNG families give
+    slightly different path counts at the same seed, both inside the
+    asserted bands."""
     from g2vec_tpu.config import G2VecConfig
     from g2vec_tpu.data.realistic import write_real_expression_tsv
     from g2vec_tpu.ops.backend import native_walker_available

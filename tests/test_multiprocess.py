@@ -43,8 +43,9 @@ def _worker_env(port: int, process_id: int) -> dict:
     return env
 
 
-@pytest.mark.slow
 def test_two_process_cluster(tmp_path):
+    # ~20 s: stays in the default suite — it is the only true 2-process
+    # coverage of jax.distributed init + sharded walks + checkpointing.
     port = _free_port()
     shared = tmp_path / "shared_ck"     # the sharded-layout phase needs it
     shared.mkdir()
