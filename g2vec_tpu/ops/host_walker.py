@@ -6,8 +6,12 @@ accelerator the JAX lockstep walker pays XLA-on-CPU overheads it was never
 designed for, while the reference's own per-node loop costs O(G) per step
 (the dense-row deepcopy at ref: G2Vec.py:334). The native sampler walks
 CSR rows at O(out_degree + path_len) per step across OS threads
-(native/walker.cpp) and reaches throughput the chip path only beats once
-real TPU hardware is attached.
+(native/walker.cpp). It is the measured DEFAULT on every host, chip
+attached or not: the walk step is branchy pointer-chasing with no matmul,
+so even the real v5e device walker stays an order of magnitude behind
+(~98k native vs >6.1k device walks/s — the measured table in
+ops/backend.py); the device walker's remaining role is mesh-sharded
+neighbor tables.
 
 Same output contract as :func:`g2vec_tpu.ops.walker.generate_path_set`:
 a set of np.packbits-encoded multi-hot rows over the sorted gene order —
