@@ -264,28 +264,32 @@ def _hostonly_fallback(probe_err: str, deadline: float) -> "NoReturn":  # noqa: 
 
 
 def _native_walker_line(src, dst, w, n_genes: int, baseline: float,
-                        note, extra: dict) -> dict:
+                        note, extra: dict, metric: str =
+                        "walker_native_walks_per_sec",
+                        len_path: "int | None" = None) -> dict:
     """Time the native C++ sampler on the bench walk workload and build the
     ``walker_native_walks_per_sec`` metric line. ONE implementation for the
     chip-round stage 2b and the dead-tunnel host-only child, so the two
-    rounds' numbers stay comparable field-for-field. Never imports jax."""
+    rounds' numbers stay comparable field-for-field. Never imports jax.
+    ``len_path`` overrides the bench default (config #2 runs 160)."""
     from g2vec_tpu.native.walker_bindings import load as load_native
     from g2vec_tpu.ops.host_walker import generate_path_set_native
 
+    lp = LEN_PATH if len_path is None else len_path
     load_native()              # one-time g++ compile outside the timed region
     t0 = time.time()
     npaths = generate_path_set_native(src, dst, w, n_genes,
-                                      len_path=LEN_PATH, reps=WALKER_REPS,
+                                      len_path=lp, reps=WALKER_REPS,
                                       seed=0)
     el = time.time() - t0
     total_n = n_genes * WALKER_REPS
-    note(f"native walker: {total_n} walks in {el:.2f}s -> "
+    note(f"native walker (len_path={lp}): {total_n} walks in {el:.2f}s -> "
          f"{total_n / el:.0f} walks/s; {len(npaths)} unique paths")
-    return {"metric": "walker_native_walks_per_sec",
+    return {"metric": metric,
             "value": round(total_n / el, 1), "unit": "walks/s",
             "vs_baseline": round(total_n / el / baseline, 2),
             "unique_paths": len(npaths), "n_genes": n_genes,
-            "len_path": LEN_PATH, "reps": WALKER_REPS, **extra}
+            "len_path": lp, "reps": WALKER_REPS, **extra}
 
 
 def _current_code_key(repo_dir: str) -> "str | None":
@@ -369,6 +373,26 @@ def _hostonly() -> None:
     baseline, n_base = _reference_walk_baseline(*csr, n_genes, LEN_PATH)
     note(f"host reference loop: {baseline:.1f} walks/s "
          f"({n_base} stratified walks)")
+    # BASELINE config #2's walker half (lenPath = 2x the default 80) is
+    # host work — measurable with no chip. Its trainer half (hidden=512)
+    # stays chip-gated in _measure. Emitted BEFORE the headline native
+    # line: the driver's parsed field reads the LAST line.
+    try:
+        print(json.dumps(_native_walker_line(
+            src, dst, w, n_genes, baseline, note,
+            {"chip_free_fallback": True,
+             "note": f"BASELINE config #2 walk shape (lenPath="
+                     f"{2 * LEN_PATH}) on the native sampler; baseline = "
+                     f"the reference loop at the DEFAULT lenPath on this "
+                     f"host"},
+            metric="config2_walker_native_walks_per_sec",
+            len_path=2 * LEN_PATH)), flush=True)
+    except Exception as e:  # noqa: BLE001 — headline line must still print
+        print(json.dumps(
+            {"metric": "config2_walker_native_walks_per_sec", "value": None,
+             "unit": "walks/s", "vs_baseline": None,
+             "len_path": 2 * LEN_PATH, "chip_free_fallback": True,
+             "error": f"{type(e).__name__}: {e}"[:400]}), flush=True)
     line = _native_walker_line(
         src, dst, w, n_genes, baseline, note,
         {"baseline_host_walks_per_sec": round(baseline, 2),

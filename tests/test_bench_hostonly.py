@@ -37,6 +37,15 @@ def test_hostonly_child_emits_real_native_metric():
     assert last["value"] and last["value"] > 0
     assert last["chip_free_fallback"] is True
     assert last["vs_baseline"] and last["vs_baseline"] > 1
+    # Config #2's walker half rides along chip-free (its trainer half is
+    # chip-gated), at 2x the default lenPath. The headline-last ordering
+    # is already pinned by the _last_metric assertion above.
+    lines = [json.loads(ln) for ln in proc.stdout.splitlines()
+             if ln.strip().startswith("{")]
+    c2 = [d for d in lines if d["metric"]
+          == "config2_walker_native_walks_per_sec"]
+    assert len(c2) == 1 and c2[0]["value"] > 0
+    assert c2[0]["len_path"] == 2 * int(_TOY["G2VEC_BENCH_LEN_PATH"])
 
 
 def test_probe_failure_falls_back_and_exits_3():
