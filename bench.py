@@ -405,7 +405,8 @@ def _hostonly() -> None:
 
 
 def _run_measure_child(budget: int, child_env: dict,
-                       first_metric_cutoff: int) -> tuple:
+                       first_metric_cutoff: int,
+                       cmd: "list | None" = None) -> tuple:
     """Run the measure child, watching its stdout as it streams.
 
     Returns (stdout, stderr, fail) where fail is None on rc=0. Beyond the
@@ -413,12 +414,13 @@ def _run_measure_child(budget: int, child_env: dict,
     ``first_metric_cutoff`` is killed early — it is wedged on a dead
     backend, and the saved window funds the caller's one retry. Callers
     pass cutoff == budget to disable the early kill (non-TPU backends).
+    ``cmd`` overrides the measure invocation (tests only).
     """
     import tempfile
 
     with tempfile.TemporaryFile() as fo, tempfile.TemporaryFile() as fe:
         proc = subprocess.Popen(
-            [sys.executable, os.path.abspath(__file__), "--_measure"],
+            cmd or [sys.executable, os.path.abspath(__file__), "--_measure"],
             stdout=fo, stderr=fe, env=child_env)
 
         def snapshot(f) -> str:

@@ -141,6 +141,36 @@ def test_epochs_to_088_freshness_outranks_platform(tmp_path, monkeypatch):
     assert bench._epochs_to_088_line(str(tmp_path))["platform"] == "tpu"
 
 
+def test_measure_child_wedge_kill_and_partial_capture():
+    # The parent's pre-metric cutoff is what saves a tunnel window from a
+    # child wedged on a dead backend (round-3 postmortem): no metric by
+    # the cutoff -> early kill; metric seen -> only the budget kill
+    # applies and already-streamed lines are preserved.
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.remove(REPO)
+
+    env = dict(os.environ)
+    # Wedged child: emits nothing, must die at the cutoff, not the budget.
+    out, err, fail = bench._run_measure_child(
+        60, env, 3,
+        cmd=[sys.executable, "-c", "import time; time.sleep(50)"])
+    assert fail and "no metric after 3s" in fail
+    assert out == ""
+    # Healthy-then-hung child: the metric line arrived before the cutoff,
+    # so the early kill is disarmed; the budget kill preserves the line.
+    out, err, fail = bench._run_measure_child(
+        8, env, 3,
+        cmd=[sys.executable, "-c",
+             "import json,time;"
+             "print(json.dumps({'metric':'m','value':1}), flush=True);"
+             "time.sleep(50)"])
+    assert fail and "exceeded 8s" in fail
+    assert json.loads(out.splitlines()[0]) == {"metric": "m", "value": 1}
+
+
 def test_exhausted_budget_skips_hostonly_child():
     # Probe retries that already consumed the driver's whole budget must
     # NOT spawn a >=30s host-only child past the deadline (an external
