@@ -96,6 +96,9 @@ REFERENCE_NETWORK = "/root/reference/ex_NETWORK.txt"
 # depends on min(DEFAULT_CHUNK, max_epochs), so a shorter warmup would
 # compile a different program than the measured run uses.
 WARMUP_EPOCHS = int(os.environ.get("G2VEC_BENCH_WARMUP_EPOCHS", "0"))
+# Seconds granted to the reference-loop baseline sample (toy-scale
+# subprocess tests shrink it; real rounds keep the full stable sample).
+BASELINE_BUDGET = float(os.environ.get("G2VEC_BENCH_BASELINE_BUDGET", "12"))
 MEASURE_EPOCHS = int(os.environ.get("G2VEC_BENCH_MEASURE_EPOCHS", "192"))
 
 PROBE_TIMEOUT = int(os.environ.get("G2VEC_BENCH_PROBE_TIMEOUT", "75"))
@@ -620,7 +623,7 @@ def _load_bench_network():
 
 
 def _reference_walk_baseline(indptr, indices, weights, n_genes: int,
-                             len_path: int, budget_s: float = 12.0,
+                             len_path: int, budget_s: "float | None" = None,
                              min_walks: int = 40) -> tuple:
     """(walks/s, n_sampled) of the reference's own algorithm on this host.
 
@@ -631,9 +634,13 @@ def _reference_walk_baseline(indptr, indices, weights, n_genes: int,
     so hub and leaf walk costs are both represented — VERDICT r2 weak #7:
     a first-come sample under-weights hubs on a scale-free graph.
     Takes the CSR form so the host-only fallback can run it without jax.
+    ``budget_s`` defaults to BASELINE_BUDGET (12 s; the toy-scale
+    subprocess tests shrink it via G2VEC_BENCH_BASELINE_BUDGET).
     """
     import numpy as np
 
+    if budget_s is None:
+        budget_s = BASELINE_BUDGET
     dense_rows = {}
 
     def row(i):
@@ -883,11 +890,14 @@ def _measure() -> None:
     train_paths = int(N_PATHS * (1 - VAL_FRACTION))
     note(f"train: sec/epoch={sec_per_epoch:.4f} (baseline "
          f"{BASELINE_EPOCH_SECONDS}) mfu={mfu:.4f}")
-    emit({"metric": "cbow_train_paths_per_sec_per_chip",
-          "value": round(train_paths / sec_per_epoch, 1), "unit": "paths/s",
-          "vs_baseline": round(train_paths / sec_per_epoch
-                               / BASELINE_PATHS_PER_SEC, 2),
-          "sec_per_epoch": round(sec_per_epoch, 5), "mfu": round(mfu, 4)})
+    headline = {"metric": "cbow_train_paths_per_sec_per_chip",
+                "value": round(train_paths / sec_per_epoch, 1),
+                "unit": "paths/s",
+                "vs_baseline": round(train_paths / sec_per_epoch
+                                     / BASELINE_PATHS_PER_SEC, 2),
+                "sec_per_epoch": round(sec_per_epoch, 5),
+                "mfu": round(mfu, 4)}
+    emit(headline)
 
     # ---- 2. headline walker (always runs; errors degrade to a line) ----
     walker_err = None
@@ -1078,6 +1088,11 @@ def _measure() -> None:
         emit({"metric": "config2_walker_walks_per_sec", "value": None,
               "unit": "walks/s", "vs_baseline": None,
               "skipped": f"headline walker stage failed: {walker_err}"[:400]})
+    # The driver records the LAST line as "the result" (BENCH_r0N.json
+    # "parsed"), and the stated contract is the headline train metric —
+    # restate it so a chip round's record leads with the right number
+    # (stage order above is priority-under-budget and cannot end on it).
+    emit({**headline, "restated": True})
 
 
 if __name__ == "__main__":

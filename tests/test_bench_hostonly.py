@@ -17,7 +17,8 @@ BENCH = os.path.join(REPO, "bench.py")
 pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
                                 reason="no C++ toolchain in this environment")
 
-_TOY = {"G2VEC_BENCH_LEN_PATH": "8", "G2VEC_BENCH_WALKER_REPS": "1"}
+_TOY = {"G2VEC_BENCH_LEN_PATH": "8", "G2VEC_BENCH_WALKER_REPS": "1",
+        "G2VEC_BENCH_BASELINE_BUDGET": "2"}
 
 
 def _last_metric(stdout: str) -> dict:
