@@ -10,8 +10,6 @@ bundled expression matrix is absent (BASELINE.md note).
 """
 import os
 
-import pytest
-
 from g2vec_tpu.config import G2VecConfig
 from g2vec_tpu.data.make_example import SCALES
 from g2vec_tpu.data.synthetic import write_synthetic_tsv

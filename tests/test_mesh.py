@@ -1,7 +1,6 @@
 """Sharded-training tests on the 8-virtual-device CPU mesh (SURVEY.md §4
 item 5): DP+TP mesh runs produce the same numerics as single-device runs,
 including with shard-uneven shapes (padding + masked means)."""
-import jax
 import numpy as np
 import pytest
 

@@ -2,7 +2,6 @@
 table-construction correctness, and dense/sparse statistical agreement."""
 import jax
 import numpy as np
-import pytest
 
 from g2vec_tpu.ops.graph import neighbor_table, thresholded_edges
 from g2vec_tpu.ops.walker import (generate_path_set, random_walks,
