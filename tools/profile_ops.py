@@ -23,6 +23,8 @@ W = 9904
 D = 1024
 ITERS = 20
 COMPILE_TIMEOUT = int(os.environ.get("PROFILE_COMPILE_TIMEOUT", "150"))
+# Separate bound for the timed run (same knob as profile_walker.py).
+RUN_TIMEOUT = int(os.environ.get("PROFILE_RUN_TIMEOUT", "240"))
 T0 = time.time()
 
 
@@ -57,9 +59,24 @@ def bench(name, fn, *args):
     finally:
         signal.alarm(0)
         signal.signal(signal.SIGALRM, old)
-    t0 = time.time()
-    jax.block_until_ready(run(*args))
-    dt = (time.time() - t0) / ITERS * 1e3
+    # The timed call is bounded too: one pathological op must cost its own
+    # number, not the rest of the battery stage. Any exception (tunnel
+    # drop, device OOM) likewise degrades to this op's error record.
+    def _run_alarm(signum, frame):
+        raise TimeoutError(f"timed run exceeded {RUN_TIMEOUT}s")
+
+    old = signal.signal(signal.SIGALRM, _run_alarm)
+    try:
+        signal.alarm(RUN_TIMEOUT)
+        t0 = time.time()
+        jax.block_until_ready(run(*args))
+        dt = (time.time() - t0) / ITERS * 1e3
+    except Exception as e:  # noqa: BLE001 — battery must move on
+        note(f"{name}: timed run failed: {str(e)[:160]}")
+        return {"error": f"timed run: {e}"[:300]}
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
     note(f"{name:24s} {dt:8.3f} ms/iter")
     return round(dt, 4)
 
@@ -191,10 +208,20 @@ def main():
 
     only = sys.argv[1:] or list(ops)
     results = {}
+    contaminated = False
     for name, (fn, arg) in ops.items():
         if name not in only:
             continue
-        results[name] = bench(name, fn, arg)
+        res = bench(name, fn, arg)
+        if contaminated and not isinstance(res, dict):
+            # An abandoned (timed-out) predecessor may still be executing
+            # on the device — flag numbers measured under contention.
+            res = {"ms_per_iter_contended": res, "after_abandoned_run": True}
+        results[name] = res
+        if isinstance(res, dict) and "timed run" in str(res.get("error", "")):
+            contaminated = True
+        # Flush per op: a stage kill mid-battery keeps what was measured.
+        print(json.dumps({"op": name, "ms_per_iter": res}), flush=True)
     print(json.dumps({"backend": jax.default_backend(), "W": W, "G": G,
                       "D": D, "ms_per_iter": results}))
 

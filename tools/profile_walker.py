@@ -37,6 +37,10 @@ NEG_INF = -1e30
 NETWORK = os.environ.get("G2VEC_PROFILE_NETWORK",
                          "/root/reference/ex_NETWORK.txt")
 COMPILE_TIMEOUT = int(os.environ.get("PROFILE_COMPILE_TIMEOUT", "240"))
+# The timed call is alarm-bounded too: a slow backend (XLA:CPU walks the
+# full workload at ~180 walks/s ~= 9 min/variant) must cost ONE variant
+# its number, not the whole battery stage.
+RUN_TIMEOUT = int(os.environ.get("PROFILE_RUN_TIMEOUT", "240"))
 T0 = time.time()
 
 
@@ -88,9 +92,23 @@ def timed(name, fn, n_walks):
     finally:
         signal.alarm(0)
         signal.signal(signal.SIGALRM, old)
-    t0 = time.time()
-    jax.block_until_ready(fn())
-    dt = time.time() - t0
+
+    def _run_alarm(signum, frame):
+        raise TimeoutError(f"timed run exceeded {RUN_TIMEOUT}s")
+
+    old = signal.signal(signal.SIGALRM, _run_alarm)
+    try:
+        signal.alarm(RUN_TIMEOUT)
+        t0 = time.time()
+        jax.block_until_ready(fn())
+        dt = time.time() - t0
+    except Exception as e:  # noqa: BLE001 — tunnel drop/OOM costs one
+        note(f"{name}: timed run failed: {str(e)[:160]}")   # variant only
+        return {"error": f"timed run: {e}"[:300],
+                "first_call_s": round(compile_s, 1)}
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
     res = {"launch_s": round(dt, 3),
            "per_step_ms": round(dt / (LEN_PATH - 1) * 1e3, 3),
            "walks_per_sec": round(n_walks / dt, 1),
@@ -175,9 +193,21 @@ def main():
     }
     only = sys.argv[1:] or list(variants)
     results = {}
+    contaminated = False
     for name, (fn, n_walks) in variants.items():
         if name in only:
-            results[name] = timed(name, fn, n_walks)
+            res = timed(name, fn, n_walks)
+            if contaminated and "error" not in res:
+                # A timed-out predecessor's dispatch cannot be cancelled
+                # and may still be executing — this number ran under
+                # contention; flag it rather than report it as clean.
+                res["after_abandoned_run"] = True
+            results[name] = res
+            if "timed run" in str(res.get("error", "")):
+                contaminated = True
+            # Flush each variant as its own line the moment it exists: a
+            # stage kill mid-battery keeps everything already measured.
+            print(json.dumps({"variant": name, **res}), flush=True)
     print(json.dumps({"backend": jax.default_backend(), "G": n_genes,
                       "D": int(D), "len_path": LEN_PATH, "variants": results}))
 
