@@ -1,0 +1,27 @@
+"""One SIGALRM-bounded region helper for the profiler tools.
+
+Four near-identical save-handler/alarm/try/finally/restore blocks lived
+across profile_ops.py and profile_walker.py; this is the single copy.
+Note the bound is best-effort: Python delivers the signal only between
+bytecodes, so a single long native call (an XLA compile) defers it until
+that call returns.
+"""
+from __future__ import annotations
+
+import signal
+from contextlib import contextmanager
+
+
+@contextmanager
+def alarm(seconds: int, message: str):
+    """Raise TimeoutError(message) if the body runs past ``seconds``."""
+    def _handler(signum, frame):
+        raise TimeoutError(message)
+
+    old = signal.signal(signal.SIGALRM, _handler)
+    try:
+        signal.alarm(seconds)
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)

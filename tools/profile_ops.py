@@ -33,50 +33,28 @@ def note(msg):
 
 
 def bench(name, fn, *args):
-    import signal
-
     import jax
 
+    from tools.alarm_guard import alarm
+
     run = jax.jit(fn)
-
-    def _alarm(signum, frame):
-        raise TimeoutError(f"compile/run exceeded {COMPILE_TIMEOUT}s")
-
-    old = signal.signal(signal.SIGALRM, _alarm)
     try:
-        try:
-            signal.alarm(COMPILE_TIMEOUT)
+        with alarm(COMPILE_TIMEOUT, f"compile/run exceeded {COMPILE_TIMEOUT}s"):
             jax.block_until_ready(run(*args))
-            signal.alarm(0)
-        except TimeoutError as e:
-            signal.alarm(0)
-            note(f"{name}: TIMEOUT {e}")
-            return {"error": str(e)}
-        except Exception as e:  # noqa: BLE001
-            signal.alarm(0)
-            note(f"{name}: compile error {str(e)[:120]}")
-            return {"error": str(e)[:200]}
-    finally:
-        signal.alarm(0)
-        signal.signal(signal.SIGALRM, old)
+    except Exception as e:  # noqa: BLE001 — battery must move on
+        note(f"{name}: compile/first-run failed: {str(e)[:120]}")
+        return {"error": str(e)[:200]}
     # The timed call is bounded too: one pathological op must cost its own
     # number, not the rest of the battery stage. Any exception (tunnel
     # drop, device OOM) likewise degrades to this op's error record.
-    def _run_alarm(signum, frame):
-        raise TimeoutError(f"timed run exceeded {RUN_TIMEOUT}s")
-
-    old = signal.signal(signal.SIGALRM, _run_alarm)
     try:
-        signal.alarm(RUN_TIMEOUT)
-        t0 = time.time()
-        jax.block_until_ready(run(*args))
-        dt = (time.time() - t0) / ITERS * 1e3
-    except Exception as e:  # noqa: BLE001 — battery must move on
+        with alarm(RUN_TIMEOUT, f"timed run exceeded {RUN_TIMEOUT}s"):
+            t0 = time.time()
+            jax.block_until_ready(run(*args))
+            dt = (time.time() - t0) / ITERS * 1e3
+    except Exception as e:  # noqa: BLE001
         note(f"{name}: timed run failed: {str(e)[:160]}")
         return {"error": f"timed run: {e}"[:300]}
-    finally:
-        signal.alarm(0)
-        signal.signal(signal.SIGALRM, old)
     note(f"{name:24s} {dt:8.3f} ms/iter")
     return round(dt, 4)
 
@@ -218,7 +196,9 @@ def main():
             # on the device — flag numbers measured under contention.
             res = {"ms_per_iter_contended": res, "after_abandoned_run": True}
         results[name] = res
-        if isinstance(res, dict) and "timed run" in str(res.get("error", "")):
+        # Any alarm-abandoned call (timed run, or the compile/first-run
+        # bound firing mid-execution) may leave live device work behind.
+        if isinstance(res, dict) and "exceeded" in str(res.get("error", "")):
             contaminated = True
         # Flush per op: a stage kill mid-battery keeps what was measured.
         print(json.dumps({"op": name, "ms_per_iter": res}), flush=True)
