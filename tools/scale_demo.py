@@ -204,6 +204,28 @@ def main() -> None:
     mesh_ctx = make_mesh_context((2, 4)) if n_dev >= 8 else None
 
     results = {}
+
+    def write_artifact(partial: bool) -> None:
+        # Rewritten after EVERY config: a stage kill mid-run (config #5's
+        # TP compiles are the slow tail) keeps everything already
+        # measured, marked partial.
+        artifact = {
+            "platform": jax.default_backend(),
+            "n_devices": n_dev,
+            "mesh": "(2,4)" if mesh_ctx is not None else None,
+            "partial": partial,
+            "note": "BASELINE configs #3-#5 name TCGA/STRING/BioGRID "
+                    "mounts this container does not have; graphs here are "
+                    "power-law synthetic stand-ins at the configs' scale, "
+                    "and the measured slices are bounded (clamped on CPU).",
+            "configs": results,
+        }
+        tmp = f"{args.out}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(artifact, f, indent=2)
+            f.write("\n")
+        os.replace(tmp, args.out)
+
     for cfg in CONFIGS:
         name = cfg[0]
         print(f"# {name} ...", file=sys.stderr, flush=True)
@@ -211,20 +233,12 @@ def main() -> None:
         results[name] = demo_config(*cfg, on_tpu=on_tpu, mesh_ctx=mesh_ctx)
         print(f"#   done in {time.time() - t0:.1f}s", file=sys.stderr,
               flush=True)
-
-    artifact = {
-        "platform": jax.default_backend(),
-        "n_devices": n_dev,
-        "mesh": "(2,4)" if mesh_ctx is not None else None,
-        "note": "BASELINE configs #3-#5 name TCGA/STRING/BioGRID mounts this "
-                "container does not have; graphs here are power-law "
-                "synthetic stand-ins at the configs' scale, and the "
-                "measured slices are bounded (clamped on CPU).",
-        "configs": results,
-    }
-    with open(args.out, "w") as f:
-        json.dump(artifact, f, indent=2)
-        f.write("\n")
+        # One line per config for the watcher's stage record as well.
+        print(json.dumps({"config": name,
+                          "measured_slice": results[name]["measured_slice"]}),
+              flush=True)
+        write_artifact(partial=True)
+    write_artifact(partial=False)
     print(json.dumps({k: v["measured_slice"] for k, v in results.items()}))
 
 
