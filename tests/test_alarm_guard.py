@@ -1,0 +1,49 @@
+"""tools/alarm_guard.py bounds every profiler stage; its contract —
+raise on overrun, leak nothing on completion, restore the handler —
+must hold or a battery stage inherits a stray alarm."""
+import os
+import signal
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.alarm_guard import alarm  # noqa: E402
+
+
+def test_raises_with_message_on_overrun():
+    with pytest.raises(TimeoutError, match="too slow"):
+        with alarm(1, "too slow"):
+            time.sleep(5)
+
+
+def test_no_alarm_leaks_after_completion():
+    prev = signal.getsignal(signal.SIGALRM)
+    with alarm(1, "unused"):
+        pass
+    # The pending alarm is cancelled and the handler restored: sleeping
+    # past the old deadline must not raise.
+    time.sleep(1.2)
+    assert signal.getsignal(signal.SIGALRM) is prev
+
+
+def test_handler_restored_after_overrun():
+    prev = signal.getsignal(signal.SIGALRM)
+    with pytest.raises(TimeoutError):
+        with alarm(1, "x"):
+            time.sleep(5)
+    assert signal.getsignal(signal.SIGALRM) is prev
+
+
+def test_nested_regions_inner_wins_then_outer_restored():
+    # The profilers use sequential regions, but nesting must at least
+    # not corrupt the outer guard's handler bookkeeping.
+    prev = signal.getsignal(signal.SIGALRM)
+    with pytest.raises(TimeoutError, match="inner"):
+        with alarm(30, "outer"):
+            with alarm(1, "inner"):
+                time.sleep(5)
+    assert signal.getsignal(signal.SIGALRM) is prev
