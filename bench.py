@@ -99,6 +99,15 @@ WARMUP_EPOCHS = int(os.environ.get("G2VEC_BENCH_WARMUP_EPOCHS", "0"))
 # Seconds granted to the reference-loop baseline sample (toy-scale
 # subprocess tests shrink it; real rounds keep the full stable sample).
 BASELINE_BUDGET = float(os.environ.get("G2VEC_BENCH_BASELINE_BUDGET", "12"))
+# The metrics only a live chip can produce: a chip-free round emits each
+# as an explicit null (tests pin the full surface against this tuple).
+GATED_CHIP_METRICS = (("walker_walks_per_sec", "walks/s"),
+                      ("tpu_acceptance_acc_val", "ACC[val]"),
+                      ("packed_matmul_vs_xla_dense", "x"),
+                      ("cbow_epoch_breakdown", "ms"),
+                      ("cbow_train_xla_dense_sec_per_epoch", "s"),
+                      ("config2_train_paths_per_sec_per_chip", "paths/s"),
+                      ("config2_walker_walks_per_sec", "walks/s"))
 MEASURE_EPOCHS = int(os.environ.get("G2VEC_BENCH_MEASURE_EPOCHS", "192"))
 
 PROBE_TIMEOUT = int(os.environ.get("G2VEC_BENCH_PROBE_TIMEOUT", "75"))
@@ -369,6 +378,17 @@ def _hostonly() -> None:
     # Chip-free but real: the convergence metric is a property of the
     # committed acceptance history, not of this host's backend.
     print(json.dumps(_epochs_to_088_line()), flush=True)
+
+    # Every chip-gated metric appears as an explicit honest null rather
+    # than being absent — the round's artifact then lists the full armed
+    # surface (VERDICT r4: metrics "never appeared in any committed
+    # bench artifact" when the tunnel stayed dead).
+    for gated, unit in GATED_CHIP_METRICS:
+        print(json.dumps({"metric": gated, "value": None, "unit": unit,
+                          "vs_baseline": None,
+                          "skipped": "chip-free round (no usable TPU "
+                                     "backend); armed for the next chip "
+                                     "window"}), flush=True)
 
     src, dst, w, n_genes = _load_bench_edges()
     csr = edges_to_csr(src, dst, w, n_genes)

@@ -47,3 +47,13 @@ def test_nested_regions_inner_wins_then_outer_restored():
             with alarm(1, "inner"):
                 time.sleep(5)
     assert signal.getsignal(signal.SIGALRM) is prev
+
+
+def test_outer_deadline_survives_clean_inner_region():
+    # SIGALRM is one process-wide timer: an inner region that completes
+    # must NOT disarm the outer bound — it re-arms the remaining time.
+    with pytest.raises(TimeoutError, match="outer"):
+        with alarm(2, "outer"):
+            with alarm(30, "inner"):
+                pass            # completes instantly
+            time.sleep(10)      # outer must still fire (~2s)

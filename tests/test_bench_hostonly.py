@@ -47,6 +47,16 @@ def test_hostonly_child_emits_real_native_metric():
           == "config2_walker_native_walks_per_sec"]
     assert len(c2) == 1 and c2[0]["value"] > 0
     assert c2[0]["len_path"] == 2 * int(_TOY["G2VEC_BENCH_LEN_PATH"])
+    # Chip-gated metrics appear as explicit honest nulls, not absences —
+    # the FULL advertised surface, pinned against bench's own tuple.
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.remove(REPO)
+    gated = {d["metric"]: d for d in lines if d.get("skipped")}
+    assert set(gated) == {m for m, _ in bench.GATED_CHIP_METRICS}
+    assert all(d["value"] is None for d in gated.values())
 
 
 def test_probe_failure_falls_back_and_exits_3():

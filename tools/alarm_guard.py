@@ -14,14 +14,26 @@ from contextlib import contextmanager
 
 @contextmanager
 def alarm(seconds: int, message: str):
-    """Raise TimeoutError(message) if the body runs past ``seconds``."""
+    """Raise TimeoutError(message) if the body runs past ``seconds``.
+
+    Nesting-safe: SIGALRM has one process-wide timer, so an inner region
+    records the outer deadline's remaining seconds and re-arms it (less
+    the time the inner body consumed, floor 1 s) on exit — an outer
+    bound survives an inner region that completes quickly.
+    """
+    import time as _time
+
     def _handler(signum, frame):
         raise TimeoutError(message)
 
     old = signal.signal(signal.SIGALRM, _handler)
+    prev_remaining = signal.alarm(seconds)
+    t0 = _time.monotonic()
     try:
-        signal.alarm(seconds)
         yield
     finally:
         signal.alarm(0)
         signal.signal(signal.SIGALRM, old)
+        if prev_remaining:
+            left = prev_remaining - (_time.monotonic() - t0)
+            signal.alarm(max(1, int(left)))
