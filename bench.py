@@ -1094,7 +1094,20 @@ def _measure() -> None:
               "stage_seconds": art["stage_seconds"],
               "pipeline_wall_seconds": art["pipeline_wall_seconds"]})
 
-    guarded("tpu_acceptance_acc_val", 180, tpu_acceptance)
+    if os.environ.get("G2VEC_BENCH_SKIP_ACCEPT") == "1":
+        # A dedicated watcher stage owns the TPU_ACCEPTANCE refresh this
+        # run: spend the child budget on the control/config2 lines below
+        # instead of re-entering the ~7-compile acceptance pipeline. (r5
+        # window #1: the tunnel died inside one of those compiles; SIGALRM
+        # can't interrupt a blocked native call, so the stage held the
+        # child until the parent's hard kill and every later line was
+        # lost.)
+        emit({"metric": "tpu_acceptance_acc_val", "value": None,
+              "unit": "", "vs_baseline": None,
+              "skipped": "G2VEC_BENCH_SKIP_ACCEPT (dedicated watcher "
+                         "stage owns the refresh)"})
+    else:
+        guarded("tpu_acceptance_acc_val", 180, tpu_acceptance)
     # After the acceptance stage so a just-written TPU_ACCEPTANCE.json (with
     # its history record) is what the convergence metric reads.
     emit(_epochs_to_088_line())

@@ -10,6 +10,14 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# The battery's bench stage only counts as landed with all of these
+# non-null (tools/chip_watcher.py battery()).
+BENCH_REQUIRED = ("cbow_train_paths_per_sec_per_chip",
+                  "packed_matmul_vs_xla_dense", "cbow_epoch_breakdown",
+                  "cbow_train_xla_dense_sec_per_epoch",
+                  "config2_train_paths_per_sec_per_chip")
+BENCH_OK_LINES = [{"metric": m, "value": 1.0} for m in BENCH_REQUIRED]
+
 
 def _load_watcher(monkeypatch, tmp_path, round_name="rTEST"):
     """Import a fresh chip_watcher with REPO-relative paths redirected to
@@ -34,7 +42,8 @@ def test_battery_runs_all_stages_and_writes_artifacts(tmp_path, monkeypatch):
     def fake_run_stage(name, cmd, timeout, out_path, env_extra=None):
         calls.append((name, timeout, env_extra))
         rec = {"stage": name, "rc": 0, "wall_seconds": 0.1,
-               "lines": [{"metric": f"{name}_ok", "value": 1}],
+               "lines": BENCH_OK_LINES if name == "bench"
+               else [{"metric": f"{name}_ok", "value": 1}],
                "stderr_tail": ""}
         if out_path:
             with open(out_path, "w") as f:
@@ -98,6 +107,113 @@ def test_battery_aborts_when_tunnel_dies_mid_run(tmp_path, monkeypatch):
     assert not (tmp_path / "PROFILE_OPS_rTEST.json").exists()
 
 
+def test_second_plan_reorders_and_isolates_the_bench_rerun(tmp_path,
+                                                           monkeypatch):
+    """WATCHER_PLAN=second: acceptance refresh first (so the bench's
+    convergence line reads the fresh artifact), then the bench re-run
+    (skip-accept, distinct artifact), then the unchanged tail."""
+    monkeypatch.setenv("WATCHER_PLAN", "second")
+    w = _load_watcher(monkeypatch, tmp_path)
+    monkeypatch.setattr(w, "probe", lambda: {"platform": "tpu"})
+    calls = []
+
+    def fake_run_stage(name, cmd, timeout, out_path, env_extra=None):
+        calls.append((name, out_path, env_extra))
+        rec = {"stage": name, "rc": 0, "wall_seconds": 0.1,
+               "lines": BENCH_OK_LINES if name == "bench" else [],
+               "stderr_tail": ""}
+        if out_path:
+            with open(out_path, "w") as f:
+                json.dump(rec, f)
+        return rec
+
+    monkeypatch.setattr(w, "run_stage", fake_run_stage)
+    w.battery({"platform": "tpu"})
+    names = [c[0] for c in calls]
+    assert names == ["acceptance", "bench", "profile_walker", "profile_ops",
+                     "acceptance_device", "scale_demo"]
+    bench_path, bench_env = calls[1][1], calls[1][2]
+    # The rerun must not clobber window #1's headline artifact...
+    assert os.path.basename(bench_path) == "BENCH_LOCAL_rTESTb.json"
+    # ...and must skip its in-bench acceptance so the budget reaches the
+    # never-landed control/config2 lines.
+    assert bench_env["G2VEC_BENCH_SKIP_ACCEPT"] == "1"
+    assert bench_env["G2VEC_BENCH_TOTAL_BUDGET"] == "860"
+    # The primary acceptance stage runs cold (wall comparable): no walker
+    # pin, no compile cache.
+    assert calls[0][2] is None
+    status = json.load(open(tmp_path / "WATCHER_STATUS_rTEST.json"))
+    assert status["state"] == "done"
+
+
+def test_second_plan_incomplete_when_required_lines_null(tmp_path,
+                                                         monkeypatch):
+    """rc==0 with a budget-skipped (null) target line is NOT done: the
+    status must say incomplete so the watch loop re-arms, and the next
+    battery must re-run the bench stage despite SKIP_DONE."""
+    monkeypatch.setenv("WATCHER_PLAN", "second")
+    monkeypatch.setenv("WATCHER_SKIP_DONE", "1")
+    w = _load_watcher(monkeypatch, tmp_path)
+    monkeypatch.setattr(w, "probe", lambda: {"platform": "tpu"})
+    calls = []
+
+    def fake_run_stage(name, cmd, timeout, out_path, env_extra=None):
+        calls.append(name)
+        lines = [{"metric": "packed_matmul_vs_xla_dense", "value": None,
+                  "skipped": "budget"}] if name == "bench" else []
+        rec = {"stage": name, "rc": 0, "wall_seconds": 0.1, "lines": lines,
+               "stderr_tail": ""}
+        if out_path:
+            with open(out_path, "w") as f:
+                json.dump(rec, f)
+        return rec
+
+    monkeypatch.setattr(w, "run_stage", fake_run_stage)
+    w.battery({"platform": "tpu"})
+    status = json.load(open(tmp_path / "WATCHER_STATUS_rTEST.json"))
+    assert status["state"] == "incomplete"
+    assert status["unmet_required"] == ["BENCH_LOCAL_rTESTb.json"]
+    # Second battery: every other stage skips (rc==0 on disk), the bench
+    # with its null target line re-runs.
+    calls.clear()
+    w.battery({"platform": "tpu"})
+    assert calls == ["bench"]
+
+
+def test_skip_done_resumes_across_windows(tmp_path, monkeypatch):
+    """WATCHER_SKIP_DONE=1: a stage whose rc==0 artifact is already on
+    disk is not re-run (a dying window can't clobber landed evidence)."""
+    monkeypatch.setenv("WATCHER_SKIP_DONE", "1")
+    w = _load_watcher(monkeypatch, tmp_path)
+    monkeypatch.setattr(w, "probe", lambda: {"platform": "tpu"})
+    # Window #1 landed bench (rc=0, every required line non-null) and a
+    # failed profile_walker (rc=-9).
+    with open(tmp_path / "BENCH_LOCAL_rTEST.json", "w") as f:
+        json.dump({"stage": "bench", "rc": 0, "lines": BENCH_OK_LINES}, f)
+    with open(tmp_path / "PROFILE_WALKER_rTEST.json", "w") as f:
+        json.dump({"stage": "profile_walker", "rc": -9, "lines": []}, f)
+    calls = []
+
+    def fake_run_stage(name, cmd, timeout, out_path, env_extra=None):
+        calls.append(name)
+        rec = {"stage": name, "rc": 0, "wall_seconds": 0.1, "lines": [],
+               "stderr_tail": ""}
+        if out_path:
+            with open(out_path, "w") as f:
+                json.dump(rec, f)
+        return rec
+
+    monkeypatch.setattr(w, "run_stage", fake_run_stage)
+    w.battery({"platform": "tpu"})
+    # bench skipped (rc==0 on disk); the failed walker stage re-runs.
+    assert "bench" not in calls
+    assert calls[0] == "profile_walker"
+    status = json.load(open(tmp_path / "WATCHER_STATUS_rTEST.json"))
+    recorded = {s["stage"]: s for s in status["stages"]}
+    assert recorded["bench"].get("skipped")
+    assert status["state"] == "done"
+
+
 def test_run_stage_survives_timeout_and_parses_partial_lines(tmp_path,
                                                              monkeypatch):
     w = _load_watcher(monkeypatch, tmp_path)
@@ -116,3 +232,28 @@ def test_run_stage_survives_timeout_and_parses_partial_lines(tmp_path,
     assert "killed at 3s" in rec["stderr_tail"]
     on_disk = json.load(open(out))
     assert on_disk["lines"] == rec["lines"]
+
+
+def test_run_stage_rerun_salvages_previously_landed_lines(tmp_path,
+                                                          monkeypatch):
+    """A re-run that dies earlier than its predecessor must not regress
+    the artifact: real values the previous run captured are carried over
+    unless this run re-measured the same metric."""
+    w = _load_watcher(monkeypatch, tmp_path)
+    out = tmp_path / "stage.json"
+    with open(out, "w") as f:
+        json.dump({"stage": "bench", "rc": -9, "lines": [
+            {"metric": "a", "value": 1.0},
+            {"metric": "b", "value": 2.0},
+            {"metric": "c", "value": None, "skipped": "budget"}]}, f)
+    # The re-run lands a fresh (different) value for a, nothing for b/c.
+    rec = w.run_stage(
+        "bench",
+        [sys.executable, "-c",
+         "import json;print(json.dumps({'metric':'a','value':9.0}))"],
+        30, str(out))
+    assert rec["rc"] == 0
+    by_metric = {d["metric"]: d["value"] for d in rec["lines"]}
+    assert by_metric == {"a": 9.0, "b": 2.0}  # b salvaged, null c dropped
+    assert rec["salvaged_lines"] == 1
+    assert json.load(open(out))["lines"] == rec["lines"]

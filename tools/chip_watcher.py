@@ -47,6 +47,12 @@ PROBE_TIMEOUT = int(os.environ.get("WATCHER_PROBE_TIMEOUT", "75"))
 PROBE_INTERVAL = int(os.environ.get("WATCHER_PROBE_INTERVAL", "240"))
 MAX_HOURS = float(os.environ.get("WATCHER_MAX_HOURS", "11"))
 ROUND = os.environ.get("WATCHER_ROUND", "r05")
+# "first" = the from-scratch battery; "second" = the follow-up plan once
+# the headline bench has landed (see battery()). WATCHER_SKIP_DONE=1 makes
+# repeat batteries resume: a stage whose artifact is already on disk with
+# rc==0 is not re-run (and cannot be clobbered by a window dying mid-rerun).
+PLAN = os.environ.get("WATCHER_PLAN", "first")
+SKIP_DONE = os.environ.get("WATCHER_SKIP_DONE") == "1"
 STATUS = os.environ.get("WATCHER_STATUS_PATH",
                         os.path.join(REPO, f"WATCHER_STATUS_{ROUND}.json"))
 T0 = time.time()
@@ -110,6 +116,22 @@ def run_stage(name: str, cmd: list, timeout: int, out_path: str | None,
     record = {"stage": name, "rc": rc, "wall_seconds": round(time.time() - t0, 1),
               "lines": parsed, "stderr_tail": err[-2500:]}
     if out_path:
+        # A re-run must not regress the evidence record: lines a previous
+        # (partial) run captured with real values are salvaged into the
+        # new record unless this run re-measured the same metric.
+        try:
+            with open(out_path) as f:
+                prev_lines = json.load(f).get("lines", [])
+        except (OSError, ValueError):
+            prev_lines = []
+        have = {d.get("metric") for d in parsed
+                if isinstance(d, dict) and d.get("value") is not None}
+        salvaged = [d for d in prev_lines
+                    if isinstance(d, dict) and d.get("value") is not None
+                    and d.get("metric") not in have]
+        if salvaged:
+            record["lines"] = parsed + salvaged
+            record["salvaged_lines"] = len(salvaged)
         with open(out_path, "w") as f:
             json.dump(record, f, indent=2)
             f.write("\n")
@@ -118,6 +140,24 @@ def run_stage(name: str, cmd: list, timeout: int, out_path: str | None,
     else:
         note(f"stage {name}: rc={rc}, {len(parsed)} json lines")
     return record
+
+
+def _stage_done(artifact: str, required_metrics: tuple = ()) -> bool:
+    """True if a previous window already landed this stage: rc==0 record,
+    and (for stages re-run to collect specific lines) every required
+    metric present with a non-null value — bench exits 0 even when
+    guarded() budget-skips a stage to a null line, so rc alone would
+    declare victory with the target metrics still missing."""
+    try:
+        with open(artifact) as f:
+            rec = json.load(f)
+    except (OSError, ValueError):
+        return False
+    if rec.get("rc") != 0:
+        return False
+    landed = {d.get("metric"): d.get("value") for d in rec.get("lines", [])
+              if isinstance(d, dict)}
+    return all(landed.get(m) is not None for m in required_metrics)
 
 
 def battery(info: dict) -> None:
@@ -160,9 +200,57 @@ def battery(info: dict) -> None:
           "--out", os.path.join(REPO, f"SCALE_DEMO_TPU_{ROUND}.json")], 600,
          os.path.join(REPO, f"WATCHER_STAGE_scale_demo_{ROUND}.json"), None),
     ]
+    if PLAN == "second":
+        # Second-window plan: the headline bench already landed (window #1),
+        # so the TPU_ACCEPTANCE refresh runs FIRST (on a healthy chip it's
+        # ~47 s wall, r2's record; window #1's 600 s was a dying tunnel
+        # blocked inside a compile) so the bench re-run's epochs-to-0.88
+        # line reads the just-refreshed artifact. The bench then skips its
+        # in-bench acceptance (G2VEC_BENCH_SKIP_ACCEPT) and spends the
+        # whole child budget on the never-landed metric lines — kernel
+        # A/B, epoch breakdown + roofline, XLA-dense control, config #2
+        # (VERDICT r4 tasks 1+2) — then the profilers. A persistent XLA
+        # cache on the bench stage makes a window-3 repeat cheap;
+        # steady-state timings are unaffected (no metric measures compile
+        # time).
+        by_name = {s[0]: s for s in stages}
+        b_name, b_cmd, b_to, _b_art, b_env = by_name["bench"]
+        bench_art = os.path.join(REPO, f"BENCH_LOCAL_{ROUND}b.json")
+        stages = [
+            ("acceptance",
+             [py, os.path.join(REPO, "tools", "tpu_acceptance.py")], 420,
+             os.path.join(REPO, f"WATCHER_STAGE_acceptance_{ROUND}.json"),
+             None),
+            # Distinct artifact: window #1's headline BENCH_LOCAL_{ROUND}
+            # stays immutable; this run's new lines land next to it.
+            (b_name, b_cmd, b_to, bench_art,
+             dict(b_env, G2VEC_BENCH_SKIP_ACCEPT="1",
+                  JAX_COMPILATION_CACHE_DIR="/tmp/g2vec-bench-xla-cache")),
+            by_name["profile_walker"],
+            by_name["profile_ops"],
+            by_name["acceptance_device"],
+            by_name["scale_demo"],
+        ]
+    # The bench stage exists to land THESE lines; rc==0 with any of them
+    # null (budget-skipped, or a truncated window-#1-style record) must
+    # not count as done — keyed on the ACTIVE plan's bench artifact only,
+    # so a superseded artifact from the other plan can't hold the battery
+    # in "incomplete" forever.
+    required = {s[3]: ("cbow_train_paths_per_sec_per_chip",
+                       "packed_matmul_vs_xla_dense",
+                       "cbow_epoch_breakdown",
+                       "cbow_train_xla_dense_sec_per_epoch",
+                       "config2_train_paths_per_sec_per_chip")
+                for s in stages if s[0] == "bench"}
     done = []
     aborted = False
     for name, cmd, timeout, artifact, env in stages:
+        if SKIP_DONE and artifact and _stage_done(artifact,
+                                                  required.get(artifact, ())):
+            note(f"stage {name}: rc=0 artifact already on disk, skipping")
+            done.append({"stage": name, "rc": 0,
+                         "skipped": "landed in an earlier window"})
+            continue
         rec = run_stage(name, cmd, timeout, artifact, env)
         done.append({"stage": name, "rc": rec["rc"],
                      "wall_seconds": rec["wall_seconds"]})
@@ -174,10 +262,17 @@ def battery(info: dict) -> None:
             done.append({"stage": "abort", "reason": "tunnel died"})
             aborted = True
             break
-    write_status({"state": "aborted" if aborted else "done",
-                  "probe": info, "stages": done})
+    # A stage can exit rc==0 with its target lines budget-skipped to null;
+    # report that as incomplete so the outer watch_loop re-arms.
+    unmet = [os.path.basename(a) for a, req in required.items()
+             if not _stage_done(a, req)]
+    state = "aborted" if aborted else ("incomplete" if unmet else "done")
+    final = {"state": state, "probe": info, "stages": done}
+    if unmet:
+        final["unmet_required"] = unmet
+    write_status(final)
     note("battery aborted mid-window — rerun the watcher for another "
-         "window" if aborted else "battery complete")
+         "window" if aborted else f"battery {state}")
 
 
 def main() -> None:
