@@ -27,11 +27,15 @@ def _last_metric(stdout: str) -> dict:
     return json.loads(lines[-1])
 
 
-def test_hostonly_child_emits_real_native_metric():
+def test_hostonly_child_emits_real_native_metric(tmp_path):
+    # Empty window dir: this pins the NO-chip-window behavior (the real
+    # repo root may hold landed BENCH_LOCAL_* artifacts, which the child
+    # would relay — covered by the relay test below).
     proc = subprocess.run(
         [sys.executable, BENCH, "--_hostonly"],
         capture_output=True, text=True, timeout=240,
-        env={**os.environ, **_TOY})
+        env={**os.environ, **_TOY,
+             "G2VEC_BENCH_WINDOW_DIR": str(tmp_path)})
     assert proc.returncode == 0, proc.stderr[-800:]
     last = _last_metric(proc.stdout)
     assert last["metric"] == "walker_native_walks_per_sec"
@@ -59,7 +63,49 @@ def test_hostonly_child_emits_real_native_metric():
     assert all(d["value"] is None for d in gated.values())
 
 
-def test_probe_failure_falls_back_and_exits_3():
+def test_hostonly_relays_landed_window_lines(tmp_path):
+    """Chip numbers the watcher battery landed earlier in the round are
+    relayed (with provenance) instead of nulls, the headline train line
+    prints LAST, and a later window artifact overrides an earlier one."""
+    win1 = {"stage": "bench", "rc": 0, "lines": [
+        {"metric": "cbow_train_paths_per_sec_per_chip", "value": 5591382.3,
+         "unit": "paths/s", "vs_baseline": 338.68},
+        {"metric": "walker_walks_per_sec", "value": 8107.2,
+         "unit": "walks/s", "vs_baseline": 41.11},
+        {"metric": "packed_matmul_vs_xla_dense", "value": None,
+         "skipped": "budget"}]}
+    win2 = {"stage": "bench", "rc": 0, "lines": [
+        {"metric": "walker_walks_per_sec", "value": 9000.0,
+         "unit": "walks/s", "vs_baseline": 45.0}]}
+    (tmp_path / "BENCH_LOCAL_r05.json").write_text(json.dumps(win1))
+    (tmp_path / "BENCH_LOCAL_r05b.json").write_text(json.dumps(win2))
+    os.utime(tmp_path / "BENCH_LOCAL_r05b.json",
+             (2_000_000_000, 2_000_000_000))   # r05b is the later window
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--_hostonly"],
+        capture_output=True, text=True, timeout=240,
+        env={**os.environ, **_TOY,
+             "G2VEC_BENCH_WINDOW_DIR": str(tmp_path)})
+    assert proc.returncode == 0, proc.stderr[-800:]
+    lines = [json.loads(ln) for ln in proc.stdout.splitlines()
+             if ln.strip().startswith("{")]
+    by_metric = {}
+    for d in lines:
+        by_metric.setdefault(d["metric"], []).append(d)
+    # Landed metrics relayed with provenance; the null line in the window
+    # artifact does NOT count as landed (stays an honest null).
+    walker = by_metric["walker_walks_per_sec"][-1]
+    assert walker["value"] == 9000.0                  # later window wins
+    assert walker["chip_window_relay"] == "BENCH_LOCAL_r05b.json"
+    ab = by_metric["packed_matmul_vs_xla_dense"][-1]
+    assert ab["value"] is None and ab.get("skipped")
+    # Headline relay is the LAST line (the driver's parsed result).
+    assert lines[-1]["metric"] == "cbow_train_paths_per_sec_per_chip"
+    assert lines[-1]["value"] == 5591382.3
+    assert lines[-1]["chip_window_relay"] == "BENCH_LOCAL_r05.json"
+
+
+def test_probe_failure_falls_back_and_exits_3(tmp_path):
     # Poison the probe deterministically: G2VEC_BENCH_PLATFORM names a
     # platform jax cannot initialize, so every probe attempt fails fast
     # regardless of how warm this host's jax import is. The host-only
@@ -69,6 +115,7 @@ def test_probe_failure_falls_back_and_exits_3():
         [sys.executable, BENCH],
         capture_output=True, text=True, timeout=300,
         env={**os.environ, **_TOY,
+             "G2VEC_BENCH_WINDOW_DIR": str(tmp_path),
              "G2VEC_BENCH_PLATFORM": "no_such_platform",
              "G2VEC_BENCH_PROBE_TIMEOUT": "30",
              "G2VEC_BENCH_TOTAL_BUDGET": "240"})
@@ -80,6 +127,71 @@ def test_probe_failure_falls_back_and_exits_3():
     assert "backend-probe" in lines[0]["error"]
     assert lines[-1]["metric"] == "walker_native_walks_per_sec"
     assert lines[-1]["value"] > 0
+
+
+def test_landed_window_lines_provenance_rules(tmp_path):
+    """Harvest rules: relayed/host-fallback lines are never re-harvested
+    (their provenance would be rewritten to the wrong artifact), and the
+    per-metric winner is deterministic when a fresh checkout flattens
+    mtimes (name order breaks the tie: r05 < r05b = window order)."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.remove(REPO)
+    (tmp_path / "BENCH_LOCAL_r05.json").write_text(json.dumps(
+        {"rc": 0, "lines": [
+            {"metric": "walker_walks_per_sec", "value": 8107.2}]}))
+    (tmp_path / "BENCH_LOCAL_r05b.json").write_text(json.dumps(
+        {"rc": 3, "lines": [
+            {"metric": "walker_walks_per_sec", "value": 9000.0},
+            # A relay of the r05 headline and a host-side fallback line:
+            # neither is a chip measurement OF THIS artifact.
+            {"metric": "cbow_train_paths_per_sec_per_chip",
+             "value": 5591382.3, "chip_window_relay": "BENCH_LOCAL_r05.json"},
+            {"metric": "walker_native_walks_per_sec", "value": 94213.0,
+             "chip_free_fallback": True}]}))
+    # Identical mtimes (fresh-checkout shape): r05b must still win by name.
+    os.utime(tmp_path / "BENCH_LOCAL_r05.json", (1_900_000_000,) * 2)
+    os.utime(tmp_path / "BENCH_LOCAL_r05b.json", (1_900_000_000,) * 2)
+    landed = bench._landed_window_lines(str(tmp_path))
+    assert landed["walker_walks_per_sec"][0]["value"] == 9000.0
+    assert landed["walker_walks_per_sec"][1] == "BENCH_LOCAL_r05b.json"
+    assert "cbow_train_paths_per_sec_per_chip" not in landed
+    assert "walker_native_walks_per_sec" not in landed
+
+
+def test_measure_child_budget_skip_relays_landed_lines(tmp_path):
+    """A live-backend measure child whose budget runs out before a stage
+    relays that stage's landed chip-window value instead of a null."""
+    (tmp_path / "BENCH_LOCAL_r05.json").write_text(json.dumps(
+        {"stage": "bench", "rc": 0, "lines": [
+            {"metric": "packed_matmul_vs_xla_dense", "value": 7.9,
+             "unit": "x"}]}))
+    proc = subprocess.run(
+        [sys.executable, BENCH], capture_output=True, text=True,
+        timeout=340,
+        env={**os.environ, **_TOY,
+             "G2VEC_BENCH_WINDOW_DIR": str(tmp_path),
+             "G2VEC_BENCH_PLATFORM": "cpu",
+             "G2VEC_BENCH_SKIP_ACCEPT": "1",
+             "G2VEC_BENCH_N_PATHS": "1024", "G2VEC_BENCH_N_GENES": "256",
+             "G2VEC_BENCH_MEASURE_EPOCHS": "4",
+             "G2VEC_BENCH_TOTAL_BUDGET": "180",
+             "G2VEC_BENCH_TIMEOUT": "170",
+             # Deliberately below every guarded stage's 60s estimate:
+             # by the time the guards run some budget is spent, so
+             # remaining() < est is guaranteed and the skip path (and its
+             # relay) is deterministic.
+             "G2VEC_BENCH_CHILD_BUDGET": "60"})
+    assert proc.returncode == 0, proc.stderr[-800:]
+    lines = [json.loads(ln) for ln in proc.stdout.splitlines()
+             if ln.strip().startswith("{")]
+    ab = [d for d in lines if d["metric"] == "packed_matmul_vs_xla_dense"]
+    assert len(ab) == 1
+    assert ab[0]["value"] == 7.9
+    assert ab[0]["chip_window_relay"] == "BENCH_LOCAL_r05.json"
+    assert "budget ran out" in ab[0]["relay_note"]
 
 
 def test_epochs_to_088_line_reads_freshest_artifact(tmp_path):
@@ -202,13 +314,16 @@ def test_exhausted_budget_skips_hostonly_child():
     assert "no budget left" in proc.stderr
 
 
-def test_ambient_nontpu_backend_routes_to_hostonly():
+def test_ambient_nontpu_backend_routes_to_hostonly(tmp_path):
     # Tunnel gone but jax healthy on CPU (no explicit platform override):
     # the full-scale CPU train would burn the budget for nothing, so the
     # bench must record the chip-free truths instead, rc=3. (If the
     # ambient env makes the probe hang instead, that IS the probe-failure
-    # path — same fallback, same rc.)
+    # path — same fallback, same rc.) Empty window dir: the repo root's
+    # real landed BENCH_LOCAL_* artifacts would otherwise relay the chip
+    # headline last (covered by the relay test).
     env = {**os.environ, **_TOY,
+           "G2VEC_BENCH_WINDOW_DIR": str(tmp_path),
            "JAX_PLATFORMS": "cpu",
            "G2VEC_BENCH_PROBE_TIMEOUT": "20",
            "G2VEC_BENCH_TOTAL_BUDGET": "200"}

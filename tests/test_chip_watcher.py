@@ -234,6 +234,20 @@ def test_run_stage_survives_timeout_and_parses_partial_lines(tmp_path,
     assert on_disk["lines"] == rec["lines"]
 
 
+def test_stage_done_ignores_relayed_lines(tmp_path, monkeypatch):
+    """A bench record whose required lines are relays of an earlier
+    window is NOT done — the metric was never re-measured."""
+    w = _load_watcher(monkeypatch, tmp_path)
+    art = tmp_path / "b.json"
+    art.write_text(json.dumps({"rc": 0, "lines": [
+        {"metric": "m1", "value": 7.9,
+         "chip_window_relay": "BENCH_LOCAL_r05.json"}]}))
+    assert not w._stage_done(str(art), ("m1",))
+    art.write_text(json.dumps({"rc": 0, "lines": [
+        {"metric": "m1", "value": 7.9}]}))
+    assert w._stage_done(str(art), ("m1",))
+
+
 def test_run_stage_rerun_salvages_previously_landed_lines(tmp_path,
                                                           monkeypatch):
     """A re-run that dies earlier than its predecessor must not regress

@@ -155,8 +155,11 @@ def _stage_done(artifact: str, required_metrics: tuple = ()) -> bool:
         return False
     if rec.get("rc") != 0:
         return False
+    # A relayed line (bench re-emitting an earlier window's number) is
+    # not a fresh measurement: counting it would stop the loop from ever
+    # re-measuring a metric whose stage was merely budget-skipped.
     landed = {d.get("metric"): d.get("value") for d in rec.get("lines", [])
-              if isinstance(d, dict)}
+              if isinstance(d, dict) and "chip_window_relay" not in d}
     return all(landed.get(m) is not None for m in required_metrics)
 
 
