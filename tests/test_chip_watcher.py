@@ -234,6 +234,26 @@ def test_run_stage_survives_timeout_and_parses_partial_lines(tmp_path,
     assert on_disk["lines"] == rec["lines"]
 
 
+def test_check_complete_predicate(tmp_path, monkeypatch):
+    """The watch_loop re-arm predicate: done + all stages ok -> 0; any
+    failed stage, non-done state, or missing status -> 1."""
+    w = _load_watcher(monkeypatch, tmp_path)
+    status = tmp_path / "WATCHER_STATUS_rTEST.json"
+    assert w.check_complete() == 1                      # no status file
+    status.write_text(json.dumps({"state": "done", "stages": [
+        {"stage": "bench", "rc": 0},
+        {"stage": "profile_walker", "skipped": "landed earlier"}]}))
+    assert w.check_complete() == 0
+    status.write_text(json.dumps({"state": "done", "stages": [
+        {"stage": "bench", "rc": -9}]}))
+    assert w.check_complete() == 1                      # failed stage
+    status.write_text(json.dumps({"state": "incomplete", "stages": [
+        {"stage": "bench", "rc": 0}]}))
+    assert w.check_complete() == 1                      # unmet required
+    status.write_text(json.dumps({"state": "probing"}))
+    assert w.check_complete() == 1                      # never fired
+
+
 def test_stage_done_ignores_relayed_lines(tmp_path, monkeypatch):
     """A bench record whose required lines are relays of an earlier
     window is NOT done — the metric was never re-measured."""

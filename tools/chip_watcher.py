@@ -278,7 +278,26 @@ def battery(info: dict) -> None:
          "window" if aborted else f"battery {state}")
 
 
+def check_complete() -> int:
+    """--check-complete: exit 0 iff the last battery landed everything —
+    state 'done' and every recorded stage rc==0 or skipped-as-done. The
+    watch_loop's re-arm predicate, kept here (not as an inline heredoc in
+    the shell) so it is testable and single-sourced."""
+    try:
+        with open(STATUS) as f:
+            s = json.load(f)
+    except (OSError, ValueError):
+        return 1
+    stages = [r for r in s.get("stages", [])
+              if "rc" in r or "skipped" in r]
+    ok = s.get("state") == "done" and stages and all(
+        r.get("rc") == 0 or r.get("skipped") for r in stages)
+    return 0 if ok else 1
+
+
 def main() -> None:
+    if "--check-complete" in sys.argv:
+        raise SystemExit(check_complete())
     write_status({"state": "probing", "since_unix": int(T0)})
     attempt = 0
     while time.time() - T0 < MAX_HOURS * 3600:

@@ -21,19 +21,10 @@ while [ "$arms" -lt "$MAX_ARMS" ] && [ "$(date +%s)" -lt "$DEADLINE" ]; do
     arms=$((arms + 1))
     left_h=$(python -c "import time;print(max(0.1,($DEADLINE-time.time())/3600))")
     WATCHER_MAX_HOURS="$left_h" python tools/chip_watcher.py
-    ok=$(python - "$ROUND" <<'EOF'
-import json, sys
-try:
-    s = json.load(open(f"WATCHER_STATUS_{sys.argv[1]}.json"))
-    stages = [r for r in s.get("stages", []) if "rc" in r or "skipped" in r]
-    done = s.get("state") == "done" and stages and all(
-        r.get("rc") == 0 or r.get("skipped") for r in stages)
-    print(1 if done else 0)
-except Exception:
-    print(0)
-EOF
-)
-    [ "$ok" = 1 ] && { echo "[watch_loop] all stages landed"; exit 0; }
+    if python tools/chip_watcher.py --check-complete; then
+        echo "[watch_loop] all stages landed"
+        exit 0
+    fi
     echo "[watch_loop] battery incomplete (arm $arms/$MAX_ARMS); re-arming in 60s"
     sleep 60
 done
