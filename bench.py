@@ -214,13 +214,41 @@ def main() -> None:
     if fail is not None:
         if out and not out.endswith("\n"):
             print()     # a killed child may leave a partial line behind
+        stage_error = {"metric": "bench_stage_error", "value": None,
+                       "unit": "", "vs_baseline": None,
+                       "error": f"measure: {fail}: {err[-300:]}"[:500]}
         if _has_real_metric(out):
             # Partial success: headline survived; record the stage failure
             # under a non-colliding metric name.
-            print(json.dumps({"metric": "bench_stage_error", "value": None,
-                              "unit": "", "vs_baseline": None,
-                              "error": f"measure: {fail}: {err[-300:]}"[:500]}))
+            print(json.dumps(stage_error))
         else:
+            # The child died before ANY metric (tunnel wedged mid-train).
+            # In-round chip evidence that already landed must still reach
+            # the round's record: relay it (headline last) and exit 3 —
+            # the partial-success code — instead of the rc=2 nothing.
+            landed = _landed_window_lines(
+                os.environ.get("G2VEC_BENCH_WINDOW_DIR") or None)
+            if landed:
+                print(json.dumps(stage_error))
+                reason = "this run's chip measurement died pre-metric"
+                headline = landed.pop("cbow_train_paths_per_sec_per_chip",
+                                      None)
+                for metric in landed:
+                    print(json.dumps(_relay_line(*landed[metric],
+                                                 reason=reason)))
+                if headline:
+                    print(json.dumps(_relay_line(*headline, reason=reason)))
+                else:
+                    # The headline metric must always close the record —
+                    # as an explicit honest null when no window landed it
+                    # (same contract as _fail/_hostonly) — so the
+                    # driver's parsed last line stays semantic.
+                    print(json.dumps(
+                        {"metric": "cbow_train_paths_per_sec_per_chip",
+                         "value": None, "unit": "paths/s",
+                         "vs_baseline": None,
+                         "error": f"measure: {fail}"[:500]}))
+                sys.exit(3)
             _fail("measure", f"{fail}: {err[-300:]}")
 
 

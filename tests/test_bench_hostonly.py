@@ -129,6 +129,68 @@ def test_probe_failure_falls_back_and_exits_3(tmp_path):
     assert lines[-1]["value"] > 0
 
 
+def test_measure_death_pre_metric_relays_and_exits_3(tmp_path):
+    """A chip bench whose measure child dies before ANY metric (the
+    mid-train tunnel wedge) must still put the landed in-round window
+    evidence into the round's record: relayed lines, headline last,
+    rc=3 (partial) instead of the rc=2 nothing."""
+    (tmp_path / "BENCH_LOCAL_r05.json").write_text(json.dumps(
+        {"stage": "bench", "rc": 0, "lines": [
+            {"metric": "cbow_train_paths_per_sec_per_chip",
+             "value": 5591382.3, "unit": "paths/s", "vs_baseline": 338.68},
+            {"metric": "walker_walks_per_sec", "value": 8107.2,
+             "unit": "walks/s"}]}))
+    proc = subprocess.run(
+        [sys.executable, BENCH], capture_output=True, text=True,
+        timeout=300,
+        env={**os.environ, **_TOY,
+             "G2VEC_BENCH_WINDOW_DIR": str(tmp_path),
+             "G2VEC_BENCH_PLATFORM": "cpu",
+             # Poison only the child's runtime (the parent never calls
+             # make_paths): 0 genes makes the train stage raise before
+             # its first metric line.
+             "G2VEC_BENCH_N_GENES": "0",
+             "G2VEC_BENCH_TOTAL_BUDGET": "200",
+             "G2VEC_BENCH_TIMEOUT": "90",
+             "G2VEC_BENCH_CHILD_BUDGET": "80"})
+    assert proc.returncode == 3, (proc.returncode, proc.stderr[-800:])
+    lines = [json.loads(ln) for ln in proc.stdout.splitlines()
+             if ln.strip().startswith("{")]
+    assert any(d["metric"] == "bench_stage_error" for d in lines)
+    assert lines[-1]["metric"] == "cbow_train_paths_per_sec_per_chip"
+    assert lines[-1]["value"] == 5591382.3
+    assert "died pre-metric" in lines[-1]["relay_note"]
+    walker = [d for d in lines if d["metric"] == "walker_walks_per_sec"
+              and d.get("chip_window_relay")]
+    assert walker and walker[0]["value"] == 8107.2
+
+
+def test_measure_death_without_landed_headline_closes_on_null(tmp_path):
+    """Same pre-metric death, but the window never landed the headline:
+    the record still relays what exists and must CLOSE on an explicit
+    null headline line (the driver's parsed result stays semantic)."""
+    (tmp_path / "BENCH_LOCAL_r05.json").write_text(json.dumps(
+        {"stage": "bench", "rc": 0, "lines": [
+            {"metric": "walker_walks_per_sec", "value": 8107.2,
+             "unit": "walks/s"}]}))
+    proc = subprocess.run(
+        [sys.executable, BENCH], capture_output=True, text=True,
+        timeout=300,
+        env={**os.environ, **_TOY,
+             "G2VEC_BENCH_WINDOW_DIR": str(tmp_path),
+             "G2VEC_BENCH_PLATFORM": "cpu",
+             "G2VEC_BENCH_N_GENES": "0",
+             "G2VEC_BENCH_TOTAL_BUDGET": "200",
+             "G2VEC_BENCH_TIMEOUT": "90",
+             "G2VEC_BENCH_CHILD_BUDGET": "80"})
+    assert proc.returncode == 3, (proc.returncode, proc.stderr[-800:])
+    lines = [json.loads(ln) for ln in proc.stdout.splitlines()
+             if ln.strip().startswith("{")]
+    assert lines[-1]["metric"] == "cbow_train_paths_per_sec_per_chip"
+    assert lines[-1]["value"] is None and "measure:" in lines[-1]["error"]
+    assert any(d.get("chip_window_relay") for d in lines)
+
+
 def test_landed_window_lines_provenance_rules(tmp_path):
     """Harvest rules: relayed/host-fallback lines are never re-harvested
     (their provenance would be rewritten to the wrong artifact), and the
