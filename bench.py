@@ -426,11 +426,13 @@ def _landed_window_lines(window_dir: "str | None" = None) -> dict:
             continue
         for d in rec.get("lines", []):
             # Only direct chip measurements: a line that is itself a
-            # relay, or a host-side fallback line, must not be re-relayed
-            # under a claim of being chip-measured in that artifact.
+            # relay, a host-side fallback line, or an artifact-carried
+            # value (from_artifact) must not be re-relayed under a claim
+            # of being chip-measured in that artifact.
             if isinstance(d, dict) and d.get("metric") \
                     and d.get("value") is not None \
                     and "chip_window_relay" not in d \
+                    and "from_artifact" not in d \
                     and not d.get("chip_free_fallback"):
                 out[d["metric"]] = (d, os.path.basename(path))
     return out
@@ -443,6 +445,40 @@ def _relay_line(line: dict, artifact: str,
             "relay_note": "measured on the real chip by the in-round "
                           "watcher battery (artifact committed at HEAD); "
                           f"relayed because {reason}"}
+
+
+def _acceptance_relay_line(artifact_dir: "str | None" = None,
+                           skip_reason: str =
+                           "G2VEC_BENCH_SKIP_ACCEPT (dedicated watcher "
+                           "stage owns the refresh)") -> dict:
+    """The acceptance stage's carry line: when TPU_ACCEPTANCE.json was
+    already produced AT THIS code state (by the dedicated watcher stage
+    or an earlier bench run) its acc_val is carried into this record
+    (with its source named) so the bench record stays self-contained;
+    otherwise the honest skip with ``skip_reason``."""
+    line = {"metric": "tpu_acceptance_acc_val", "value": None,
+            "unit": "", "vs_baseline": None, "skipped": skip_reason}
+    try:
+        from tools.tpu_acceptance import _code_key
+
+        here = artifact_dir or os.path.dirname(os.path.abspath(__file__))
+        with open(os.path.join(here, "TPU_ACCEPTANCE.json")) as f:
+            art = json.load(f)
+        if art.get("code_key") == _code_key() \
+                and art.get("acc_val") is not None:
+            ref_acc = art["reference_transcript"]["acc_val"]
+            line = {"metric": "tpu_acceptance_acc_val",
+                    "value": round(art["acc_val"], 4),
+                    "unit": "ACC[val]",
+                    "vs_baseline": round(art["acc_val"] / ref_acc, 3),
+                    "n_paths": art.get("n_paths"),
+                    "pipeline_wall_seconds":
+                        art.get("pipeline_wall_seconds"),
+                    "from_artifact": "TPU_ACCEPTANCE.json (dedicated "
+                                     "watcher stage, code_key match)"}
+    except Exception:  # noqa: BLE001 — fall back to the skip line
+        pass
+    return line
 
 
 def _hostonly() -> None:
@@ -1170,9 +1206,10 @@ def _measure() -> None:
             except ValueError:
                 recorded = None
             if recorded and recorded == _code_key():
-                emit({"metric": "tpu_acceptance_acc_val", "value": None,
-                      "unit": "", "vs_baseline": None,
-                      "skipped": "already recorded at this code state"})
+                # Carry the fresh artifact's acc_val so this record is
+                # self-contained (falls back to the skip if unreadable).
+                emit(_acceptance_relay_line(
+                    skip_reason="already recorded at this code state"))
                 return
 
         # Abort cleanly if the run outlives the remaining budget: later
@@ -1204,11 +1241,10 @@ def _measure() -> None:
         # window #1: the tunnel died inside one of those compiles; SIGALRM
         # can't interrupt a blocked native call, so the stage held the
         # child until the parent's hard kill and every later line was
-        # lost.)
-        emit({"metric": "tpu_acceptance_acc_val", "value": None,
-              "unit": "", "vs_baseline": None,
-              "skipped": "G2VEC_BENCH_SKIP_ACCEPT (dedicated watcher "
-                         "stage owns the refresh)"})
+        # lost.) If that stage already refreshed the artifact AT THIS
+        # code state, carry its acc_val here so this bench record is
+        # self-contained.
+        emit(_acceptance_relay_line())
     else:
         guarded("tpu_acceptance_acc_val", 180, tpu_acceptance)
     # After the acceptance stage so a just-written TPU_ACCEPTANCE.json (with

@@ -191,6 +191,37 @@ def test_measure_death_without_landed_headline_closes_on_null(tmp_path):
     assert any(d.get("chip_window_relay") for d in lines)
 
 
+def test_acceptance_relay_line_codekey_gated(tmp_path, monkeypatch):
+    """SKIP_ACCEPT's line carries the dedicated stage's acc_val only when
+    the artifact's code_key matches the current tree; anything else (or
+    no artifact) stays the honest skip."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+        import tools.tpu_acceptance as acc
+    finally:
+        sys.path.remove(REPO)
+    monkeypatch.setattr(acc, "_code_key", lambda: "tree-NOW")
+
+    line = bench._acceptance_relay_line(str(tmp_path))
+    assert line["value"] is None and "skipped" in line   # no artifact
+
+    (tmp_path / "TPU_ACCEPTANCE.json").write_text(json.dumps(
+        {"code_key": "tree-OLD", "acc_val": 0.89,
+         "reference_transcript": {"acc_val": 0.8812}}))
+    line = bench._acceptance_relay_line(str(tmp_path))
+    assert line["value"] is None                         # stale code_key
+
+    (tmp_path / "TPU_ACCEPTANCE.json").write_text(json.dumps(
+        {"code_key": "tree-NOW", "acc_val": 0.8948, "n_paths": 40014,
+         "pipeline_wall_seconds": 31.2,
+         "reference_transcript": {"acc_val": 0.8812}}))
+    line = bench._acceptance_relay_line(str(tmp_path))
+    assert line["value"] == 0.8948
+    assert line["vs_baseline"] == round(0.8948 / 0.8812, 3)
+    assert "TPU_ACCEPTANCE.json" in line["from_artifact"]
+
+
 def test_landed_window_lines_provenance_rules(tmp_path):
     """Harvest rules: relayed/host-fallback lines are never re-harvested
     (their provenance would be rewritten to the wrong artifact), and the
@@ -212,7 +243,9 @@ def test_landed_window_lines_provenance_rules(tmp_path):
             {"metric": "cbow_train_paths_per_sec_per_chip",
              "value": 5591382.3, "chip_window_relay": "BENCH_LOCAL_r05.json"},
             {"metric": "walker_native_walks_per_sec", "value": 94213.0,
-             "chip_free_fallback": True}]}))
+             "chip_free_fallback": True},
+            {"metric": "tpu_acceptance_acc_val", "value": 0.8948,
+             "from_artifact": "TPU_ACCEPTANCE.json"}]}))
     # Identical mtimes (fresh-checkout shape): r05b must still win by name.
     os.utime(tmp_path / "BENCH_LOCAL_r05.json", (1_900_000_000,) * 2)
     os.utime(tmp_path / "BENCH_LOCAL_r05b.json", (1_900_000_000,) * 2)
@@ -221,6 +254,7 @@ def test_landed_window_lines_provenance_rules(tmp_path):
     assert landed["walker_walks_per_sec"][1] == "BENCH_LOCAL_r05b.json"
     assert "cbow_train_paths_per_sec_per_chip" not in landed
     assert "walker_native_walks_per_sec" not in landed
+    assert "tpu_acceptance_acc_val" not in landed   # artifact-carried
 
 
 def test_measure_child_budget_skip_relays_landed_lines(tmp_path):
