@@ -401,18 +401,24 @@ def _landed_window_lines(window_dir: "str | None" = None) -> dict:
     BENCH_LOCAL_{round}*.json). A dead tunnel at driver bench time must
     not erase chip numbers that DID land at HEAD earlier in the round —
     the fallback relays them with provenance instead of printing nulls.
-    Round-scoped glob (G2VEC_BENCH_WINDOW_ROUND, default r05, same
-    convention as WATCHER_ROUND) so a later round can never relay a
-    stale round's lines as current. Later files win per metric."""
+    Round-scoped glob (G2VEC_BENCH_WINDOW_ROUND, or the watcher's
+    WATCHER_ROUND — itself defaulted from the single-sourced tools/ROUND
+    file) so a later round can never relay a stale round's lines as
+    current. With NEITHER env var set the relay is SKIPPED with a warning
+    (ADVICE r5 #2): guessing a round here is exactly how stale numbers
+    get re-stamped as current. Later files win per metric."""
     import glob as _glob
 
     here = window_dir if window_dir is not None \
         else os.path.dirname(os.path.abspath(__file__))
-    # One shared round source with the watcher (WATCHER_ROUND), so a new
-    # round that bumps the watcher's suffix cannot leave this glob
-    # serving the previous round's numbers as current.
     rnd = os.environ.get("G2VEC_BENCH_WINDOW_ROUND") \
-        or os.environ.get("WATCHER_ROUND") or "r05"
+        or os.environ.get("WATCHER_ROUND")
+    if not rnd:
+        print("# window-relay skipped: neither G2VEC_BENCH_WINDOW_ROUND "
+              "nor WATCHER_ROUND is set, so the current round is unknown "
+              "(the watcher exports it from tools/ROUND)", file=sys.stderr,
+              flush=True)
+        return {}
     out = {}
     # (mtime, name): deterministic when a fresh checkout flattens mtimes —
     # BENCH_LOCAL_r05 < _r05b lexicographically matches window order.
@@ -438,13 +444,26 @@ def _landed_window_lines(window_dir: "str | None" = None) -> dict:
     return out
 
 
+# Metrics whose measurement runs on the HOST even during a chip window
+# (the native C++ sampler never touches the accelerator): a relay of one
+# of these must not be stamped with chip provenance (ADVICE r5 #1/#3).
+HOST_SIDE_METRICS = frozenset({
+    "walker_native_walks_per_sec",
+    "config2_walker_native_walks_per_sec",
+})
+
+
 def _relay_line(line: dict, artifact: str,
                 reason: str = "no TPU backend is usable at driver bench "
                               "time") -> dict:
+    host_side = (line.get("metric") in HOST_SIDE_METRICS
+                 or bool(line.get("chip_free_fallback")))
+    where = "measuring host, not the chip" if host_side else "real chip"
     return {**line, "chip_window_relay": artifact,
-            "relay_note": "measured on the real chip by the in-round "
-                          "watcher battery (artifact committed at HEAD); "
-                          f"relayed because {reason}"}
+            "relay_measured_on": "host-cpu" if host_side else "tpu",
+            "relay_note": "measured during the in-round chip window by "
+                          f"the watcher battery (on the {where}; artifact "
+                          f"committed at HEAD); relayed because {reason}"}
 
 
 def _acceptance_relay_line(artifact_dir: "str | None" = None,

@@ -15,6 +15,16 @@ def main(argv=None) -> int:
     from g2vec_tpu.config import config_from_args
 
     cfg = config_from_args(argv)
+    if cfg.supervise:
+        # Child-process supervision: the supervisor re-invokes this module
+        # (minus its own flags, plus --resume) so even a SIGKILL'd child —
+        # the shape of a real TPU preemption — is restarted from its last
+        # checkpoint. Checked BEFORE any jax/platform setup: the supervisor
+        # process itself must hold no accelerator state.
+        from g2vec_tpu.resilience.supervisor import supervise_cli
+
+        return supervise_cli(cfg, list(argv) if argv is not None
+                             else sys.argv[1:])
     if cfg.platform == "cpu" and cfg.mesh_shape:
         # Virtual-device convenience: an NxM mesh on CPU means the user wants
         # the sharding dry-run — give them the devices. XLA reads this flag

@@ -83,6 +83,10 @@ class G2VecConfig:
                                      # runs skip the ~20-40s TPU compiles that
                                      # dominate a cold pipeline's wall clock
     checkpoint_dir: Optional[str] = None
+    checkpoint_every: int = 25       # epochs between trainer checkpoints
+                                     # (also the device chunk size while
+                                     # checkpointing — a chunk boundary is
+                                     # a save point)
     resume: bool = False
     # "single": one gathered npz (process-0 write, broadcast restore; dir
     # need not be shared). "sharded": orbax OCDBT per-process shards (no
@@ -91,6 +95,16 @@ class G2VecConfig:
     metrics_jsonl: Optional[str] = None
     use_native_io: bool = True       # use the C++ TSV reader when available
     debug_nans: bool = False
+
+    # ---- resilience (resilience/) ----
+    supervise: bool = False          # wrap the run in the auto-resume
+                                     # supervisor (bounded retries, backoff,
+                                     # re-enter via --resume)
+    supervise_retries: int = 3       # retries after the first failure
+    supervise_backoff: float = 1.0   # backoff base seconds (doubles/retry)
+    fault_plan: Optional[str] = None  # injection spec, e.g.
+                                     # "stage=train,epoch=40,kind=crash"
+                                     # (resilience/faults.py docstring)
 
     # ---- multi-host (parallel/distributed.py) ----
     distributed: bool = False        # join the multi-process JAX runtime
@@ -138,6 +152,20 @@ class G2VecConfig:
             raise ValueError(
                 f"walker_backend must be auto|device|native, "
                 f"got {self.walker_backend}")
+        if self.checkpoint_every < 1:
+            raise ValueError(
+                f"checkpoint_every must be >= 1, got {self.checkpoint_every}")
+        if self.supervise_retries < 0:
+            raise ValueError(
+                f"supervise_retries must be >= 0, got {self.supervise_retries}")
+        if self.supervise_backoff < 0.0:
+            raise ValueError(
+                f"supervise_backoff must be >= 0, got {self.supervise_backoff}")
+        if self.fault_plan:
+            # Fail at config time with the offending token, not mid-run.
+            from g2vec_tpu.resilience.faults import parse_plan
+
+            parse_plan(self.fault_plan)
 
 
 def _version() -> str:
@@ -209,6 +237,9 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--profile-dir", type=str, default=None,
                         help="Write a jax.profiler trace of the run here.")
     parser.add_argument("--checkpoint-dir", type=str, default=None)
+    parser.add_argument("--checkpoint-every", type=int, default=25,
+                        help="Epochs between trainer checkpoints "
+                             "(default 25).")
     parser.add_argument("--resume", action="store_true")
     parser.add_argument("--checkpoint-layout", type=str, default="single",
                         choices=("single", "sharded"),
@@ -220,6 +251,24 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--no-native-io", action="store_true",
                         help="Disable the C++ TSV reader.")
     parser.add_argument("--debug-nans", action="store_true")
+    # resilience
+    parser.add_argument("--supervise", action="store_true",
+                        help="Run under the auto-resume supervisor: bounded "
+                             "retries with exponential backoff; retryable "
+                             "failures (preemption, OOM, worker death) "
+                             "re-enter via --resume, fatal ones (bad input, "
+                             "config errors) stop immediately.")
+    parser.add_argument("--supervise-retries", type=int, default=3,
+                        help="Retry budget for --supervise (default 3).")
+    parser.add_argument("--supervise-backoff", type=float, default=1.0,
+                        help="Backoff base seconds for --supervise; doubles "
+                             "per retry, jittered (default 1.0).")
+    parser.add_argument("--fault-plan", type=str, default=None,
+                        metavar="SPEC",
+                        help="Inject faults at named seams, e.g. "
+                             "'stage=train,epoch=40,kind=crash' "
+                             "(kinds: crash|fatal|sigkill|stall|corrupt; "
+                             "equivalently env G2VEC_FAULT_PLAN).")
     # multi-host
     parser.add_argument("--distributed", action="store_true",
                         help="Join the multi-process JAX runtime (one process "
@@ -269,11 +318,16 @@ def config_from_args(argv=None) -> G2VecConfig:
         profile_dir=args.profile_dir,
         compilation_cache=args.compilation_cache,
         checkpoint_dir=args.checkpoint_dir,
+        checkpoint_every=args.checkpoint_every,
         resume=args.resume,
         checkpoint_layout=args.checkpoint_layout,
         metrics_jsonl=args.metrics_jsonl,
         use_native_io=not args.no_native_io,
         debug_nans=args.debug_nans,
+        supervise=args.supervise,
+        supervise_retries=args.supervise_retries,
+        supervise_backoff=args.supervise_backoff,
+        fault_plan=args.fault_plan,
         distributed=args.distributed,
         coordinator=args.coordinator,
         process_id=args.process_id,

@@ -44,6 +44,12 @@ def _configure(lib: ctypes.CDLL) -> None:
 
 
 def _load() -> ctypes.CDLL:
+    # Fault seam: an injected crash here (InjectedFault IS a RuntimeError)
+    # exercises the caller's fallback-to-Python-parser path, the same
+    # degradation a segfault-poisoned .so would force.
+    from g2vec_tpu.resilience.faults import fault_point
+
+    fault_point("native_load")
     return build_and_load(_SRC, _SO, [], _configure)
 
 

@@ -123,7 +123,11 @@ void* g2v_expr_read(const char* path, char* err, int errlen) try {
   size_t n_samples = expr->samples.size();
   size_t n_genes = gene_rows.size();
   if (n_genes == 0) {
-    fail(err, errlen, std::string(path) + ": no gene rows after the header");
+    // Same wording contract as the Python reader: actionable, names the
+    // file shape the caller must fix.
+    fail(err, errlen, std::string(path) +
+                          ": expression file needs a header and at least "
+                          "one gene row");
     return nullptr;
   }
   expr->genes.reserve(n_genes);
@@ -132,10 +136,19 @@ void* g2v_expr_read(const char* path, char* err, int errlen) try {
   for (size_t j = 0; j < n_genes; ++j) {
     split_fields(gene_rows[j].first, gene_rows[j].second, &fields);
     if (fields.size() != n_samples + 1) {
+      // Name the offending gene (Python-reader parity): a truncated row
+      // in a million-line TSV is unfindable by row count alone.
+      std::string gene(fields.empty() ? "" : fields[0].first,
+                       fields.empty()
+                           ? 0
+                           : static_cast<size_t>(fields[0].second -
+                                                 fields[0].first));
       fail(err, errlen,
            std::string(path) + ": gene row " + std::to_string(j + 2) +
-               " has " + std::to_string(fields.size() - 1) +
-               " values, expected " + std::to_string(n_samples));
+               " ('" + gene + "') has " +
+               std::to_string(fields.empty() ? 0 : fields.size() - 1) +
+               " values, expected " + std::to_string(n_samples) +
+               " (one per sample in the header)");
       return nullptr;
     }
     expr->genes.emplace_back(fields[0].first,

@@ -49,6 +49,12 @@ def _configure(lib: ctypes.CDLL) -> None:
 def load() -> ctypes.CDLL:
     """Build/load the library (RuntimeError when unavailable). Public so
     benchmarks can warm the one-time compile outside their timed region."""
+    # Fault seam: an injected crash (a RuntimeError) makes the "auto"
+    # backend resolution degrade to the device walker — the path a host
+    # with a broken toolchain takes.
+    from g2vec_tpu.resilience.faults import fault_point
+
+    fault_point("native_walker_load")
     return build_and_load(_SRC, _SO, ["-pthread"], _configure)
 
 

@@ -22,6 +22,7 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 from g2vec_tpu.config import G2VecConfig
+from g2vec_tpu.resilience.faults import fault_point, install_plan
 
 
 @dataclasses.dataclass
@@ -90,6 +91,11 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
     from g2vec_tpu.utils.timing import StageTimer
 
     cfg.validate()
+    if cfg.fault_plan:
+        # Config-driven fault injection (tests/chaos drills); the env-var
+        # form needs no install. Re-installing on a supervised retry keeps
+        # already-fired once-only entries fired.
+        install_plan(cfg.fault_plan)
     if cfg.debug_nans:
         jax.config.update("jax_debug_nans", True)
     if cfg.compilation_cache:
@@ -119,7 +125,9 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
                                       profile_dir=None)
 
     timer = StageTimer()
-    metrics = MetricsWriter(cfg.metrics_jsonl)
+    # A resumed run APPENDS: its records continue the interrupted attempt's
+    # stream (and the supervisor's retry/resume events in between survive).
+    metrics = MetricsWriter(cfg.metrics_jsonl, append=cfg.resume)
     if cfg.profile_dir:
         jax.profiler.start_trace(cfg.profile_dir)
 
@@ -130,12 +138,14 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
                                   for f in dataclasses.fields(cfg)})
 
         console(">>> 1. Load data")
+        fault_point("load")
         with timer.stage("load"):
             data = load_expression(cfg.expression_file, use_native=cfg.use_native_io)
             clinical = load_clinical(cfg.clinical_file)
             network = load_network(cfg.network_file)
 
         console(">>> 2. Preprocess data")
+        fault_point("preprocess")
         with timer.stage("preprocess"):
             data.label = match_labels(clinical, data.sample)
             common = find_common_genes(network.genes, data.gene)
@@ -166,6 +176,7 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
 
         walker_backend = resolve_walker_backend(cfg)
         path_sets = []
+        fault_point("paths")
         with timer.stage("paths"):
             for i, group in enumerate(["g", "p"]):
                 expr_group = data.expr[data.label == i]
@@ -233,6 +244,7 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
             reporter.on_epoch(step, acc_val, acc_tr, secs)
             metrics.emit("epoch", step=step, acc_val=acc_val, acc_tr=acc_tr, secs=secs)
 
+        fault_point("train")
         with timer.stage("train"):
             result = train_cbow(
                 paths, labels, packed_genes=n_genes,
@@ -242,6 +254,7 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
                 compute_dtype=cfg.compute_dtype, param_dtype=cfg.param_dtype,
                 seed=cfg.seed, mesh_ctx=mesh_ctx, on_epoch=on_epoch,
                 checkpoint_dir=cfg.checkpoint_dir, resume=cfg.resume,
+                checkpoint_every=cfg.checkpoint_every,
                 checkpoint_layout=cfg.checkpoint_layout)
         if result.stopped_early:
             reporter.on_stop(result.stop_epoch, result.acc_val, result.acc_tr)
@@ -251,6 +264,7 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
                      stopped_early=result.stopped_early)
 
         console(">>> 5. Find L-groups")
+        fault_point("lgroups")
         with timer.stage("lgroups"):
             lgroup_idx = find_lgroups(
                 result.w_ih, data.gene, gene_freq,
@@ -258,6 +272,7 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
                 compat_tiebreak=cfg.compat_lgroup_tiebreak, iters=cfg.kmeans_iters)
 
         console(">>> 6. Select biomarkers with gene scores")
+        fault_point("biomarkers")
         with timer.stage("biomarkers"):
             biomarkers, _ = select_biomarkers(
                 result.w_ih, data.expr, data.label, data.gene, lgroup_idx,
@@ -269,6 +284,7 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
             from g2vec_tpu.parallel.distributed import is_coordinator
 
             write_outputs = is_coordinator()
+        fault_point("save")
         with timer.stage("save"):
             outputs = []
             if write_outputs:

@@ -1,0 +1,28 @@
+"""Resilience subsystem: fault injection, checkpoint integrity, auto-resume.
+
+Three cooperating layers (none of which the reference has — a single fault
+kills its whole run with no recovery path):
+
+- :mod:`g2vec_tpu.resilience.faults` — a config/env-driven fault plan that
+  can raise, SIGKILL, stall, or corrupt bytes at named seams (stage
+  boundaries, the epoch loop, checkpoint writes, native-library loads).
+  Zero-cost when no plan is set; exists so the recovery paths below are
+  continuously testable instead of exercised only by real outages.
+- checkpoint integrity — ``train/checkpoint.py`` writes a sidecar manifest
+  (per-leaf sha256 + config fingerprint + schema version) with every save
+  and verifies it on load, falling back to the kept-previous checkpoint on
+  corruption.
+- :mod:`g2vec_tpu.resilience.supervisor` — wraps ``pipeline.run`` in a
+  bounded retry loop (exponential backoff + jitter), classifies failures as
+  retryable vs fatal, re-enters via resume, and emits ``retry`` / ``resume``
+  / ``gave_up`` events to the MetricsWriter JSONL stream.
+
+This package must stay importable without jax: the fault hooks run inside
+modules (native bindings, CLI entry) that are deliberately jax-free.
+"""
+from g2vec_tpu.resilience.faults import (FaultPlanError, InjectedFatal,
+                                         InjectedFault, fault_point,
+                                         install_plan)
+
+__all__ = ["fault_point", "install_plan", "InjectedFault", "InjectedFatal",
+           "FaultPlanError"]

@@ -1,0 +1,268 @@
+"""Config/env-driven fault injection at the pipeline's failure seams.
+
+Production embedding systems treat restartability as a first-class design
+axis; a recovery path that is never exercised is a recovery path that does
+not work. This module makes every fault mode the supervisor and the
+checkpoint-integrity layer claim to survive *injectable on demand*, so the
+fault-matrix tests (tests/test_resilience.py) can drive them continuously.
+
+A fault plan is one or more ``;``-separated entries of ``,``-separated
+``key=value`` pairs::
+
+    G2VEC_FAULT_PLAN="stage=train,epoch=40,kind=crash"
+    --fault-plan "stage=paths,kind=sigkill;stage=checkpoint_finalize,kind=corrupt"
+
+Keys:
+
+- ``stage`` (required) — the seam name. Pipeline stage boundaries: ``load``,
+  ``preprocess``, ``paths``, ``train``, ``lgroups``, ``biomarkers``,
+  ``save``. Trainer epoch loop: ``train`` with ``epoch=N``. Checkpointing:
+  ``checkpoint_write`` (before the write), ``checkpoint_finalize`` (after
+  the atomic rename — the seam for ``corrupt``). Native libraries:
+  ``native_load`` (TSV parser), ``native_walker_load`` (walk sampler).
+- ``epoch`` — only fire once the hook reports an epoch >= this value
+  (meaningful at the ``train`` seam).
+- ``kind`` — what to do when the seam is hit:
+  ``crash`` (default) raises :class:`InjectedFault` (classified retryable);
+  ``fatal`` raises :class:`InjectedFatal` (classified fatal);
+  ``sigkill`` SIGKILLs the current process — no Python cleanup runs, the
+  exact shape of a TPU preemption;
+  ``stall`` sleeps ``seconds`` (default 300) then raises
+  :class:`InjectedFault`, modelling a wedged collective that a watchdog
+  eventually shoots;
+  ``corrupt`` flips bytes in the middle of the file the seam passes as
+  ``path`` (checkpoint seams) and then RETURNS — a torn write that the
+  writer believes succeeded, detectable only by manifest verification.
+- ``times`` — fire at most this many times (default 1).
+- ``skip`` — let the first N matching hits pass before firing (default 0;
+  e.g. ``stage=checkpoint_finalize,kind=corrupt,skip=1`` corrupts the
+  SECOND checkpoint save, leaving a good ``.prev`` generation behind).
+- ``seconds`` — stall duration for ``kind=stall``.
+
+Fired entries are recorded in ``G2VEC_FAULT_STATE`` (a JSON file) when that
+env var is set, so a one-shot fault stays one-shot ACROSS process restarts —
+without it a supervisor-restarted run would re-hit the same SIGKILL forever.
+The supervisor sets this automatically when it sees a plan and no state path.
+
+Zero-cost when inactive: with no plan installed and no ``G2VEC_FAULT_PLAN``
+in the environment, :func:`fault_point` is one falsy check and a return.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import time
+from typing import List, Optional
+
+ENV_PLAN = "G2VEC_FAULT_PLAN"
+ENV_STATE = "G2VEC_FAULT_STATE"
+
+KINDS = ("crash", "fatal", "sigkill", "stall", "corrupt")
+
+#: The seams the pipeline exposes. fault_point() accepts only these so a
+#: typo'd plan fails at install time, not by silently never firing.
+SEAMS = ("load", "preprocess", "paths", "train", "lgroups", "biomarkers",
+         "save", "checkpoint_write", "checkpoint_finalize",
+         "native_load", "native_walker_load")
+
+
+class FaultPlanError(ValueError):
+    """A malformed --fault-plan / G2VEC_FAULT_PLAN spec."""
+
+
+class InjectedFault(RuntimeError):
+    """An injected RETRYABLE failure (preemption-shaped). Subclasses
+    RuntimeError so every layer that degrades on RuntimeError (native
+    bindings fall back to Python, the supervisor retries) treats it like
+    the real faults it stands in for."""
+
+
+class InjectedFatal(ValueError):
+    """An injected FATAL failure (bad-input-shaped). Subclasses ValueError
+    — the type the readers/config raise — so classification tests exercise
+    the supervisor's real fatal path."""
+
+
+@dataclasses.dataclass
+class _Entry:
+    stage: str
+    kind: str = "crash"
+    epoch: Optional[int] = None
+    times: int = 1
+    skip: int = 0
+    seconds: float = 300.0
+    seen: int = 0       # matching hits so far (this process; drives skip)
+
+    @property
+    def key(self) -> str:
+        return f"{self.stage}:{self.epoch}:{self.kind}"
+
+
+# None = environment not consulted yet; [] = consulted, no plan (the
+# zero-cost steady state for un-faulted runs).
+_plan: Optional[List[_Entry]] = None
+_state_path: Optional[str] = None
+_fired: dict = {}          # entry.key -> count, this process
+_INJECTED_NOTE = "injected by the G2VEC fault plan"
+
+
+def parse_plan(spec: str) -> List[_Entry]:
+    """Parse a plan spec; raises FaultPlanError with the offending token."""
+    entries = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        fields = {}
+        for tok in part.split(","):
+            if "=" not in tok:
+                raise FaultPlanError(
+                    f"fault plan token {tok!r} is not key=value (in {part!r})")
+            k, v = tok.split("=", 1)
+            fields[k.strip()] = v.strip()
+        unknown = set(fields) - {"stage", "kind", "epoch", "times", "skip",
+                                 "seconds"}
+        if unknown:
+            raise FaultPlanError(
+                f"unknown fault plan keys {sorted(unknown)} in {part!r} "
+                "(want stage/kind/epoch/times/skip/seconds)")
+        if "stage" not in fields:
+            raise FaultPlanError(f"fault plan entry {part!r} needs stage=")
+        if fields["stage"] not in SEAMS:
+            raise FaultPlanError(
+                f"unknown fault seam {fields['stage']!r}; seams: "
+                f"{', '.join(SEAMS)}")
+        kind = fields.get("kind", "crash")
+        if kind not in KINDS:
+            raise FaultPlanError(
+                f"unknown fault kind {kind!r}; kinds: {', '.join(KINDS)}")
+        try:
+            entries.append(_Entry(
+                stage=fields["stage"], kind=kind,
+                epoch=int(fields["epoch"]) if "epoch" in fields else None,
+                times=int(fields.get("times", 1)),
+                skip=int(fields.get("skip", 0)),
+                seconds=float(fields.get("seconds", 300.0))))
+        except ValueError as e:
+            raise FaultPlanError(
+                f"non-numeric epoch/times/skip/seconds in {part!r}: "
+                f"{e}") from e
+    return entries
+
+
+def install_plan(spec: Optional[str], state_path: Optional[str] = None) -> None:
+    """Install (or with ``None``/empty spec, clear) the process fault plan.
+
+    Re-installing the same plan does NOT reset which entries already fired
+    in this process — an in-process supervisor retry must not re-trip a
+    once-only fault.
+    """
+    global _plan, _state_path
+    _plan = parse_plan(spec) if spec else []
+    if state_path is not None:
+        _state_path = state_path
+
+
+def _load_state() -> dict:
+    path = _state_path or os.environ.get(ENV_STATE)
+    if not path or not os.path.exists(path):
+        return {}
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _record_fired(entry: _Entry) -> None:
+    _fired[entry.key] = _fired.get(entry.key, 0) + 1
+    path = _state_path or os.environ.get(ENV_STATE)
+    if not path:
+        return
+    state = _load_state()
+    state[entry.key] = state.get(entry.key, 0) + 1
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(state, f)
+    os.replace(tmp, path)
+
+
+def _corrupt_file(path: str) -> None:
+    """Flip a byte run in the middle of ``path`` — a torn write the writer
+    never notices. The file length is preserved (a truncation would be
+    caught by far cruder checks than the manifest hashes)."""
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        if size < 128:
+            f.write(b"\xff" * max(size, 1))
+            return
+        f.seek(size // 2)
+        chunk = f.read(64)
+        f.seek(size // 2)
+        f.write(bytes(b ^ 0xFF for b in chunk))
+
+
+def _fire(entry: _Entry, seam: str, epoch: Optional[int],
+          path: Optional[str]) -> None:
+    where = f"seam={seam}" + (f" epoch={epoch}" if epoch is not None else "")
+    # State is recorded BEFORE the action: a sigkill leaves no later chance,
+    # and a crash must not re-fire on the supervised retry.
+    _record_fired(entry)
+    if entry.kind == "crash":
+        raise InjectedFault(f"injected crash at {where} ({_INJECTED_NOTE})")
+    if entry.kind == "fatal":
+        raise InjectedFatal(f"injected fatal error at {where} "
+                            f"({_INJECTED_NOTE})")
+    if entry.kind == "sigkill":
+        os.kill(os.getpid(), signal.SIGKILL)
+        time.sleep(30)     # unreachable; belt for exotic signal handling
+        raise InjectedFault(f"sigkill at {where} did not terminate")
+    if entry.kind == "stall":
+        time.sleep(entry.seconds)
+        raise InjectedFault(
+            f"injected stall at {where} expired after {entry.seconds}s "
+            f"({_INJECTED_NOTE})")
+    if entry.kind == "corrupt":
+        if not path or not os.path.exists(path):
+            raise InjectedFault(
+                f"kind=corrupt at {where} needs a seam that passes a file "
+                f"path (checkpoint_write/checkpoint_finalize); got "
+                f"path={path!r}")
+        _corrupt_file(path)    # silent: the torn write "succeeds"
+
+
+def fault_point(seam: str, *, epoch: Optional[int] = None,
+                path: Optional[str] = None) -> None:
+    """Hook called at every named seam. No-op unless a plan entry matches.
+
+    ``epoch`` qualifies the ``train`` seam; ``path`` hands ``corrupt``
+    faults their target file (checkpoint seams).
+    """
+    global _plan
+    if _plan is None:
+        _plan = parse_plan(os.environ.get(ENV_PLAN, ""))
+    if not _plan:
+        return
+    persisted = _load_state()
+    for entry in _plan:
+        if entry.stage != seam:
+            continue
+        if entry.epoch is not None and (epoch is None or epoch < entry.epoch):
+            continue
+        fired = max(_fired.get(entry.key, 0), persisted.get(entry.key, 0))
+        if fired >= entry.times:
+            continue
+        entry.seen += 1
+        if entry.seen <= entry.skip:
+            continue
+        _fire(entry, seam, epoch, path)
+
+
+def _reset_for_tests() -> None:
+    """Forget the installed plan, fired counts, and state path."""
+    global _plan, _state_path
+    _plan = None
+    _state_path = None
+    _fired.clear()

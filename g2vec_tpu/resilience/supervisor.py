@@ -1,0 +1,240 @@
+"""Auto-resume supervision: bounded retries around ``pipeline.run``.
+
+Two entry points with one retry policy:
+
+- :func:`supervise` — in-process: call ``pipeline.run(cfg)``, classify any
+  exception, and re-enter with ``resume=True`` after exponential backoff
+  with jitter. Survives everything that surfaces as a Python exception
+  (injected crashes, preemption errors, OOMs, stalls that time out).
+- :func:`supervise_cli` — child-process (the ``--supervise`` CLI path):
+  re-invoke ``python -m g2vec_tpu`` with the original argv (minus the
+  supervisor flags, plus ``--resume``) and classify the child's exit.
+  This is the only mode that survives SIGKILL / hard preemption — the
+  supervisor process itself holds no accelerator state.
+
+Classification (the table is documented in ARCHITECTURE.md):
+
+retryable — preemption/worker-death shapes: ``InjectedFault``,
+``RuntimeError`` (XLA runtime errors — preemption, stale collectives —
+surface here), ``MemoryError``/OOM, ``ConnectionError``, transient
+``OSError``; in child mode any signal exit (negative returncode).
+
+fatal — wrong-input shapes where a retry would burn the whole budget
+reproducing the same error: ``InjectedFatal``, ``ValueError`` (config and
+reader validation errors; unless its message matches a retryable pattern
+like "preempted" or "resource exhausted"), ``TypeError``/``KeyError``/
+``AttributeError``/``ImportError``/``NotImplementedError``,
+``FileNotFoundError``/``PermissionError``/``IsADirectoryError``.
+
+Every decision is emitted to the run's MetricsWriter JSONL stream
+(``retry`` / ``resume`` / ``gave_up`` events, appended so the events from
+all attempts form one stream with the pipeline's own records).
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import re
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Callable, List, Optional
+
+from g2vec_tpu.resilience.faults import (ENV_PLAN, ENV_STATE, InjectedFatal,
+                                         InjectedFault)
+
+# Message patterns that mark an otherwise-fatal-typed exception as
+# preemption/capacity-shaped (jax wraps several of these in ValueError).
+RETRYABLE_MESSAGE = re.compile(
+    r"preempt|out of memory|resource[ _]?exhausted|oom\b|unavailable|"
+    r"deadline|collective|all[- ]reduce|socket closed|connection reset|"
+    r"data[ _]?loss|injected (crash|stall)", re.I)
+
+_FATAL_TYPES = (InjectedFatal, FileNotFoundError, IsADirectoryError,
+                PermissionError, TypeError, KeyError, AttributeError,
+                ImportError, NotImplementedError)
+_RETRYABLE_TYPES = (InjectedFault, MemoryError, ConnectionError)
+
+# Child-mode stderr classification: the last traceback line names the type.
+_FATAL_NAME = re.compile(
+    r"\b(InjectedFatal|ValueError|TypeError|KeyError|AttributeError|"
+    r"ImportError|ModuleNotFoundError|NotImplementedError|"
+    r"FileNotFoundError|PermissionError|IsADirectoryError)\b")
+
+
+def classify_exception(exc: BaseException) -> str:
+    """Return ``"retryable"`` or ``"fatal"`` for an in-process failure."""
+    if isinstance(exc, _RETRYABLE_TYPES):
+        return "retryable"
+    if isinstance(exc, InjectedFatal):
+        return "fatal"
+    if isinstance(exc, ValueError):
+        # Reader/config validation errors are ValueError by contract
+        # (io/readers.py, config.validate) — but jax also ValueError-wraps
+        # some capacity errors, so the message gets a vote.
+        return "retryable" if RETRYABLE_MESSAGE.search(str(exc)) else "fatal"
+    if isinstance(exc, _FATAL_TYPES):
+        return "fatal"
+    # RuntimeError (incl. XlaRuntimeError), OSError, and anything unknown:
+    # assume worker-death shape. The bounded retry budget caps the cost of
+    # guessing wrong; misclassifying a preemption as fatal costs the run.
+    return "retryable"
+
+
+def classify_child(returncode: int, stderr_tail: str) -> str:
+    """Classify a supervised child process exit (``supervise_cli``)."""
+    if returncode < 0:
+        return "retryable"     # killed by signal: preemption-shaped
+    if RETRYABLE_MESSAGE.search(stderr_tail):
+        return "retryable"
+    # InjectedFault is a RuntimeError subclass — retryable — so check it
+    # before the fatal-name scan (which would not match it anyway, but be
+    # explicit about precedence).
+    if "InjectedFault" in stderr_tail:
+        return "retryable"
+    if _FATAL_NAME.search(stderr_tail):
+        return "fatal"
+    return "retryable"
+
+
+@dataclasses.dataclass
+class RetryPolicy:
+    max_retries: int = 3        # retries, not attempts: N+1 runs total
+    backoff_base: float = 1.0   # seconds; doubles per retry
+    backoff_max: float = 60.0
+    jitter: float = 0.25        # +[0, jitter) fraction, decorrelates a fleet
+
+    def delay(self, attempt: int, rng: random.Random) -> float:
+        base = min(self.backoff_max, self.backoff_base * (2.0 ** attempt))
+        return base * (1.0 + self.jitter * rng.random())
+
+
+def _policy_from_cfg(cfg) -> RetryPolicy:
+    return RetryPolicy(max_retries=cfg.supervise_retries,
+                       backoff_base=cfg.supervise_backoff)
+
+
+def _event_writer(cfg):
+    from g2vec_tpu.utils.metrics import MetricsWriter
+
+    return MetricsWriter(cfg.metrics_jsonl, append=True)
+
+
+def supervise(cfg, policy: Optional[RetryPolicy] = None,
+              console: Callable[[str], None] = print,
+              sleep: Callable[[float], None] = time.sleep):
+    """Run the pipeline under in-process supervision; returns its
+    PipelineResult or re-raises the exception that exhausted the policy."""
+    from g2vec_tpu.pipeline import run
+
+    policy = policy if policy is not None else _policy_from_cfg(cfg)
+    rng = random.Random(cfg.seed)
+    attempt = 0
+    while True:
+        try:
+            result = run(cfg, console=console)
+        except BaseException as e:  # noqa: BLE001 — classified right below
+            if isinstance(e, (KeyboardInterrupt, SystemExit)):
+                raise
+            verdict = classify_exception(e)
+            err = f"{type(e).__name__}: {e}"[:500]
+            if verdict == "fatal" or attempt >= policy.max_retries:
+                with _event_writer(cfg) as events:
+                    events.emit("gave_up", attempt=attempt, classified=verdict,
+                                error=err)
+                console(f"[supervisor] giving up after attempt {attempt}: "
+                        f"{verdict} — {err}")
+                raise
+            delay = policy.delay(attempt, rng)
+            with _event_writer(cfg) as events:
+                events.emit("retry", attempt=attempt, classified=verdict,
+                            error=err, delay_seconds=round(delay, 3))
+            console(f"[supervisor] attempt {attempt} failed ({err}); "
+                    f"retrying with --resume in {delay:.1f}s")
+            sleep(delay)
+            attempt += 1
+            cfg = dataclasses.replace(cfg, resume=True)
+            with _event_writer(cfg) as events:
+                events.emit("resume", attempt=attempt,
+                            checkpoint_dir=cfg.checkpoint_dir)
+            continue
+        if attempt:
+            with _event_writer(cfg) as events:
+                events.emit("supervised_done", attempts=attempt + 1)
+        return result
+
+
+def _scrub_supervisor_argv(argv: List[str]) -> List[str]:
+    """Drop the supervisor's own flags from the child argv."""
+    out, skip = [], False
+    for tok in argv:
+        if skip:
+            skip = False
+            continue
+        if tok == "--supervise":
+            continue
+        if tok in ("--supervise-retries", "--supervise-backoff"):
+            skip = True
+            continue
+        if tok.startswith("--supervise-retries=") \
+                or tok.startswith("--supervise-backoff="):
+            continue
+        out.append(tok)
+    return out
+
+
+def supervise_cli(cfg, argv: List[str],
+                  sleep: Callable[[float], None] = time.sleep) -> int:
+    """The ``--supervise`` entry: run ``python -m g2vec_tpu`` children until
+    one succeeds, the policy is exhausted, or a failure classifies fatal.
+    Returns the exit code to hand the shell."""
+    policy = _policy_from_cfg(cfg)
+    rng = random.Random(cfg.seed)
+    child_argv = _scrub_supervisor_argv(list(argv))
+    env = dict(os.environ)
+    if cfg.fault_plan:
+        env[ENV_PLAN] = cfg.fault_plan
+    if env.get(ENV_PLAN) and not env.get(ENV_STATE):
+        # One-shot faults must stay one-shot across child restarts; without
+        # a cross-process state file the same sigkill would fire forever.
+        fd, state = tempfile.mkstemp(prefix="g2vec-fault-state-")
+        os.close(fd)
+        os.unlink(state)        # the fault hook creates it on first fire
+        env[ENV_STATE] = state
+    attempt = 0
+    while True:
+        cmd = [sys.executable, "-m", "g2vec_tpu", *child_argv]
+        if attempt and "--resume" not in child_argv:
+            cmd.append("--resume")
+        proc = subprocess.run(cmd, env=env, stderr=subprocess.PIPE, text=True)
+        if proc.stderr:
+            sys.stderr.write(proc.stderr)
+        if proc.returncode == 0:
+            if attempt:
+                with _event_writer(cfg) as events:
+                    events.emit("supervised_done", attempts=attempt + 1)
+            return 0
+        tail = (proc.stderr or "")[-2000:]
+        verdict = classify_child(proc.returncode, tail)
+        err = f"child rc={proc.returncode}: {tail[-300:].strip()}"[:500]
+        if verdict == "fatal" or attempt >= policy.max_retries:
+            with _event_writer(cfg) as events:
+                events.emit("gave_up", attempt=attempt, classified=verdict,
+                            error=err)
+            print(f"[supervisor] giving up after attempt {attempt}: "
+                  f"{verdict} — rc={proc.returncode}", file=sys.stderr)
+            return proc.returncode if proc.returncode > 0 else 1
+        delay = policy.delay(attempt, rng)
+        with _event_writer(cfg) as events:
+            events.emit("retry", attempt=attempt, classified=verdict,
+                        error=err, delay_seconds=round(delay, 3))
+        print(f"[supervisor] attempt {attempt} failed "
+              f"(rc={proc.returncode}); retrying with --resume in "
+              f"{delay:.1f}s", file=sys.stderr)
+        sleep(delay)
+        attempt += 1
+        with _event_writer(cfg) as events:
+            events.emit("resume", attempt=attempt,
+                        checkpoint_dir=cfg.checkpoint_dir)

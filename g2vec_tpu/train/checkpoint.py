@@ -22,17 +22,36 @@ Both layouts store pytree leaves flattened in deterministic order and keyed
 by index, plus a scalar metadata array — the format never hard-codes optax
 internals. Writes are atomic in both (tmp + rename; orbax does its own
 finalize-rename dance).
+
+Integrity (resilience subsystem): every save also writes a sidecar manifest
+— schema version, per-leaf sha256 + shape + dtype, whole-file sha256
+(single layout) or per-shard-file sha256 (sharded layout), and an optional
+config fingerprint. ``load_state`` verifies the manifest BEFORE trusting
+the checkpoint: a torn or corrupted write is detected up front and the
+load falls back to the kept-previous checkpoint (``.prev`` twin in the
+single layout; the previous numbered dir in the sharded layout) with a
+clear warning, instead of surfacing an opaque unpickling error — and a
+manifest-less checkpoint from an older version still loads (legacy path).
 """
 from __future__ import annotations
 
+import hashlib
+import json
 import os
-from typing import Any, Optional, Tuple
+import time
+import warnings
+from typing import Any, List, Optional, Tuple
 
 import jax
 import numpy as np
 
+from g2vec_tpu.resilience.faults import fault_point
+
 CKPT_NAME = "cbow_state.npz"
 SHARDED_NAME = "cbow_state_ocdbt"
+MANIFEST_SUFFIX = ".manifest.json"
+PREV_SUFFIX = ".prev"
+SCHEMA_VERSION = 1
 
 
 # ``done`` codes in the meta record: the trainer refuses to continue a
@@ -43,18 +62,74 @@ RUN_COMPLETED = 1      # reached max_epochs
 RUN_EARLY_STOPPED = 2  # first val-accuracy dip
 
 
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _sha256_array(arr: np.ndarray) -> str:
+    return hashlib.sha256(np.ascontiguousarray(arr).tobytes()).hexdigest()
+
+
+def _write_json_atomic(path: str, payload: dict) -> None:
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def _load_manifest(ckpt_path: str) -> Optional[dict]:
+    """The sidecar manifest for ``ckpt_path``, or None (legacy/unreadable —
+    unreadable is reported by _verify_single, not here)."""
+    try:
+        with open(ckpt_path + MANIFEST_SUFFIX) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _check_fingerprint(ckpt_path: str, manifest: Optional[dict],
+                       fingerprint: Optional[dict]) -> None:
+    """Raise when the manifest records a DIFFERENT config fingerprint than
+    the resuming run's — same-shape config drift (a changed learning rate,
+    seed, dtype) would otherwise silently blend two runs."""
+    stored = (manifest or {}).get("fingerprint")
+    if not stored or not fingerprint:
+        return      # legacy checkpoint or caller without a fingerprint
+    diffs = {k: (stored.get(k), fingerprint.get(k))
+             for k in set(stored) | set(fingerprint)
+             if stored.get(k) != fingerprint.get(k)}
+    if diffs:
+        shapes = " (these change the checkpoint leaf shapes)" \
+            if {"hidden", "n_genes_pad"} & set(diffs) else ""
+        raise ValueError(
+            f"checkpoint {ckpt_path} was written under a different config — "
+            + "; ".join(f"{k}: checkpoint={a!r} vs current={b!r}"
+                        for k, (a, b) in sorted(diffs.items()))
+            + f"{shapes} — restore the original flags or point "
+              "--checkpoint-dir at a fresh directory")
+
+
 def save_state(directory: str, params: Any, opt_state: Any, snapshot: Any,
                epoch: int, before_val: float, before_tr: float,
-               done: int = RUN_IN_PROGRESS, layout: str = "single") -> str:
+               done: int = RUN_IN_PROGRESS, layout: str = "single",
+               fingerprint: Optional[dict] = None) -> str:
     """Atomically write the full trainer state under ``directory``.
 
     Collective: every process must call it. ``layout="single"`` gathers and
     process 0 writes one npz; ``layout="sharded"`` writes per-process orbax
     shards and never gathers (see module docstring for the trade-off).
+    ``fingerprint`` (a flat dict of config scalars) is recorded in the
+    integrity manifest and checked on resume.
     """
     meta = np.array([float(epoch), before_val, before_tr, float(done)])
     if layout == "sharded":
-        return _save_sharded(directory, (params, opt_state, snapshot), meta)
+        return _save_sharded(directory, (params, opt_state, snapshot), meta,
+                             fingerprint)
     if layout != "single":
         raise ValueError(f"unknown checkpoint layout {layout!r}")
     from g2vec_tpu.parallel.distributed import fetch_global
@@ -66,10 +141,34 @@ def save_state(directory: str, params: Any, opt_state: Any, snapshot: Any,
     if jax.process_index() != 0:
         return path
     os.makedirs(directory, exist_ok=True)
+    fault_point("checkpoint_write", path=path)
     tmp = path + ".tmp"
     np.savez(tmp, **arrays)
     # np.savez appends .npz to names without it.
-    os.replace(tmp + ".npz" if os.path.exists(tmp + ".npz") else tmp, path)
+    written = tmp + ".npz" if os.path.exists(tmp + ".npz") else tmp
+    manifest = {
+        "schema": SCHEMA_VERSION, "layout": "single",
+        "file_sha256": _sha256_file(written),
+        "leaves": [{"name": f"leaf_{i}",
+                    "sha256": _sha256_array(arrays[f"leaf_{i}"]),
+                    "shape": list(np.shape(arrays[f"leaf_{i}"])),
+                    "dtype": str(arrays[f"leaf_{i}"].dtype)}
+                   for i in range(len(leaves))],
+        "meta": [float(x) for x in meta],
+        "fingerprint": fingerprint,
+        "written_unix": int(time.time()),
+    }
+    if os.path.exists(path):
+        # Keep-previous: the last committed checkpoint (and its manifest)
+        # survives as ``.prev`` until the new one is fully in place — the
+        # fallback load_state consults when the latest fails verification.
+        os.replace(path, path + PREV_SUFFIX)
+        if os.path.exists(path + MANIFEST_SUFFIX):
+            os.replace(path + MANIFEST_SUFFIX,
+                       path + PREV_SUFFIX + MANIFEST_SUFFIX)
+    os.replace(written, path)
+    _write_json_atomic(path + MANIFEST_SUFFIX, manifest)
+    fault_point("checkpoint_finalize", path=path)
     return path
 
 
@@ -86,10 +185,32 @@ def _leaf_dict(tree: Any, meta: Optional[np.ndarray] = None) -> dict:
 _LATEST_NAME = SHARDED_NAME + ".LATEST"
 
 
-def _save_sharded(directory: str, state: Any, meta: np.ndarray) -> str:
+def _write_sharded_manifest(path: str, meta: np.ndarray,
+                            fingerprint: Optional[dict]) -> None:
+    """Integrity manifest for one numbered OCDBT dir: every file with its
+    size and sha256. The full state never materializes on one host in this
+    layout, so integrity is per shard FILE, not per logical leaf. The
+    manifest is a SIBLING (``<dir>.manifest.json``) — orbax owns the dir's
+    contents and must not find foreign files inside it."""
+    files = {}
+    for root, _, names in os.walk(path):
+        for n in sorted(names):
+            fp = os.path.join(root, n)
+            files[os.path.relpath(fp, path)] = {
+                "size": os.path.getsize(fp), "sha256": _sha256_file(fp)}
+    _write_json_atomic(path + MANIFEST_SUFFIX, {
+        "schema": SCHEMA_VERSION, "layout": "sharded", "files": files,
+        "meta": [float(x) for x in meta], "fingerprint": fingerprint,
+        "written_unix": int(time.time())})
+
+
+def _save_sharded(directory: str, state: Any, meta: np.ndarray,
+                  fingerprint: Optional[dict] = None) -> str:
     """Keep-previous atomic save: each save goes to a FRESH numbered dir,
-    then the LATEST pointer file flips atomically and process 0 prunes the
-    older dirs. A crash mid-save leaves the previous checkpoint fully
+    then the LATEST pointer file flips atomically and process 0 prunes all
+    but the newest PREVIOUS dir — one generation is kept on purpose, as
+    the fallback the restore consults when the latest dir fails manifest
+    verification. A crash mid-save leaves the previous checkpoint fully
     intact (orbax's force=True would rmtree it BEFORE committing the new
     one — the exact window checkpointing exists to survive)."""
     import orbax.checkpoint as ocp
@@ -104,19 +225,63 @@ def _save_sharded(directory: str, state: Any, meta: np.ndarray) -> str:
                 and n.rsplit(".", 1)[1].isdigit()]
     name = f"{SHARDED_NAME}.{max(existing, default=-1) + 1}"
     path = os.path.join(base, name)
+    fault_point("checkpoint_write", path=path)
     with ocp.PyTreeCheckpointer() as ckptr:
         ckptr.save(path, args=ocp.args.PyTreeSave(_leaf_dict(state, meta)))
     if jax.process_index() == 0:
+        _write_sharded_manifest(path, meta, fingerprint)
         tmp = os.path.join(base, _LATEST_NAME + ".tmp")
         with open(tmp, "w") as f:
             f.write(name)
         os.replace(tmp, os.path.join(base, _LATEST_NAME))
-        for idx in existing:
+        for idx in sorted(existing)[:-1]:
             import shutil
 
-            shutil.rmtree(os.path.join(base, f"{SHARDED_NAME}.{idx}"),
-                          ignore_errors=True)
+            stale = os.path.join(base, f"{SHARDED_NAME}.{idx}")
+            shutil.rmtree(stale, ignore_errors=True)
+            if os.path.exists(stale + MANIFEST_SUFFIX):
+                os.unlink(stale + MANIFEST_SUFFIX)
+        fault_point("checkpoint_finalize", path=_largest_file(path))
     return path
+
+
+def _largest_file(dirpath: str) -> Optional[str]:
+    """The biggest payload file under ``dirpath`` — the corrupt-fault
+    target for the sharded layout (flipping manifest bytes would test the
+    manifest, not the data path)."""
+    best, best_size = None, -1
+    for root, _, names in os.walk(dirpath):
+        for n in names:
+            fp = os.path.join(root, n)
+            size = os.path.getsize(fp)
+            if size > best_size:
+                best, best_size = fp, size
+    return best
+
+
+def _verify_sharded(dirpath: str) -> Optional[str]:
+    """None when ``dirpath`` passes manifest verification (or predates
+    manifests); else the human-readable failure reason."""
+    mpath = dirpath + MANIFEST_SUFFIX
+    if not os.path.exists(mpath):
+        return None      # legacy dir: no integrity data to check against
+    try:
+        with open(mpath) as f:
+            man = json.load(f)
+    except (OSError, ValueError) as e:
+        return f"manifest unreadable ({type(e).__name__}: {e})"
+    if man.get("schema") != SCHEMA_VERSION:
+        return f"unknown manifest schema {man.get('schema')!r}"
+    for rel, want in man.get("files", {}).items():
+        fp = os.path.join(dirpath, rel)
+        if not os.path.exists(fp):
+            return f"missing shard file {rel}"
+        if os.path.getsize(fp) != want.get("size"):
+            return (f"shard file {rel} is {os.path.getsize(fp)} bytes, "
+                    f"manifest says {want.get('size')} (torn write)")
+        if want.get("sha256") and _sha256_file(fp) != want["sha256"]:
+            return f"shard file {rel} sha256 mismatch (corrupted bytes)"
+    return None
 
 
 def _latest_sharded_dir(directory: str) -> Optional[str]:
@@ -129,25 +294,85 @@ def _latest_sharded_dir(directory: str) -> Optional[str]:
     return path if os.path.isdir(path) else None
 
 
-def _load_sharded(directory: str, like_leaves
+def _sharded_candidates(directory: str) -> List[str]:
+    """Restore candidates, best first: the LATEST-pointer dir, then the
+    remaining numbered dirs newest-first (the keep-previous fallbacks)."""
+    base = os.path.abspath(directory)
+    ordered = []
+    pointed = _latest_sharded_dir(directory)
+    if pointed is not None:
+        ordered.append(pointed)
+    if not os.path.isdir(base):
+        return ordered
+    numbered = sorted(
+        ((int(n.rsplit(".", 1)[1]), n) for n in os.listdir(base)
+         if n.startswith(SHARDED_NAME + ".") and n.rsplit(".", 1)[1].isdigit()),
+        reverse=True)
+    for _, n in numbered:
+        p = os.path.join(base, n)
+        if p != pointed and os.path.isdir(p):
+            ordered.append(p)
+    return ordered
+
+
+def _load_sharded(directory: str, like_leaves,
+                  fingerprint: Optional[dict] = None
                   ) -> Optional[Tuple[list, np.ndarray]]:
     """Restore per-process shards with the LIKE tree's shardings preserved.
 
     ``like_leaves`` must be device arrays (a freshly initialized, correctly
     sharded state) — orbax restores each leaf directly onto those shardings,
-    so every process reads only its own devices' slices.
+    so every process reads only its own devices' slices. A candidate dir
+    that fails manifest verification is skipped with a warning and the
+    previous numbered dir is tried instead.
     """
+    candidates = _sharded_candidates(directory)
+    if not candidates:
+        return None
+    path, failures = None, []
+    for cand in candidates:
+        reason = _verify_sharded(cand)
+        if reason is None:
+            path = cand
+            break
+        failures.append(f"{os.path.basename(cand)}: {reason}")
+        warnings.warn(
+            f"checkpoint {cand} failed integrity verification ({reason}); "
+            "trying the previous numbered checkpoint", RuntimeWarning)
+    if path is None:
+        raise ValueError(
+            f"no intact sharded checkpoint under {directory} — "
+            + "; ".join(failures)
+            + " — every kept generation is corrupt; restart without "
+              "--resume to retrain from scratch")
+    if path != candidates[0]:
+        warnings.warn(
+            f"resuming from the previous checkpoint {path} (the latest "
+            "failed verification) — at most one checkpoint interval of "
+            "progress is repeated", RuntimeWarning)
+    sharded_manifest = None
+    mpath = path + MANIFEST_SUFFIX
+    if os.path.exists(mpath):
+        with open(mpath) as f:
+            sharded_manifest = json.load(f)
+    _check_fingerprint(path, sharded_manifest, fingerprint)
+    return _restore_sharded_dir(path, like_leaves)
+
+
+def _restore_sharded_dir(path: str, like_leaves
+                         ) -> Tuple[list, np.ndarray]:
     import orbax.checkpoint as ocp
 
-    path = _latest_sharded_dir(directory)
-    if path is None:
-        return None
     like = _leaf_dict(like_leaves, np.zeros(4, np.float64))
     with ocp.PyTreeCheckpointer() as ckptr:
         # Validate shapes against the stored metadata FIRST, so a config
         # change surfaces as the same clear error the single layout raises
-        # instead of an obscure tensorstore chunk mismatch.
-        stored = ckptr.metadata(path).item_metadata.tree
+        # instead of an obscure tensorstore chunk mismatch. Older orbax
+        # (<=0.7) returns the name->ArrayMetadata dict directly; newer
+        # versions wrap it in .item_metadata.tree.
+        stored = ckptr.metadata(path)
+        if hasattr(stored, "item_metadata"):
+            stored = stored.item_metadata.tree
         for i, want in enumerate(like_leaves):
             got = stored.get(f"leaf_{i}")
             got_shape = tuple(getattr(got, "shape", ()) or ())
@@ -195,8 +420,91 @@ def _leaf_dtype(want) -> np.dtype:
         np.asarray(want).dtype
 
 
+def _verify_single(ckpt_path: str) -> Optional[str]:
+    """None when ``ckpt_path`` passes manifest verification (or predates
+    manifests); else the human-readable failure reason."""
+    mpath = ckpt_path + MANIFEST_SUFFIX
+    if not os.path.exists(mpath):
+        return None     # legacy checkpoint: nothing to verify against
+    try:
+        with open(mpath) as f:
+            man = json.load(f)
+    except (OSError, ValueError) as e:
+        return f"manifest unreadable ({type(e).__name__}: {e})"
+    if man.get("schema") != SCHEMA_VERSION:
+        return f"unknown manifest schema {man.get('schema')!r}"
+    want = man.get("file_sha256")
+    if want and _sha256_file(ckpt_path) != want:
+        return "file sha256 mismatch (truncated or corrupted write)"
+    return None
+
+
+def _verify_leaves(ckpt_path: str, leaves: list) -> Optional[str]:
+    """Per-leaf hash check against the manifest (defense in depth behind
+    the whole-file hash — catches a stale manifest paired with the wrong
+    npz)."""
+    man = _load_manifest(ckpt_path)
+    if man is None:
+        return None
+    records = man.get("leaves", [])
+    if len(records) != len(leaves):
+        return (f"manifest lists {len(records)} leaves, checkpoint holds "
+                f"{len(leaves)}")
+    for rec, leaf in zip(records, leaves):
+        if rec.get("sha256") and _sha256_array(leaf) != rec["sha256"]:
+            return f"{rec.get('name', 'leaf')} sha256 mismatch"
+    return None
+
+
+def _read_single(directory: str, like_leaves,
+                 fingerprint: Optional[dict] = None
+                 ) -> Optional[Tuple[list, np.ndarray]]:
+    """Single-layout read with integrity verification and keep-previous
+    fallback: a latest checkpoint that fails its manifest (or is an
+    unreadable zip) is skipped WITH A WARNING and the ``.prev`` twin is
+    restored instead; only when every kept generation is bad does this
+    raise — with the verification reasons, not an opaque zip error."""
+    path = os.path.join(directory, CKPT_NAME)
+    failures = []
+    for cand in (path, path + PREV_SUFFIX):
+        if not os.path.exists(cand):
+            continue
+        reason = _verify_single(cand)
+        if reason is None:
+            try:
+                loaded = _read_leaves(cand, like_leaves)
+            except ValueError:
+                # Shape/config mismatch: structural, not corruption — the
+                # .prev twin has the same shapes, so propagate as-is.
+                raise
+            except Exception as e:  # noqa: BLE001 — corrupt legacy zip
+                reason = f"unreadable ({type(e).__name__}: {e})"
+            else:
+                reason = _verify_leaves(cand, loaded[0])
+                if reason is None:
+                    _check_fingerprint(cand, _load_manifest(cand), fingerprint)
+                    if cand != path:
+                        warnings.warn(
+                            f"resuming from the previous checkpoint {cand} "
+                            "(the latest failed verification) — at most one "
+                            "checkpoint interval of progress is repeated",
+                            RuntimeWarning)
+                    return loaded
+        failures.append(f"{os.path.basename(cand)}: {reason}")
+        warnings.warn(
+            f"checkpoint {cand} failed integrity verification ({reason}); "
+            "falling back to the previous checkpoint", RuntimeWarning)
+    if failures:
+        raise ValueError(
+            f"no intact checkpoint under {directory} — " + "; ".join(failures)
+            + " — every kept generation is corrupt; restart without "
+              "--resume to retrain from scratch")
+    return None
+
+
 def load_state(directory: str, params_like: Any, opt_state_like: Any,
-               layout: str = "single"
+               layout: str = "single",
+               fingerprint: Optional[dict] = None
                ) -> Optional[Tuple[Any, Any, Any, int, float, float, int]]:
     """Restore (params, opt_state, snapshot, epoch, before_val, before_tr, done).
 
@@ -216,13 +524,14 @@ def load_state(directory: str, params_like: Any, opt_state_like: Any,
     like = (params_like, opt_state_like, params_like)
     like_leaves, treedef = jax.tree_util.tree_flatten(like)
     if layout == "sharded":
-        loaded = _load_sharded(directory, like_leaves)
+        loaded = _load_sharded(directory, like_leaves, fingerprint)
     elif layout != "single":
         raise ValueError(f"unknown checkpoint layout {layout!r}")
     elif jax.process_count() > 1:
-        loaded = _broadcast_from_coordinator(path, like_leaves)
+        loaded = _broadcast_from_coordinator(directory, like_leaves,
+                                             fingerprint)
     else:
-        loaded = _read_leaves(path, like_leaves)
+        loaded = _read_single(directory, like_leaves, fingerprint)
     if loaded is None:
         # A resume that silently starts over because the OTHER layout's
         # artifact sits in the directory would bypass the terminal
@@ -245,9 +554,11 @@ def load_state(directory: str, params_like: Any, opt_state_like: Any,
             int(meta[0]), float(meta[1]), float(meta[2]), done)
 
 
-def _broadcast_from_coordinator(path: str, like_leaves
+def _broadcast_from_coordinator(directory: str, like_leaves,
+                                fingerprint: Optional[dict] = None
                                 ) -> Optional[Tuple[list, np.ndarray]]:
-    """Process 0 reads the npz; every process receives the same state.
+    """Process 0 reads the npz (with integrity verification + keep-previous
+    fallback); every process receives the same state.
 
     The status scalar goes first so a missing file or a validation error on
     the coordinator surfaces as the SAME outcome on every process instead of
@@ -259,7 +570,7 @@ def _broadcast_from_coordinator(path: str, like_leaves
     leaves, meta, err = None, None, ""
     if jax.process_index() == 0:
         try:
-            loaded = _read_leaves(path, like_leaves)
+            loaded = _read_single(directory, like_leaves, fingerprint)
             if loaded is not None:
                 leaves, meta = loaded
                 status = 1

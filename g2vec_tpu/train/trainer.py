@@ -472,12 +472,23 @@ def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
     start_epoch = 0
     stopped_early = False
     stop_epoch = max_epochs - 1
+    # Recorded in the checkpoint manifest and checked on resume: same-shape
+    # config drift (changed lr/seed/dtype) must fail loudly, not blend two
+    # runs. max_epochs is deliberately absent — extending it is supported.
+    ckpt_fingerprint = {
+        "hidden": hidden, "learning_rate": learning_rate,
+        "compute_dtype": compute_dtype, "param_dtype": param_dtype,
+        "seed": seed, "val_fraction": val_fraction,
+        "decision_threshold": decision_threshold,
+        "n_genes_pad": int(n_genes_pad),
+    }
     if checkpoint_dir and resume:
         from g2vec_tpu.train.checkpoint import (RUN_EARLY_STOPPED,
                                                 RUN_IN_PROGRESS, load_state)
 
         restored = load_state(checkpoint_dir, params, opt_state,
-                              layout=checkpoint_layout)
+                              layout=checkpoint_layout,
+                              fingerprint=ckpt_fingerprint)
         if restored is not None:
             (params, opt_state, snapshot, last_epoch,
              before_val, before_tr, done) = restored
@@ -537,12 +548,18 @@ def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
         hist = np.asarray(jax.device_get(hist_d))[:count]
         secs = (time.time() - t0) / max(count, 1)
         t0 = time.time()
+        from g2vec_tpu.resilience.faults import fault_point
+
         for j in range(count):
             av, at, ls = (float(hist[j, 0]), float(hist[j, 1]), float(hist[j, 2]))
             history.append({"epoch": step + j, "acc_val": av, "acc_tr": at,
                             "loss": ls, "secs": secs})
             if on_epoch is not None:
                 on_epoch(step + j, av, at, secs)
+            # The train-loop fault seam: fires at the host-side epoch
+            # callback (the epoch's device work is done, its checkpoint may
+            # not be) — the exact place a preemption hurts most.
+            fault_point("train", epoch=step + j)
         step += count
         if stopped_early:
             stop_epoch = step - 2                # dip epoch minus one
@@ -551,7 +568,8 @@ def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
 
             save_state(checkpoint_dir, params, opt_state, snapshot,
                        step - 1, before_val, before_tr,
-                       layout=checkpoint_layout)
+                       layout=checkpoint_layout,
+                       fingerprint=ckpt_fingerprint)
 
     if checkpoint_dir:
         from g2vec_tpu.train.checkpoint import (RUN_COMPLETED,
@@ -561,7 +579,8 @@ def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
                    stop_epoch if stopped_early else max_epochs - 1,
                    before_val, before_tr,
                    done=RUN_EARLY_STOPPED if stopped_early else RUN_COMPLETED,
-                   layout=checkpoint_layout)
+                   layout=checkpoint_layout,
+                   fingerprint=ckpt_fingerprint)
     from g2vec_tpu.parallel.distributed import fetch_global
 
     w_ih = fetch_global(snapshot.w_ih).astype(np.float32)[:n_genes]

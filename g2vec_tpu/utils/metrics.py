@@ -12,10 +12,17 @@ from typing import IO, Optional
 
 
 class MetricsWriter:
-    """Append-only JSONL metrics sink; no-op when constructed with None."""
+    """Append-only JSONL metrics sink; no-op when constructed with None.
 
-    def __init__(self, path: Optional[str]):
-        self._fout: Optional[IO[str]] = open(path, "w") if path else None
+    ``append=True`` continues an existing stream instead of truncating it —
+    resumed runs and the resilience supervisor use it so the events of all
+    attempts (config/epoch records, ``retry``/``resume``/``gave_up``) form
+    one chronological stream per file.
+    """
+
+    def __init__(self, path: Optional[str], append: bool = False):
+        mode = "a" if append else "w"
+        self._fout: Optional[IO[str]] = open(path, mode) if path else None
         self._seq = 0
 
     def emit(self, event: str, **fields) -> None:

@@ -85,6 +85,7 @@ def test_hostonly_relays_landed_window_lines(tmp_path):
         [sys.executable, BENCH, "--_hostonly"],
         capture_output=True, text=True, timeout=240,
         env={**os.environ, **_TOY,
+             "G2VEC_BENCH_WINDOW_ROUND": "r05",
              "G2VEC_BENCH_WINDOW_DIR": str(tmp_path)})
     assert proc.returncode == 0, proc.stderr[-800:]
     lines = [json.loads(ln) for ln in proc.stdout.splitlines()
@@ -149,6 +150,7 @@ def test_measure_death_pre_metric_relays_and_exits_3(tmp_path):
              # Poison only the child's runtime (the parent never calls
              # make_paths): 0 genes makes the train stage raise before
              # its first metric line.
+             "G2VEC_BENCH_WINDOW_ROUND": "r05",
              "G2VEC_BENCH_N_GENES": "0",
              "G2VEC_BENCH_TOTAL_BUDGET": "200",
              "G2VEC_BENCH_TIMEOUT": "90",
@@ -179,6 +181,7 @@ def test_measure_death_without_landed_headline_closes_on_null(tmp_path):
         env={**os.environ, **_TOY,
              "G2VEC_BENCH_WINDOW_DIR": str(tmp_path),
              "G2VEC_BENCH_PLATFORM": "cpu",
+             "G2VEC_BENCH_WINDOW_ROUND": "r05",
              "G2VEC_BENCH_N_GENES": "0",
              "G2VEC_BENCH_TOTAL_BUDGET": "200",
              "G2VEC_BENCH_TIMEOUT": "90",
@@ -222,11 +225,12 @@ def test_acceptance_relay_line_codekey_gated(tmp_path, monkeypatch):
     assert "TPU_ACCEPTANCE.json" in line["from_artifact"]
 
 
-def test_landed_window_lines_provenance_rules(tmp_path):
+def test_landed_window_lines_provenance_rules(tmp_path, monkeypatch):
     """Harvest rules: relayed/host-fallback lines are never re-harvested
     (their provenance would be rewritten to the wrong artifact), and the
     per-metric winner is deterministic when a fresh checkout flattens
     mtimes (name order breaks the tie: r05 < r05b = window order)."""
+    monkeypatch.setenv("G2VEC_BENCH_WINDOW_ROUND", "r05")
     sys.path.insert(0, REPO)
     try:
         import bench
@@ -257,6 +261,46 @@ def test_landed_window_lines_provenance_rules(tmp_path):
     assert "tpu_acceptance_acc_val" not in landed   # artifact-carried
 
 
+def test_landed_window_lines_requires_round_env(tmp_path, monkeypatch,
+                                                capsys):
+    """With NEITHER round env var set the relay is skipped with a warning
+    (ADVICE r5 #2): bench must not guess the round and re-stamp a stale
+    round's numbers as current."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.remove(REPO)
+    monkeypatch.delenv("G2VEC_BENCH_WINDOW_ROUND", raising=False)
+    monkeypatch.delenv("WATCHER_ROUND", raising=False)
+    (tmp_path / "BENCH_LOCAL_r05.json").write_text(json.dumps(
+        {"rc": 0, "lines": [
+            {"metric": "walker_walks_per_sec", "value": 8107.2}]}))
+    assert bench._landed_window_lines(str(tmp_path)) == {}
+    assert "window-relay skipped" in capsys.readouterr().err
+
+
+def test_relay_line_backend_provenance():
+    """Host-side metrics relayed out of a chip-window artifact must not
+    carry chip provenance (ADVICE r5 #1/#3)."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.remove(REPO)
+    chip = bench._relay_line(
+        {"metric": "cbow_train_paths_per_sec_per_chip", "value": 1.0},
+        "BENCH_LOCAL_r05.json")
+    assert chip["relay_measured_on"] == "tpu"
+    assert "real chip" in chip["relay_note"]
+    host = bench._relay_line(
+        {"metric": "walker_native_walks_per_sec", "value": 2.0},
+        "BENCH_LOCAL_r05.json")
+    assert host["relay_measured_on"] == "host-cpu"
+    assert "not the chip" in host["relay_note"]
+    assert "measured on the real chip" not in host["relay_note"]
+
+
 def test_measure_child_budget_skip_relays_landed_lines(tmp_path):
     """A live-backend measure child whose budget runs out before a stage
     relays that stage's landed chip-window value instead of a null."""
@@ -269,6 +313,7 @@ def test_measure_child_budget_skip_relays_landed_lines(tmp_path):
         timeout=340,
         env={**os.environ, **_TOY,
              "G2VEC_BENCH_WINDOW_DIR": str(tmp_path),
+             "G2VEC_BENCH_WINDOW_ROUND": "r05",
              "G2VEC_BENCH_PLATFORM": "cpu",
              "G2VEC_BENCH_SKIP_ACCEPT": "1",
              "G2VEC_BENCH_N_PATHS": "1024", "G2VEC_BENCH_N_GENES": "256",

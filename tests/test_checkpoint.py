@@ -144,8 +144,10 @@ def test_sharded_layout_shape_mismatch_and_cross_layout(rng, tmp_path):
 
 
 def test_sharded_layout_keeps_previous_until_commit(rng, tmp_path):
-    """Each save lands in a fresh numbered dir + atomic LATEST flip; after
-    two saves only the newest remains and LATEST points at it."""
+    """Each save lands in a fresh numbered dir + atomic LATEST flip; the
+    newest AND one previous generation are kept (the previous is the
+    corruption fallback — resilience subsystem), anything older is pruned,
+    and LATEST points at the newest."""
     import os
 
     paths, labels = _data(rng)
@@ -153,10 +155,14 @@ def test_sharded_layout_keeps_previous_until_commit(rng, tmp_path):
     kwargs = dict(hidden=8, learning_rate=0.05, compute_dtype="float32",
                   seed=0, checkpoint_dir=ckpt, checkpoint_every=2,
                   checkpoint_layout="sharded")
-    train_cbow(paths, labels, max_epochs=4, **kwargs)
-    names = sorted(n for n in os.listdir(ckpt)
-                   if n.startswith("cbow_state_ocdbt."))
-    dirs = [n for n in names if not n.endswith(".LATEST")]
-    assert len(dirs) == 1, names                 # older saves pruned
+    train_cbow(paths, labels, max_epochs=6, **kwargs)
+    dirs = sorted(n for n in os.listdir(ckpt)
+                  if n.startswith("cbow_state_ocdbt.")
+                  and os.path.isdir(os.path.join(ckpt, n)))
+    assert 1 <= len(dirs) <= 2, dirs             # newest + one fallback
+    newest = max(dirs, key=lambda n: int(n.rsplit(".", 1)[1]))
     with open(os.path.join(ckpt, "cbow_state_ocdbt.LATEST")) as f:
-        assert f.read().strip() == dirs[0]
+        assert f.read().strip() == newest
+    # Every kept generation carries its integrity manifest.
+    for n in dirs:
+        assert os.path.exists(os.path.join(ckpt, n + ".manifest.json")), n

@@ -1,0 +1,94 @@
+"""Malformed-input contracts: every truncated/mangled file must surface as
+the documented actionable ValueError — never an IndexError or a raw parse
+crash — under BOTH the Python and native parsers (the native parser covers
+the expression matrix; clinical/network are Python-only by design)."""
+import shutil
+
+import pytest
+
+from g2vec_tpu.io.readers import load_clinical, load_expression, load_network
+
+_HAS_GXX = shutil.which("g++") is not None
+PARSERS = [pytest.param(False, id="python"),
+           pytest.param(True, id="native",
+                        marks=pytest.mark.skipif(
+                            not _HAS_GXX,
+                            reason="no C++ toolchain in this environment"))]
+
+
+def _write_truncated_expression(tmp_path):
+    """A kill-mid-write expression file: full rows, then a byte-truncated
+    final row (what a dead writer or a torn copy leaves behind)."""
+    full = ("PATIENT\tS1\tS2\tS3\n"
+            "GENEA\t1.5\t-0.25\t0.0\n"
+            "GENEB\t2.0\t3.0\t4.0\n")
+    cut = full[:full.index("GENEB\t2.0\t3.0") + len("GENEB\t2.0\t3")]
+    p = tmp_path / "truncated.txt"
+    p.write_text(cut)
+    return str(p)
+
+
+@pytest.mark.parametrize("use_native", PARSERS)
+def test_truncated_expression_row_raises_value_error(tmp_path, use_native):
+    path = _write_truncated_expression(tmp_path)
+    with pytest.raises(ValueError, match="GENEB"):
+        load_expression(path, use_native=use_native)
+
+
+@pytest.mark.parametrize("use_native", PARSERS)
+def test_expression_header_only_raises_value_error(tmp_path, use_native):
+    p = tmp_path / "header_only.txt"
+    p.write_text("PATIENT\tS1\tS2\n")
+    with pytest.raises(ValueError, match="at least one gene row"):
+        load_expression(str(p), use_native=use_native)
+
+
+@pytest.mark.parametrize("use_native", PARSERS)
+def test_expression_empty_file_raises_value_error(tmp_path, use_native):
+    p = tmp_path / "empty.txt"
+    p.write_text("")
+    with pytest.raises(ValueError):
+        load_expression(str(p), use_native=use_native)
+
+
+@pytest.mark.parametrize("use_native", PARSERS)
+def test_expression_gene_name_only_row_raises_value_error(tmp_path,
+                                                          use_native):
+    # A row truncated right after the gene name (no values at all) — the
+    # reference would IndexError on row[1:] mismatch downstream.
+    p = tmp_path / "nameonly.txt"
+    p.write_text("PATIENT\tS1\nGENEA\t1.0\nGENEB\n")
+    with pytest.raises(ValueError, match="GENEB"):
+        load_expression(str(p), use_native=use_native)
+
+
+def test_clinical_non_integer_label_raises_value_error(tmp_path):
+    p = tmp_path / "clin.txt"
+    p.write_text("PATIENT_BARCODE\tLABEL\nS1\t0\nS2\tpoor\n")
+    with pytest.raises(ValueError, match="label must be an integer"):
+        load_clinical(str(p))
+    # And a float label is just as malformed.
+    p.write_text("PATIENT_BARCODE\tLABEL\nS1\t0.5\n")
+    with pytest.raises(ValueError, match="label must be an integer"):
+        load_clinical(str(p))
+
+
+def test_clinical_missing_label_column_raises_value_error(tmp_path):
+    p = tmp_path / "clin.txt"
+    p.write_text("PATIENT_BARCODE\tLABEL\nS1\n")
+    with pytest.raises(ValueError, match="sample"):
+        load_clinical(str(p))
+
+
+def test_network_single_column_row_raises_value_error(tmp_path):
+    p = tmp_path / "net.txt"
+    p.write_text("src\tdest\nGENEA\tGENEB\nGENEC\n")
+    with pytest.raises(ValueError, match="src"):
+        load_network(str(p))
+
+
+def test_network_empty_file_raises_value_error(tmp_path):
+    p = tmp_path / "net.txt"
+    p.write_text("")
+    with pytest.raises(ValueError, match="header"):
+        load_network(str(p))

@@ -1,0 +1,332 @@
+"""Fault-matrix tests for the resilience subsystem (tier-1 subset).
+
+Every seam the fault injector exposes is driven end to end here: stage
+raise, train-loop crash, checkpoint corruption (manifest detection +
+keep-previous fallback), and native-load failure (graceful degradation).
+For each, the supervised run must complete with outputs BYTE-IDENTICAL to
+an uninterrupted run at the same seed, and the metrics JSONL must carry
+the supervisor's retry/resume events. The SIGKILL + child-process
+supervisor path is the slow-marked e2e test (test_supervisor_e2e.py).
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from g2vec_tpu.config import G2VecConfig
+from g2vec_tpu.resilience import faults
+from g2vec_tpu.resilience.supervisor import (RetryPolicy, classify_child,
+                                             classify_exception, supervise,
+                                             _scrub_supervisor_argv)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    """Fault state is process-global: every test starts and ends clean."""
+    monkeypatch.delenv(faults.ENV_PLAN, raising=False)
+    monkeypatch.delenv(faults.ENV_STATE, raising=False)
+    faults._reset_for_tests()
+    yield
+    faults._reset_for_tests()
+
+
+@pytest.fixture(scope="module")
+def tsv_paths(tmp_path_factory):
+    from g2vec_tpu.data.synthetic import SyntheticSpec, write_synthetic_tsv
+
+    spec = SyntheticSpec(n_good=24, n_poor=20, module_size=12,
+                         n_background=24, n_expr_only=4, n_net_only=4,
+                         module_chords=2, background_edges=40, seed=7)
+    out = tmp_path_factory.mktemp("syn")
+    return write_synthetic_tsv(spec, str(out))
+
+
+def _cfg(tsv_paths, tmp_path, **overrides):
+    defaults = dict(
+        expression_file=tsv_paths["expression"],
+        clinical_file=tsv_paths["clinical"],
+        network_file=tsv_paths["network"],
+        result_name=os.path.join(str(tmp_path), "out"),
+        lenPath=8, numRepetition=2, sizeHiddenlayer=16, epoch=30,
+        learningRate=0.05, numBiomarker=5, compute_dtype="float32",
+        kmeans_iters=50, seed=0,
+    )
+    defaults.update(overrides)
+    return G2VecConfig(**defaults)
+
+
+_FAST = RetryPolicy(max_retries=3, backoff_base=0.0, backoff_max=0.0,
+                    jitter=0.0)
+_quiet = lambda s: None  # noqa: E731
+_nosleep = lambda s: None  # noqa: E731
+
+
+def _read_events(path):
+    with open(path) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+def _assert_outputs_identical(run_a, run_b):
+    assert len(run_a.output_files) == len(run_b.output_files) == 3
+    for fa, fb in zip(run_a.output_files, run_b.output_files):
+        with open(fa, "rb") as a, open(fb, "rb") as b:
+            assert a.read() == b.read(), f"{fa} differs from {fb}"
+
+
+# ---------------------------------------------------------------- units
+
+def test_plan_parsing_rejects_bad_specs():
+    with pytest.raises(faults.FaultPlanError, match="seam"):
+        faults.parse_plan("stage=nonsense")
+    with pytest.raises(faults.FaultPlanError, match="kind"):
+        faults.parse_plan("stage=train,kind=explode")
+    with pytest.raises(faults.FaultPlanError, match="key"):
+        faults.parse_plan("stage=train,when=now")
+    with pytest.raises(faults.FaultPlanError, match="stage"):
+        faults.parse_plan("kind=crash")
+    with pytest.raises(faults.FaultPlanError, match="non-numeric"):
+        faults.parse_plan("stage=train,epoch=soon")
+    entries = faults.parse_plan(
+        "stage=train,epoch=40,kind=crash; stage=save,kind=sigkill,times=2")
+    assert [(e.stage, e.epoch, e.kind, e.times) for e in entries] == \
+        [("train", 40, "crash", 1), ("save", None, "sigkill", 2)]
+    # Config validation surfaces plan errors at parse time.
+    with pytest.raises(ValueError, match="seam"):
+        G2VecConfig(fault_plan="stage=nope").validate()
+
+
+def test_fault_point_is_noop_without_plan():
+    faults.fault_point("load")
+    faults.fault_point("train", epoch=5)
+
+
+def test_crash_fires_once_and_epoch_gates():
+    faults.install_plan("stage=train,epoch=10,kind=crash")
+    faults.fault_point("train", epoch=9)          # below the gate
+    with pytest.raises(faults.InjectedFault):
+        faults.fault_point("train", epoch=10)
+    faults.fault_point("train", epoch=11)         # already fired
+
+
+def test_stall_and_fatal_kinds():
+    faults.install_plan("stage=paths,kind=stall,seconds=0")
+    with pytest.raises(faults.InjectedFault, match="stall"):
+        faults.fault_point("paths")
+    faults.install_plan("stage=paths,kind=fatal")
+    faults._fired.clear()
+    with pytest.raises(faults.InjectedFatal):
+        faults.fault_point("paths")
+
+
+def test_skip_defers_firing():
+    faults.install_plan("stage=save,kind=crash,skip=2")
+    faults.fault_point("save")
+    faults.fault_point("save")
+    with pytest.raises(faults.InjectedFault):
+        faults.fault_point("save")
+
+
+def test_state_file_persists_fired_entries(tmp_path, monkeypatch):
+    state = str(tmp_path / "fault-state.json")
+    monkeypatch.setenv(faults.ENV_STATE, state)
+    faults.install_plan("stage=load,kind=crash")
+    with pytest.raises(faults.InjectedFault):
+        faults.fault_point("load")
+    # A "restarted process": fresh module state, same state file.
+    faults._reset_for_tests()
+    faults.install_plan("stage=load,kind=crash")
+    faults.fault_point("load")                     # fired-state honored
+    assert json.load(open(state)) == {"load:None:crash": 1}
+
+
+def test_classification_table():
+    assert classify_exception(faults.InjectedFault("x")) == "retryable"
+    assert classify_exception(faults.InjectedFatal("x")) == "fatal"
+    assert classify_exception(RuntimeError("preempted")) == "retryable"
+    assert classify_exception(MemoryError()) == "retryable"
+    assert classify_exception(OSError("io wobble")) == "retryable"
+    assert classify_exception(ValueError("label must be 0 or 1")) == "fatal"
+    assert classify_exception(
+        ValueError("RESOURCE_EXHAUSTED: hbm oom")) == "retryable"
+    assert classify_exception(FileNotFoundError("gone")) == "fatal"
+    assert classify_exception(TypeError("bad arg")) == "fatal"
+    # Child-process classification mirrors it from rc + stderr.
+    assert classify_child(-9, "") == "retryable"           # SIGKILL
+    assert classify_child(1, "ValueError: bad label") == "fatal"
+    assert classify_child(1, "RuntimeError: preempted") == "retryable"
+    assert classify_child(1, "InjectedFault: injected crash") == "retryable"
+    assert classify_child(1, "") == "retryable"
+
+
+def test_scrub_supervisor_argv():
+    argv = ["e", "c", "n", "r", "--supervise", "--supervise-retries", "5",
+            "--supervise-backoff=0.1", "--seed", "3"]
+    assert _scrub_supervisor_argv(argv) == ["e", "c", "n", "r", "--seed", "3"]
+
+
+def test_metrics_writer_append_mode(tmp_path):
+    from g2vec_tpu.utils.metrics import MetricsWriter
+
+    path = str(tmp_path / "m.jsonl")
+    with MetricsWriter(path) as m:
+        m.emit("a")
+    with MetricsWriter(path, append=True) as m:
+        m.emit("b")
+    assert [e["event"] for e in _read_events(path)] == ["a", "b"]
+    with MetricsWriter(path) as m:      # default mode truncates
+        m.emit("c")
+    assert [e["event"] for e in _read_events(path)] == ["c"]
+
+
+# ------------------------------------------------- fault matrix (pipeline)
+
+def test_supervised_recovers_from_stage_crash(tsv_paths, tmp_path):
+    """Seam 1 — stage-boundary raise: retried, resumed, byte-identical."""
+    from g2vec_tpu.pipeline import run
+
+    clean = run(_cfg(tsv_paths, tmp_path, result_name=str(tmp_path / "a")),
+                console=_quiet)
+    mj = str(tmp_path / "m.jsonl")
+    cfg = _cfg(tsv_paths, tmp_path, result_name=str(tmp_path / "b"),
+               metrics_jsonl=mj, fault_plan="stage=paths,kind=crash")
+    recovered = supervise(cfg, policy=_FAST, console=_quiet, sleep=_nosleep)
+    _assert_outputs_identical(clean, recovered)
+    events = [e["event"] for e in _read_events(mj)]
+    assert "retry" in events and "resume" in events and "done" in events
+    retry = next(e for e in _read_events(mj) if e["event"] == "retry")
+    assert retry["classified"] == "retryable"
+    assert "injected crash at seam=paths" in retry["error"]
+
+
+def test_supervised_recovers_from_train_loop_crash(tsv_paths, tmp_path):
+    """Seam 2 — crash mid-epoch-loop: the retry resumes from the last
+    checkpoint (epochs before it are NOT redone) and the final outputs are
+    byte-identical to an uninterrupted checkpointed run."""
+    from g2vec_tpu.pipeline import run
+
+    # learningRate=0.01 trains ~9 epochs before the early stop at this
+    # scale — enough room for two checkpoint intervals before the crash.
+    clean = run(_cfg(tsv_paths, tmp_path, result_name=str(tmp_path / "a"),
+                     learningRate=0.01, checkpoint_dir=str(tmp_path / "cka"),
+                     checkpoint_every=3),
+                console=_quiet)
+    assert clean.train_history[-1]["epoch"] >= 7, "config trains too briefly"
+    mj = str(tmp_path / "m.jsonl")
+    cfg = _cfg(tsv_paths, tmp_path, result_name=str(tmp_path / "b"),
+               learningRate=0.01, checkpoint_dir=str(tmp_path / "ckb"),
+               checkpoint_every=3, metrics_jsonl=mj,
+               fault_plan="stage=train,epoch=6,kind=crash")
+    recovered = supervise(cfg, policy=_FAST, console=_quiet, sleep=_nosleep)
+    _assert_outputs_identical(clean, recovered)
+    events = _read_events(mj)
+    assert [e["event"] for e in events].count("retry") == 1
+    # The resumed attempt's epoch records start at the checkpoint, not 0:
+    # completed epochs are not redone. (seq restarts per attempt, so split
+    # the stream at the resume event's file position, not by seq.)
+    idx = events.index(next(e for e in events if e["event"] == "resume"))
+    resumed_epochs = [e["step"] for e in events[idx + 1:]
+                      if e["event"] == "epoch"]
+    assert resumed_epochs and resumed_epochs[0] == 6   # ckpt at epoch 5
+
+
+def test_supervised_survives_corrupt_latest_checkpoint(tsv_paths, tmp_path):
+    """Seam 3 — corrupted checkpoint: the torn write is detected by
+    manifest verification on resume, the previous numbered generation is
+    used (with a warning), and the outputs still match bit-for-bit."""
+    from g2vec_tpu.pipeline import run
+
+    clean = run(_cfg(tsv_paths, tmp_path, result_name=str(tmp_path / "a"),
+                     learningRate=0.01, checkpoint_dir=str(tmp_path / "cka"),
+                     checkpoint_every=3),
+                console=_quiet)
+    mj = str(tmp_path / "m.jsonl")
+    # skip=1: the SECOND save (epoch 5) is silently corrupted, then the
+    # crash at epoch 6 forces a resume that must detect it and fall back
+    # to the good epoch-2 generation.
+    cfg = _cfg(tsv_paths, tmp_path, result_name=str(tmp_path / "b"),
+               learningRate=0.01, checkpoint_dir=str(tmp_path / "ckb"),
+               checkpoint_every=3, metrics_jsonl=mj,
+               fault_plan="stage=checkpoint_finalize,kind=corrupt,skip=1;"
+                          "stage=train,epoch=6,kind=crash")
+    with pytest.warns(RuntimeWarning, match="integrity"):
+        recovered = supervise(cfg, policy=_FAST, console=_quiet,
+                              sleep=_nosleep)
+    _assert_outputs_identical(clean, recovered)
+    events = _read_events(mj)
+    idx = events.index(next(e for e in events if e["event"] == "resume"))
+    resumed_epochs = [e["step"] for e in events[idx + 1:]
+                      if e["event"] == "epoch"]
+    assert resumed_epochs and resumed_epochs[0] == 3   # prev ckpt: epoch 2
+
+
+def test_corrupt_checkpoint_unit_fallback(tmp_path):
+    """Unit twin of seam 3: latest corrupt -> .prev used with a warning;
+    both corrupt -> one clear ValueError, never an opaque zip error."""
+    from g2vec_tpu.train import checkpoint as ck
+
+    d = str(tmp_path)
+    params = {"w": np.arange(6, dtype=np.float32).reshape(2, 3)}
+    opt = {"m": np.zeros((2, 3), np.float32)}
+    ck.save_state(d, params, opt, params, 4, 0.5, 0.6)
+    ck.save_state(d, params, opt, params, 9, 0.7, 0.8)
+    latest = os.path.join(d, ck.CKPT_NAME)
+    faults._corrupt_file(latest)
+    with pytest.warns(RuntimeWarning, match="integrity"):
+        restored = ck.load_state(d, params, opt)
+    assert restored[3] == 4                       # the .prev generation
+    faults._corrupt_file(latest + ck.PREV_SUFFIX)
+    with pytest.warns(RuntimeWarning):
+        with pytest.raises(ValueError, match="no intact checkpoint"):
+            ck.load_state(d, params, opt)
+
+
+def test_native_load_fault_degrades_not_dies(tsv_paths, tmp_path):
+    """Seam 4 — native-library load failure: the reader falls back to the
+    Python parser and the auto walker resolves to the device backend; the
+    run COMPLETES (degradation, not retry) with outputs identical to a
+    run that pinned the degraded backends."""
+    from g2vec_tpu.ops.backend import resolve_walker_backend
+    from g2vec_tpu.pipeline import run
+
+    pinned = run(_cfg(tsv_paths, tmp_path, result_name=str(tmp_path / "a"),
+                      use_native_io=False, walker_backend="device"),
+                 console=_quiet)
+    cfg = _cfg(tsv_paths, tmp_path, result_name=str(tmp_path / "b"),
+               use_native_io=True, walker_backend="auto",
+               fault_plan="stage=native_load,kind=crash;"
+                          "stage=native_walker_load,kind=crash")
+    faults.install_plan(cfg.fault_plan)
+    assert resolve_walker_backend(cfg) == "device"   # degraded resolution
+    faults._reset_for_tests()
+    degraded = run(cfg, console=_quiet)
+    assert degraded.walker_backend == "device"
+    _assert_outputs_identical(pinned, degraded)
+
+
+def test_supervisor_gives_up_on_fatal(tsv_paths, tmp_path):
+    """A wrong-input failure must NOT be retried: one attempt, a gave_up
+    event, and the original error."""
+    mj = str(tmp_path / "m.jsonl")
+    cfg = _cfg(tsv_paths, tmp_path, metrics_jsonl=mj,
+               fault_plan="stage=preprocess,kind=fatal")
+    attempts = []
+    with pytest.raises(faults.InjectedFatal):
+        supervise(cfg, policy=_FAST, console=attempts.append,
+                  sleep=_nosleep)
+    events = [e["event"] for e in _read_events(mj)]
+    assert "gave_up" in events and "retry" not in events
+
+
+def test_supervisor_exhausts_retry_budget(tsv_paths, tmp_path):
+    """A fault that keeps firing (times=99) drains the budget and then
+    re-raises with a gave_up event."""
+    mj = str(tmp_path / "m.jsonl")
+    cfg = _cfg(tsv_paths, tmp_path, metrics_jsonl=mj,
+               fault_plan="stage=load,kind=crash,times=99")
+    policy = RetryPolicy(max_retries=2, backoff_base=0.0, backoff_max=0.0,
+                         jitter=0.0)
+    with pytest.raises(faults.InjectedFault):
+        supervise(cfg, policy=policy, console=_quiet, sleep=_nosleep)
+    events = [e["event"] for e in _read_events(mj)]
+    assert events.count("retry") == 2 and "gave_up" in events

@@ -1,0 +1,82 @@
+"""End-to-end kill-and-resume: the CLI supervisor SIGKILLs its child at a
+chosen epoch via the fault plan (no Python cleanup — the shape of a real
+TPU preemption), restarts it with --resume, and the final vectors must be
+bit-identical to an uninterrupted seeded run. Slow: three full CLI
+pipeline runs, each a fresh interpreter + jax import."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def tsv_paths(tmp_path_factory):
+    from g2vec_tpu.data.synthetic import SyntheticSpec, write_synthetic_tsv
+
+    spec = SyntheticSpec(n_good=24, n_poor=20, module_size=12,
+                         n_background=24, n_expr_only=4, n_net_only=4,
+                         module_chords=2, background_edges=40, seed=7)
+    out = tmp_path_factory.mktemp("syn")
+    return write_synthetic_tsv(spec, str(out))
+
+
+def _cli(tsv_paths, result, ckpt, metrics=None, extra=()):
+    args = [sys.executable, "-m", "g2vec_tpu",
+            tsv_paths["expression"], tsv_paths["clinical"],
+            tsv_paths["network"], result,
+            "-p", "8", "-r", "2", "-s", "16", "-e", "30", "-l", "0.01",
+            "-n", "5", "--seed", "0", "--compute-dtype", "float32",
+            "--platform", "cpu",
+            "--checkpoint-dir", ckpt, "--checkpoint-every", "3"]
+    if metrics:
+        args += ["--metrics-jsonl", metrics]
+    return args + list(extra)
+
+
+def test_sigkill_at_epoch_resumes_bit_identical(tsv_paths, tmp_path):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("G2VEC_FAULT_PLAN", None)
+    env.pop("G2VEC_FAULT_STATE", None)
+
+    clean = subprocess.run(
+        _cli(tsv_paths, str(tmp_path / "a"), str(tmp_path / "cka")),
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    assert clean.returncode == 0, clean.stderr[-1500:]
+
+    mj = str(tmp_path / "m.jsonl")
+    supervised = subprocess.run(
+        _cli(tsv_paths, str(tmp_path / "b"), str(tmp_path / "ckb"),
+             metrics=mj,
+             extra=["--supervise", "--supervise-backoff", "0.01",
+                    "--fault-plan", "stage=train,epoch=6,kind=sigkill"]),
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+    assert supervised.returncode == 0, supervised.stderr[-1500:]
+    assert "[supervisor] attempt 0 failed" in supervised.stderr
+
+    # Final vectors bit-identical to the uninterrupted run.
+    for suffix in ("_vectors.txt", "_lgroups.txt", "_biomarkers.txt"):
+        with open(str(tmp_path / "a") + suffix, "rb") as fa, \
+                open(str(tmp_path / "b") + suffix, "rb") as fb:
+            assert fa.read() == fb.read(), suffix
+
+    # The metrics stream carries the recovery story end to end: the first
+    # attempt's records, the supervisor's retry/resume, the resumed
+    # attempt's records (appended, not truncated), and the final done.
+    with open(mj) as f:
+        events = [json.loads(ln) for ln in f if ln.strip()]
+    names = [e["event"] for e in events]
+    assert "retry" in names and "resume" in names
+    assert names.count("done") == 1
+    retry = next(e for e in events if e["event"] == "retry")
+    assert retry["classified"] == "retryable"       # rc=-9: signal exit
+    # The resumed attempt starts at the checkpoint, not epoch 0.
+    idx = names.index("resume")
+    resumed_epochs = [e["step"] for e in events[idx + 1:]
+                      if e["event"] == "epoch"]
+    assert resumed_epochs and resumed_epochs[0] > 0

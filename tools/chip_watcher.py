@@ -6,7 +6,7 @@ scripted was lost). This watcher loops a bounded backend probe and, on the
 FIRST success, runs the full round evidence agenda in priority order,
 flushing each artifact to the repo root the moment it exists so a window
 that dies mid-battery still leaves everything earlier on disk (ROUND below
-is WATCHER_ROUND, default r05):
+is WATCHER_ROUND, defaulting to the single-sourced tools/ROUND file):
 
   1. bench.py                    -> BENCH_LOCAL_{ROUND}.json  (headline
      debt: walker, native control, kernel A/B, epoch breakdown, XLA-dense
@@ -46,7 +46,24 @@ PROBE_CMD = [sys.executable, os.path.join(REPO, "bench.py"), "--_probe"]
 PROBE_TIMEOUT = int(os.environ.get("WATCHER_PROBE_TIMEOUT", "75"))
 PROBE_INTERVAL = int(os.environ.get("WATCHER_PROBE_INTERVAL", "240"))
 MAX_HOURS = float(os.environ.get("WATCHER_MAX_HOURS", "11"))
-ROUND = os.environ.get("WATCHER_ROUND", "r05")
+
+
+def _default_round() -> str:
+    """The round id's single source (tools/ROUND, ADVICE r5 #2): bumping
+    the round for a new evidence cycle is one file edit that bench.py,
+    watch_loop.sh, and this watcher all see — two independently hardcoded
+    defaults once let a stale round's numbers be relayed as current."""
+    try:
+        with open(os.path.join(REPO, "tools", "ROUND")) as f:
+            return f.read().strip() or "r00"
+    except OSError:
+        return "r00"
+
+
+ROUND = os.environ.get("WATCHER_ROUND") or _default_round()
+# Child stages (bench.py's relay path) resolve the round from this env var
+# ONLY — export it so a watcher launched bare keeps its battery coherent.
+os.environ.setdefault("WATCHER_ROUND", ROUND)
 # "first" = the from-scratch battery; "second" = the follow-up plan once
 # the headline bench has landed (see battery()). WATCHER_SKIP_DONE=1 makes
 # repeat batteries resume: a stage whose artifact is already on disk with
@@ -118,15 +135,26 @@ def run_stage(name: str, cmd: list, timeout: int, out_path: str | None,
     if out_path:
         # A re-run must not regress the evidence record: lines a previous
         # (partial) run captured with real values are salvaged into the
-        # new record unless this run re-measured the same metric.
+        # new record unless this run re-measured the same metric. Each
+        # carried line is tagged with per-line provenance (ADVICE r5 #3) —
+        # the new record's rc/stderr belong to THIS run, so without the
+        # tag a consumer could not tell fresh from carried measurements.
+        prev_rc, prev_mtime, prev_lines = None, None, []
         try:
+            prev_mtime = int(os.path.getmtime(out_path))
             with open(out_path) as f:
-                prev_lines = json.load(f).get("lines", [])
+                prev_record = json.load(f)
+            prev_rc = prev_record.get("rc")
+            prev_lines = prev_record.get("lines", [])
         except (OSError, ValueError):
             prev_lines = []
         have = {d.get("metric") for d in parsed
                 if isinstance(d, dict) and d.get("value") is not None}
-        salvaged = [d for d in prev_lines
+        # A line salvaged across several re-runs keeps its ORIGINAL
+        # provenance (d's existing tags win over this run's).
+        salvaged = [{"salvaged": True, "salvaged_from_rc": prev_rc,
+                     "salvaged_from_unix": prev_mtime, **d}
+                    for d in prev_lines
                     if isinstance(d, dict) and d.get("value") is not None
                     and d.get("metric") not in have]
         if salvaged:

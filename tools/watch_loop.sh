@@ -9,19 +9,22 @@
 #       >/tmp/chip_watcher_loop.log 2>&1 &
 set -u
 cd "$(dirname "$0")/.."
-ROUND="${WATCHER_ROUND:-r05}"
+PY="${PYTHON:-python3}"
+ROUND="${WATCHER_ROUND:-$(cat tools/ROUND)}"
 export WATCHER_ROUND="$ROUND" WATCHER_SKIP_DONE=1
 # Bounded: a deterministically failing stage must not burn chip windows
 # forever, and the loop must not outlive the round. Each watcher
 # invocation gets the REMAINING loop budget as its probe bound.
+# The deadline is computed in python (not bash integer arithmetic) so a
+# fractional LOOP_MAX_HOURS (e.g. 0.5) works (ADVICE r5 #4).
 MAX_ARMS="${LOOP_MAX_ARMS:-12}"
-DEADLINE=$(($(date +%s) + ${LOOP_MAX_HOURS:-10} * 3600))
+DEADLINE=$("$PY" -c "import sys,time;print(int(time.time()+float(sys.argv[1])*3600))" "${LOOP_MAX_HOURS:-10}")
 arms=0
 while [ "$arms" -lt "$MAX_ARMS" ] && [ "$(date +%s)" -lt "$DEADLINE" ]; do
     arms=$((arms + 1))
-    left_h=$(python -c "import time;print(max(0.1,($DEADLINE-time.time())/3600))")
-    WATCHER_MAX_HOURS="$left_h" python tools/chip_watcher.py
-    if python tools/chip_watcher.py --check-complete; then
+    left_h=$("$PY" -c "import sys,time;print(max(0.1,(float(sys.argv[1])-time.time())/3600))" "$DEADLINE")
+    WATCHER_MAX_HOURS="$left_h" "$PY" tools/chip_watcher.py
+    if "$PY" tools/chip_watcher.py --check-complete; then
         echo "[watch_loop] all stages landed"
         exit 0
     fi
