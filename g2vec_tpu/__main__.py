@@ -15,6 +15,17 @@ def main(argv=None) -> int:
     from g2vec_tpu.config import config_from_args
 
     cfg = config_from_args(argv)
+    if cfg.fleet_size:
+        # Fleet launcher/supervisor: spawns one child per rank (the
+        # children get --fleet-size scrubbed from their argv), watches
+        # them, and on peer death re-plans the mesh over the surviving
+        # devices and relaunches with --resume. Checked BEFORE any
+        # jax/platform setup, like --supervise: the launcher holds no
+        # accelerator state.
+        from g2vec_tpu.resilience.fleet import supervise_fleet
+
+        return supervise_fleet(cfg, list(argv) if argv is not None
+                               else sys.argv[1:])
     if cfg.supervise:
         # Child-process supervision: the supervisor re-invokes this module
         # (minus its own flags, plus --resume) so even a SIGKILL'd child —
@@ -49,7 +60,25 @@ def main(argv=None) -> int:
         initialize(cfg.coordinator, cfg.process_id, cfg.num_processes)
     from g2vec_tpu.pipeline import run
 
-    run(cfg)
+    try:
+        run(cfg)
+    except BaseException:
+        if cfg.distributed and not isinstance(
+                (sys.exc_info()[1]), (KeyboardInterrupt, SystemExit)):
+            # A failed distributed run must EXIT, not linger: interpreter
+            # teardown blocks in the coordination-service shutdown waiting
+            # for dead/stalled peers (the coordinator process hosts the
+            # service), which would hold the fleet supervisor's
+            # failure-detection hostage to the very hang the watchdog just
+            # converted into an error. Print the classifiable traceback,
+            # flush, and exit hard.
+            import traceback
+
+            traceback.print_exc()
+            sys.stderr.flush()
+            sys.stdout.flush()
+            os._exit(1)
+        raise
     return 0
 
 

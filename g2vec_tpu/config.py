@@ -106,6 +106,17 @@ class G2VecConfig:
                                      # "stage=train,epoch=40,kind=crash"
                                      # (resilience/faults.py docstring)
 
+    # ---- fleet resilience (resilience/fleet.py) ----
+    fleet_size: int = 0              # >0: launch/supervise this many ranks
+                                     # with degraded-mesh resume (0 = off)
+    fleet_devices_per_rank: int = 0  # virtual/local devices per rank
+                                     # (0 = mesh size / fleet_size)
+    fleet_liveness_dir: Optional[str] = None  # heartbeat/liveness files
+    fleet_heartbeat_interval: float = 1.0  # seconds between beats
+    fleet_watchdog_deadline: float = 0.0   # collective timeout (0 = block)
+    fleet_straggler_factor: float = 0.0    # warn when a rank exceeds this
+                                     # x median stage time (0 = off)
+
     # ---- multi-host (parallel/distributed.py) ----
     distributed: bool = False        # join the multi-process JAX runtime
     coordinator: Optional[str] = None    # host:port of process 0 (or env/auto)
@@ -161,6 +172,39 @@ class G2VecConfig:
         if self.supervise_backoff < 0.0:
             raise ValueError(
                 f"supervise_backoff must be >= 0, got {self.supervise_backoff}")
+        if self.fleet_size < 0 or self.fleet_size == 1:
+            raise ValueError(
+                f"fleet_size must be 0 (off) or >= 2, got {self.fleet_size}")
+        if self.fleet_devices_per_rank < 0:
+            raise ValueError(
+                f"fleet_devices_per_rank must be >= 0, "
+                f"got {self.fleet_devices_per_rank}")
+        if self.fleet_heartbeat_interval <= 0.0:
+            raise ValueError(
+                f"fleet_heartbeat_interval must be > 0, "
+                f"got {self.fleet_heartbeat_interval}")
+        if self.fleet_watchdog_deadline < 0.0:
+            raise ValueError(
+                f"fleet_watchdog_deadline must be >= 0, "
+                f"got {self.fleet_watchdog_deadline}")
+        if self.fleet_straggler_factor < 0.0:
+            raise ValueError(
+                f"fleet_straggler_factor must be >= 0, "
+                f"got {self.fleet_straggler_factor}")
+        if self.fleet_size and self.checkpoint_dir \
+                and self.checkpoint_layout != "sharded":
+            raise ValueError(
+                "--fleet-size with --checkpoint-dir requires "
+                "--checkpoint-layout sharded: degraded-mesh resume reshards "
+                "the orbax leaves onto the survivors' mesh at load")
+        if self.fleet_size and self.mesh_shape:
+            total = self.mesh_shape[0] * self.mesh_shape[1]
+            per = self.fleet_devices_per_rank or total // self.fleet_size
+            if per * self.fleet_size != total:
+                raise ValueError(
+                    f"--fleet-size {self.fleet_size} cannot evenly host the "
+                    f"{total}-device mesh {self.mesh_shape} "
+                    f"({per} devices/rank)")
         if self.fault_plan:
             # Fail at config time with the offending token, not mid-run.
             from g2vec_tpu.resilience.faults import parse_plan
@@ -269,6 +313,35 @@ def build_parser() -> argparse.ArgumentParser:
                              "'stage=train,epoch=40,kind=crash' "
                              "(kinds: crash|fatal|sigkill|stall|corrupt; "
                              "equivalently env G2VEC_FAULT_PLAN).")
+    # fleet resilience
+    parser.add_argument("--fleet-size", type=int, default=0, metavar="N",
+                        help="Launch and supervise an N-rank fleet with "
+                             "degraded-mesh resume: on peer death the mesh "
+                             "is re-planned over the surviving devices and "
+                             "the fleet relaunches with --resume from the "
+                             "sharded checkpoint (0 = off).")
+    parser.add_argument("--fleet-devices-per-rank", type=int, default=0,
+                        help="Devices each fleet rank hosts (0 = mesh size "
+                             "/ fleet size; on --platform cpu these are "
+                             "virtual devices).")
+    parser.add_argument("--fleet-liveness-dir", type=str, default=None,
+                        metavar="DIR",
+                        help="Shared dir for per-rank heartbeat/liveness "
+                             "files; enables the heartbeat thread and "
+                             "watchdog blame attribution (the fleet "
+                             "launcher creates one when unset).")
+    parser.add_argument("--fleet-heartbeat-interval", type=float,
+                        default=1.0,
+                        help="Seconds between liveness beats (default 1).")
+    parser.add_argument("--fleet-watchdog-deadline", type=float, default=0.0,
+                        help="Seconds a blocking multihost collective may "
+                             "take before PeerTimeoutError names the "
+                             "missing/straggler rank(s); 0 (default) "
+                             "blocks forever (legacy semantics).")
+    parser.add_argument("--fleet-straggler-factor", type=float, default=0.0,
+                        help="Warn (straggler_warning metrics event) when "
+                             "a rank's stage time exceeds this multiple of "
+                             "the fleet median; 0 disables.")
     # multi-host
     parser.add_argument("--distributed", action="store_true",
                         help="Join the multi-process JAX runtime (one process "
@@ -328,6 +401,12 @@ def config_from_args(argv=None) -> G2VecConfig:
         supervise_retries=args.supervise_retries,
         supervise_backoff=args.supervise_backoff,
         fault_plan=args.fault_plan,
+        fleet_size=args.fleet_size,
+        fleet_devices_per_rank=args.fleet_devices_per_rank,
+        fleet_liveness_dir=args.fleet_liveness_dir,
+        fleet_heartbeat_interval=args.fleet_heartbeat_interval,
+        fleet_watchdog_deadline=args.fleet_watchdog_deadline,
+        fleet_straggler_factor=args.fleet_straggler_factor,
         distributed=args.distributed,
         coordinator=args.coordinator,
         process_id=args.process_id,

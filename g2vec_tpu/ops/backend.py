@@ -80,9 +80,12 @@ def resolve_walker_backend(cfg: "G2VecConfig") -> str:
 
         if jax.process_count() > 1:
             import numpy as np
-            from jax.experimental import multihost_utils
 
-            flags = multihost_utils.process_allgather(
-                np.array([avail], dtype=bool))
+            # Backend-aware transport (KV on CPU fleets, watchdogged XLA
+            # elsewhere) — a dead peer names itself instead of wedging.
+            from g2vec_tpu.parallel.distributed import host_allgather
+
+            flags = host_allgather("walker_backend",
+                                   np.array([avail], dtype=bool))
             avail = bool(flags.all())
     return "native" if avail else "device"

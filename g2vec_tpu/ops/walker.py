@@ -380,7 +380,7 @@ def _sharded_sparse_walk_fn(mesh, n_genes: int, len_path: int):
     """
     from jax.sharding import PartitionSpec as P
 
-    from g2vec_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+    from g2vec_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, shard_map
 
     def walk(nbr_idx_local, nbr_w_local, starts, keys):
         rows_per_shard = nbr_idx_local.shape[0]
@@ -400,7 +400,7 @@ def _sharded_sparse_walk_fn(mesh, n_genes: int, len_path: int):
         path_list = _sparse_path_scan(nbr_rows, starts, uniforms, len_path)
         return _packed_from_path_list(path_list, n_genes)
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         walk, mesh=mesh,
         in_specs=(P(MODEL_AXIS, None), P(MODEL_AXIS, None),
                   P(DATA_AXIS), P(DATA_AXIS, None)),

@@ -40,6 +40,11 @@ _ENV_NPROC = "G2VEC_NUM_PROCESSES"
 
 _initialized = False
 
+# Structured records of initialize() outcomes that matter operationally,
+# queued until a metrics stream exists to receive them (initialize runs
+# before the pipeline opens --metrics-jsonl). pipeline.run drains this.
+_pending_events: list = []
+
 
 def initialize(coordinator: Optional[str] = None,
                process_id: Optional[int] = None,
@@ -47,7 +52,9 @@ def initialize(coordinator: Optional[str] = None,
     """Join (or bootstrap) the multi-process JAX runtime. Idempotent.
 
     Argument > environment > auto-detection (TPU metadata). Must run before
-    the first jax backend use in the process.
+    the first jax backend use in the process. After :func:`shutdown` the
+    module is re-initializable — an in-process supervisor restart can tear
+    the runtime down and rejoin.
     """
     global _initialized
     if _initialized:
@@ -76,7 +83,9 @@ def initialize(coordinator: Optional[str] = None,
         # is a no-op rather than an error (useful for smoke tests). LOUD:
         # on a misconfigured fleet launch every process would land here
         # believing it is process 0 and write the same outputs (ADVICE.md
-        # round 1) — the warning is the only visible symptom.
+        # round 1) — so besides the stderr warning, a structured
+        # ``single_process_fallback`` event is queued for the metrics
+        # stream, where post-hoc tooling actually looks.
         import sys
 
         print("g2vec_tpu: WARNING: --distributed found no coordinator "
@@ -93,7 +102,80 @@ def initialize(coordinator: Optional[str] = None,
         jax.distributed.initialize(
             coordinator_address=f"127.0.0.1:{port}",
             num_processes=1, process_id=0)
+        _pending_events.append({
+            "event": "single_process_fallback",
+            "reason": "no coordinator: no TPU metadata and no "
+                      "G2VEC_COORDINATOR/PROCESS_ID/NUM_PROCESSES",
+            "coordinator": f"127.0.0.1:{port}"})
     _initialized = True
+
+
+def drain_pending_events() -> list:
+    """Hand queued initialize() events to the caller (the pipeline emits
+    them into the metrics stream); the queue empties."""
+    out, _pending_events[:] = list(_pending_events), []
+    return out
+
+
+def shutdown() -> None:
+    """Tear down the distributed runtime and make :func:`initialize`
+    callable again (reset-safe ``_initialized``). Safe to call when never
+    initialized. An in-process supervisor restart uses this to rejoin
+    after a runtime teardown instead of silently reusing dead state."""
+    global _initialized
+    if not _initialized:
+        return
+    import jax
+
+    try:
+        jax.distributed.shutdown()
+    except Exception as e:  # noqa: BLE001 — a dead runtime must not block
+        import warnings
+
+        warnings.warn(f"jax.distributed.shutdown failed ({e!r}); "
+                      "resetting the module flag anyway", RuntimeWarning)
+    _initialized = False
+
+
+def cpu_fleet() -> bool:
+    """True in a multi-process run on the CPU backend — where XLA cannot
+    compile cross-process computations (``Multiprocess computations aren't
+    implemented on the CPU backend``), so device stages run replicated on
+    process-local meshes and every host-data collective rides the
+    coordination-service KV transport (parallel/hostcomm.py)."""
+    import jax
+
+    return jax.process_count() > 1 and jax.default_backend() == "cpu"
+
+
+def host_allgather(name: str, arr) -> "np.ndarray":  # noqa: F821
+    """Backend-aware host-array allgather: ``[nproc, *arr.shape]``.
+
+    COLLECTIVE. CPU fleets use the KV transport (deadline-aware, names
+    missing ranks); backends with real cross-process XLA use
+    ``multihost_utils.process_allgather`` under the fleet watchdog, so a
+    dead peer surfaces as PeerTimeoutError instead of an eternal block.
+    """
+    import jax
+    import numpy as np
+
+    arr = np.asarray(arr)
+    if jax.process_count() == 1:
+        return arr[None]
+    from g2vec_tpu.resilience import fleet
+
+    if cpu_fleet():
+        from g2vec_tpu.parallel import hostcomm
+
+        return hostcomm.allgather_array(
+            name, arr, deadline=fleet.config().watchdog_deadline or None)
+    from g2vec_tpu.resilience.faults import fault_point
+
+    fault_point("allgather")
+    from jax.experimental import multihost_utils
+
+    return fleet.collective_watchdog(
+        name, lambda: np.asarray(multihost_utils.process_allgather(arr)))
 
 
 def make_global_mesh(mesh_shape: Tuple[int, int],
@@ -137,16 +219,26 @@ def fetch_global(arr) -> "np.ndarray":  # noqa: F821 — np imported lazily
     ``np.asarray``/``jax.device_get`` raise on a global array whose shards
     live on devices other processes own (e.g. the model-sharded W_ih under
     a multi-host mesh). This gathers the full value on every process — it
-    is a COLLECTIVE: all processes must call it, in the same order.
+    is a COLLECTIVE: all processes must call it, in the same order. The
+    gather runs under the fleet watchdog: with a configured
+    ``--fleet-watchdog-deadline`` a dead/straggling peer raises
+    :class:`~g2vec_tpu.resilience.fleet.PeerTimeoutError` naming the
+    suspect rank(s) instead of blocking forever.
     """
     import jax
     import numpy as np
 
     if getattr(arr, "is_fully_addressable", True):
         return np.asarray(jax.device_get(arr))
+    from g2vec_tpu.resilience import fleet
+    from g2vec_tpu.resilience.faults import fault_point
+
+    fault_point("allgather")
     from jax.experimental import multihost_utils
 
-    return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+    return fleet.collective_watchdog(
+        "fetch_global",
+        lambda: np.asarray(multihost_utils.process_allgather(arr, tiled=True)))
 
 
 def sharded_native_path_set(src, dst, w, n_genes: int, *, len_path: int,
@@ -164,11 +256,12 @@ def sharded_native_path_set(src, dst, w, n_genes: int, *, len_path: int,
     COLLECTIVE: all processes must call it with identical arguments. The
     native toolchain is availability-checked across processes FIRST, so a
     host without g++ fails every process with one clear error instead of
-    wedging the allgather.
+    wedging the allgather. All three gathers ride :func:`host_allgather`,
+    so they work on CPU fleets (KV transport) and time out with named
+    ranks under the fleet watchdog everywhere.
     """
     import jax
     import numpy as np
-    from jax.experimental import multihost_utils
 
     from g2vec_tpu.ops.backend import native_walker_available
     from g2vec_tpu.ops.host_walker import walk_packed_rows
@@ -180,8 +273,8 @@ def sharded_native_path_set(src, dst, w, n_genes: int, *, len_path: int,
         return generate_path_set_native(src, dst, w, n_genes,
                                         len_path=len_path, reps=reps,
                                         seed=seed, n_threads=n_threads)
-    avail = multihost_utils.process_allgather(
-        np.array([native_walker_available()], dtype=bool))
+    avail = host_allgather(
+        "native_avail", np.array([native_walker_available()], dtype=bool))
     if not avail.all():
         missing = [int(p) for p in np.nonzero(~avail.reshape(-1))[0]]
         raise RuntimeError(
@@ -200,9 +293,9 @@ def sharded_native_path_set(src, dst, w, n_genes: int, *, len_path: int,
     nbytes = (n_genes + 7) // 8
     padded = np.zeros((per, nbytes), dtype=np.uint8)
     padded[:rows.shape[0]] = rows
-    counts = multihost_utils.process_allgather(
-        np.array([rows.shape[0]], dtype=np.int64))          # [nproc, 1]
-    gathered = multihost_utils.process_allgather(padded)    # [nproc, per, nb]
+    counts = host_allgather(
+        "native_counts", np.array([rows.shape[0]], dtype=np.int64))
+    gathered = host_allgather("native_rows", padded)    # [nproc, per, nb]
     counts = counts.reshape(-1)
     out: set = set()
     for p in range(nproc):

@@ -1,0 +1,195 @@
+"""Host-side collectives over the JAX coordination-service KV store.
+
+Why this exists: the multihost collectives this framework needs outside of
+jit — the packed-row allgather behind ``sharded_native_path_set``, the
+coordinator-broadcast checkpoint restore, the per-stage duration gather the
+straggler detector runs — were all built on ``jax.experimental
+.multihost_utils``, which lowers to XLA programs over a global mesh. Two
+problems at fleet scale:
+
+1. XLA collectives BLOCK FOREVER when a peer dies, stalls, or never joins —
+   a single preempted host wedges every other rank with no diagnostic
+   (the exact failure mode resilience/fleet.py exists to convert into a
+   named, classified, retryable error).
+2. The CPU backend cannot run cross-process XLA computations at all
+   (``Multiprocess computations aren't implemented on the CPU backend``),
+   so none of those paths could even be exercised by a real multi-process
+   test off-TPU.
+
+The coordination service (the distributed KV store + barriers every
+``jax.distributed.initialize`` brings up, on every backend) solves both:
+values are plain host bytes, every blocking read takes a deadline, and a
+missed deadline identifies exactly WHICH rank never published — the
+attribution a watchdog needs to say "rank 1 is the straggler" instead of
+"something hung". These helpers are therefore the transport for every
+host-data collective in ``parallel/distributed.py`` on backends without
+cross-process XLA, and the fleet watchdog's rank-attribution source
+everywhere.
+
+Collective contract (same as multihost_utils): every process calls every
+helper in the same program order. Keys are namespaced by a process-local
+monotonically increasing sequence number, so the order itself is the only
+thing that must agree; a restarted supervisor attempt starts a fresh
+process and therefore a fresh sequence. Published values are left in the
+store (the coordination service dies with the job; payloads here are
+kilobytes except the checkpoint broadcast, which is one-shot per resume).
+"""
+from __future__ import annotations
+
+import base64
+import io
+import itertools
+import time
+from typing import List, Optional
+
+import numpy as np
+
+#: Deadline used when the caller passes 0/None — effectively "block like the
+#: legacy collective did", but still bounded so a wedged fleet eventually
+#: surfaces an error instead of holding its slot forever.
+DEFAULT_DEADLINE_S = 7 * 24 * 3600.0
+
+_seq = itertools.count()
+
+
+def kv_client():
+    """The process's coordination-service client, or None outside a
+    ``jax.distributed.initialize``-ed run."""
+    try:
+        from jax._src import distributed as _jdist
+
+        return _jdist.global_state.client
+    except Exception:  # noqa: BLE001 — jax layout drift: treat as absent
+        return None
+
+
+def _is_deadline_error(e: BaseException) -> bool:
+    msg = str(e)
+    return "DEADLINE_EXCEEDED" in msg or "timed out" in msg.lower()
+
+
+# The KV payload encoding rides the STRING key/value API: the pinned
+# jaxlib's ``*_bytes`` variants segfault outright (observed on both the
+# 1-byte and the get side), while string values are solid to multi-MB. The
+# leading "1" frames the value so empty payloads (barriers) stay non-empty.
+
+def _encode(payload: bytes) -> str:
+    return "1" + base64.b64encode(payload).decode("ascii")
+
+
+def _decode(value: str) -> bytes:
+    return base64.b64decode(value[1:])
+
+
+def allgather_bytes(name: str, payload: bytes, *,
+                    deadline: Optional[float] = None) -> List[bytes]:
+    """Gather one bytes payload per rank, in rank order. COLLECTIVE.
+
+    On deadline expiry raises :class:`~g2vec_tpu.resilience.fleet
+    .PeerTimeoutError` naming every rank whose payload never arrived —
+    enriched with heartbeat-staleness detail when a liveness dir is
+    configured (dead host vs live straggler).
+    """
+    import jax
+
+    from g2vec_tpu.resilience import fleet
+    from g2vec_tpu.resilience.faults import fault_point
+
+    nproc = jax.process_count()
+    if nproc == 1:
+        return [payload]
+    # The distributed fault seam: a scoped stall/kill here models a rank
+    # that never reaches the collective. Fires BEFORE the publish so the
+    # faulted rank's key stays absent — exactly what its peers then report.
+    fault_point("allgather")
+    client = kv_client()
+    if client is None:
+        raise RuntimeError(
+            f"host collective {name!r} needs the coordination service; "
+            "was jax.distributed.initialize() skipped?")
+    rank = jax.process_index()
+    seq = next(_seq)
+    fleet.note_collective(name, seq)
+    key = f"g2vec/ag/{seq}/{name}"
+    client.key_value_set(f"{key}/{rank}", _encode(payload))
+    budget = deadline if deadline else DEFAULT_DEADLINE_S
+    t_end = time.monotonic() + budget
+    out: List[Optional[bytes]] = [None] * nproc
+    out[rank] = payload
+    missing: List[int] = []
+    for peer in range(nproc):
+        if peer == rank:
+            continue
+        left_ms = max(1, int((t_end - time.monotonic()) * 1000))
+        try:
+            out[peer] = _decode(client.blocking_key_value_get(
+                f"{key}/{peer}", left_ms))
+        except Exception as e:  # noqa: BLE001 — classify, don't swallow
+            if not _is_deadline_error(e):
+                raise
+            missing.append(peer)
+    if missing:
+        raise fleet.PeerTimeoutError(
+            f"collective {name!r} (seq {seq}) exceeded its "
+            f"{budget:.1f}s deadline; missing rank(s): {missing}"
+            f"{fleet.describe_ranks(missing)}",
+            collective=name, suspects=tuple(missing))
+    return out  # type: ignore[return-value] — no None gaps past the raise
+
+
+def allgather_array(name: str, arr: np.ndarray, *,
+                    deadline: Optional[float] = None) -> np.ndarray:
+    """process_allgather semantics for a host array: returns the
+    ``[nproc, *arr.shape]`` stack (every rank must contribute one array of
+    the same shape/dtype)."""
+    arr = np.ascontiguousarray(arr)
+    buf = io.BytesIO()
+    np.save(buf, arr, allow_pickle=False)
+    parts = allgather_bytes(name, buf.getvalue(), deadline=deadline)
+    return np.stack([np.load(io.BytesIO(p), allow_pickle=False)
+                     for p in parts])
+
+
+def broadcast_bytes(name: str, payload: Optional[bytes], *,
+                    deadline: Optional[float] = None) -> bytes:
+    """Rank 0 publishes ``payload``; every rank returns it. COLLECTIVE."""
+    import jax
+
+    from g2vec_tpu.resilience import fleet
+    from g2vec_tpu.resilience.faults import fault_point
+
+    nproc = jax.process_count()
+    if nproc == 1:
+        if payload is None:
+            raise ValueError(f"broadcast {name!r}: rank 0 payload is None")
+        return payload
+    fault_point("allgather")
+    client = kv_client()
+    if client is None:
+        raise RuntimeError(
+            f"host broadcast {name!r} needs the coordination service; "
+            "was jax.distributed.initialize() skipped?")
+    seq = next(_seq)
+    fleet.note_collective(name, seq)
+    key = f"g2vec/bc/{seq}/{name}"
+    if jax.process_index() == 0:
+        if payload is None:
+            raise ValueError(f"broadcast {name!r}: rank 0 payload is None")
+        client.key_value_set(key, _encode(payload))
+        return payload
+    budget = deadline if deadline else DEFAULT_DEADLINE_S
+    try:
+        return _decode(client.blocking_key_value_get(
+            key, max(1, int(budget * 1000))))
+    except Exception as e:  # noqa: BLE001
+        if not _is_deadline_error(e):
+            raise
+        raise fleet.PeerTimeoutError(
+            f"broadcast {name!r} (seq {seq}) exceeded its {budget:.1f}s "
+            f"deadline; missing rank(s): [0]{fleet.describe_ranks([0])}",
+            collective=name, suspects=(0,)) from e
+
+
+def barrier(name: str, *, deadline: Optional[float] = None) -> None:
+    """All ranks rendezvous; stragglers are named on deadline expiry."""
+    allgather_bytes(f"barrier/{name}", b"", deadline=deadline)

@@ -109,3 +109,21 @@ def make_mesh_context(mesh_shape: Optional[Tuple[int, int]],
 def pad_to_multiple(n: int, k: int) -> int:
     """Smallest multiple of k that is >= n (shard-even padding helper)."""
     return ((n + k - 1) // k) * k
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` across the API move+rename.
+
+    jax >= 0.6 exposes it top-level with the replication check spelled
+    ``check_vma``; older releases (the pinned 0.4.x toolchain) only have
+    ``jax.experimental.shard_map.shard_map`` with the same check spelled
+    ``check_rep``. Every in-repo caller goes through this shim so the walker
+    and trainer track the drift in exactly one place.
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=check_vma)

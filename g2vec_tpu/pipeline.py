@@ -96,6 +96,19 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
         # form needs no install. Re-installing on a supervised retry keeps
         # already-fired once-only entries fired.
         install_plan(cfg.fault_plan)
+    if cfg.distributed:
+        # Idempotent when __main__ already joined; after a runtime
+        # teardown (distributed.shutdown) an in-process supervisor restart
+        # re-initializes here.
+        from g2vec_tpu.parallel.distributed import initialize
+
+        initialize(cfg.coordinator, cfg.process_id, cfg.num_processes)
+    from g2vec_tpu.resilience import fleet
+
+    fleet.configure(liveness_dir=cfg.fleet_liveness_dir,
+                    heartbeat_interval=cfg.fleet_heartbeat_interval,
+                    watchdog_deadline=cfg.fleet_watchdog_deadline,
+                    straggler_factor=cfg.fleet_straggler_factor)
     if cfg.debug_nans:
         jax.config.update("jax_debug_nans", True)
     if cfg.compilation_cache:
@@ -128,6 +141,26 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
     # A resumed run APPENDS: its records continue the interrupted attempt's
     # stream (and the supervisor's retry/resume events in between survive).
     metrics = MetricsWriter(cfg.metrics_jsonl, append=cfg.resume)
+    if cfg.distributed:
+        # Structured init-outcome records (e.g. single_process_fallback —
+        # the misconfigured-fleet hazard whose only other symptom is one
+        # stderr line) land in the stream ahead of the run's own records.
+        from g2vec_tpu.parallel.distributed import drain_pending_events
+
+        for ev in drain_pending_events():
+            metrics.emit(ev.pop("event"), **ev)
+    # Liveness beacon + per-stage fleet barriers (no-ops unless --fleet-*
+    # flags enable them; see resilience/fleet.py).
+    fleet.start_heartbeat(metrics)
+
+    def _stage_edge(name: str) -> None:
+        # Post-stage fleet barrier + straggler check: a rank that died
+        # mid-stage surfaces here as PeerTimeoutError naming it, at the
+        # stage edge, instead of wedging an arbitrary later collective.
+        if cfg.distributed:
+            fleet.stage_barrier(name, timer.as_dict().get(name, 0.0),
+                                metrics, console)
+
     if cfg.profile_dir:
         jax.profiler.start_trace(cfg.profile_dir)
 
@@ -139,13 +172,16 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
 
         console(">>> 1. Load data")
         fault_point("load")
+        fleet.note_phase("load")
         with timer.stage("load"):
             data = load_expression(cfg.expression_file, use_native=cfg.use_native_io)
             clinical = load_clinical(cfg.clinical_file)
             network = load_network(cfg.network_file)
+        _stage_edge("load")
 
         console(">>> 2. Preprocess data")
         fault_point("preprocess")
+        fleet.note_phase("preprocess")
         with timer.stage("preprocess"):
             data.label = match_labels(clinical, data.sample)
             common = find_common_genes(network.genes, data.gene)
@@ -153,6 +189,7 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
             data = restrict_data(data, common)
             gene2idx = make_gene2idx(data.gene)
             src, dst = edges_to_indices(network, gene2idx)
+        _stage_edge("preprocess")
         n_samples, n_genes = data.expr.shape
         n_edges = len(network.edges)
         console("    n_samples: %d" % n_samples)
@@ -164,9 +201,25 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
         console("    *** most time consuming step ***")
         key = jax.random.key(cfg.seed)
         if cfg.distributed and cfg.mesh_shape:
-            from g2vec_tpu.parallel.distributed import make_global_mesh
+            from g2vec_tpu.parallel.distributed import (cpu_fleet,
+                                                        make_global_mesh)
 
-            mesh_ctx = make_global_mesh(cfg.mesh_shape)
+            if cpu_fleet():
+                # The CPU backend cannot compile cross-process XLA, so a
+                # CPU fleet runs its device stages REPLICATED on a
+                # process-local mesh (deterministic: every rank lands on
+                # identical state) and divides only the host-side walk
+                # work across ranks (sharded_native_path_set). The local
+                # mesh is the global plan folded onto this rank's devices.
+                local = fleet.plan_mesh(len(jax.local_devices()),
+                                        prefer_model=cfg.mesh_shape[1])
+                console(f"    [fleet] cpu backend: replicated local mesh "
+                        f"{local[0]}x{local[1]} per rank "
+                        f"(global plan {cfg.mesh_shape})")
+                mesh_ctx = make_mesh_context(local,
+                                             devices=jax.local_devices())
+            else:
+                mesh_ctx = make_global_mesh(cfg.mesh_shape)
         else:
             mesh_ctx = make_mesh_context(cfg.mesh_shape)
         # "auto" = host-walks-chip-trains: the walk step is CPU-shaped
@@ -177,6 +230,7 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
         walker_backend = resolve_walker_backend(cfg)
         path_sets = []
         fault_point("paths")
+        fleet.note_phase("paths")
         with timer.stage("paths"):
             for i, group in enumerate(["g", "p"]):
                 expr_group = data.expr[data.label == i]
@@ -224,6 +278,7 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
             paths, labels = integrate_path_sets(path_sets[0], path_sets[1],
                                                 n_genes, packed=True)
             gene_freq = count_gene_freq(paths, labels, data.gene, packed=True)
+        _stage_edge("paths")
         n_paths = paths.shape[0]
         if n_paths < 2:
             raise ValueError(
@@ -245,6 +300,7 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
             metrics.emit("epoch", step=step, acc_val=acc_val, acc_tr=acc_tr, secs=secs)
 
         fault_point("train")
+        fleet.note_phase("train")
         with timer.stage("train"):
             result = train_cbow(
                 paths, labels, packed_genes=n_genes,
@@ -256,6 +312,7 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
                 checkpoint_dir=cfg.checkpoint_dir, resume=cfg.resume,
                 checkpoint_every=cfg.checkpoint_every,
                 checkpoint_layout=cfg.checkpoint_layout)
+        _stage_edge("train")
         if result.stopped_early:
             reporter.on_stop(result.stop_epoch, result.acc_val, result.acc_tr)
         console("    Optimization Finish")
@@ -265,18 +322,22 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
 
         console(">>> 5. Find L-groups")
         fault_point("lgroups")
+        fleet.note_phase("lgroups")
         with timer.stage("lgroups"):
             lgroup_idx = find_lgroups(
                 result.w_ih, data.gene, gene_freq,
                 key=jax.random.key(cfg.kmeans_seed), k=cfg.n_lgroups,
                 compat_tiebreak=cfg.compat_lgroup_tiebreak, iters=cfg.kmeans_iters)
+        _stage_edge("lgroups")
 
         console(">>> 6. Select biomarkers with gene scores")
         fault_point("biomarkers")
+        fleet.note_phase("biomarkers")
         with timer.stage("biomarkers"):
             biomarkers, _ = select_biomarkers(
                 result.w_ih, data.expr, data.label, data.gene, lgroup_idx,
                 cfg.numBiomarker, score_mix=cfg.score_mix)
+        _stage_edge("biomarkers")
 
         console(">>> 7. Save results")
         write_outputs = True
@@ -285,6 +346,7 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
 
             write_outputs = is_coordinator()
         fault_point("save")
+        fleet.note_phase("save")
         with timer.stage("save"):
             outputs = []
             if write_outputs:
@@ -293,6 +355,7 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
                     write_lgroups(cfg.result_name, lgroup_idx, data.gene),
                     write_vectors(cfg.result_name, result.w_ih, data.gene),
                 ]
+        _stage_edge("save")
         for path in outputs:
             console("    %s" % path)
         metrics.emit("done", outputs=outputs, stage_seconds=timer.as_dict())
@@ -305,6 +368,7 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
             train_history=result.history, acc_val=result.acc_val,
             stage_seconds=timer.as_dict(), walker_backend=walker_backend)
     finally:
+        fleet.stop_heartbeat()
         if cfg.profile_dir:
             jax.profiler.stop_trace()
         metrics.close()

@@ -16,13 +16,21 @@ kills its whole run with no recovery path):
   bounded retry loop (exponential backoff + jitter), classifies failures as
   retryable vs fatal, re-enters via resume, and emits ``retry`` / ``resume``
   / ``gave_up`` events to the MetricsWriter JSONL stream.
+- :mod:`g2vec_tpu.resilience.fleet` — the multi-process extension:
+  per-rank heartbeats/liveness files, deadline watchdogs over every
+  blocking multihost collective (``PeerTimeoutError`` names the missing
+  rank instead of hanging), per-stage straggler detection, and the
+  degraded-mesh fleet supervisor (on peer death: re-plan the mesh over
+  the surviving devices, relaunch, resume from the sharded checkpoint).
 
 This package must stay importable without jax: the fault hooks run inside
-modules (native bindings, CLI entry) that are deliberately jax-free.
+modules (native bindings, CLI entry) that are deliberately jax-free, and
+``fleet`` defers every jax import to call time for the same reason.
 """
 from g2vec_tpu.resilience.faults import (FaultPlanError, InjectedFatal,
                                          InjectedFault, fault_point,
                                          install_plan)
+from g2vec_tpu.resilience.fleet import PeerTimeoutError
 
 __all__ = ["fault_point", "install_plan", "InjectedFault", "InjectedFatal",
-           "FaultPlanError"]
+           "FaultPlanError", "PeerTimeoutError"]

@@ -33,6 +33,11 @@ Keys:
   ``corrupt`` flips bytes in the middle of the file the seam passes as
   ``path`` (checkpoint seams) and then RETURNS — a torn write that the
   writer believes succeeded, detectable only by manifest verification.
+- ``process`` — only fire on this rank of a multi-process (fleet) run, e.g.
+  ``process=1,stage=allgather,kind=stall`` stalls rank 1 at the collective
+  entry while its peers proceed into the watchdog. Rank identity comes from
+  ``G2VEC_PROCESS_ID`` (exported by every fleet launcher) or
+  ``jax.process_index()``; entries without ``process=`` fire on every rank.
 - ``times`` — fire at most this many times (default 1).
 - ``skip`` — let the first N matching hits pass before firing (default 0;
   e.g. ``stage=checkpoint_finalize,kind=corrupt,skip=1`` corrupts the
@@ -53,6 +58,7 @@ import dataclasses
 import json
 import os
 import signal
+import sys
 import time
 from typing import List, Optional
 
@@ -62,10 +68,16 @@ ENV_STATE = "G2VEC_FAULT_STATE"
 KINDS = ("crash", "fatal", "sigkill", "stall", "corrupt")
 
 #: The seams the pipeline exposes. fault_point() accepts only these so a
-#: typo'd plan fails at install time, not by silently never firing.
+#: typo'd plan fails at install time, not by silently never firing. The last
+#: three are the distributed seams (resilience/fleet.py): ``allgather`` fires
+#: at the entry of every host-side collective gather, ``stage_barrier`` at
+#: the per-stage fleet barrier, ``heartbeat`` inside the liveness thread's
+#: beat loop (a ``crash`` there silently stops the beats — the shape of a
+#: host whose monitoring died before the host did).
 SEAMS = ("load", "preprocess", "paths", "train", "lgroups", "biomarkers",
          "save", "checkpoint_write", "checkpoint_finalize",
-         "native_load", "native_walker_load")
+         "native_load", "native_walker_load",
+         "allgather", "stage_barrier", "heartbeat")
 
 
 class FaultPlanError(ValueError):
@@ -93,6 +105,7 @@ class _Entry:
     times: int = 1
     skip: int = 0
     seconds: float = 300.0
+    process: Optional[int] = None   # only fire on this rank (None = any)
     seen: int = 0       # matching hits so far (this process; drives skip)
 
     @property
@@ -123,11 +136,11 @@ def parse_plan(spec: str) -> List[_Entry]:
             k, v = tok.split("=", 1)
             fields[k.strip()] = v.strip()
         unknown = set(fields) - {"stage", "kind", "epoch", "times", "skip",
-                                 "seconds"}
+                                 "seconds", "process"}
         if unknown:
             raise FaultPlanError(
                 f"unknown fault plan keys {sorted(unknown)} in {part!r} "
-                "(want stage/kind/epoch/times/skip/seconds)")
+                "(want stage/kind/epoch/times/skip/seconds/process)")
         if "stage" not in fields:
             raise FaultPlanError(f"fault plan entry {part!r} needs stage=")
         if fields["stage"] not in SEAMS:
@@ -144,10 +157,12 @@ def parse_plan(spec: str) -> List[_Entry]:
                 epoch=int(fields["epoch"]) if "epoch" in fields else None,
                 times=int(fields.get("times", 1)),
                 skip=int(fields.get("skip", 0)),
-                seconds=float(fields.get("seconds", 300.0))))
+                seconds=float(fields.get("seconds", 300.0)),
+                process=(int(fields["process"]) if "process" in fields
+                         else None)))
         except ValueError as e:
             raise FaultPlanError(
-                f"non-numeric epoch/times/skip/seconds in {part!r}: "
+                f"non-numeric epoch/times/skip/seconds/process in {part!r}: "
                 f"{e}") from e
     return entries
 
@@ -233,12 +248,36 @@ def _fire(entry: _Entry, seam: str, epoch: Optional[int],
         _corrupt_file(path)    # silent: the torn write "succeeds"
 
 
+def current_rank() -> int:
+    """The process's rank for ``process=K`` fault scoping.
+
+    Fleet launches (resilience/fleet.py and real multi-host drivers) export
+    ``G2VEC_PROCESS_ID``, so the common case needs no jax. Fall back to
+    ``jax.process_index()`` only when jax is already imported — this hook
+    must never be the thing that drags the backend up.
+    """
+    pid = os.environ.get("G2VEC_PROCESS_ID")
+    if pid is not None:
+        try:
+            return int(pid)
+        except ValueError:
+            pass
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            return int(jax.process_index())
+        except Exception:  # noqa: BLE001 — backend not up yet
+            return 0
+    return 0
+
+
 def fault_point(seam: str, *, epoch: Optional[int] = None,
                 path: Optional[str] = None) -> None:
     """Hook called at every named seam. No-op unless a plan entry matches.
 
-    ``epoch`` qualifies the ``train`` seam; ``path`` hands ``corrupt``
-    faults their target file (checkpoint seams).
+    ``epoch`` qualifies the ``train`` seam and the checkpoint seams (the
+    save's epoch); ``path`` hands ``corrupt`` faults their target file
+    (checkpoint seams). ``process=K`` entries fire only on rank K.
     """
     global _plan
     if _plan is None:
@@ -248,6 +287,8 @@ def fault_point(seam: str, *, epoch: Optional[int] = None,
     persisted = _load_state()
     for entry in _plan:
         if entry.stage != seam:
+            continue
+        if entry.process is not None and entry.process != current_rank():
             continue
         if entry.epoch is not None and (epoch is None or epoch < entry.epoch):
             continue

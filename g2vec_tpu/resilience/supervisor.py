@@ -50,7 +50,7 @@ from g2vec_tpu.resilience.faults import (ENV_PLAN, ENV_STATE, InjectedFatal,
 RETRYABLE_MESSAGE = re.compile(
     r"preempt|out of memory|resource[ _]?exhausted|oom\b|unavailable|"
     r"deadline|collective|all[- ]reduce|socket closed|connection reset|"
-    r"data[ _]?loss|injected (crash|stall)", re.I)
+    r"data[ _]?loss|injected (crash|stall)|PeerTimeoutError", re.I)
 
 _FATAL_TYPES = (InjectedFatal, FileNotFoundError, IsADirectoryError,
                 PermissionError, TypeError, KeyError, AttributeError,
@@ -155,6 +155,14 @@ def supervise(cfg, policy: Optional[RetryPolicy] = None,
                     f"retrying with --resume in {delay:.1f}s")
             sleep(delay)
             attempt += 1
+            if cfg.distributed:
+                # Tear the (possibly wedged) distributed runtime down so
+                # the re-entered pipeline.run re-initializes instead of
+                # silently reusing dead state — distributed.shutdown()
+                # resets the module's _initialized flag for exactly this.
+                from g2vec_tpu.parallel.distributed import shutdown
+
+                shutdown()
             cfg = dataclasses.replace(cfg, resume=True)
             with _event_writer(cfg) as events:
                 events.emit("resume", attempt=attempt,

@@ -35,6 +35,7 @@ manifest-less checkpoint from an older version still loads (legacy path).
 """
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import json
 import os
@@ -128,8 +129,13 @@ def save_state(directory: str, params: Any, opt_state: Any, snapshot: Any,
     """
     meta = np.array([float(epoch), before_val, before_tr, float(done)])
     if layout == "sharded":
-        return _save_sharded(directory, (params, opt_state, snapshot), meta,
-                             fingerprint)
+        from g2vec_tpu.parallel.distributed import cpu_fleet
+
+        state = (params, opt_state, snapshot)
+        if cpu_fleet():
+            return _save_sharded_cpu_fleet(directory, state, meta,
+                                           fingerprint)
+        return _save_sharded(directory, state, meta, fingerprint)
     if layout != "single":
         raise ValueError(f"unknown checkpoint layout {layout!r}")
     from g2vec_tpu.parallel.distributed import fetch_global
@@ -141,7 +147,7 @@ def save_state(directory: str, params: Any, opt_state: Any, snapshot: Any,
     if jax.process_index() != 0:
         return path
     os.makedirs(directory, exist_ok=True)
-    fault_point("checkpoint_write", path=path)
+    fault_point("checkpoint_write", path=path, epoch=epoch)
     tmp = path + ".tmp"
     np.savez(tmp, **arrays)
     # np.savez appends .npz to names without it.
@@ -168,7 +174,7 @@ def save_state(directory: str, params: Any, opt_state: Any, snapshot: Any,
                        path + PREV_SUFFIX + MANIFEST_SUFFIX)
     os.replace(written, path)
     _write_json_atomic(path + MANIFEST_SUFFIX, manifest)
-    fault_point("checkpoint_finalize", path=path)
+    fault_point("checkpoint_finalize", path=path, epoch=epoch)
     return path
 
 
@@ -225,8 +231,8 @@ def _save_sharded(directory: str, state: Any, meta: np.ndarray,
                 and n.rsplit(".", 1)[1].isdigit()]
     name = f"{SHARDED_NAME}.{max(existing, default=-1) + 1}"
     path = os.path.join(base, name)
-    fault_point("checkpoint_write", path=path)
-    with ocp.PyTreeCheckpointer() as ckptr:
+    fault_point("checkpoint_write", path=path, epoch=int(meta[0]))
+    with _orbax_local_io(), ocp.PyTreeCheckpointer() as ckptr:
         ckptr.save(path, args=ocp.args.PyTreeSave(_leaf_dict(state, meta)))
     if jax.process_index() == 0:
         _write_sharded_manifest(path, meta, fingerprint)
@@ -241,7 +247,62 @@ def _save_sharded(directory: str, state: Any, meta: np.ndarray,
             shutil.rmtree(stale, ignore_errors=True)
             if os.path.exists(stale + MANIFEST_SUFFIX):
                 os.unlink(stale + MANIFEST_SUFFIX)
-        fault_point("checkpoint_finalize", path=_largest_file(path))
+        fault_point("checkpoint_finalize", path=_largest_file(path),
+                    epoch=int(meta[0]))
+    return path
+
+
+@contextlib.contextmanager
+def _orbax_local_io():
+    """On CPU fleets orbax's end-of-op process sync lowers to an XLA
+    collective the CPU backend cannot run (``Multiprocess computations
+    aren't implemented``). Checkpoint I/O there is coordinator-write /
+    local-read by construction (see :func:`_save_sharded_cpu_fleet`), so
+    the sync is disabled for the duration of the orbax call; fleet-level
+    ordering is enforced by the KV barrier instead. No-op everywhere
+    else."""
+    from g2vec_tpu.parallel.distributed import cpu_fleet
+
+    if not cpu_fleet():
+        yield
+        return
+    from orbax.checkpoint.multihost import utils as _omh
+
+    orig = _omh.should_skip_process_sync
+    _omh.should_skip_process_sync = lambda: True
+    try:
+        yield
+    finally:
+        _omh.should_skip_process_sync = orig
+
+
+def _save_sharded_cpu_fleet(directory: str, state: Any, meta: np.ndarray,
+                            fingerprint: Optional[dict] = None) -> str:
+    """Sharded save for CPU fleets, where ranks train REPLICATED on
+    process-local meshes (the CPU backend has no cross-process XLA — see
+    parallel/distributed.cpu_fleet). Every rank holds the identical full
+    state, so the coordinator alone writes it — as host numpy leaves,
+    since orbax refuses host-local jax.Arrays in a multi-process runtime —
+    into the shared dir; peers rendezvous on the KV barrier so none races
+    ahead of a durable save. Every rank then passes the
+    ``checkpoint_finalize`` fault seam — the boundary ``process=K``
+    kill/stall tests target, guaranteed to sit AFTER the save committed on
+    all ranks. Restores reshard these leaves onto whatever mesh the
+    (possibly degraded) resuming run brings."""
+    import jax
+
+    from g2vec_tpu.parallel import hostcomm
+    from g2vec_tpu.resilience import fleet
+
+    path = directory
+    if jax.process_index() == 0:
+        host_state = jax.tree.map(
+            lambda leaf: np.asarray(jax.device_get(leaf)), state)
+        path = _save_sharded(directory, host_state, meta, fingerprint)
+    hostcomm.barrier("checkpoint_save",
+                     deadline=fleet.config().watchdog_deadline or None)
+    if jax.process_index() != 0:
+        fault_point("checkpoint_finalize", epoch=int(meta[0]))
     return path
 
 
@@ -364,7 +425,7 @@ def _restore_sharded_dir(path: str, like_leaves
     import orbax.checkpoint as ocp
 
     like = _leaf_dict(like_leaves, np.zeros(4, np.float64))
-    with ocp.PyTreeCheckpointer() as ckptr:
+    with _orbax_local_io(), ocp.PyTreeCheckpointer() as ckptr:
         # Validate shapes against the stored metadata FIRST, so a config
         # change surfaces as the same clear error the single layout raises
         # instead of an obscure tensorstore chunk mismatch. Older orbax
@@ -560,12 +621,13 @@ def _broadcast_from_coordinator(directory: str, like_leaves,
     """Process 0 reads the npz (with integrity verification + keep-previous
     fallback); every process receives the same state.
 
-    The status scalar goes first so a missing file or a validation error on
-    the coordinator surfaces as the SAME outcome on every process instead of
-    a hang in a half-entered collective.
+    The status travels WITH the payload so a missing file or a validation
+    error on the coordinator surfaces as the SAME outcome on every process
+    instead of a hang in a half-entered collective. CPU fleets ship the
+    state over the KV transport (one serialized npz, deadline-aware);
+    backends with cross-process XLA broadcast device-side under the fleet
+    watchdog.
     """
-    from jax.experimental import multihost_utils
-
     status = 0          # 0 = no checkpoint, 1 = ok, 2 = coordinator error
     leaves, meta, err = None, None, ""
     if jax.process_index() == 0:
@@ -580,17 +642,75 @@ def _broadcast_from_coordinator(directory: str, like_leaves,
         # collective.
         except Exception as e:  # noqa: BLE001
             status, err = 2, f"{type(e).__name__}: {e}"
-    status = int(multihost_utils.broadcast_one_to_all(np.int32(status)))
+    from g2vec_tpu.parallel.distributed import cpu_fleet
+
+    if cpu_fleet():
+        status, leaves, meta, err = _kv_broadcast_state(
+            status, leaves, meta, err, like_leaves)
+    else:
+        from jax.experimental import multihost_utils
+
+        from g2vec_tpu.resilience import fleet
+
+        status = int(fleet.collective_watchdog(
+            "checkpoint_restore_status",
+            lambda: multihost_utils.broadcast_one_to_all(np.int32(status))))
+        if status == 1:
+            # One collective for the whole state: non-coordinators
+            # contribute shape/dtype-matched zero protos (values ignored).
+            if leaves is None:
+                leaves = [np.zeros(np.shape(w), _leaf_dtype(w))
+                          for w in like_leaves]
+                meta = np.zeros(4, np.float64)
+            out, meta_b = fleet.collective_watchdog(
+                "checkpoint_restore_state",
+                lambda: multihost_utils.broadcast_one_to_all((leaves, meta)))
+            leaves = [np.asarray(x) for x in out]
+            meta = np.asarray(meta_b)
     if status == 0:
         return None
     if status == 2:
         raise ValueError(
             f"checkpoint restore failed on the coordinator: "
             f"{err or '(see process 0 logs)'}")
-    # One collective for the whole state: non-coordinators contribute
-    # shape/dtype-matched zero protos (their values are ignored).
-    if leaves is None:
-        leaves = [np.zeros(np.shape(w), _leaf_dtype(w)) for w in like_leaves]
-        meta = np.zeros(4, np.float64)
-    out, meta_b = multihost_utils.broadcast_one_to_all((leaves, meta))
-    return [np.asarray(x) for x in out], np.asarray(meta_b)
+    return leaves, meta
+
+
+def _kv_broadcast_state(status: int, leaves, meta, err: str, like_leaves
+                        ) -> Tuple[int, Optional[list],
+                                   Optional[np.ndarray], str]:
+    """Serialize (status, leaves, meta, err) on the coordinator into one
+    npz payload and ship it over the KV transport — the CPU-fleet stand-in
+    for ``broadcast_one_to_all``. ml_dtypes leaves (bfloat16) survive the
+    round trip the same way the on-disk format does: raw void bytes
+    reinterpreted against the expected leaf dtype on receive."""
+    import io
+
+    from g2vec_tpu.parallel import hostcomm
+    from g2vec_tpu.resilience import fleet
+
+    deadline = fleet.config().watchdog_deadline or None
+    payload = None
+    if jax.process_index() == 0:
+        buf = io.BytesIO()
+        arrays = {"status": np.int32(status), "err": np.array(err or "")}
+        if status == 1:
+            arrays.update({f"leaf_{i}": np.asarray(leaf)
+                           for i, leaf in enumerate(leaves)})
+            arrays["meta"] = np.asarray(meta)
+        np.savez(buf, **arrays)
+        payload = buf.getvalue()
+    payload = hostcomm.broadcast_bytes("checkpoint_restore", payload,
+                                       deadline=deadline)
+    with np.load(io.BytesIO(payload)) as data:
+        status = int(data["status"])
+        err = str(data["err"])
+        if status != 1:
+            return status, None, None, err
+        leaves = [data[f"leaf_{i}"] for i in range(len(like_leaves))]
+        meta = np.asarray(data["meta"])
+    for i, want in enumerate(like_leaves):
+        want_dtype = _leaf_dtype(want)
+        if leaves[i].dtype.kind == "V" and leaves[i].dtype != want_dtype:
+            leaves[i] = leaves[i].view(want_dtype)
+    return status, leaves, meta, err

@@ -124,7 +124,9 @@ def _make_chunk_fn(tx: optax.GradientTransformation, compute_dtype,
                 return pm.packed_matmul(x, w_ih, interpret)
             from jax.sharding import PartitionSpec as P
 
-            return jax.shard_map(
+            from g2vec_tpu.parallel.mesh import shard_map
+
+            return shard_map(
                 lambda xs, w: pm.packed_matmul(xs, w, interpret),
                 mesh=ctx.mesh,
                 in_specs=(ctx.packed_batch_spec, P(None, None)),

@@ -7,6 +7,7 @@ without parsing the console transcript.
 from __future__ import annotations
 
 import json
+import threading
 import time
 from typing import IO, Optional
 
@@ -18,25 +19,35 @@ class MetricsWriter:
     resumed runs and the resilience supervisor use it so the events of all
     attempts (config/epoch records, ``retry``/``resume``/``gave_up``) form
     one chronological stream per file.
+
+    Thread-safe: the fleet heartbeat thread (resilience/fleet.py) emits
+    into the same writer the pipeline's main thread uses; a lock keeps
+    every JSONL line whole and the sequence numbers strictly increasing.
     """
 
     def __init__(self, path: Optional[str], append: bool = False):
         mode = "a" if append else "w"
         self._fout: Optional[IO[str]] = open(path, mode) if path else None
         self._seq = 0
+        self._lock = threading.Lock()
 
     def emit(self, event: str, **fields) -> None:
         if self._fout is None:
             return
-        record = {"seq": self._seq, "ts": time.time(), "event": event, **fields}
-        self._fout.write(json.dumps(record) + "\n")
-        self._fout.flush()
-        self._seq += 1
+        with self._lock:
+            if self._fout is None:
+                return
+            record = {"seq": self._seq, "ts": time.time(), "event": event,
+                      **fields}
+            self._fout.write(json.dumps(record) + "\n")
+            self._fout.flush()
+            self._seq += 1
 
     def close(self) -> None:
-        if self._fout is not None:
-            self._fout.close()
-            self._fout = None
+        with self._lock:
+            if self._fout is not None:
+                self._fout.close()
+                self._fout = None
 
     def __enter__(self) -> "MetricsWriter":
         return self

@@ -79,3 +79,101 @@ def test_cli_flags_parse():
     assert cfg.distributed and cfg.coordinator == "host:99"
     assert cfg.process_id == 1 and cfg.num_processes == 4
     assert cfg.mesh_shape == (2, 2)
+
+
+# --------------------------------------------- fetch_global (PR-2 satellite)
+
+class _NonAddressable:
+    """Stand-in for a jax.Array whose shards live on other processes'
+    devices — unconstructible in one process, so only the attribute the
+    router consults is modelled."""
+
+    is_fully_addressable = False
+
+
+@pytest.fixture(autouse=True)
+def _inert_fleet():
+    from g2vec_tpu.resilience import fleet
+
+    fleet.configure()
+    yield
+    fleet.configure()
+
+
+def test_fetch_global_sharded_array_virtual_devices():
+    """Fully-addressable path on a REAL global array sharded over the 8
+    virtual devices — the exact layout a single-host mesh run fetches."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    ctx = dist.make_global_mesh((4, 2))
+    x = np.arange(32.0, dtype=np.float32).reshape(8, 4)
+    arr = jax.device_put(x, NamedSharding(ctx.mesh, P(DATA_AXIS, MODEL_AXIS)))
+    np.testing.assert_array_equal(dist.fetch_global(arr), x)
+
+
+def test_fetch_global_non_addressable_routes_to_allgather(monkeypatch):
+    from jax.experimental import multihost_utils
+
+    sentinel = np.arange(6.0)
+    calls = {}
+
+    def fake_allgather(a, tiled=False):
+        calls["tiled"] = tiled
+        return sentinel
+
+    monkeypatch.setattr(multihost_utils, "process_allgather", fake_allgather)
+    out = dist.fetch_global(_NonAddressable())
+    assert np.array_equal(out, sentinel)
+    assert calls["tiled"] is True
+
+
+def test_fetch_global_watchdog_names_the_hang(monkeypatch):
+    """A peer that never joins the allgather must surface as a named
+    PeerTimeoutError within the configured deadline, not an eternal block."""
+    import time
+
+    from jax.experimental import multihost_utils
+
+    from g2vec_tpu.resilience import fleet
+
+    monkeypatch.setattr(multihost_utils, "process_allgather",
+                        lambda a, tiled=False: time.sleep(30))
+    fleet.configure(watchdog_deadline=0.3)
+    t0 = time.time()
+    with pytest.raises(fleet.PeerTimeoutError, match="fetch_global"):
+        dist.fetch_global(_NonAddressable())
+    assert time.time() - t0 < 5.0
+
+
+# ---------------------------- sharded_native_path_set (PR-2 satellite)
+
+def test_sharded_native_missing_toolchain_fails_every_rank(monkeypatch):
+    """One host without g++ must fail with the clear cross-rank message —
+    the availability agreement runs BEFORE any row gather, so no rank can
+    wedge a half-entered collective. The agreement itself is symmetric
+    (every rank computes the same gathered vector), so asserting rank 0's
+    error text pins the message every rank raises."""
+    import jax
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    gathered = {}
+
+    def fake_host_allgather(name, arr):
+        gathered[name] = np.asarray(arr)
+        return np.array([[True], [False]])
+
+    monkeypatch.setattr(dist, "host_allgather", fake_host_allgather)
+    with pytest.raises(RuntimeError, match=r"process\(es\) \[1\]"):
+        dist.sharded_native_path_set(
+            np.zeros(2, np.int32), np.ones(2, np.int32),
+            np.ones(2, np.float32), 4, len_path=3, reps=1, seed=0)
+    # The gate really consulted the collective agreement, not a local probe.
+    assert "native_avail" in gathered
+
+
+def test_host_allgather_single_process_identity():
+    arr = np.arange(6.0).reshape(2, 3)
+    out = dist.host_allgather("t", arr)
+    assert out.shape == (1, 2, 3) and np.array_equal(out[0], arr)

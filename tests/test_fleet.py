@@ -1,0 +1,338 @@
+"""Unit tests for the fleet resilience layer (resilience/fleet.py,
+parallel/hostcomm.py) — everything that can be certified in one process on
+virtual devices. The true multi-rank behavior (KV collectives across
+processes, degraded-mesh relaunch, rank-scoped kills) runs in
+tests/test_multiprocess.py and the `fleet`-marked tests/test_fleet_e2e.py.
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from g2vec_tpu.parallel import hostcomm
+from g2vec_tpu.resilience import faults, fleet
+
+
+@pytest.fixture(autouse=True)
+def _clean_fleet(monkeypatch):
+    """Fleet config, heartbeat, and fault state are process-global: every
+    test starts and ends inert."""
+    monkeypatch.delenv(faults.ENV_PLAN, raising=False)
+    monkeypatch.delenv(faults.ENV_STATE, raising=False)
+    monkeypatch.delenv("G2VEC_PROCESS_ID", raising=False)
+    faults._reset_for_tests()
+    fleet.configure()
+    yield
+    fleet.stop_heartbeat()
+    fleet.configure()
+    faults._reset_for_tests()
+
+
+# ------------------------------------------------------------ mesh planning
+
+def test_plan_mesh_factorizations():
+    assert fleet.plan_mesh(4, prefer_model=1) == (4, 1)
+    assert fleet.plan_mesh(4, prefer_model=2) == (2, 2)
+    assert fleet.plan_mesh(2, prefer_model=2) == (1, 2)
+    # Model axis may shrink to the largest divisor, never grow.
+    assert fleet.plan_mesh(6, prefer_model=4) == (2, 3)
+    assert fleet.plan_mesh(3, prefer_model=2) == (3, 1)
+    assert fleet.plan_mesh(1, prefer_model=8) == (1, 1)
+    with pytest.raises(ValueError, match="0 devices"):
+        fleet.plan_mesh(0)
+
+
+# ------------------------------------------------- per-rank fault scoping
+
+def test_fault_plan_process_scoping(monkeypatch, tmp_path):
+    entries = faults.parse_plan("process=1,stage=allgather,kind=stall")
+    assert entries[0].process == 1 and entries[0].stage == "allgather"
+    with pytest.raises(faults.FaultPlanError, match="non-numeric"):
+        faults.parse_plan("stage=train,process=one")
+
+    faults.install_plan("process=1,stage=load,kind=crash")
+    monkeypatch.setenv("G2VEC_PROCESS_ID", "0")
+    faults.fault_point("load")          # rank 0: entry must not fire
+    monkeypatch.setenv("G2VEC_PROCESS_ID", "1")
+    with pytest.raises(faults.InjectedFault):
+        faults.fault_point("load")
+
+
+def test_distributed_seams_accepted_by_config():
+    from g2vec_tpu.config import G2VecConfig
+
+    cfg = G2VecConfig(fault_plan="process=1,stage=stage_barrier,kind=sigkill;"
+                                 "stage=heartbeat,kind=crash")
+    cfg.validate()
+
+
+# ------------------------------------------------------------- heartbeats
+
+def test_heartbeat_writes_liveness_and_metrics(tmp_path):
+    from g2vec_tpu.utils.metrics import MetricsWriter
+
+    mpath = str(tmp_path / "m.jsonl")
+    fleet.configure(liveness_dir=str(tmp_path), heartbeat_interval=0.02)
+    with MetricsWriter(mpath) as metrics:
+        hb = fleet.start_heartbeat(metrics)
+        assert hb is not None
+        fleet.note_phase("train")
+        deadline = time.time() + 5.0
+        while hb.beats < 4 and time.time() < deadline:
+            time.sleep(0.01)
+        fleet.stop_heartbeat()
+    rec = fleet.read_liveness(str(tmp_path), 0)
+    assert rec is not None and rec["rank"] == 0 and rec["beats"] >= 3
+    assert rec["phase"] == "train"
+    with open(mpath) as f:
+        events = [json.loads(ln) for ln in f if ln.strip()]
+    beats = [e for e in events if e["event"] == "heartbeat"]
+    assert len(beats) >= 4 and beats[-1]["phase"] == "train"
+
+
+def test_heartbeat_fault_seam_kills_only_the_thread(tmp_path):
+    faults.install_plan("stage=heartbeat,kind=crash")
+    fleet.configure(liveness_dir=str(tmp_path), heartbeat_interval=0.02)
+    hb = fleet.start_heartbeat()
+    deadline = time.time() + 5.0
+    while hb._thread.is_alive() and time.time() < deadline:
+        time.sleep(0.01)
+    # The injected crash stopped the beats (thread dead, liveness going
+    # stale) — but the process lives: exactly "monitoring died first".
+    assert not hb._thread.is_alive()
+    assert hb.beats == 1     # only the synchronous start() beat landed
+
+
+# ------------------------------------------------------ collective watchdog
+
+def test_watchdog_passes_results_and_errors_through():
+    fleet.configure(watchdog_deadline=5.0)
+    assert fleet.collective_watchdog("ok", lambda: 42) == 42
+    with pytest.raises(KeyError):
+        fleet.collective_watchdog("boom", lambda: {}["x"])
+
+
+def test_watchdog_times_out_and_names_suspects(tmp_path, monkeypatch):
+    fleet.configure(liveness_dir=str(tmp_path), heartbeat_interval=5.0,
+                    watchdog_deadline=0.3)
+    fleet.start_heartbeat()
+    # Fabricate a peer whose heartbeat went stale mid-collective.
+    with open(fleet.liveness_path(str(tmp_path), 1), "w") as f:
+        json.dump({"rank": 1, "ts": time.time() - 120.0, "beats": 7,
+                   "phase": "train", "collective": None,
+                   "collective_seq": None}, f)
+    monkeypatch.setattr(fleet, "_nranks", lambda: 2)
+    t0 = time.time()
+    with pytest.raises(fleet.PeerTimeoutError) as ei:
+        fleet.collective_watchdog("unit", lambda: time.sleep(30))
+    assert time.time() - t0 < 5.0       # raised at the deadline, not at 30s
+    assert ei.value.suspects == (1,)
+    assert "rank 1" in str(ei.value) and "stale" in str(ei.value)
+
+
+def test_watchdog_inline_when_disabled():
+    fleet.configure(watchdog_deadline=0.0)
+    evt = threading.Event()
+    assert fleet.collective_watchdog("inline", lambda: evt.is_set()) is False
+
+
+# ------------------------------------------------- single-process hostcomm
+
+def test_hostcomm_single_process_shortcuts():
+    assert hostcomm.allgather_bytes("a", b"payload") == [b"payload"]
+    arr = np.arange(6.0).reshape(2, 3)
+    out = hostcomm.allgather_array("b", arr)
+    assert out.shape == (1, 2, 3) and np.array_equal(out[0], arr)
+    assert hostcomm.broadcast_bytes("c", b"xyz") == b"xyz"
+    hostcomm.barrier("d")               # no-op, must not raise
+
+
+# ------------------------------------------------------ straggler detection
+
+class _Recorder:
+    def __init__(self):
+        self.events = []
+
+    def emit(self, event, **fields):
+        self.events.append({"event": event, **fields})
+
+
+def test_stage_barrier_flags_stragglers(monkeypatch):
+    fleet.configure(watchdog_deadline=5.0, straggler_factor=3.0)
+    monkeypatch.setattr(fleet, "_nranks", lambda: 4)
+    durs = np.asarray([[0.1], [0.11], [0.09], [1.2]])
+    monkeypatch.setattr(hostcomm, "allgather_array",
+                        lambda name, arr, deadline=None: durs)
+    rec = _Recorder()
+    lines = []
+    fleet.stage_barrier("paths", 0.1, rec, lines.append)
+    warns = [e for e in rec.events if e["event"] == "straggler_warning"]
+    assert len(warns) == 1 and warns[0]["rank"] == 3
+    assert warns[0]["stage"] == "paths"
+    assert any("rank 3" in ln for ln in lines)
+
+
+def test_stage_barrier_noop_when_inert(monkeypatch):
+    # Single process: never calls the transport at all.
+    called = []
+    monkeypatch.setattr(hostcomm, "allgather_array",
+                        lambda *a, **k: called.append(1))
+    fleet.configure(watchdog_deadline=5.0, straggler_factor=3.0)
+    fleet.stage_barrier("load", 0.1)
+    assert not called
+
+
+# --------------------------------------------------- supervisor integration
+
+def test_peer_timeout_classifies_retryable():
+    from g2vec_tpu.resilience.supervisor import (classify_child,
+                                                 classify_exception)
+
+    err = fleet.PeerTimeoutError("collective 'x' missing rank(s): [1]",
+                                 collective="x", suspects=(1,))
+    assert classify_exception(err) == "retryable"
+    assert classify_child(1, "g2vec_tpu.resilience.fleet.PeerTimeoutError: "
+                             "collective 'x' missing rank(s): [1]") \
+        == "retryable"
+
+
+def test_scrub_fleet_argv_keeps_child_flags():
+    argv = ["e.txt", "c.txt", "n.txt", "out", "--fleet-size", "2",
+            "--fleet-devices-per-rank", "2", "--mesh", "4x1", "--supervise",
+            "--supervise-retries", "3", "--fault-plan", "stage=load",
+            "--resume", "--fleet-watchdog-deadline", "5",
+            "--checkpoint-dir", "ck"]
+    out = fleet._scrub_fleet_argv(argv)
+    assert "--fleet-size" not in out and "--mesh" not in out
+    assert "--fault-plan" not in out and "--resume" not in out
+    assert "--supervise" not in out and "3" not in out
+    assert out[:4] == ["e.txt", "c.txt", "n.txt", "out"]
+    assert "--fleet-watchdog-deadline" in out and "--checkpoint-dir" in out
+
+
+def test_fleet_config_validation():
+    from g2vec_tpu.config import G2VecConfig, config_from_args
+
+    with pytest.raises(ValueError, match="fleet_size"):
+        G2VecConfig(fleet_size=1).validate()
+    with pytest.raises(ValueError, match="sharded"):
+        G2VecConfig(fleet_size=2, checkpoint_dir="ck").validate()
+    with pytest.raises(ValueError, match="evenly"):
+        G2VecConfig(fleet_size=2, mesh_shape=(3, 1)).validate()
+    cfg = config_from_args([
+        "e.txt", "c.txt", "n.txt", "out", "--fleet-size", "2", "--mesh",
+        "4x1", "--checkpoint-dir", "ck", "--checkpoint-layout", "sharded",
+        "--fleet-watchdog-deadline", "6", "--fleet-straggler-factor", "3",
+        "--fleet-liveness-dir", "L"])
+    assert cfg.fleet_size == 2 and cfg.fleet_watchdog_deadline == 6.0
+    assert cfg.fleet_straggler_factor == 3.0 and cfg.fleet_liveness_dir == "L"
+
+
+# ------------------------------------------- degraded-mesh reshard on load
+
+def _planted(rng, n_paths=120, n_genes=40):
+    labels = (rng.random(n_paths) < 0.5).astype(np.int32)
+    paths = np.zeros((n_paths, n_genes), dtype=np.int8)
+    half = n_genes // 2
+    for i, lab in enumerate(labels):
+        idx = rng.choice(half, size=5, replace=False) + (0 if lab == 0 else half)
+        paths[i, idx] = 1
+    return paths, labels
+
+
+def test_sharded_checkpoint_reshards_onto_degraded_mesh(tmp_path):
+    """The resume half of degraded-mesh recovery, single-process on virtual
+    devices: a sharded checkpoint written under a (4, 1) mesh restores onto
+    a (2, 1) mesh (orbax reshards each leaf onto the new shardings at
+    load). Terminal-state resume must hand back bit-identical vectors; a
+    mid-train resume must keep training without error."""
+    from g2vec_tpu.parallel.mesh import make_mesh_context
+    from g2vec_tpu.train.trainer import train_cbow
+
+    paths, labels = _planted(np.random.default_rng(0))
+    common = dict(hidden=8, learning_rate=0.05, compute_dtype="float32",
+                  seed=0, checkpoint_every=3, checkpoint_layout="sharded")
+    ck = str(tmp_path / "ck")
+    full = train_cbow(paths, labels, max_epochs=6, checkpoint_dir=ck,
+                      mesh_ctx=make_mesh_context((4, 1)), **common)
+    assert not full.stopped_early
+    resumed = train_cbow(paths, labels, max_epochs=6, checkpoint_dir=ck,
+                         resume=True, mesh_ctx=make_mesh_context((2, 1)),
+                         **common)
+    # Zero epochs left to retrain: the restored (resharded) state is final.
+    np.testing.assert_array_equal(resumed.w_ih, full.w_ih)
+
+    # Mid-train degrade: checkpoint at epoch 5 of 12 under (4, 1), then
+    # finish under (2, 1). Retrained epochs reassociate FP reductions, so
+    # parity with the uninterrupted (4, 1) run is close, not bit-exact —
+    # the boundary ARCHITECTURE.md documents.
+    ck2 = str(tmp_path / "ck2")
+    train_cbow(paths, labels, max_epochs=6, checkpoint_dir=ck2,
+               mesh_ctx=make_mesh_context((4, 1)), **common)
+    ref = train_cbow(paths, labels, max_epochs=12,
+                     mesh_ctx=make_mesh_context((4, 1)),
+                     **{k: v for k, v in common.items()
+                        if not k.startswith("checkpoint")})
+    degraded = train_cbow(paths, labels, max_epochs=12, checkpoint_dir=ck2,
+                          resume=True, mesh_ctx=make_mesh_context((2, 1)),
+                          **common)
+    assert not degraded.stopped_early
+    np.testing.assert_allclose(degraded.w_ih, ref.w_ih, rtol=2e-4, atol=1e-5)
+
+
+# ------------------------------------------------ initialize() satellites
+
+def test_initialize_fallback_emits_structured_event(monkeypatch):
+    import jax
+
+    from g2vec_tpu.parallel import distributed as dist
+
+    calls = []
+
+    def fake_init(**kwargs):
+        if not kwargs:
+            raise ValueError("no cluster")
+        calls.append(kwargs)
+
+    monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+    monkeypatch.setattr(dist, "_initialized", False)
+    for var in ("G2VEC_COORDINATOR", "G2VEC_PROCESS_ID",
+                "G2VEC_NUM_PROCESSES"):
+        monkeypatch.delenv(var, raising=False)
+    dist.drain_pending_events()
+    dist.initialize()
+    assert calls and calls[0]["num_processes"] == 1
+    events = dist.drain_pending_events()
+    assert len(events) == 1
+    assert events[0]["event"] == "single_process_fallback"
+    assert "coordinator" in events[0]
+    assert dist.drain_pending_events() == []    # drained means drained
+    monkeypatch.setattr(dist, "_initialized", False)
+
+
+def test_shutdown_makes_initialize_reset_safe(monkeypatch):
+    import jax
+
+    from g2vec_tpu.parallel import distributed as dist
+
+    inits, downs = [], []
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: inits.append(kw))
+    monkeypatch.setattr(jax.distributed, "shutdown",
+                        lambda: downs.append(1))
+    monkeypatch.setattr(dist, "_initialized", False)
+    monkeypatch.setenv("G2VEC_COORDINATOR", "10.0.0.1:1")
+    monkeypatch.setenv("G2VEC_PROCESS_ID", "0")
+    monkeypatch.setenv("G2VEC_NUM_PROCESSES", "2")
+    dist.initialize()
+    dist.initialize()                   # idempotent: one real init
+    assert len(inits) == 1
+    dist.shutdown()                     # runtime teardown resets the flag
+    assert downs == [1]
+    dist.initialize()                   # an in-process restart can rejoin
+    assert len(inits) == 2
+    dist.shutdown()
+    monkeypatch.setattr(dist, "_initialized", False)

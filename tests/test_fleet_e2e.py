@@ -1,0 +1,144 @@
+"""Fleet e2e on virtual devices: the PR-2 acceptance matrix.
+
+Two true multi-process scenarios through the real CLI launcher
+(``--fleet-size``), each a full ``python -m g2vec_tpu`` fleet on CPU
+virtual devices:
+
+1. SIGKILL of rank 1 at a chosen epoch (the epoch-5 checkpoint-finalize
+   boundary — the save is durable on every rank when the kill lands) →
+   the supervisor detects the death, re-plans the 4-device ``4x1`` mesh to
+   the surviving 2 devices (``2x1``), relaunches with ``--resume``, and
+   the run completes with final vectors BIT-IDENTICAL to an uninterrupted
+   fleet run: the walks re-execute bit-identically under any mesh (global
+   stream identities), the restored trainer state reshards at load, and
+   the degraded ``2x1`` mesh matches the per-rank local mesh of the
+   2-rank fleet, so even retrained epochs reproduce the same arithmetic.
+
+2. A ``process=1,kind=stall`` fault at the allgather seam → rank 0's
+   watchdog raises PeerTimeoutError NAMING rank 1 within the configured
+   deadline instead of blocking forever; the whole fleet fails fast.
+
+Tier-1 via the ``fleet`` marker (pytest -m fleet selects just this
+matrix); ~7 child interpreters total, so the configs stay tiny.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.fleet
+
+
+@pytest.fixture(scope="module")
+def tsv_paths(tmp_path_factory):
+    from g2vec_tpu.data.synthetic import SyntheticSpec, write_synthetic_tsv
+
+    spec = SyntheticSpec(n_good=24, n_poor=20, module_size=12,
+                         n_background=24, n_expr_only=4, n_net_only=4,
+                         module_chords=2, background_edges=40, seed=7)
+    out = tmp_path_factory.mktemp("syn")
+    return write_synthetic_tsv(spec, str(out))
+
+
+def _env():
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("G2VEC_", "XLA_", "TPU_", "LIBTPU",
+                                "PJRT_"))}
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _cli(tsv_paths, result, ckpt, liveness, extra=()):
+    args = [sys.executable, "-m", "g2vec_tpu",
+            tsv_paths["expression"], tsv_paths["clinical"],
+            tsv_paths["network"], result,
+            "-p", "8", "-r", "2", "-s", "16", "-e", "12", "-l", "0.01",
+            "-n", "5", "--seed", "0", "--compute-dtype", "float32",
+            "--platform", "cpu", "--mesh", "4x1", "--fleet-size", "2",
+            "--checkpoint-dir", ckpt, "--checkpoint-every", "3",
+            "--checkpoint-layout", "sharded",
+            "--fleet-liveness-dir", liveness,
+            "--fleet-watchdog-deadline", "10",
+            "--fleet-heartbeat-interval", "0.2"]
+    return args + list(extra)
+
+
+def test_fleet_sigkill_rank1_degraded_resume_bit_identical(tsv_paths,
+                                                           tmp_path):
+    env = _env()
+    clean = subprocess.run(
+        _cli(tsv_paths, str(tmp_path / "a"), str(tmp_path / "cka"),
+             str(tmp_path / "La"),
+             extra=["--supervise-retries", "0"]),
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    assert clean.returncode == 0, \
+        f"stdout:{clean.stdout[-800:]}\nstderr:{clean.stderr[-2500:]}"
+
+    mj = str(tmp_path / "m.jsonl")
+    faulted = subprocess.run(
+        _cli(tsv_paths, str(tmp_path / "b"), str(tmp_path / "ckb"),
+             str(tmp_path / "Lb"),
+             extra=["--metrics-jsonl", mj,
+                    "--supervise-retries", "2",
+                    "--supervise-backoff", "0.01",
+                    "--fault-plan",
+                    "process=1,stage=checkpoint_finalize,epoch=5,"
+                    "kind=sigkill"]),
+        capture_output=True, text=True, timeout=480, env=env, cwd=REPO)
+    assert faulted.returncode == 0, \
+        f"stdout:{faulted.stdout[-800:]}\nstderr:{faulted.stderr[-2500:]}"
+    assert "re-planning mesh 4x1 -> 2x1" in faulted.stderr
+
+    # Final vectors (and every other output) bit-identical to the
+    # uninterrupted fleet run.
+    for suffix in ("_vectors.txt", "_lgroups.txt", "_biomarkers.txt"):
+        with open(str(tmp_path / "a") + suffix, "rb") as fa, \
+                open(str(tmp_path / "b") + suffix, "rb") as fb:
+            assert fa.read() == fb.read(), suffix
+
+    # The metrics stream carries the fleet recovery story.
+    with open(mj) as f:
+        events = [json.loads(ln) for ln in f if ln.strip()]
+    names = [e["event"] for e in events]
+    assert "fleet_peer_death" in names and "fleet_replan" in names
+    assert "fleet_done" in names
+    death = next(e for e in events if e["event"] == "fleet_peer_death")
+    assert 1 in death["dead_ranks"]
+    assert death["classified"] == "retryable"
+    replan = next(e for e in events if e["event"] == "fleet_replan")
+    assert replan["old_mesh"] == [4, 1] and replan["new_mesh"] == [2, 1]
+    assert replan["surviving_ranks"] == 1
+    relaunch = next(e for e in events if e["event"] == "fleet_launch")
+    assert relaunch["resume"] is True and relaunch["ranks"] == 1
+    # Heartbeats made it into the coordinator's stream.
+    assert any(e["event"] == "heartbeat" for e in events)
+
+
+def test_fleet_stall_at_allgather_names_rank_1(tsv_paths, tmp_path):
+    liveness = str(tmp_path / "L")
+    t0 = time.time()
+    proc = subprocess.run(
+        _cli(tsv_paths, str(tmp_path / "o"), str(tmp_path / "ck"), liveness,
+             extra=["--supervise-retries", "0",
+                    "--fleet-watchdog-deadline", "3",
+                    "--fault-plan",
+                    "process=1,stage=allgather,kind=stall,seconds=90"]),
+        capture_output=True, text=True, timeout=180, env=_env(), cwd=REPO)
+    wall = time.time() - t0
+    assert proc.returncode != 0
+    # Fast, named failure: nothing waited out the 90s stall.
+    assert wall < 75, wall
+    rank0_err = os.path.join(liveness, "logs-attempt0", "rank0.err")
+    with open(rank0_err) as f:
+        err = f.read()
+    assert "PeerTimeoutError" in err
+    assert "missing rank(s): [1]" in err
+    # Liveness attribution saw a live-but-stalled peer, not a dead one.
+    assert "rank 1" in err
+    # The launcher relayed the named failure to its own stderr.
+    assert "PeerTimeoutError" in proc.stderr

@@ -1,13 +1,22 @@
 """TRUE multi-process distributed test: 2 JAX processes over localhost.
 
-Round-1 gap (VERDICT.md "what's weak" #4): every multi-host code path —
-``jax.distributed.initialize``, ``fetch_global``'s process_allgather branch,
-the checkpoint save/broadcast-restore collective — had only ever run
-single-process with mocks. Here two real CPU processes (2 virtual devices
-each) form a 4-device cluster, build a (2, 2) DP x TP global mesh, train,
-checkpoint into NON-shared per-process dirs, resume, and must land on
-bit-identical state. SURVEY.md §2 names the comm backend a first-class
-component; this is its integration test.
+Round-1 gap (VERDICT.md "what's weak" #4): every multi-host code path had
+only ever run single-process with mocks. Here two real CPU processes
+(2 virtual devices each) form a 4-device cluster and exercise the
+cpu_fleet() contract end to end: replicated local-mesh training,
+coordinator-broadcast single-layout resume over the KV transport,
+coordinator-written shared-dir sharded (orbax) resume, and
+cross-process-sharded native walks.
+
+Triage record (this test was a seed failure): the original worker built a
+cross-process (2, 2) GLOBAL mesh and trained over it, which the pinned
+jaxlib cannot do off-TPU — ``jax.device_put`` onto a non-addressable
+sharding (and every other cross-process XLA computation) dies with
+``Multiprocess computations aren't implemented on the CPU backend``. That
+is a backend limitation, not a framework bug; the global-mesh SPMD path
+still exists for real pods (parallel/distributed.make_global_mesh) and the
+worker now covers everything a CPU fleet genuinely runs — see
+tests/two_process_worker.py's docstring for the full scope note.
 """
 import json
 import os

@@ -22,6 +22,18 @@ DEADLINE=$("$PY" -c "import sys,time;print(int(time.time()+float(sys.argv[1])*36
 arms=0
 while [ "$arms" -lt "$MAX_ARMS" ] && [ "$(date +%s)" -lt "$DEADLINE" ]; do
     arms=$((arms + 1))
+    # Resilience regression gate, re-run every arm on host CPU: the
+    # single-process fault matrix plus the multi-rank fleet matrix
+    # (watchdogs, rank-scoped kills, degraded-mesh resume) on virtual
+    # devices. Non-fatal: a red matrix is reported, the chip battery
+    # still runs.
+    if ! JAX_PLATFORMS=cpu "$PY" -m pytest tests/test_resilience.py \
+            tests/test_fleet.py tests/test_fleet_e2e.py -q -m "not slow" \
+            -p no:cacheprovider >/tmp/fault_matrix_arm$arms.log 2>&1; then
+        echo "[watch_loop] WARNING: fault/fleet matrix FAILED on arm $arms (log: /tmp/fault_matrix_arm$arms.log)"
+    else
+        echo "[watch_loop] fault/fleet matrix green (arm $arms)"
+    fi
     left_h=$("$PY" -c "import sys,time;print(max(0.1,(float(sys.argv[1])-time.time())/3600))" "$DEADLINE")
     WATCHER_MAX_HOURS="$left_h" "$PY" tools/chip_watcher.py
     if "$PY" tools/chip_watcher.py --check-complete; then
