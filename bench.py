@@ -303,33 +303,105 @@ def _hostonly_fallback(probe_err: str, deadline: float) -> "NoReturn":  # noqa: 
     sys.exit(3 if ok else 2)
 
 
+def _cli_sampler_threads() -> int:
+    """--sampler-threads N from this invocation's argv (or the
+    G2VEC_BENCH_SAMPLER_THREADS env); 0 = auto (all cores)."""
+    env = os.environ.get("G2VEC_BENCH_SAMPLER_THREADS")
+    val = env if env else None
+    if "--sampler-threads" in sys.argv:
+        idx = sys.argv.index("--sampler-threads")
+        if idx + 1 >= len(sys.argv):
+            _fail("args", "--sampler-threads needs a value")
+        val = sys.argv[idx + 1]
+    if val is None:
+        return 0
+    try:
+        n = int(val)
+    except ValueError:
+        _fail("args", f"--sampler-threads must be an int, got {val!r}")
+    if n < 0:
+        _fail("args", f"--sampler-threads must be >= 0, got {n}")
+    return n
+
+
 def _native_walker_line(src, dst, w, n_genes: int, baseline: float,
                         note, extra: dict, metric: str =
                         "walker_native_walks_per_sec",
-                        len_path: "int | None" = None) -> dict:
+                        len_path: "int | None" = None,
+                        n_threads: int = 0) -> dict:
     """Time the native C++ sampler on the bench walk workload and build the
     ``walker_native_walks_per_sec`` metric line. ONE implementation for the
     chip-round stage 2b and the dead-tunnel host-only child, so the two
     rounds' numbers stay comparable field-for-field. Never imports jax.
     ``len_path`` overrides the bench default (config #2 runs 160)."""
     from g2vec_tpu.native.walker_bindings import load as load_native
-    from g2vec_tpu.ops.host_walker import generate_path_set_native
+    from g2vec_tpu.ops.host_walker import (generate_path_set_native,
+                                           resolve_sampler_threads)
 
     lp = LEN_PATH if len_path is None else len_path
+    threads = resolve_sampler_threads(n_threads)
     load_native()              # one-time g++ compile outside the timed region
     t0 = time.time()
     npaths = generate_path_set_native(src, dst, w, n_genes,
                                       len_path=lp, reps=WALKER_REPS,
-                                      seed=0)
+                                      seed=0, n_threads=threads)
     el = time.time() - t0
     total_n = n_genes * WALKER_REPS
-    note(f"native walker (len_path={lp}): {total_n} walks in {el:.2f}s -> "
-         f"{total_n / el:.0f} walks/s; {len(npaths)} unique paths")
+    note(f"native walker (len_path={lp}, threads={threads}): {total_n} "
+         f"walks in {el:.2f}s -> {total_n / el:.0f} walks/s; "
+         f"{len(npaths)} unique paths")
     return {"metric": metric,
             "value": round(total_n / el, 1), "unit": "walks/s",
             "vs_baseline": round(total_n / el / baseline, 2),
             "unique_paths": len(npaths), "n_genes": n_genes,
-            "len_path": lp, "reps": WALKER_REPS, **extra}
+            "len_path": lp, "reps": WALKER_REPS,
+            "sampler_threads": threads, **extra}
+
+
+def _mt_speedup_line(src, dst, w, n_genes: int, note) -> dict:
+    """``walker_native_mt_speedup``: the SAME walk workload once on one
+    thread and once on the resolved --sampler-threads pool, with the
+    bit-identity of the two outputs checked on the spot — the multicore
+    win is measured (and its determinism contract verified), never
+    asserted. Raw walk_packed_rows (pre-dedup) so the rows admit an exact
+    array compare. Never imports jax."""
+    import numpy as np
+
+    from g2vec_tpu.ops.host_walker import (resolve_sampler_threads,
+                                           walk_packed_rows)
+
+    threads = resolve_sampler_threads(_cli_sampler_threads())
+    kwargs = dict(len_path=LEN_PATH, reps=WALKER_REPS, seed=0)
+    t0 = time.time()
+    rows1 = walk_packed_rows(src, dst, w, n_genes, n_threads=1, **kwargs)
+    el1 = time.time() - t0
+    t0 = time.time()
+    rows_n = walk_packed_rows(src, dst, w, n_genes, n_threads=threads,
+                              **kwargs)
+    el_n = time.time() - t0
+    bit_identical = bool(np.array_equal(rows1, rows_n))
+    total_n = n_genes * WALKER_REPS
+    note(f"native sampler scaling: 1 thread {total_n / el1:.0f} walks/s vs "
+         f"{threads} thread(s) {total_n / el_n:.0f} walks/s "
+         f"({el1 / el_n:.2f}x); bit_identical={bit_identical}")
+    line = {"metric": "walker_native_mt_speedup",
+            "value": round(el1 / el_n, 2), "unit": "x",
+            "vs_baseline": None, "sampler_threads": threads,
+            "host_cores": os.cpu_count() or 1,
+            "single_thread_walks_per_sec": round(total_n / el1, 1),
+            "threaded_walks_per_sec": round(total_n / el_n, 1),
+            "bit_identical": bit_identical, "n_genes": n_genes,
+            "len_path": LEN_PATH, "reps": WALKER_REPS}
+    if not bit_identical:
+        # A determinism break outranks any speedup claim.
+        line["error"] = (f"{threads}-thread rows differ from the 1-thread "
+                         f"ordering — per-walker stream keying is broken")
+        line["value"] = None
+    elif threads == 1:
+        line["note"] = ("resolved to 1 thread (single-core host or pinned "
+                        "--sampler-threads 1): no parallel speedup to "
+                        "measure, bit-identity still verified")
+    return line
 
 
 def _current_code_key(repo_dir: str) -> "str | None":
@@ -556,6 +628,17 @@ def _hostonly() -> None:
              "unit": "walks/s", "vs_baseline": None,
              "len_path": 2 * LEN_PATH, "chip_free_fallback": True,
              "error": f"{type(e).__name__}: {e}"[:400]}), flush=True)
+    # Sampler thread-scaling + bit-identity check (the --sampler-threads
+    # breakdown): host work, chip-free measurable, printed BEFORE the
+    # headline native line (the driver parses the last line).
+    try:
+        print(json.dumps({**_mt_speedup_line(src, dst, w, n_genes, note),
+                          "chip_free_fallback": True}), flush=True)
+    except Exception as e:  # noqa: BLE001 — headline line must still print
+        print(json.dumps(
+            {"metric": "walker_native_mt_speedup", "value": None,
+             "unit": "x", "vs_baseline": None, "chip_free_fallback": True,
+             "error": f"{type(e).__name__}: {e}"[:400]}), flush=True)
     line = _native_walker_line(
         src, dst, w, n_genes, baseline, note,
         {"baseline_host_walks_per_sec": round(baseline, 2),
@@ -563,7 +646,8 @@ def _hostonly() -> None:
          "note": "threaded C++ CSR sampler (ops/host_walker.py), the "
                  "default single-host stage-3 backend; baseline = the "
                  "reference's own walk loop on this host. Measured with NO "
-                 "usable jax backend this round."})
+                 "usable jax backend this round."},
+        n_threads=_cli_sampler_threads())
     print(json.dumps(line), flush=True)
     # The driver records the LAST line as "the result": when the watcher
     # battery landed the headline train metric on the real chip earlier
@@ -1111,7 +1195,11 @@ def _measure() -> None:
         emit(_native_walker_line(
             edges[0], edges[1], edges[2], n_genes, baseline, note,
             {"note": "threaded C++ CSR sampler (ops/host_walker.py) on the "
-                     "bench host; the default single-host stage-3 backend"}))
+                     "bench host; the default single-host stage-3 backend"},
+            n_threads=_cli_sampler_threads()))
+        # Thread-scaling + bit-identity breakdown: same host workload, so
+        # chip rounds record the multicore claim too.
+        emit(_mt_speedup_line(edges[0], edges[1], edges[2], n_genes, note))
     except Exception as e:  # noqa: BLE001
         emit({"metric": "walker_native_walks_per_sec", "value": None,
               "unit": "walks/s", "vs_baseline": None,
@@ -1202,8 +1290,6 @@ def _measure() -> None:
     # headline stage (same shapes), so the extra cost is the acceptance
     # walker/kmeans compiles plus the run itself.
     def tpu_acceptance():
-        import signal
-
         import jax
 
         from tools.tpu_acceptance import _code_key, run_acceptance
@@ -1233,17 +1319,18 @@ def _measure() -> None:
 
         # Abort cleanly if the run outlives the remaining budget: later
         # stages still get their skip/error lines and the parent's kill
-        # window is never hit mid-pipeline.
-        def _alarm(signum, frame):
-            raise TimeoutError("acceptance run exceeded the stage budget")
+        # window is never hit mid-pipeline. Thread watchdog, not SIGALRM:
+        # the r5 window died in exactly this stage when the kmeans compile
+        # blocked on a dead tunnel and the alarm signal was deferred until
+        # the (never-returning) native call came back. hard=True turns
+        # that wedge into an honest early exit 124 — the parent relays the
+        # lines that already printed and its retry window survives.
+        from tools.watchdog import watchdog
 
-        old = signal.signal(signal.SIGALRM, _alarm)
-        signal.alarm(max(30, int(remaining() - 25)))
-        try:
+        with watchdog(max(30, int(remaining() - 25)),
+                      "acceptance run exceeded the stage budget",
+                      grace=20, hard=True):
             art = run_acceptance(out_path)
-        finally:
-            signal.alarm(0)
-            signal.signal(signal.SIGALRM, old)
         ref_acc = art["reference_transcript"]["acc_val"]
         emit({"metric": "tpu_acceptance_acc_val",
               "value": round(art["acc_val"], 4),
@@ -1251,6 +1338,11 @@ def _measure() -> None:
               "vs_baseline": round(art["acc_val"] / ref_acc, 3),
               "n_paths": art["n_paths"],
               "stage_seconds": art["stage_seconds"],
+              # Overlap attribution: how the stage_seconds were achieved
+              # (sampler pool width, background time hidden under
+              # foreground stages) — the measured overlap win.
+              "sampler_threads": art.get("sampler_threads"),
+              "overlap_saved_s": art.get("overlap_saved_s"),
               "pipeline_wall_seconds": art["pipeline_wall_seconds"]})
 
     if os.environ.get("G2VEC_BENCH_SKIP_ACCEPT") == "1":

@@ -36,6 +36,18 @@ def main(argv=None) -> int:
 
         return supervise_cli(cfg, list(argv) if argv is not None
                              else sys.argv[1:])
+    if cfg.compilation_cache or cfg.cache_dir:
+        # Persistent-compile tier, wired through the env BEFORE jax comes
+        # up anywhere in this process: the pipeline re-applies it via
+        # jax.config (idempotent), but programs compiled earlier than
+        # that — e.g. by --distributed init — must hit the cache too.
+        from g2vec_tpu.cache import resolve_cache_tiers
+
+        xla_dir, _ = resolve_cache_tiers(cfg.cache_dir,
+                                         cfg.compilation_cache,
+                                         walk_cache_enabled=False)
+        if xla_dir:
+            os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", xla_dir)
     if cfg.platform == "cpu" and cfg.mesh_shape:
         # Virtual-device convenience: an NxM mesh on CPU means the user wants
         # the sharding dry-run — give them the devices. XLA reads this flag

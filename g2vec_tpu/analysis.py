@@ -111,3 +111,28 @@ def select_biomarkers(embeddings: np.ndarray, expr: np.ndarray,
         biomarkers += top
         detail["good" if group == 0 else "poor"] = scores
     return sorted(biomarkers), detail
+
+
+def warm_lgroups_compile(n_genes: int, hidden: int, *, k: int = 3,
+                         iters: int = 50, n_init: int = 10) -> bool:
+    """Compile (and once-execute) the k-means program find_lgroups will
+    run at [n_genes, hidden].
+
+    The overlap scheduler (parallel/overlap.py) calls this in the
+    background during stage 3: the walks are host-core work and the
+    device sits idle, so the multi-second k-means compile — the one that
+    wedged the r5 chip window — hides under the sampling instead of
+    extending stage 5. A zeros input is used; the jit executable cache
+    keys on shapes/statics, never values, so stage 5's real call is a
+    pure cache hit. Keep the statics in lockstep with find_lgroups's
+    kmeans call or the warm compiles a program nobody uses.
+    """
+    import jax
+
+    from g2vec_tpu.ops.kmeans import kmeans
+
+    x = np.zeros((n_genes, hidden), dtype=np.float32)
+    labels_d, _, _ = kmeans(x, k, jax.random.key(0), n_init=n_init,
+                            iters=iters)
+    jax.block_until_ready(labels_d)
+    return True

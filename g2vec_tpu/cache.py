@@ -1,0 +1,170 @@
+"""Persistent on-disk caches: compile tier + walk-artifact tier.
+
+Two costs dominate a repeat pipeline run at the same config and the same
+inputs, and neither is new information the second time:
+
+- **XLA compiles** (~20-40 s cold on a real chip for the trainer chunk +
+  k-means programs). JAX already ships a persistent compilation cache;
+  ``--cache-dir`` wires it to ``<dir>/xla`` (an explicit
+  ``--compilation-cache`` still wins — it is the narrower flag).
+- **Stage 3 walks** — the paper's "most time consuming step"
+  (ref: G2Vec.py:58). A group's path set is a pure function of its
+  thresholded edge list and the walk parameters, so it is cached here as
+  a content-addressed artifact: the key is the sha256 of the exact CSR
+  inputs (src/dst/weight arrays + n_genes) plus the walk params plus a
+  VERSIONED PRNG-family tag (the two samplers draw from different
+  families — ops/host_walker.py docstring — so their artifacts must
+  never alias). Repeat runs skip the walks entirely; any input or
+  config drift changes the key and misses.
+
+Artifacts are verified before they are trusted (same stance as the
+checkpoint manifests, whose sha256 machinery this reuses via
+utils/integrity.py): every store writes ``<key>.npz`` plus a sidecar
+manifest with the file's sha256; a load whose bytes do not match the
+manifest — a torn write, bitrot, or an injected ``corrupt`` fault at the
+``walk_cache`` seam — warns and reports a miss, and the caller's
+recompute overwrites the bad entry. A cache can make a run faster; it
+must never be able to make one wrong.
+
+This module imports no jax: the bench host-only child and toy tests use
+it with no backend in the process.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import warnings
+from typing import Dict, Optional, Set
+
+import numpy as np
+
+from g2vec_tpu.resilience.faults import fault_point
+from g2vec_tpu.utils.integrity import sha256_file, write_json_atomic
+
+SCHEMA_VERSION = 1
+MANIFEST_SUFFIX = ".manifest.json"
+
+#: PRNG-family tags baked into every key. Version them on ANY change to
+#: the corresponding sampler's stream derivation — a stale artifact from
+#: an older stream family must miss, not load.
+NATIVE_FAMILY = "native-splitmix64-v1"
+DEVICE_FAMILY = "device-jaxrandom-v1"
+
+
+def walk_cache_key(src: np.ndarray, dst: np.ndarray, w: np.ndarray,
+                   n_genes: int, *, len_path: int, reps: int, seed: int,
+                   family: str) -> str:
+    """Content hash of everything the walk output is a function of."""
+    h = hashlib.sha256()
+    h.update(f"schema={SCHEMA_VERSION};family={family};"
+             f"n_genes={n_genes};len_path={len_path};reps={reps};"
+             f"seed={seed};".encode())
+    for arr, dtype in ((src, np.int32), (dst, np.int32), (w, np.float32)):
+        a = np.ascontiguousarray(np.asarray(arr), dtype=dtype)
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+@dataclasses.dataclass
+class WalkCache:
+    """The walk-artifact tier rooted at one directory.
+
+    ``load``/``store`` speak the pipeline's path-set currency — a set of
+    np.packbits-encoded multi-hot rows — and store it as the sorted
+    [n_unique, ceil(n_genes/8)] uint8 matrix (sets are unordered; sorting
+    makes the artifact bytes, and therefore its sha256, deterministic).
+    """
+
+    directory: str
+
+    def _paths(self, key: str) -> tuple:
+        art = os.path.join(self.directory, f"walks-{key[:32]}.npz")
+        return art, art + MANIFEST_SUFFIX
+
+    def load(self, key: str) -> Optional[Set[bytes]]:
+        """The cached path set for ``key``, or None (miss / failed
+        verification — the latter with a warning; the caller recomputes
+        and the next store overwrites the bad entry)."""
+        art, man_path = self._paths(key)
+        if not os.path.exists(art) or not os.path.exists(man_path):
+            return None
+        try:
+            with open(man_path) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError) as e:
+            warnings.warn(f"walk cache manifest {man_path} unreadable "
+                          f"({e!r}); recomputing walks", RuntimeWarning)
+            return None
+        if manifest.get("schema") != SCHEMA_VERSION \
+                or manifest.get("key") != key:
+            warnings.warn(
+                f"walk cache entry {art} is stale (schema/key mismatch — "
+                f"a truncated key collision or an older cache layout); "
+                f"recomputing walks", RuntimeWarning)
+            return None
+        actual = sha256_file(art)
+        if actual != manifest.get("sha256"):
+            warnings.warn(
+                f"walk cache entry {art} failed sha256 verification "
+                f"(manifest {str(manifest.get('sha256'))[:12]}... vs file "
+                f"{actual[:12]}...) — corrupt or torn entry; recomputing "
+                f"walks", RuntimeWarning)
+            return None
+        try:
+            with np.load(art) as z:
+                rows = z["rows"]
+        except Exception as e:  # noqa: BLE001 — any unreadable npz = miss
+            warnings.warn(f"walk cache entry {art} unreadable ({e!r}); "
+                          f"recomputing walks", RuntimeWarning)
+            return None
+        return {row.tobytes() for row in rows}
+
+    def store(self, key: str, path_set: Set[bytes], n_genes: int,
+              meta: Optional[Dict] = None) -> str:
+        """Write ``path_set`` under ``key`` (atomic: tmp + rename, manifest
+        last — a crash between the two leaves a manifest-less file that
+        load() treats as a miss). Returns the artifact path."""
+        os.makedirs(self.directory, exist_ok=True)
+        art, man_path = self._paths(key)
+        nbytes = (n_genes + 7) // 8
+        rows = np.frombuffer(b"".join(sorted(path_set)), dtype=np.uint8)
+        rows = rows.reshape(len(path_set), nbytes) if path_set \
+            else np.zeros((0, nbytes), dtype=np.uint8)
+        tmp = f"{art}.{os.getpid()}.tmp"
+        with open(tmp, "wb") as f:
+            np.savez_compressed(f, rows=rows)
+        os.replace(tmp, art)
+        write_json_atomic(man_path, {
+            "schema": SCHEMA_VERSION, "key": key,
+            "sha256": sha256_file(art), "n_rows": int(rows.shape[0]),
+            "n_genes": int(n_genes), **(meta or {})})
+        # Fault seam: kind=corrupt flips bytes in the artifact AFTER the
+        # manifest recorded the good hash — silent post-save bitrot, the
+        # torn-write shape the verification exists for. (Corrupting
+        # before the hash would give the bad bytes a matching manifest
+        # and the cache would serve them as truth.)
+        fault_point("walk_cache", path=art)
+        return art
+
+
+def resolve_cache_tiers(cache_dir: Optional[str],
+                        compilation_cache: Optional[str],
+                        walk_cache_enabled: bool = True,
+                        ) -> tuple:
+    """(compilation_cache_dir | None, WalkCache | None) for a run's flags.
+
+    ``--cache-dir`` implies both tiers under one root; each narrower
+    control still works alone (``--compilation-cache`` overrides the xla
+    tier's location, ``--no-walk-cache`` disables the artifact tier).
+    """
+    xla_dir = compilation_cache
+    walks: Optional[WalkCache] = None
+    if cache_dir:
+        if not xla_dir:
+            xla_dir = os.path.join(cache_dir, "xla")
+        if walk_cache_enabled:
+            walks = WalkCache(os.path.join(cache_dir, "walks"))
+    return xla_dir, walks

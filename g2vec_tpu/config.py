@@ -76,12 +76,29 @@ class G2VecConfig:
                                      # ops/backend.py). "device"/"native"
                                      # pin a sampler; each is per-seed
                                      # deterministic in its own PRNG family
+    sampler_threads: int = 0         # host cores for the native sampler's
+                                     # thread pool (0 = all cores; output is
+                                     # bit-identical at ANY count — streams
+                                     # are keyed by global walker index)
+    overlap: bool = True             # overlapped stage execution
+                                     # (parallel/overlap.py): group walks run
+                                     # concurrently and the trainer/kmeans
+                                     # compiles warm in the background during
+                                     # stage 3; never changes results
     mesh_shape: Optional[Tuple[int, int]] = None  # (data, model); None = single device
     platform: Optional[str] = None   # force jax platform (e.g. "cpu")
     profile_dir: Optional[str] = None
     compilation_cache: Optional[str] = None  # persistent XLA cache dir: repeat
                                      # runs skip the ~20-40s TPU compiles that
                                      # dominate a cold pipeline's wall clock
+    cache_dir: Optional[str] = None  # one root for BOTH persistent tiers:
+                                     # <dir>/xla (the XLA compilation cache,
+                                     # unless --compilation-cache overrides)
+                                     # and <dir>/walks (stage-3 walk
+                                     # artifacts — g2vec_tpu/cache.py)
+    walk_cache: bool = True          # the walk-artifact tier (only active
+                                     # with --cache-dir; --no-walk-cache
+                                     # disables it alone)
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 25       # epochs between trainer checkpoints
                                      # (also the device chunk size while
@@ -163,6 +180,10 @@ class G2VecConfig:
             raise ValueError(
                 f"walker_backend must be auto|device|native, "
                 f"got {self.walker_backend}")
+        if self.sampler_threads < 0:
+            raise ValueError(
+                f"sampler_threads must be >= 0 (0 = all cores), "
+                f"got {self.sampler_threads}")
         if self.checkpoint_every < 1:
             raise ValueError(
                 f"checkpoint_every must be >= 1, got {self.checkpoint_every}")
@@ -270,6 +291,25 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--walker-hbm-budget", type=int, default=0,
                         help="Device bytes the walker auto-sizer may plan "
                              "for (0 = 4 GiB default).")
+    parser.add_argument("--sampler-threads", type=int, default=0,
+                        help="Host cores for the native sampler's thread "
+                             "pool (0 = all cores). Walk output is "
+                             "bit-identical at any count — per-walker PRNG "
+                             "streams are keyed by global walker index.")
+    parser.add_argument("--no-overlap", action="store_true",
+                        help="Disable overlapped stage execution (concurrent "
+                             "group walks + background compile warming). "
+                             "Results are identical either way; this is a "
+                             "debugging/attribution switch.")
+    parser.add_argument("--cache-dir", type=str, default=None, metavar="DIR",
+                        help="Root for BOTH persistent caches: <DIR>/xla "
+                             "(XLA compilation cache) and <DIR>/walks "
+                             "(sha256-verified stage-3 walk artifacts — a "
+                             "repeat run at the same inputs/config skips "
+                             "the walks entirely).")
+    parser.add_argument("--no-walk-cache", action="store_true",
+                        help="Keep --cache-dir's compile tier but never "
+                             "read/write walk artifacts.")
     parser.add_argument("--mesh", type=str, default=None, metavar="DATAxMODEL",
                         help="Device mesh shape, e.g. 4x2 (data x model).")
     parser.add_argument("--platform", type=str, default=None,
@@ -386,10 +426,14 @@ def config_from_args(argv=None) -> G2VecConfig:
         walker_batch=args.walker_batch,
         walker_hbm_budget=args.walker_hbm_budget,
         walker_backend=args.walker_backend,
+        sampler_threads=args.sampler_threads,
+        overlap=not args.no_overlap,
         mesh_shape=parse_mesh(args.mesh),
         platform=args.platform,
         profile_dir=args.profile_dir,
         compilation_cache=args.compilation_cache,
+        cache_dir=args.cache_dir,
+        walk_cache=not args.no_walk_cache,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
         resume=args.resume,

@@ -118,18 +118,32 @@ def walk_paths(indptr: np.ndarray, indices: np.ndarray, weights: np.ndarray,
 def walk_paths_packed(indptr: np.ndarray, indices: np.ndarray,
                       weights: np.ndarray, n_genes: int, starts: np.ndarray,
                       stream_ids: np.ndarray, len_path: int, seed: int,
-                      n_threads: int = 0) -> np.ndarray:
+                      n_threads: int = 0,
+                      out: "np.ndarray | None" = None) -> np.ndarray:
     """Same walks as :func:`walk_paths`, emitted as the path-set encoding:
     [n_walkers, ceil(n_genes/8)] uint8 np.packbits-layout multi-hot rows
     (MSB of byte 0 = gene 0). The packing happens inside the sampler's
     walk loop, so no [W, n_genes] dense matrix ever exists on either side
     of the boundary.
+
+    ``out`` lets the caller hand in the destination buffer — the Python
+    thread pool (ops/host_walker.py) writes each walker range into a
+    disjoint row slice of ONE array, so the sharded result needs no
+    concatenate pass and is byte-for-byte the single-call layout. Must be
+    C-contiguous uint8 of exactly [n_walkers, ceil(n_genes/8)] (a row
+    slice of a C-contiguous matrix qualifies).
     """
     lib = load()
     indptr, indices, weights, starts, stream_ids, n_walkers = _validated(
         indptr, indices, weights, n_genes, starts, stream_ids, len_path)
     nbytes = (n_genes + 7) // 8
-    out = np.empty((n_walkers, nbytes), dtype=np.uint8)
+    if out is None:
+        out = np.empty((n_walkers, nbytes), dtype=np.uint8)
+    elif (out.dtype != np.uint8 or out.shape != (n_walkers, nbytes)
+            or not out.flags.c_contiguous):
+        raise ValueError(
+            f"out must be C-contiguous uint8 [{n_walkers}, {nbytes}], got "
+            f"{out.dtype} {out.shape} (contiguous={out.flags.c_contiguous})")
     lib.g2v_walk_packed(
         indptr, indices, weights, np.int32(n_genes), starts, stream_ids,
         np.int64(n_walkers), np.int32(len_path),

@@ -28,9 +28,68 @@ caveat in generate_path_set.
 """
 from __future__ import annotations
 
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
 from typing import Optional, Set
 
 import numpy as np
+
+# ---- the sampler thread pool ------------------------------------------------
+# The walker axis is sharded in PYTHON (contiguous ranges over a persistent
+# ThreadPoolExecutor; each range calls the C++ sampler single-threaded with
+# the GIL released by ctypes) rather than inside one C++ call: the pool is
+# shared by BOTH prognosis groups, so the overlap scheduler
+# (parallel/overlap.py) can sample group 2 while group 1 is still draining
+# — ranges from the two groups interleave on the same cores instead of the
+# second group waiting for a full-width C++ join. Bit-identity at any
+# thread count is structural: streams are keyed by global walker index and
+# every range writes a fixed disjoint row slice of one output buffer.
+# The pool is private to this module — overlap.py uses its own executor;
+# sharing one would let a stage task that WAITS on range futures starve
+# the ranges it waits for.
+_POOL: Optional[ThreadPoolExecutor] = None
+_POOL_SIZE = 0
+_POOL_LOCK = threading.Lock()
+
+#: Walkers per pool task. Small enough that two concurrent groups
+#: interleave at sub-second granularity, large enough that the per-task
+#: dispatch overhead (a ctypes call) stays negligible.
+RANGE_CHUNK = 2048
+
+
+def resolve_sampler_threads(n_threads: int = 0) -> int:
+    """Map the --sampler-threads value to a concrete count: 0 (auto) means
+    every core (``G2VEC_SAMPLER_THREADS`` overrides — the bench and tests
+    pin counts through it without plumbing flags)."""
+    if n_threads < 0:
+        raise ValueError(f"sampler threads must be >= 0, got {n_threads}")
+    if n_threads:
+        return n_threads
+    env = os.environ.get("G2VEC_SAMPLER_THREADS")
+    if env:
+        try:
+            n = int(env)
+        except ValueError as e:
+            raise ValueError(
+                f"G2VEC_SAMPLER_THREADS must be an int, got {env!r}") from e
+        if n > 0:
+            return n
+    return max(1, os.cpu_count() or 1)
+
+
+def _pool(n_threads: int) -> ThreadPoolExecutor:
+    """The shared sampler pool, grown (never shrunk) to ``n_threads``."""
+    global _POOL, _POOL_SIZE
+    with _POOL_LOCK:
+        if _POOL is None or _POOL_SIZE < n_threads:
+            old = _POOL
+            _POOL = ThreadPoolExecutor(
+                max_workers=n_threads, thread_name_prefix="g2v-sampler")
+            _POOL_SIZE = n_threads
+            if old is not None:
+                old.shutdown(wait=False)
+        return _POOL
 
 
 def edges_to_csr(src: np.ndarray, dst: np.ndarray, w: np.ndarray,
@@ -97,9 +156,31 @@ def walk_packed_rows(src: np.ndarray, dst: np.ndarray, w: np.ndarray,
     # set inside the C++ walk loop): no [W, n_genes] dense expansion on
     # either side of the boundary — at bundled scale the old
     # expand-and-packbits pass cost more than the walks themselves.
-    return walk_paths_packed(indptr, indices, weights, n_genes,
-                             all_starts, stream_ids, len_path, seed,
-                             n_threads)
+    n_local = walker_hi - walker_lo
+    threads = min(resolve_sampler_threads(n_threads), max(n_local, 1))
+    if threads <= 1 or n_local <= RANGE_CHUNK:
+        # Degenerate/small cases skip the pool; the C++ call is told 1
+        # thread — the Python pool is the only fan-out layer, so thread
+        # accounting has a single owner.
+        return walk_paths_packed(indptr, indices, weights, n_genes,
+                                 all_starts, stream_ids, len_path, seed,
+                                 n_threads=1)
+    nbytes = (n_genes + 7) // 8
+    out = np.empty((n_local, nbytes), dtype=np.uint8)
+    # Contiguous ranges of at most RANGE_CHUNK walkers (but no more tasks
+    # than needed for ``threads``-way parallelism x a small queue depth).
+    chunk = max(RANGE_CHUNK, -(-n_local // (threads * 8)))
+    futures = []
+    pool = _pool(threads)
+    for lo in range(0, n_local, chunk):
+        hi = min(lo + chunk, n_local)
+        futures.append(pool.submit(
+            walk_paths_packed, indptr, indices, weights, n_genes,
+            all_starts[lo:hi], stream_ids[lo:hi], len_path, seed,
+            1, out[lo:hi]))
+    for f in futures:
+        f.result()      # propagate the first worker exception, if any
+    return out
 
 
 def generate_path_set_native(src: np.ndarray, dst: np.ndarray, w: np.ndarray,

@@ -12,7 +12,15 @@ Differences from the reference, all deliberate (SURVEY.md §7):
 - ``--epoch`` is honored (the reference hardcodes 500, G2Vec.py:262);
 - structured JSONL metrics / profiler traces / checkpoints behind flags;
 - stage 3 walks all sources in lockstep on device instead of one Python
-  walker at a time (ops/walker.py docstring has the mapping).
+  walker at a time (ops/walker.py docstring has the mapping);
+- overlapped execution (parallel/overlap.py): the two groups' native
+  walks sample concurrently on the host pool, and the trainer-chunk and
+  k-means compiles warm in the background while stage 3 walks — the
+  transcript and every output stay byte-identical, only the wall clock
+  moves; ``--no-overlap`` restores strictly sequential stages;
+- persistent caches (g2vec_tpu/cache.py): ``--cache-dir`` wires the XLA
+  compilation cache AND a sha256-verified walk-artifact tier, so a
+  repeat run at the same inputs/config skips stage 3's walks entirely.
 """
 from __future__ import annotations
 
@@ -43,6 +51,29 @@ class PipelineResult:
     walker_backend: str = ""     # the RESOLVED stage-3 sampler ("device" |
                                  # "native") — what actually ran, not the
                                  # config value (which may be "auto")
+    sampler_threads: int = 0     # resolved host-pool width (0 when the
+                                 # device walker ran)
+    overlap_saved_s: Dict[str, float] = dataclasses.field(
+        default_factory=dict)    # per-background-task run time the
+                                 # foreground never waited for
+    walk_cache_hits: List[str] = dataclasses.field(default_factory=list)
+                                 # groups whose stage-3 walks were served
+                                 # from the artifact cache
+
+
+def _background_warm(fn, console):
+    """Wrap a compile-warm thunk for the overlap scheduler: a warm is an
+    optimization, so ANY failure degrades to a console note and False —
+    the foreground stage then simply pays the compile itself, exactly the
+    pre-overlap behavior."""
+    def task():
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — warm failure must not kill
+            console(f"    [overlap] background compile warm skipped "
+                    f"({type(e).__name__}: {str(e)[:120]})")
+            return False
+    return task
 
 
 class _EpochReporter:
@@ -111,11 +142,21 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
                     straggler_factor=cfg.fleet_straggler_factor)
     if cfg.debug_nans:
         jax.config.update("jax_debug_nans", True)
-    if cfg.compilation_cache:
+    from g2vec_tpu.cache import resolve_cache_tiers
+
+    xla_cache_dir, walk_cache = resolve_cache_tiers(
+        cfg.cache_dir, cfg.compilation_cache, cfg.walk_cache)
+    if cfg.distributed:
+        # The artifact tier is per-host files; in a multi-process run the
+        # ranks would race identical writes and the sharded native walk
+        # would cache only this rank's shard under a full-set key. Keep
+        # multi-process runs uncached until the tier learns rank scoping.
+        walk_cache = None
+    if xla_cache_dir:
         # Persistent XLA cache: a warm repeat run skips the compiles that
         # dominate a cold pipeline's wall (the TPU acceptance run spends
         # most of its train/lgroups/biomarkers stage time compiling).
-        jax.config.update("jax_compilation_cache_dir", cfg.compilation_cache)
+        jax.config.update("jax_compilation_cache_dir", xla_cache_dir)
         # Persist every program: a pipeline run compiles a bounded set of
         # programs, so cache-write cost is trivial next to ANY compile.
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
@@ -164,6 +205,10 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
     if cfg.profile_dir:
         jax.profiler.start_trace(cfg.profile_dir)
 
+    # Created at stage 3 (it needs the resolved backend); closed in the
+    # outer finally so a failing FOREGROUND stage still drains the
+    # background tasks instead of leaking threads mid-walk.
+    overlap = None
     try:
         console(">>> 0. Arguments")
         console(str(cfg))
@@ -225,19 +270,70 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
         # "auto" = host-walks-chip-trains: the walk step is CPU-shaped
         # (pointer-chase, no matmul), the trainer is MXU-shaped — measured
         # basis and resolution rules in ops/backend.py.
+        from g2vec_tpu.cache import (DEVICE_FAMILY, NATIVE_FAMILY,
+                                     walk_cache_key)
         from g2vec_tpu.ops.backend import resolve_walker_backend
+        from g2vec_tpu.ops.host_walker import resolve_sampler_threads
+        from g2vec_tpu.parallel.overlap import OverlapScheduler
 
         walker_backend = resolve_walker_backend(cfg)
-        path_sets = []
+        sampler_threads = (resolve_sampler_threads(cfg.sampler_threads)
+                           if walker_backend == "native" else 0)
+        # Overlap is single-process only: the collectives in a distributed
+        # stage 3 must stay on the main thread in program order on every
+        # rank, or ranks deadlock on mismatched gather sequences.
+        use_overlap = cfg.overlap and not cfg.distributed
+        overlap = OverlapScheduler(max_workers=4)
+        if walker_backend == "native":
+            console(f"    [sampler] native C++ CSR sampler, "
+                    f"{sampler_threads} host thread(s)"
+                    + (", groups overlapped" if use_overlap else ""))
+        if use_overlap:
+            # The device sits idle while the host walks: warm stage 5's
+            # k-means program now so its multi-second compile hides under
+            # stage 3 instead of extending stage 5 (wall only — results
+            # are a pure jit-cache hit on identical shapes/statics).
+            from g2vec_tpu.analysis import warm_lgroups_compile
+
+            overlap.submit("warm_lgroups", _background_warm(
+                lambda: warm_lgroups_compile(
+                    n_genes, cfg.sizeHiddenlayer, k=cfg.n_lgroups,
+                    iters=cfg.kmeans_iters), console))
+        walk_cache_hits: List[str] = []
         fault_point("paths")
         fleet.note_phase("paths")
         with timer.stage("paths"):
+            path_sets: List = [None, None]
+            joins = []
             for i, group in enumerate(["g", "p"]):
                 expr_group = data.expr[data.label == i]
                 # Sparse transitions: per-step walk cost O(W*D) instead of
                 # O(W*G), and no dense G^2 matrix in HBM (ops/graph.py).
                 s_k, d_k, w_k = thresholded_edges(expr_group, src, dst,
                                                   threshold=cfg.pcc_threshold)
+                ckey = None
+                if walk_cache is not None:
+                    # Content-addressed: the exact thresholded edges + the
+                    # walk params + the sampler's PRNG-family tag. Any
+                    # input or config drift misses; a verified hit skips
+                    # this group's walks entirely (g2vec_tpu/cache.py).
+                    ckey = walk_cache_key(
+                        np.asarray(s_k), np.asarray(d_k), np.asarray(w_k),
+                        n_genes, len_path=cfg.lenPath,
+                        reps=cfg.numRepetition, seed=(cfg.seed << 1) | i,
+                        family=(NATIVE_FAMILY if walker_backend == "native"
+                                else DEVICE_FAMILY))
+                    cached = walk_cache.load(ckey)
+                    if cached is not None:
+                        path_sets[i] = cached
+                        walk_cache_hits.append(group)
+                        console(f"    [cache] group {group!r}: verified "
+                                f"walk artifact hit ({len(cached)} unique "
+                                f"paths) — walks skipped")
+                        metrics.emit("walk_cache", group=group,
+                                     outcome="hit", n_rows=len(cached))
+                        continue
+                    metrics.emit("walk_cache", group=group, outcome="miss")
                 if walker_backend == "native":
                     # Threaded C++ CSR sampler (ops/host_walker.py): the
                     # default host path (ops/backend.py has the measured
@@ -252,31 +348,76 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
                         from g2vec_tpu.parallel.distributed import \
                             sharded_native_path_set
 
-                        path_sets.append(sharded_native_path_set(
+                        path_sets[i] = sharded_native_path_set(
                             np.asarray(s_k), np.asarray(d_k),
                             np.asarray(w_k), n_genes,
                             len_path=cfg.lenPath, reps=cfg.numRepetition,
-                            seed=(cfg.seed << 1) | i))
+                            seed=(cfg.seed << 1) | i,
+                            n_threads=cfg.sampler_threads)
                         continue
                     from g2vec_tpu.ops.host_walker import \
                         generate_path_set_native
 
-                    path_sets.append(generate_path_set_native(
-                        s_k, d_k, w_k, n_genes, len_path=cfg.lenPath,
-                        reps=cfg.numRepetition,
-                        seed=(cfg.seed << 1) | i))
+                    def _walk(s=np.asarray(s_k), d=np.asarray(d_k),
+                              w=np.asarray(w_k), i=i, group=group,
+                              ckey=ckey):
+                        ps = generate_path_set_native(
+                            s, d, w, n_genes, len_path=cfg.lenPath,
+                            reps=cfg.numRepetition,
+                            seed=(cfg.seed << 1) | i,
+                            n_threads=cfg.sampler_threads)
+                        if walk_cache is not None and ckey:
+                            walk_cache.store(ckey, ps, n_genes,
+                                             meta={"group": group})
+                        return ps
+
+                    if use_overlap:
+                        # Both groups' walks share the sampler pool; the
+                        # second group's ranges interleave with the
+                        # first's instead of waiting for its full join.
+                        overlap.submit(f"walks_{group}", _walk)
+                        joins.append((i, f"walks_{group}"))
+                    else:
+                        path_sets[i] = _walk()
                     continue
                 table = neighbor_table(s_k, d_k, w_k, n_genes)
-                path_sets.append(generate_path_set(
+                path_sets[i] = generate_path_set(
                     table, jax.random.fold_in(key, i), len_path=cfg.lenPath,
                     reps=cfg.numRepetition, walker_batch=cfg.walker_batch,
                     walker_hbm_budget=cfg.walker_hbm_budget,
-                    mesh_ctx=mesh_ctx))
+                    mesh_ctx=mesh_ctx)
+                if walk_cache is not None and ckey:
+                    walk_cache.store(ckey, path_sets[i], n_genes,
+                                     meta={"group": group})
+            for i, name in joins:
+                # Re-raises a walk task's exception here, inside the
+                # stage — same failure surface as the sequential order.
+                path_sets[i] = overlap.result(name)
             # Paths stay bit-packed from the walker all the way into the
             # trainer — the dense uint8 [n_paths, n_genes] matrix never
             # materializes on the host (8x smaller at any scale).
             paths, labels = integrate_path_sets(path_sets[0], path_sets[1],
                                                 n_genes, packed=True)
+            if use_overlap and paths.shape[0] >= 2:
+                # n_paths is known the moment integrate returns: warm the
+                # trainer's chunk program in the background while the
+                # foreground counts gene frequencies and train_cbow
+                # bit-packs the split — train_cbow joins this via its
+                # pre-compile hook, right where it wants the executable.
+                from g2vec_tpu.train.trainer import warm_train_compile
+
+                n_paths_known = int(paths.shape[0])
+                overlap.submit("warm_trainer", _background_warm(
+                    lambda: warm_train_compile(
+                        n_paths_known, n_genes, hidden=cfg.sizeHiddenlayer,
+                        learning_rate=cfg.learningRate,
+                        max_epochs=cfg.epoch,
+                        val_fraction=cfg.val_fraction,
+                        decision_threshold=cfg.decision_threshold,
+                        compute_dtype=cfg.compute_dtype,
+                        param_dtype=cfg.param_dtype, mesh_ctx=mesh_ctx,
+                        checkpoint_dir=cfg.checkpoint_dir,
+                        checkpoint_every=cfg.checkpoint_every), console))
             gene_freq = count_gene_freq(paths, labels, data.gene, packed=True)
         _stage_edge("paths")
         n_paths = paths.shape[0]
@@ -289,7 +430,12 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
         console("    n_paths : %d" % n_paths)
         console("    n_genes : %d\t(genes in good or poor random paths)" % len(gene_freq))
         metrics.emit("paths", n_paths=n_paths, n_path_genes=len(gene_freq),
-                     walker_backend=walker_backend)
+                     walker_backend=walker_backend,
+                     sampler_threads=sampler_threads,
+                     walk_cache_hits=walk_cache_hits)
+        timer.annotate("paths", walker_backend=walker_backend,
+                       sampler_threads=sampler_threads,
+                       walk_cache_hits=list(walk_cache_hits))
 
         console(">>> 4. Compute distributed representations using modified CBOW")
         console("     Start training the modified CBOW with early stopping")
@@ -311,7 +457,14 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
                 seed=cfg.seed, mesh_ctx=mesh_ctx, on_epoch=on_epoch,
                 checkpoint_dir=cfg.checkpoint_dir, resume=cfg.resume,
                 checkpoint_every=cfg.checkpoint_every,
-                checkpoint_layout=cfg.checkpoint_layout)
+                checkpoint_layout=cfg.checkpoint_layout,
+                # Joins the background chunk-program warm right before the
+                # trainer requests the executable (after the host-side
+                # packing it overlapped); None = compile in line.
+                pre_compile_hook=(
+                    (lambda: overlap.result("warm_trainer"))
+                    if use_overlap and overlap.has("warm_trainer")
+                    else None))
         _stage_edge("train")
         if result.stopped_early:
             reporter.on_stop(result.stop_epoch, result.acc_val, result.acc_tr)
@@ -321,6 +474,10 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
                      stopped_early=result.stopped_early)
 
         console(">>> 5. Find L-groups")
+        if use_overlap:
+            # Join the k-means warm (long done by now — training ran in
+            # between); find_lgroups then hits the compiled program.
+            overlap.result("warm_lgroups")
         fault_point("lgroups")
         fleet.note_phase("lgroups")
         with timer.stage("lgroups"):
@@ -358,7 +515,18 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
         _stage_edge("save")
         for path in outputs:
             console("    %s" % path)
-        metrics.emit("done", outputs=outputs, stage_seconds=timer.as_dict())
+        overlap_saved = overlap.saved_seconds() if use_overlap else {}
+        if overlap_saved:
+            console("    [overlap] background time hidden under foreground "
+                    "stages: " + ", ".join(
+                        f"{k}={v:.2f}s"
+                        for k, v in sorted(overlap_saved.items())))
+        metrics.emit("done", outputs=outputs, stage_seconds=timer.as_dict(),
+                     stage_extras=timer.extras_dict(),
+                     walker_backend=walker_backend,
+                     sampler_threads=sampler_threads,
+                     overlap_saved_s=overlap_saved,
+                     walk_cache_hits=walk_cache_hits)
 
         return PipelineResult(
             genes=data.gene, embeddings=result.w_ih, lgroup_idx=lgroup_idx,
@@ -366,8 +534,15 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
             n_samples=n_samples, n_genes=n_genes, n_edges=n_edges,
             n_paths=n_paths, n_path_genes=len(gene_freq),
             train_history=result.history, acc_val=result.acc_val,
-            stage_seconds=timer.as_dict(), walker_backend=walker_backend)
+            stage_seconds=timer.as_dict(), walker_backend=walker_backend,
+            sampler_threads=sampler_threads, overlap_saved_s=overlap_saved,
+            walk_cache_hits=walk_cache_hits)
     finally:
+        if overlap is not None:
+            # Drain, never raise: the exception in flight (if any) is the
+            # one the caller must see; background task errors were either
+            # already re-raised at a join or are warm-task noise.
+            overlap.close()
         fleet.stop_heartbeat()
         if cfg.profile_dir:
             jax.profiler.stop_trace()

@@ -20,6 +20,8 @@ Keys:
   ``checkpoint_write`` (before the write), ``checkpoint_finalize`` (after
   the atomic rename — the seam for ``corrupt``). Native libraries:
   ``native_load`` (TSV parser), ``native_walker_load`` (walk sampler).
+  Walk-artifact cache: ``walk_cache`` (after a store finalizes — the
+  ``corrupt`` drill for g2vec_tpu/cache.py's sha256 verification).
 - ``epoch`` — only fire once the hook reports an epoch >= this value
   (meaningful at the ``train`` seam).
 - ``kind`` — what to do when the seam is hit:
@@ -77,7 +79,11 @@ KINDS = ("crash", "fatal", "sigkill", "stall", "corrupt")
 SEAMS = ("load", "preprocess", "paths", "train", "lgroups", "biomarkers",
          "save", "checkpoint_write", "checkpoint_finalize",
          "native_load", "native_walker_load",
-         "allgather", "stage_barrier", "heartbeat")
+         "allgather", "stage_barrier", "heartbeat",
+         # Walk-artifact cache (g2vec_tpu/cache.py): fires right after a
+         # store finalizes, so kind=corrupt models post-save bitrot that
+         # only the manifest verification can catch.
+         "walk_cache")
 
 
 class FaultPlanError(ValueError):

@@ -36,7 +36,6 @@ manifest-less checkpoint from an older version still loads (legacy path).
 from __future__ import annotations
 
 import contextlib
-import hashlib
 import json
 import os
 import time
@@ -47,6 +46,11 @@ import jax
 import numpy as np
 
 from g2vec_tpu.resilience.faults import fault_point
+# Shared sha256/atomic-write machinery (also the walk-artifact cache's —
+# g2vec_tpu/cache.py — which must import it without jax in the process).
+from g2vec_tpu.utils.integrity import (sha256_array as _sha256_array,
+                                       sha256_file as _sha256_file,
+                                       write_json_atomic as _write_json_atomic)
 
 CKPT_NAME = "cbow_state.npz"
 SHARDED_NAME = "cbow_state_ocdbt"
@@ -61,26 +65,6 @@ SCHEMA_VERSION = 1
 RUN_IN_PROGRESS = 0
 RUN_COMPLETED = 1      # reached max_epochs
 RUN_EARLY_STOPPED = 2  # first val-accuracy dip
-
-
-def _sha256_file(path: str) -> str:
-    h = hashlib.sha256()
-    with open(path, "rb") as f:
-        for chunk in iter(lambda: f.read(1 << 20), b""):
-            h.update(chunk)
-    return h.hexdigest()
-
-
-def _sha256_array(arr: np.ndarray) -> str:
-    return hashlib.sha256(np.ascontiguousarray(arr).tobytes()).hexdigest()
-
-
-def _write_json_atomic(path: str, payload: dict) -> None:
-    tmp = f"{path}.{os.getpid()}.tmp"
-    with open(tmp, "w") as f:
-        json.dump(payload, f, indent=1)
-        f.write("\n")
-    os.replace(tmp, path)
 
 
 def _load_manifest(ckpt_path: str) -> Optional[dict]:

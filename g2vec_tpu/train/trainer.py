@@ -46,7 +46,9 @@ training behavior is unaffected).
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
+from collections import OrderedDict
 from typing import Callable, List, Optional
 
 import jax
@@ -238,10 +240,51 @@ def _make_chunk_fn(tx: optax.GradientTransformation, compute_dtype,
 
 # jit caches live on the function object, so the compiled chunk must be
 # reused across train_cbow calls (a fresh closure per call would recompile
-# the whole while_loop program every run — ~10 s at example scale).
-_CHUNK_FN_CACHE: dict = {}
-_UNPACK_FN_CACHE: dict = {}
+# the whole while_loop program every run — ~10 s at example scale). Both
+# caches are TRUE LRUs (hits refresh recency, eviction drops the least
+# recently USED entry): a long supervised run sweeping shapes/hyperparams
+# must neither grow them without bound nor evict the entry it re-hits
+# every retry just because it was inserted first.
+_CHUNK_FN_CACHE: "OrderedDict" = OrderedDict()
+_UNPACK_FN_CACHE: "OrderedDict" = OrderedDict()
 _CHUNK_FN_CACHE_MAX = 16   # hyperparameter sweeps must not pin old executables
+_UNPACK_FN_CACHE_MAX = 8   # keyed by (mesh, dtype) only — 8 is generous
+
+
+_CACHE_LOCK = threading.Lock()
+_CACHE_PENDING: dict = {}      # (cache id, key) -> Event for in-flight makes
+
+
+def _lru_get(cache: "OrderedDict", key, limit: int, make):
+    """Thread-safe bounded LRU lookup. A second requester of an in-flight
+    key BLOCKS until the first finishes and then shares the same fn —
+    the overlap scheduler warms the chunk fn in the background while the
+    foreground trainer may request the identical key, and two distinct
+    jitted wrappers would compile the same program twice."""
+    pending_key = (id(cache), key)
+    while True:
+        with _CACHE_LOCK:
+            fn = cache.get(key)
+            if fn is not None:
+                cache.move_to_end(key)
+                return fn
+            ev = _CACHE_PENDING.get(pending_key)
+            if ev is None:
+                ev = threading.Event()
+                _CACHE_PENDING[pending_key] = ev
+                break
+        ev.wait()
+    try:
+        fn = make()
+        with _CACHE_LOCK:
+            while len(cache) >= limit:
+                cache.popitem(last=False)
+            cache[key] = fn
+        return fn
+    finally:
+        with _CACHE_LOCK:
+            _CACHE_PENDING.pop(pending_key, None)
+        ev.set()
 
 
 def _get_chunk_fn(learning_rate: float, compute_dtype, decision_threshold: float,
@@ -249,15 +292,13 @@ def _get_chunk_fn(learning_rate: float, compute_dtype, decision_threshold: float
                   interpret: bool = False):
     key = (learning_rate, jnp.dtype(compute_dtype).name, decision_threshold,
            ctx.mesh, chunk, packed, interpret)
-    fn = _CHUNK_FN_CACHE.get(key)
-    if fn is None:
+
+    def make():
         tx = optax.adam(learning_rate, b1=0.9, b2=0.999, eps=1e-8)
-        fn = _make_chunk_fn(tx, compute_dtype, decision_threshold, ctx, chunk,
-                            packed, interpret)
-        while len(_CHUNK_FN_CACHE) >= _CHUNK_FN_CACHE_MAX:
-            _CHUNK_FN_CACHE.pop(next(iter(_CHUNK_FN_CACHE)))
-        _CHUNK_FN_CACHE[key] = fn
-    return fn
+        return _make_chunk_fn(tx, compute_dtype, decision_threshold, ctx,
+                              chunk, packed, interpret)
+
+    return _lru_get(_CHUNK_FN_CACHE, key, _CHUNK_FN_CACHE_MAX, make)
 
 
 def _get_unpack_fn(ctx: MeshContext, compute_dtype):
@@ -268,16 +309,16 @@ def _get_unpack_fn(ctx: MeshContext, compute_dtype):
     expanded on device, where HBM bandwidth is ~800 GB/s. Bit order matches
     ``np.packbits`` (MSB first)."""
     key = (ctx.mesh, jnp.dtype(compute_dtype).name)
-    fn = _UNPACK_FN_CACHE.get(key)
-    if fn is None:
+
+    def make():
         def unpack(packed):
             shifts = jnp.arange(7, -1, -1, dtype=jnp.uint8)
             bits = (packed[:, :, None] >> shifts) & jnp.uint8(1)
             x = bits.reshape(packed.shape[0], -1).astype(compute_dtype)
             return ctx.constrain(x, ctx.batch_spec)
-        fn = jax.jit(unpack)
-        _UNPACK_FN_CACHE[key] = fn
-    return fn
+        return jax.jit(unpack)
+
+    return _lru_get(_UNPACK_FN_CACHE, key, _UNPACK_FN_CACHE_MAX, make)
 
 
 def _pad_rows(arr: np.ndarray, n_rows: int) -> np.ndarray:
@@ -288,62 +329,30 @@ def _pad_rows(arr: np.ndarray, n_rows: int) -> np.ndarray:
     return np.concatenate([arr, pad], axis=0)
 
 
-def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
-               hidden: int, learning_rate: float, max_epochs: int,
-               val_fraction: float = 0.2, decision_threshold: float = 0.5,
-               compute_dtype: str = "bfloat16", param_dtype: str = "float32",
-               seed: int = 0, mesh_ctx: Optional[MeshContext] = None,
-               on_epoch: Optional[Callable[[int, float, float, float], None]] = None,
-               checkpoint_dir: Optional[str] = None, resume: bool = False,
-               checkpoint_every: int = 25, use_pallas: Optional[bool] = None,
-               packed_genes: Optional[int] = None,
-               checkpoint_layout: str = "single",
-               ) -> TrainResult:
-    """Train the modified CBOW; returns the embedding table and history.
+_DTYPES = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}
 
-    ``paths``: [n_paths, n_genes] multi-hot (any integer/float dtype) — or,
-    with ``packed_genes=G``, the bit-packed [n_paths, ceil(G/8)] uint8 form
-    (np.packbits layout, e.g. from ``integrate_path_sets(packed=True)``);
-    the dense matrix is then never materialized whole on the host.
-    ``labels``: [n_paths] in {0, 1}. ``on_epoch(step, acc_val, acc_tr, secs)``
-    fires every epoch so the CLI can render the reference's log cadence.
+
+@dataclasses.dataclass(frozen=True)
+class _Layout:
+    """Everything about the device programs that follows from shapes alone.
+
+    ONE derivation shared by :func:`train_cbow` and
+    :func:`warm_train_compile` — the background compile warm is only a
+    win if it compiles EXACTLY the program the real run then requests,
+    so the kernel/padding decision must not be duplicated logic that can
+    drift.
     """
-    if paths.shape[0] < 2:
-        raise ValueError(f"need at least 2 paths to split, got {paths.shape[0]}")
-    ctx = mesh_ctx if mesh_ctx is not None else make_mesh_context(None)
-    _DTYPES = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}
-    if compute_dtype not in _DTYPES:
-        raise ValueError(
-            f"compute_dtype must be one of {sorted(_DTYPES)}, got {compute_dtype!r}")
-    if param_dtype not in _DTYPES:
-        raise ValueError(
-            f"param_dtype must be one of {sorted(_DTYPES)}, got {param_dtype!r}")
-    cdtype = _DTYPES[compute_dtype]
-    pdtype = _DTYPES[param_dtype]
-    if packed_genes is not None:
-        n_paths, nb_in = paths.shape
-        n_genes = packed_genes
-        if nb_in != (n_genes + 7) // 8 or paths.dtype != np.uint8:
-            raise ValueError(
-                f"packed_genes={n_genes} expects uint8 paths of width "
-                f"{(n_genes + 7) // 8}, got {paths.dtype} width {nb_in}")
-    else:
-        n_paths, n_genes = paths.shape
+    use_pallas: bool
+    interpret: bool
+    n_genes_pad: int
+    row_multiple: int
+    data_dim: int
+    model_dim: int
 
-    # ---- shuffled hold-out split (ref: G2Vec.py:219-226) ----
-    rng = np.random.default_rng(seed)
-    perm = rng.permutation(n_paths)
-    pivot = int(n_paths * (1.0 - val_fraction))
-    if pivot in (0, n_paths):
-        raise ValueError(
-            f"val_fraction={val_fraction} leaves an empty split for {n_paths} paths")
-    tr_idx, vl_idx = perm[:pivot], perm[pivot:]
 
-    # ---- shard-even padding (SPMD needs dims divisible by mesh axes) ----
-    # Rows pad to a multiple of the data axis, the gene axis to a multiple of
-    # the model axis. Padding rows carry weight 0 (masked means above);
-    # padding gene columns are all-zero in X, so the matching W_ih rows get
-    # exactly zero gradient and are sliced off before returning.
+def _plan_layout(n_paths: int, n_genes: int, hidden: int,
+                 compute_dtype: str, ctx: MeshContext,
+                 use_pallas: Optional[bool]) -> _Layout:
     from g2vec_tpu.parallel.mesh import pad_to_multiple
 
     if ctx.mesh is not None:
@@ -379,7 +388,7 @@ def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
         if hidden % 128:
             raise ValueError(f"use_pallas=True requires hidden % 128 == 0, "
                              f"got {hidden}")
-    pallas_interpret = use_pallas and _default_backend() != "tpu"
+    interpret = bool(use_pallas) and _default_backend() != "tpu"
 
     if use_pallas:
         # Gene axis pads to the kernel's lane block; rows to a full row tile
@@ -392,6 +401,77 @@ def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
         # coincide with shard boundaries.
         n_genes_pad = pad_to_multiple(n_genes, 8 * model_dim)
         row_multiple = data_dim
+    return _Layout(bool(use_pallas), interpret, n_genes_pad, row_multiple,
+                   data_dim, model_dim)
+
+
+def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
+               hidden: int, learning_rate: float, max_epochs: int,
+               val_fraction: float = 0.2, decision_threshold: float = 0.5,
+               compute_dtype: str = "bfloat16", param_dtype: str = "float32",
+               seed: int = 0, mesh_ctx: Optional[MeshContext] = None,
+               on_epoch: Optional[Callable[[int, float, float, float], None]] = None,
+               checkpoint_dir: Optional[str] = None, resume: bool = False,
+               checkpoint_every: int = 25, use_pallas: Optional[bool] = None,
+               packed_genes: Optional[int] = None,
+               checkpoint_layout: str = "single",
+               pre_compile_hook: Optional[Callable[[], None]] = None,
+               ) -> TrainResult:
+    """Train the modified CBOW; returns the embedding table and history.
+
+    ``paths``: [n_paths, n_genes] multi-hot (any integer/float dtype) — or,
+    with ``packed_genes=G``, the bit-packed [n_paths, ceil(G/8)] uint8 form
+    (np.packbits layout, e.g. from ``integrate_path_sets(packed=True)``);
+    the dense matrix is then never materialized whole on the host.
+    ``labels``: [n_paths] in {0, 1}. ``on_epoch(step, acc_val, acc_tr, secs)``
+    fires every epoch so the CLI can render the reference's log cadence.
+    """
+    if paths.shape[0] < 2:
+        raise ValueError(f"need at least 2 paths to split, got {paths.shape[0]}")
+    ctx = mesh_ctx if mesh_ctx is not None else make_mesh_context(None)
+    if compute_dtype not in _DTYPES:
+        raise ValueError(
+            f"compute_dtype must be one of {sorted(_DTYPES)}, got {compute_dtype!r}")
+    if param_dtype not in _DTYPES:
+        raise ValueError(
+            f"param_dtype must be one of {sorted(_DTYPES)}, got {param_dtype!r}")
+    cdtype = _DTYPES[compute_dtype]
+    pdtype = _DTYPES[param_dtype]
+    if packed_genes is not None:
+        n_paths, nb_in = paths.shape
+        n_genes = packed_genes
+        if nb_in != (n_genes + 7) // 8 or paths.dtype != np.uint8:
+            raise ValueError(
+                f"packed_genes={n_genes} expects uint8 paths of width "
+                f"{(n_genes + 7) // 8}, got {paths.dtype} width {nb_in}")
+    else:
+        n_paths, n_genes = paths.shape
+
+    # ---- shuffled hold-out split (ref: G2Vec.py:219-226) ----
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(n_paths)
+    pivot = int(n_paths * (1.0 - val_fraction))
+    if pivot in (0, n_paths):
+        raise ValueError(
+            f"val_fraction={val_fraction} leaves an empty split for {n_paths} paths")
+    tr_idx, vl_idx = perm[:pivot], perm[pivot:]
+
+    # ---- shard-even padding (SPMD needs dims divisible by mesh axes) ----
+    # Rows pad to a multiple of the data axis, the gene axis to a multiple of
+    # the model axis. Padding rows carry weight 0 (masked means above);
+    # padding gene columns are all-zero in X, so the matching W_ih rows get
+    # exactly zero gradient and are sliced off before returning. The whole
+    # kernel/padding decision lives in _plan_layout — shared with
+    # warm_train_compile, which must predict this run's programs exactly.
+    from g2vec_tpu.parallel.mesh import pad_to_multiple
+
+    plan = _plan_layout(n_paths, n_genes, hidden, compute_dtype, ctx,
+                        use_pallas)
+    use_pallas = plan.use_pallas
+    pallas_interpret = plan.interpret
+    n_genes_pad = plan.n_genes_pad
+    row_multiple = plan.row_multiple
+    if not use_pallas:
         unpack_fn = _get_unpack_fn(ctx, cdtype)
 
     def _prep(idx):
@@ -463,6 +543,11 @@ def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
     # host round trip over DEFAULT_CHUNK epochs.
     chunk = checkpoint_every if checkpoint_dir else DEFAULT_CHUNK
     chunk = max(1, min(chunk, max_epochs))
+    if pre_compile_hook is not None:
+        # The overlap scheduler joins its background warm_train_compile
+        # here — AFTER the host-side _prep packing it overlapped, right
+        # before the chunk-fn request that wants the warmed executable.
+        pre_compile_hook()
     chunk_fn = _get_chunk_fn(learning_rate, cdtype, decision_threshold, ctx,
                              chunk, packed=use_pallas,
                              interpret=pallas_interpret)
@@ -590,3 +675,86 @@ def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
                        stopped_early=stopped_early,
                        acc_val=before_val, acc_tr=before_tr,
                        history=history, params=snapshot)
+
+
+def warm_train_compile(n_paths: int, n_genes: int, *, hidden: int,
+                       learning_rate: float, max_epochs: int,
+                       val_fraction: float = 0.2,
+                       decision_threshold: float = 0.5,
+                       compute_dtype: str = "bfloat16",
+                       param_dtype: str = "float32",
+                       mesh_ctx: Optional[MeshContext] = None,
+                       checkpoint_dir: Optional[str] = None,
+                       checkpoint_every: int = 25,
+                       use_pallas: Optional[bool] = None) -> bool:
+    """Compile the chunk (and unpack) programs train_cbow will run at
+    these shapes, without training anything.
+
+    The overlap scheduler (parallel/overlap.py) calls this in the
+    BACKGROUND the moment ``n_paths`` is known (right after
+    integrate_path_sets), so the multi-second XLA compile runs while the
+    foreground is still counting gene frequencies and bit-packing the
+    path matrix — by the time train_cbow asks for the chunk fn, the LRU
+    already holds the compiled executable. Identity with the real
+    request is structural: the same _plan_layout/_get_chunk_fn derivation
+    from the same arguments produces the same cache key, and the dummy
+    zero inputs here have exactly the shapes/dtypes/shardings _prep
+    produces (the jit executable cache keys on those, never on values).
+
+    The warm call runs the chunk program once with ``limit=0``: the
+    device while_loop exits before epoch 0 and only the per-chunk
+    accuracy backfill executes — one eval forward, trivial next to the
+    compile it buys. Returns True when the programs were warmed, False
+    for degenerate shapes train_cbow would reject anyway (its own error
+    messages are the better report).
+    """
+    if n_paths < 2 or compute_dtype not in _DTYPES \
+            or param_dtype not in _DTYPES:
+        return False
+    pivot = int(n_paths * (1.0 - val_fraction))
+    if pivot in (0, n_paths):
+        return False
+    ctx = mesh_ctx if mesh_ctx is not None else make_mesh_context(None)
+    cdtype = _DTYPES[compute_dtype]
+    pdtype = _DTYPES[param_dtype]
+    from g2vec_tpu.parallel.mesh import pad_to_multiple
+
+    plan = _plan_layout(n_paths, n_genes, hidden, compute_dtype, ctx,
+                        use_pallas)
+    chunk = checkpoint_every if checkpoint_dir else DEFAULT_CHUNK
+    chunk = max(1, min(chunk, max_epochs))
+    chunk_fn = _get_chunk_fn(learning_rate, cdtype, decision_threshold, ctx,
+                             chunk, packed=plan.use_pallas,
+                             interpret=plan.interpret)
+
+    def dummy(n_rows):
+        n_pad = pad_to_multiple(n_rows, plan.row_multiple)
+        y = ctx.put(np.zeros((n_pad, 1), np.float32), ctx.label_spec)
+        w = ctx.put(_pad_rows(np.ones((n_rows, 1), np.float32), n_pad),
+                    ctx.label_spec)
+        packed = np.zeros((n_pad, plan.n_genes_pad // 8), dtype=np.uint8)
+        if plan.use_pallas:
+            return ctx.put(packed, ctx.packed_batch_spec), y, w
+        return _get_unpack_fn(ctx, cdtype)(
+            ctx.put(packed, ctx.batch_spec)), y, w
+
+    xtr, ytr, wtr = dummy(pivot)
+    xval, yval, wval = dummy(n_paths - pivot)
+    params = init_params(jax.random.key(0), plan.n_genes_pad, hidden,
+                         param_dtype=pdtype)
+    if ctx.mesh is not None:
+        params = CBOWParams(w_ih=ctx.put(params.w_ih, ctx.w_ih_spec),
+                            w_ho=ctx.put(params.w_ho, ctx.w_ho_spec))
+    tx = optax.adam(learning_rate, b1=0.9, b2=0.999, eps=1e-8)
+    opt_state = tx.init(params)
+    if ctx.mesh is not None:
+        from jax.sharding import PartitionSpec as P
+
+        opt_state = jax.tree.map(
+            lambda sub: (sub if isinstance(sub, CBOWParams)
+                         else ctx.put(sub, P())),
+            opt_state, is_leaf=lambda x: isinstance(x, CBOWParams))
+    out = chunk_fn(params, opt_state, params, -1.0, -1.0, 0,
+                   xtr, ytr, wtr, xval, yval, wval)
+    jax.block_until_ready(out[5])      # the epoch count — compile is done
+    return True

@@ -1,0 +1,36 @@
+"""Content-hash / atomic-write primitives shared by every integrity layer.
+
+One home for the sha256-manifest machinery: the checkpoint manifests
+(train/checkpoint.py) and the walk-artifact cache (g2vec_tpu/cache.py)
+verify bytes the same way, and the cache must be importable with NO jax
+in the process (bench.py's host-only child), so these helpers cannot live
+in checkpoint.py (which imports jax at module scope).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+import numpy as np
+
+
+def sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def sha256_array(arr: np.ndarray) -> str:
+    return hashlib.sha256(np.ascontiguousarray(arr).tobytes()).hexdigest()
+
+
+def write_json_atomic(path: str, payload: dict) -> None:
+    """tmp + rename so a torn write never leaves a half-JSON behind."""
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, path)

@@ -12,10 +12,19 @@ from typing import Dict, Iterator, List, Tuple
 
 
 class StageTimer:
-    """Records (stage, seconds) pairs in order of completion."""
+    """Records (stage, seconds) pairs in order of completion.
+
+    Under overlapped execution (parallel/overlap.py) a stage's seconds
+    alone no longer say HOW it got that fast — stage 3 may have run on N
+    sampler threads, stage 4's compile may have been warmed elsewhere, a
+    cache hit may have skipped the walks outright. :meth:`annotate`
+    attaches those attribution facts to a stage; they ride the ``done``
+    metrics event as ``stage_extras`` beside ``stage_seconds``.
+    """
 
     def __init__(self) -> None:
         self.stages: List[Tuple[str, float]] = []
+        self.extras: Dict[str, Dict] = {}
 
     @contextlib.contextmanager
     def stage(self, name: str) -> Iterator[None]:
@@ -25,8 +34,16 @@ class StageTimer:
         finally:
             self.stages.append((name, time.perf_counter() - t0))
 
+    def annotate(self, name: str, **extras) -> None:
+        """Attach attribution facts (backend, thread count, cache hits,
+        overlap savings) to ``name``'s record."""
+        self.extras.setdefault(name, {}).update(extras)
+
     def as_dict(self) -> Dict[str, float]:
         return dict(self.stages)
+
+    def extras_dict(self) -> Dict[str, Dict]:
+        return {k: dict(v) for k, v in self.extras.items()}
 
     @property
     def total(self) -> float:

@@ -1,0 +1,420 @@
+"""Overlapped execution + persistent caches (ISSUE 3): the scheduler's
+failure/drain contract, the walk-artifact cache's verify-before-trust
+matrix (hit / miss / tampered / stale), the sampler pool's N-thread
+bit-identity, and the pipeline-level warm-cache rerun that skips stage 3.
+
+The scheduler drain test is the tier-1 smoke gate wired into
+tools/watch_loop.sh: a foreground stage failure must propagate the
+ORIGINAL exception and leave no thread waiting (no deadlock)."""
+import json
+import os
+import shutil
+import sys
+import threading
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from g2vec_tpu.cache import (NATIVE_FAMILY, MANIFEST_SUFFIX, WalkCache,
+                             resolve_cache_tiers, walk_cache_key)
+from g2vec_tpu.parallel.overlap import OverlapScheduler, TaskCancelled
+from g2vec_tpu.resilience import faults
+
+g_plus_plus = shutil.which("g++")
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv(faults.ENV_PLAN, raising=False)
+    monkeypatch.delenv(faults.ENV_STATE, raising=False)
+    faults._reset_for_tests()
+    yield
+    faults._reset_for_tests()
+
+
+# ---- overlap scheduler ------------------------------------------------------
+
+
+def test_scheduler_runs_tasks_and_respects_deps():
+    order = []
+    with OverlapScheduler(max_workers=2) as sched:
+        sched.submit("a", lambda: order.append("a") or 1)
+        sched.submit("b", lambda: order.append("b") or 2, deps=["a"])
+        assert sched.result("b") == 2
+        assert sched.result("a") == 1
+    assert order == ["a", "b"]
+
+
+def test_scheduler_result_reraises_task_exception():
+    with OverlapScheduler() as sched:
+        sched.submit("boom", lambda: 1 / 0)
+        with pytest.raises(ZeroDivisionError):
+            sched.result("boom")
+
+
+def test_scheduler_cancels_dependents_of_failed_task():
+    ran = []
+    with OverlapScheduler() as sched:
+        sched.submit("boom", lambda: 1 / 0)
+        sched.submit("child", lambda: ran.append(True), deps=["boom"])
+        with pytest.raises(TaskCancelled, match="dependency 'boom' failed"):
+            sched.result("child")
+    assert ran == []        # never started
+
+
+def test_scheduler_drain_propagates_first_real_failure():
+    # The no-deadlock smoke gate (tools/watch_loop.sh): one task fails,
+    # its dependent is cancelled, a slow independent task still runs —
+    # drain must join EVERYTHING promptly and re-raise the original
+    # exception, not a TaskCancelled shadow of it.
+    slow_done = threading.Event()
+
+    def slow():
+        time.sleep(0.2)
+        slow_done.set()
+
+    sched = OverlapScheduler(max_workers=4)
+    sched.submit("boom", lambda: (_ for _ in ()).throw(KeyError("orig")))
+    sched.submit("child", lambda: None, deps=["boom"])
+    sched.submit("slow", slow)
+    t0 = time.monotonic()
+    with pytest.raises(KeyError, match="orig"):
+        sched.drain()
+    assert time.monotonic() - t0 < 10          # no deadlock
+    assert slow_done.is_set()                  # independent task completed
+    sched.close()                              # idempotent after drain
+
+
+def test_scheduler_close_never_raises():
+    sched = OverlapScheduler()
+    sched.submit("boom", lambda: 1 / 0)
+    sched.close()           # the finally-path contract: swallow, drain
+
+
+def test_scheduler_rejects_bad_submissions():
+    with OverlapScheduler() as sched:
+        sched.submit("a", lambda: None)
+        with pytest.raises(ValueError, match="duplicate"):
+            sched.submit("a", lambda: None)
+        with pytest.raises(ValueError, match="unsubmitted"):
+            sched.submit("b", lambda: None, deps=["nope"])
+        assert sched.has("a") and not sched.has("b")
+
+
+def test_scheduler_saved_seconds_accounting():
+    with OverlapScheduler() as sched:
+        sched.submit("bg", lambda: time.sleep(0.15))
+        time.sleep(0.25)            # foreground "work" the task hid under
+        sched.result("bg")
+    saved = sched.saved_seconds()
+    # The task ran ~0.15s and the join waited ~0s: nearly all of it saved.
+    assert 0.05 <= saved["bg"] <= 0.15
+
+
+# ---- walk-artifact cache ----------------------------------------------------
+
+
+def _toy_edges():
+    src = np.array([0, 1, 2], dtype=np.int32)
+    dst = np.array([1, 2, 3], dtype=np.int32)
+    w = np.array([1.0, 1.0, 1.0], dtype=np.float32)
+    return src, dst, w, 4
+
+
+def _toy_key(seed=0):
+    src, dst, w, n = _toy_edges()
+    return walk_cache_key(src, dst, w, n, len_path=5, reps=2, seed=seed,
+                          family=NATIVE_FAMILY)
+
+
+def _toy_path_set(n=4):
+    rows = np.packbits(np.eye(n, dtype=np.uint8), axis=1)
+    return {r.tobytes() for r in rows}
+
+
+def test_cache_key_tracks_every_input():
+    src, dst, w, n = _toy_edges()
+    base = _toy_key()
+    assert base == _toy_key()                      # deterministic
+    assert base != _toy_key(seed=1)                # params in the key
+    assert base != walk_cache_key(src, dst, w + 1, n, len_path=5, reps=2,
+                                  seed=0, family=NATIVE_FAMILY)
+    # PRNG family tags must never alias (the two samplers draw from
+    # different stream families).
+    assert base != walk_cache_key(src, dst, w, n, len_path=5, reps=2,
+                                  seed=0, family="device-jaxrandom-v1")
+
+
+def test_cache_store_load_roundtrip(tmp_path):
+    cache = WalkCache(str(tmp_path / "walks"))
+    key = _toy_key()
+    assert cache.load(key) is None                 # cold miss
+    ps = _toy_path_set()
+    art = cache.store(key, ps, 4, meta={"group": "g"})
+    assert os.path.exists(art) and os.path.exists(art + MANIFEST_SUFFIX)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")             # a hit must be silent
+        assert cache.load(key) == ps
+    # The manifest records provenance the next session can audit.
+    manifest = json.loads(open(art + MANIFEST_SUFFIX).read())
+    assert manifest["key"] == key and manifest["group"] == "g"
+    assert manifest["n_rows"] == len(ps)
+
+
+def test_cache_empty_path_set_roundtrip(tmp_path):
+    cache = WalkCache(str(tmp_path))
+    key = _toy_key()
+    cache.store(key, set(), 4)
+    assert cache.load(key) == set()
+
+
+def test_cache_tampered_artifact_verified_and_recomputed(tmp_path):
+    # The acceptance drill: bytes flipped AFTER the manifest recorded the
+    # good hash -> sha mismatch -> warning + miss; the recompute's store
+    # overwrites the bad entry and the next load is a clean hit.
+    cache = WalkCache(str(tmp_path))
+    key = _toy_key()
+    ps = _toy_path_set()
+    art = cache.store(key, ps, 4)
+    with open(art, "r+b") as f:
+        f.seek(8)
+        byte = f.read(1)
+        f.seek(8)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    with pytest.warns(RuntimeWarning, match="sha256 verification"):
+        assert cache.load(key) is None
+    cache.store(key, ps, 4)                        # the recompute
+    assert cache.load(key) == ps
+
+
+def test_cache_fault_plan_corrupt_seam(tmp_path):
+    # kind=corrupt at the walk_cache seam models the same bitrot through
+    # the production fault grammar — store "succeeds", load must refuse.
+    faults.install_plan("stage=walk_cache,kind=corrupt")
+    cache = WalkCache(str(tmp_path))
+    key = _toy_key()
+    cache.store(key, _toy_path_set(), 4)
+    with pytest.warns(RuntimeWarning, match="corrupt or torn"):
+        assert cache.load(key) is None
+
+
+def test_cache_missing_or_mangled_manifest_is_a_miss(tmp_path):
+    cache = WalkCache(str(tmp_path))
+    key = _toy_key()
+    ps = _toy_path_set()
+    art = cache.store(key, ps, 4)
+    os.remove(art + MANIFEST_SUFFIX)               # manifest-less artifact
+    assert cache.load(key) is None
+    cache.store(key, ps, 4)
+    with open(art + MANIFEST_SUFFIX, "w") as f:
+        f.write("{not json")
+    with pytest.warns(RuntimeWarning, match="unreadable"):
+        assert cache.load(key) is None
+
+
+def test_cache_stale_schema_or_foreign_key_is_a_miss(tmp_path):
+    import g2vec_tpu.utils.integrity as integrity
+
+    cache = WalkCache(str(tmp_path))
+    key = _toy_key()
+    art = cache.store(key, _toy_path_set(), 4)
+    man_path = art + MANIFEST_SUFFIX
+    manifest = json.loads(open(man_path).read())
+    for bad in ({**manifest, "schema": 0},
+                {**manifest, "key": "f" * 64}):    # truncated-key collision
+        integrity.write_json_atomic(man_path, bad)
+        with pytest.warns(RuntimeWarning, match="stale"):
+            assert cache.load(key) is None
+
+
+def test_resolve_cache_tiers_semantics(tmp_path):
+    root = str(tmp_path / "c")
+    xla, walks = resolve_cache_tiers(root, None)
+    assert xla == os.path.join(root, "xla")
+    assert walks is not None and walks.directory == os.path.join(root, "walks")
+    # --compilation-cache is the narrower flag: it wins the xla tier.
+    xla, walks = resolve_cache_tiers(root, "/elsewhere/xla")
+    assert xla == "/elsewhere/xla" and walks is not None
+    # --no-walk-cache keeps the compile tier only.
+    xla, walks = resolve_cache_tiers(root, None, walk_cache_enabled=False)
+    assert xla and walks is None
+    # No --cache-dir: legacy behavior, xla tier only if explicitly set.
+    assert resolve_cache_tiers(None, None) == (None, None)
+
+
+# ---- sampler thread resolution + bit-identity -------------------------------
+
+
+def test_resolve_sampler_threads(monkeypatch):
+    from g2vec_tpu.ops.host_walker import resolve_sampler_threads
+
+    assert resolve_sampler_threads(3) == 3         # explicit wins
+    monkeypatch.setenv("G2VEC_SAMPLER_THREADS", "5")
+    assert resolve_sampler_threads(0) == 5         # env override for auto
+    assert resolve_sampler_threads(2) == 2
+    monkeypatch.setenv("G2VEC_SAMPLER_THREADS", "nope")
+    with pytest.raises(ValueError, match="G2VEC_SAMPLER_THREADS"):
+        resolve_sampler_threads(0)
+    monkeypatch.delenv("G2VEC_SAMPLER_THREADS")
+    assert resolve_sampler_threads(0) >= 1         # auto = all cores
+    with pytest.raises(ValueError, match=">= 0"):
+        resolve_sampler_threads(-1)
+
+
+@pytest.mark.skipif(g_plus_plus is None, reason="no C++ toolchain")
+def test_pool_sharded_rows_bit_identical_to_single_thread():
+    # The determinism contract on a workload LARGE enough to engage the
+    # Python range pool (n_walkers > RANGE_CHUNK): streams are keyed by
+    # global walker index and every range writes a disjoint row slice,
+    # so any thread count reproduces the 1-thread bytes exactly.
+    from g2vec_tpu.ops.host_walker import RANGE_CHUNK, walk_packed_rows
+
+    src, dst, w, n = _toy_edges()
+    reps = RANGE_CHUNK // n + 2                    # push past one chunk
+    kwargs = dict(len_path=5, reps=reps, seed=17)
+    rows1 = walk_packed_rows(src, dst, w, n, n_threads=1, **kwargs)
+    assert rows1.shape[0] == n * reps > RANGE_CHUNK
+    for threads in (2, 4, 7):
+        rows_t = walk_packed_rows(src, dst, w, n, n_threads=threads,
+                                  **kwargs)
+        np.testing.assert_array_equal(rows1, rows_t)
+
+
+@pytest.mark.skipif(g_plus_plus is None, reason="no C++ toolchain")
+def test_path_set_thread_invariant_on_example_network():
+    from g2vec_tpu.ops.host_walker import generate_path_set_native
+
+    src, dst, w, n = _toy_edges()
+    a = generate_path_set_native(src, dst, w, n, len_path=5, reps=600,
+                                 seed=3, n_threads=1)
+    b = generate_path_set_native(src, dst, w, n, len_path=5, reps=600,
+                                 seed=3, n_threads=4)
+    assert a == b and a
+
+
+# ---- pipeline integration ---------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tsv_paths(tmp_path_factory):
+    from g2vec_tpu.data.synthetic import SyntheticSpec, write_synthetic_tsv
+
+    spec = SyntheticSpec(n_good=14, n_poor=10, module_size=10,
+                         n_background=10, n_expr_only=2, n_net_only=2,
+                         module_chords=2, background_edges=16, seed=3)
+    out = tmp_path_factory.mktemp("syn_overlap")
+    return write_synthetic_tsv(spec, str(out))
+
+
+def _cfg(tsv_paths, tmp_path, **overrides):
+    from g2vec_tpu.config import G2VecConfig
+
+    os.makedirs(str(tmp_path), exist_ok=True)
+    defaults = dict(
+        expression_file=tsv_paths["expression"],
+        clinical_file=tsv_paths["clinical"],
+        network_file=tsv_paths["network"],
+        result_name=os.path.join(str(tmp_path), "out"),
+        lenPath=6, numRepetition=4, sizeHiddenlayer=16, epoch=3,
+        compute_dtype="float32", seed=0,
+    )
+    defaults.update(overrides)
+    return G2VecConfig(**defaults)
+
+
+@pytest.mark.skipif(g_plus_plus is None, reason="no C++ toolchain")
+def test_pipeline_warm_cache_rerun_skips_walks(tsv_paths, tmp_path):
+    # Cold run populates the artifact tier; the warm rerun must serve
+    # BOTH groups from it (stage 3 sampled nothing) and produce byte-
+    # identical outputs. Then a tampered artifact forces a verified
+    # recompute — the cache can be fast, never wrong.
+    from g2vec_tpu.pipeline import run
+
+    cache_dir = str(tmp_path / "cache")
+    cold = run(_cfg(tsv_paths, tmp_path / "a", walker_backend="native",
+                    cache_dir=cache_dir), console=lambda s: None)
+    assert cold.walk_cache_hits == []
+    assert cold.sampler_threads >= 1
+    lines = []
+    warm = run(_cfg(tsv_paths, tmp_path / "b", walker_backend="native",
+                    cache_dir=cache_dir), console=lines.append)
+    assert sorted(warm.walk_cache_hits) == ["g", "p"]
+    assert warm.n_paths == cold.n_paths
+    assert any("verified walk artifact hit" in ln for ln in lines)
+    assert (tmp_path / "a" / "out_biomarkers.txt").read_text() \
+        == (tmp_path / "b" / "out_biomarkers.txt").read_text()
+    # Tamper with every cached artifact: the next run must detect the
+    # sha mismatch, warn, recompute, and still match the cold outputs.
+    walks_dir = os.path.join(cache_dir, "walks")
+    for name in os.listdir(walks_dir):
+        if name.endswith(".npz"):
+            with open(os.path.join(walks_dir, name), "r+b") as f:
+                f.seek(10)
+                f.write(b"\xff\xff")
+    with pytest.warns(RuntimeWarning, match="sha256 verification"):
+        redo = run(_cfg(tsv_paths, tmp_path / "c", walker_backend="native",
+                        cache_dir=cache_dir), console=lambda s: None)
+    assert redo.walk_cache_hits == []
+    assert (tmp_path / "a" / "out_biomarkers.txt").read_text() \
+        == (tmp_path / "c" / "out_biomarkers.txt").read_text()
+
+
+@pytest.mark.skipif(g_plus_plus is None, reason="no C++ toolchain")
+def test_pipeline_overlap_matches_sequential(tsv_paths, tmp_path):
+    # --no-overlap is an attribution/debug switch: the transcript moves,
+    # the bytes must not.
+    from g2vec_tpu.pipeline import run
+
+    res_seq = run(_cfg(tsv_paths, tmp_path / "seq", walker_backend="native",
+                       overlap=False), console=lambda s: None)
+    res_ovl = run(_cfg(tsv_paths, tmp_path / "ovl", walker_backend="native",
+                       overlap=True), console=lambda s: None)
+    assert res_seq.n_paths == res_ovl.n_paths
+    assert (tmp_path / "seq" / "out_biomarkers.txt").read_text() \
+        == (tmp_path / "ovl" / "out_biomarkers.txt").read_text()
+    np.testing.assert_array_equal(res_seq.embeddings, res_ovl.embeddings)
+
+
+@pytest.mark.skipif(g_plus_plus is None, reason="no C++ toolchain")
+def test_pipeline_stage_failure_drains_overlap(tsv_paths, tmp_path):
+    # A foreground stage failure with background tasks in flight: the
+    # ORIGINAL injected fault must propagate (not a scheduler artifact)
+    # and the run must end promptly — the outer finally drains the
+    # scheduler instead of deadlocking on it.
+    from g2vec_tpu.pipeline import run
+
+    faults.install_plan("stage=train,kind=crash")
+    t0 = time.monotonic()
+    with pytest.raises(faults.InjectedFault):
+        run(_cfg(tsv_paths, tmp_path, walker_backend="native"),
+            console=lambda s: None)
+    assert time.monotonic() - t0 < 120
+    # The scheduler left no stray non-daemon workers holding the process.
+    stray = [t for t in threading.enumerate()
+             if t.name.startswith("g2v-overlap") and not t.daemon]
+    assert all(not t.is_alive() for t in stray)
+
+
+def test_pipeline_done_event_carries_attribution(tsv_paths, tmp_path):
+    # The done metrics event must say HOW stage_seconds were achieved:
+    # backend, pool width, per-task overlap savings, cache hits.
+    from g2vec_tpu.pipeline import run
+
+    metrics_path = str(tmp_path / "m.jsonl")
+    run(_cfg(tsv_paths, tmp_path, metrics_jsonl=metrics_path),
+        console=lambda s: None)
+    events = [json.loads(ln) for ln in open(metrics_path)]
+    done = [e for e in events if e["event"] == "done"]
+    assert len(done) == 1
+    for field in ("walker_backend", "sampler_threads", "overlap_saved_s",
+                  "walk_cache_hits", "stage_extras"):
+        assert field in done[0], field
+    paths_ev = [e for e in events if e["event"] == "paths"]
+    assert paths_ev and "sampler_threads" in paths_ev[0]
+    assert done[0]["stage_extras"].get("paths", {}).get("walker_backend") \
+        == done[0]["walker_backend"]

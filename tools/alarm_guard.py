@@ -1,39 +1,27 @@
-"""One SIGALRM-bounded region helper for the profiler tools.
+"""Deadline guard for the profiler tools — now a shim over tools/watchdog.
 
-Four near-identical save-handler/alarm/try/finally/restore blocks lived
-across profile_ops.py and profile_walker.py; this is the single copy.
-Note the bound is best-effort: Python delivers the signal only between
-bytecodes, so a single long native call (an XLA compile) defers it until
-that call returns.
+The original implementation armed SIGALRM, whose handler runs only
+between bytecodes on the main thread: one blocked native call (an XLA
+compile on a dead tunnel) deferred it forever, which is how the r5 chip
+window died inside the kmeans compile (PROFILE.md). The replacement is
+the thread watchdog (tools/watchdog.py): async-exception injection at
+the deadline, re-injection while the body stays wedged, optional hard
+process exit for bounded subprocesses. This module keeps the old entry
+point's name and contract (raise TimeoutError(message) on overrun,
+nesting-safe, nothing leaks after completion) so the profiler batteries
+did not need to change call sites.
 """
 from __future__ import annotations
 
-import signal
-from contextlib import contextmanager
+from tools.watchdog import WatchdogTimeout, watchdog  # noqa: F401
 
 
-@contextmanager
 def alarm(seconds: int, message: str):
     """Raise TimeoutError(message) if the body runs past ``seconds``.
 
-    Nesting-safe: SIGALRM has one process-wide timer, so an inner region
-    records the outer deadline's remaining seconds and re-arms it (less
-    the time the inner body consumed, floor 1 s) on exit — an outer
-    bound survives an inner region that completes quickly.
+    Thin wrapper over :func:`tools.watchdog.watchdog`; each region owns
+    its own watcher thread, so nested regions need no timer arithmetic —
+    the inner deadline fires inside the outer one and both restore
+    nothing process-wide.
     """
-    import time as _time
-
-    def _handler(signum, frame):
-        raise TimeoutError(message)
-
-    old = signal.signal(signal.SIGALRM, _handler)
-    prev_remaining = signal.alarm(seconds)
-    t0 = _time.monotonic()
-    try:
-        yield
-    finally:
-        signal.alarm(0)
-        signal.signal(signal.SIGALRM, old)
-        if prev_remaining:
-            left = prev_remaining - (_time.monotonic() - t0)
-            signal.alarm(max(1, int(left)))
+    return watchdog(seconds, message)

@@ -160,6 +160,13 @@ def run_acceptance(out_path: str) -> dict:
         "code_key": _code_key(),
         "stage_seconds": {k: round(v, 2)
                           for k, v in res.stage_seconds.items()},
+        # Overlap attribution (parallel/overlap.py): stage_seconds alone
+        # understate what ran — these say how many host threads sampled
+        # and how much background (compile-warm / concurrent-walk) time
+        # hid under foreground stages in THIS run.
+        "sampler_threads": res.sampler_threads,
+        "overlap_saved_s": res.overlap_saved_s,
+        "walk_cache_hits": res.walk_cache_hits,
         "pipeline_wall_seconds": round(total, 2),
         "expression_gen_seconds": round(gen_secs, 2),
         "script_wall_seconds": round(time.time() - t_start, 2),

@@ -23,12 +23,15 @@ arms=0
 while [ "$arms" -lt "$MAX_ARMS" ] && [ "$(date +%s)" -lt "$DEADLINE" ]; do
     arms=$((arms + 1))
     # Resilience regression gate, re-run every arm on host CPU: the
-    # single-process fault matrix plus the multi-rank fleet matrix
+    # single-process fault matrix, the multi-rank fleet matrix
     # (watchdogs, rank-scoped kills, degraded-mesh resume) on virtual
-    # devices. Non-fatal: a red matrix is reported, the chip battery
-    # still runs.
+    # devices, and the overlap/cache suite (scheduler drains cleanly on
+    # stage failure — no deadlock, original exception propagates — plus
+    # the walk-cache verify matrix). Non-fatal: a red matrix is
+    # reported, the chip battery still runs.
     if ! JAX_PLATFORMS=cpu "$PY" -m pytest tests/test_resilience.py \
-            tests/test_fleet.py tests/test_fleet_e2e.py -q -m "not slow" \
+            tests/test_fleet.py tests/test_fleet_e2e.py \
+            tests/test_overlap_cache.py -q -m "not slow" \
             -p no:cacheprovider >/tmp/fault_matrix_arm$arms.log 2>&1; then
         echo "[watch_loop] WARNING: fault/fleet matrix FAILED on arm $arms (log: /tmp/fault_matrix_arm$arms.log)"
     else

@@ -1,0 +1,108 @@
+"""Thread-based deadline guard for the profiler/bench stages.
+
+Replaces the SIGALRM guard (tools/alarm_guard.py, now a shim over this):
+SIGALRM's Python handler only runs between bytecodes ON THE MAIN THREAD,
+so one long-blocked native call — an XLA compile dialing a dead TPU
+tunnel — defers it indefinitely. That exact failure burned the r5 chip
+window: the kmeans compile wedged inside the acceptance stage, the alarm
+never fired, and the measure child hung until the parent's hard kill
+threw away every later stage (PROFILE.md).
+
+This guard arms one daemon WATCHER THREAD per region instead:
+
+1. At the deadline it injects :class:`WatchdogTimeout` into the guarded
+   thread via ``PyThreadState_SetAsyncExc`` — same delivery power as the
+   signal path (next bytecode boundary) but it works on any thread, needs
+   no process-wide timer (regions nest without re-arming arithmetic), and
+   cannot be swallowed by a foreign SIGALRM handler.
+2. If the region is STILL inside the body ``grace`` seconds later, the
+   guarded thread is blocked in a native call the injection cannot reach.
+   With ``hard=True`` the watcher prints a diagnostic (with the stuck
+   region's name) and ``os._exit(124)``s the process — for a bounded
+   subprocess (bench's measure child, the profiler batteries) an early
+   honest death returns the window to the parent's retry loop, where the
+   old guard's silent hang forfeited it. With ``hard=False`` (default)
+   the watcher keeps re-injecting each ``grace`` so a body that pops back
+   into Python even briefly still dies with the timeout.
+
+The injection/exit race at body completion is closed with a per-region
+lock: the watcher checks-and-injects under it, ``__exit__`` flips the
+done flag under it — after a clean exit no stale timeout can surface in
+the caller's frame.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import sys
+import threading
+from contextlib import contextmanager
+
+
+class WatchdogTimeout(TimeoutError):
+    """Raised in the guarded thread when a region overruns its deadline."""
+
+
+def _make_timeout_cls(message: str):
+    # PyThreadState_SetAsyncExc takes an exception CLASS and instantiates
+    # it with no arguments at the raise site — bake the message in.
+    class _Timeout(WatchdogTimeout):
+        def __init__(self, *args):  # noqa: D401 — fixed message
+            super().__init__(message)
+
+    _Timeout.__name__ = "WatchdogTimeout"
+    return _Timeout
+
+
+def _inject(thread_id: int, exc_cls) -> None:
+    ctypes.pythonapi.PyThreadState_SetAsyncExc(
+        ctypes.c_ulong(thread_id), ctypes.py_object(exc_cls))
+
+
+@contextmanager
+def watchdog(seconds: float, message: str, *, grace: float = 10.0,
+             hard: bool = False):
+    """Raise ``WatchdogTimeout(message)`` in the calling thread if the body
+    runs past ``seconds``; escalate per the module docstring when the body
+    is wedged in a native call (``hard=True`` -> ``os._exit(124)`` after
+    ``grace`` more seconds).
+    """
+    if seconds <= 0:
+        raise ValueError(f"watchdog needs seconds > 0, got {seconds}")
+    target = threading.get_ident()
+    exc_cls = _make_timeout_cls(message)
+    done = threading.Event()
+    lock = threading.Lock()
+
+    def watch():
+        if done.wait(seconds):
+            return
+        with lock:
+            if done.is_set():
+                return
+            _inject(target, exc_cls)
+        # The injection lands at the next bytecode; a thread blocked in a
+        # native call never reaches one. Escalate after each grace.
+        while not done.wait(grace):
+            if hard:
+                print(f"[watchdog] region {message!r} still wedged "
+                      f"{grace:.0f}s past its {seconds:.0f}s deadline "
+                      f"(blocked native call?) — exiting 124",
+                      file=sys.stderr, flush=True)
+                sys.stderr.flush()
+                sys.stdout.flush()
+                os._exit(124)
+            with lock:
+                if done.is_set():
+                    return
+                _inject(target, exc_cls)
+
+    watcher = threading.Thread(target=watch, daemon=True,
+                               name=f"watchdog({message[:40]})")
+    watcher.start()
+    try:
+        yield
+    finally:
+        with lock:
+            done.set()
+        watcher.join(timeout=5.0)
