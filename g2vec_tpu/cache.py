@@ -150,6 +150,21 @@ class WalkCache:
         return art
 
 
+def autotune_cache_path(cache_dir: Optional[str]) -> Optional[str]:
+    """The kernel-autotune tier's record file under ``--cache-dir``.
+
+    A third tier beside xla/ and walks/: measured packed-kernel tile
+    plans, keyed inside the file by exact problem shape + backend
+    signature + kernel schema (ops/packed_matmul.py owns the format and
+    its staleness rules — this helper only names the location, so every
+    caller agrees on it). None when no cache root is configured: the
+    sweep then runs in-memory only and repeat runs re-measure.
+    """
+    if not cache_dir:
+        return None
+    return os.path.join(cache_dir, "autotune", "packed_matmul.json")
+
+
 def resolve_cache_tiers(cache_dir: Optional[str],
                         compilation_cache: Optional[str],
                         walk_cache_enabled: bool = True,
@@ -159,6 +174,8 @@ def resolve_cache_tiers(cache_dir: Optional[str],
     ``--cache-dir`` implies both tiers under one root; each narrower
     control still works alone (``--compilation-cache`` overrides the xla
     tier's location, ``--no-walk-cache`` disables the artifact tier).
+    The kernel-autotune tier rides the same root via
+    :func:`autotune_cache_path`.
     """
     xla_dir = compilation_cache
     walks: Optional[WalkCache] = None

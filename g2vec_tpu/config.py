@@ -85,6 +85,27 @@ class G2VecConfig:
                                      # concurrently and the trainer/kmeans
                                      # compiles warm in the background during
                                      # stage 3; never changes results
+    fused_eval: bool = True          # fold the val-split eval forward into
+                                     # the chunk body's grad pass (one fused
+                                     # program per epoch; --no-fused-eval
+                                     # restores the split grad+eval shape).
+                                     # float32 history is bitwise-identical
+                                     # either way (trainer.py parity contract)
+    epoch_superstep: int = 1         # epochs unrolled per while_loop
+                                     # iteration in the chunk program (K>=1);
+                                     # amortizes per-iteration dispatch/cond
+                                     # overhead, early stop still lands ON
+                                     # the dip
+    donate_state: bool = True        # donate the (params, opt_state,
+                                     # snapshot, history) carry to the chunk
+                                     # program so Adam's fp32 read/write set
+                                     # updates in place instead of
+                                     # double-buffering in HBM
+    kernel_autotune: bool = False    # measure packed-kernel tile plans at
+                                     # this run's exact shapes instead of
+                                     # trusting the VMEM-model heuristic
+                                     # (persisted under --cache-dir's
+                                     # autotune tier)
     mesh_shape: Optional[Tuple[int, int]] = None  # (data, model); None = single device
     platform: Optional[str] = None   # force jax platform (e.g. "cpu")
     profile_dir: Optional[str] = None
@@ -180,6 +201,9 @@ class G2VecConfig:
             raise ValueError(
                 f"walker_backend must be auto|device|native, "
                 f"got {self.walker_backend}")
+        if self.epoch_superstep < 1:
+            raise ValueError(
+                f"epoch_superstep must be >= 1, got {self.epoch_superstep}")
         if self.sampler_threads < 0:
             raise ValueError(
                 f"sampler_threads must be >= 0 (0 = all cores), "
@@ -296,6 +320,29 @@ def build_parser() -> argparse.ArgumentParser:
                              "pool (0 = all cores). Walk output is "
                              "bit-identical at any count — per-walker PRNG "
                              "streams are keyed by global walker index.")
+    parser.add_argument("--no-fused-eval", action="store_true",
+                        help="Keep the val-split eval as its own per-epoch "
+                             "program instead of riding the grad pass's "
+                             "forward. float32 results are bitwise-identical "
+                             "either way; this is an attribution/debugging "
+                             "switch.")
+    parser.add_argument("--epoch-superstep", type=int, default=1,
+                        metavar="K",
+                        help="Epochs unrolled per device-loop iteration in "
+                             "the trainer chunk program (default 1). K>=8 "
+                             "amortizes the while_loop's per-iteration "
+                             "overhead; the early stop still exits on the "
+                             "dip epoch.")
+    parser.add_argument("--no-donate", action="store_true",
+                        help="Do not donate the trainer carry buffers to "
+                             "the chunk program (keeps Adam's fp32 state "
+                             "double-buffered in HBM; attribution switch).")
+    parser.add_argument("--kernel-autotune", action="store_true",
+                        help="Sweep the packed kernel's legal tile plans at "
+                             "this run's exact matmul shapes and use the "
+                             "measured best instead of the heuristic "
+                             "(persisted under --cache-dir/autotune so "
+                             "repeat runs skip the sweep).")
     parser.add_argument("--no-overlap", action="store_true",
                         help="Disable overlapped stage execution (concurrent "
                              "group walks + background compile warming). "
@@ -427,6 +474,10 @@ def config_from_args(argv=None) -> G2VecConfig:
         walker_hbm_budget=args.walker_hbm_budget,
         walker_backend=args.walker_backend,
         sampler_threads=args.sampler_threads,
+        fused_eval=not args.no_fused_eval,
+        epoch_superstep=args.epoch_superstep,
+        donate_state=not args.no_donate,
+        kernel_autotune=args.kernel_autotune,
         overlap=not args.no_overlap,
         mesh_shape=parse_mesh(args.mesh),
         platform=args.platform,

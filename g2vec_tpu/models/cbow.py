@@ -27,14 +27,30 @@ class CBOWParams(NamedTuple):
 
 
 def init_params(key: jax.Array, n_genes: int, hidden: int,
-                param_dtype=jnp.float32) -> CBOWParams:
+                param_dtype=jnp.float32,
+                pad_to: "int | None" = None) -> CBOWParams:
     """Truncated-normal init, std 1/sqrt(hidden) (ref: G2Vec.py:234-235).
 
     ``jax.random.truncated_normal(-2, 2)`` matches TF1's
-    ``tf.truncated_normal`` (resample beyond 2 sigma)."""
+    ``tf.truncated_normal`` (resample beyond 2 sigma).
+
+    ``pad_to`` appends ZERO rows to W_ih up to the padded gene count. The
+    random draw covers exactly the REAL genes, so the init — and therefore
+    the whole seeded trajectory — is invariant to the layout's padding
+    choice. (Drawing at the padded shape instead made the init a function
+    of the kernel/mesh layout: jax.random counts over the flattened shape,
+    so [704, h] and [1024, h] draws disagree at EVERY entry — the
+    pallas-vs-XLA and per-mesh-shape drift the parity tests kept
+    tripping over.) Pad rows only ever see all-zero X columns, collect
+    exactly zero gradient, and Adam holds a zero-init zero-grad row at
+    zero — they are dead weight sliced off before results surface.
+    """
     k1, k2 = jax.random.split(key)
     std = 1.0 / sqrt(hidden)
     w_ih = jax.random.truncated_normal(k1, -2.0, 2.0, (n_genes, hidden)) * std
+    if pad_to is not None and pad_to > n_genes:
+        w_ih = jnp.concatenate(
+            [w_ih, jnp.zeros((pad_to - n_genes, hidden), w_ih.dtype)], axis=0)
     w_ho = jax.random.truncated_normal(k2, -2.0, 2.0, (hidden, 1)) * std
     return CBOWParams(w_ih=w_ih.astype(param_dtype), w_ho=w_ho.astype(param_dtype))
 
@@ -66,3 +82,36 @@ def predict_logits(params: CBOWParams, x: jax.Array,
                    compute_dtype=jnp.bfloat16) -> jax.Array:
     """Alias used by serving/entry points."""
     return forward(params, x, compute_dtype)
+
+
+def masked_bce_loss(logits: jax.Array, y: jax.Array,
+                    w: jax.Array) -> jax.Array:
+    """Weighted-mean sigmoid BCE (ref: the reference's reduce_mean at
+    G2Vec.py:245, generalized to row masks).
+
+    ``w`` is a [batch, 1] 0/1 row mask. Masked rows contribute EXACTLY
+    zero (0.0 * finite bce) to both the numerator and the denominator, so
+    shard-padding rows — and, under the trainer's fused-eval fold, the
+    val-split rows riding the same forward — leave the train loss and its
+    gradients bitwise-unchanged: IEEE x + 0.0 == x, and appended zero
+    terms never regroup the live terms' reduction order. ONE definition
+    shared by the trainer's chunk program and bench.py's standalone
+    breakdown pieces, so the measured terms are the shipped math.
+    """
+    import optax
+
+    bce = optax.sigmoid_binary_cross_entropy(logits, y)
+    return jnp.sum(bce * w) / jnp.sum(w)
+
+
+def accuracy_from_logits(logits: jax.Array, y: jax.Array, w: jax.Array,
+                         logit_threshold: float = 0.0) -> jax.Array:
+    """Masked classification accuracy at a logit threshold.
+
+    Numerator and denominator are sums of exact 0/1 float terms, so the
+    result is reduction-order-independent (exact integers below 2^24) —
+    the property the fused-eval fold's bitwise-parity contract leans on
+    when the same rows land at different offsets of a bigger batch.
+    """
+    pred = (logits > logit_threshold).astype(jnp.float32)
+    return jnp.sum((pred == y).astype(jnp.float32) * w) / jnp.sum(w)

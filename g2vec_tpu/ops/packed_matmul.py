@@ -44,7 +44,10 @@ step within the VMEM budget.
 from __future__ import annotations
 
 import functools
-from typing import Optional
+import json
+import os
+import time
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -123,9 +126,24 @@ def _shift_array(rows: int) -> jax.Array:
     return 7 - col // _LB_BYTES
 
 
-def _unpack_tile(p_chunk: jax.Array, shift: jax.Array) -> jax.Array:
-    """[rows, LB_BYTES] uint8 -> [rows, LANE_BLOCK] bf16 0/1."""
-    rep = pltpu.repeat(p_chunk.astype(jnp.int32), 8, axis=1)
+def _unpack_tile(p_chunk: jax.Array, shift: jax.Array,
+                 interpret: bool = False) -> jax.Array:
+    """[rows, LB_BYTES] uint8 -> [rows, LANE_BLOCK] bf16 0/1.
+
+    ``interpret`` must match the enclosing pallas_call's flag: Mosaic's
+    ``pltpu.repeat`` is a TILE repeat (concatenate whole copies along the
+    lane axis — the layout pack_blockwise encodes), but the pallas
+    interpreter in this jax version executes it as an ELEMENT repeat
+    (``jnp.repeat`` semantics), silently scrambling the bit<->gene map on
+    CPU. The interpret path therefore spells the tile repeat out as an
+    explicit concatenate — identical math, and the interpret-mode tests
+    exercise the real layout again.
+    """
+    p32 = p_chunk.astype(jnp.int32)
+    if interpret:
+        rep = jnp.concatenate([p32] * 8, axis=1)
+    else:
+        rep = pltpu.repeat(p32, 8, axis=1)
     return ((rep >> shift) & 1).astype(jnp.bfloat16)
 
 
@@ -146,7 +164,7 @@ def _blocks_per_group(g: int, h: int) -> int:
     return bpg
 
 
-def _fwd_kernel(p_ref, w_ref, o_ref):
+def _fwd_kernel(p_ref, w_ref, o_ref, *, interpret: bool = False):
     nchunks = w_ref.shape[0] // LANE_BLOCK
     shift = _shift_array(p_ref.shape[0])
 
@@ -157,7 +175,8 @@ def _fwd_kernel(p_ref, w_ref, o_ref):
         o_ref[:] = jnp.zeros_like(o_ref)
 
     def body(c, acc):
-        x = _unpack_tile(p_ref[:, pl.ds(c * _LB_BYTES, _LB_BYTES)], shift)
+        x = _unpack_tile(p_ref[:, pl.ds(c * _LB_BYTES, _LB_BYTES)], shift,
+                         interpret)
         wc = w_ref[pl.ds(c * LANE_BLOCK, LANE_BLOCK), :]
         return acc + jax.lax.dot_general(
             x, wc, (((1,), (0,)), ((), ())),
@@ -167,7 +186,7 @@ def _fwd_kernel(p_ref, w_ref, o_ref):
     o_ref[:] += jax.lax.fori_loop(0, nchunks, body, acc)
 
 
-def _bwd_kernel(p_ref, g_ref, o_ref):
+def _bwd_kernel(p_ref, g_ref, o_ref, *, interpret: bool = False):
     nchunks = o_ref.shape[0] // LANE_BLOCK
     shift = _shift_array(p_ref.shape[0])
 
@@ -180,7 +199,8 @@ def _bwd_kernel(p_ref, g_ref, o_ref):
     gtile = g_ref[:].astype(jnp.bfloat16)
 
     def body(c, _):
-        x = _unpack_tile(p_ref[:, pl.ds(c * _LB_BYTES, _LB_BYTES)], shift)
+        x = _unpack_tile(p_ref[:, pl.ds(c * _LB_BYTES, _LB_BYTES)], shift,
+                         interpret)
         sl = pl.ds(c * LANE_BLOCK, LANE_BLOCK)
         o_ref[sl, :] += jax.lax.dot_general(
             x, gtile, (((0,), (0,)), ((), ())),
@@ -190,14 +210,97 @@ def _bwd_kernel(p_ref, g_ref, o_ref):
     jax.lax.fori_loop(0, nchunks, body, 0)
 
 
-def _fwd_call(packed: jax.Array, w: jax.Array, interpret: bool) -> jax.Array:
+# ---------------------------------------------------------------------------
+# Tile planning: heuristic defaults + measured (autotuned) overrides.
+# ---------------------------------------------------------------------------
+
+#: Measured tile overrides, installed by :func:`autotune_packed_matmul` (or
+#: :func:`load_tuned` from the persistent --cache-dir tier). Keyed by the
+#: exact problem (m, g, h); values per direction: (row_block,
+#: blocks_per_group). The heuristic (_row_block/_blocks_per_group) stays the
+#: fallback for any shape not measured.
+_TUNED: Dict[Tuple[int, int, int], Dict[str, Tuple[int, int]]] = {}
+
+#: Monotonic token bumped on every override install: callers that cache
+#: compiled programs embedding a tile plan (the trainer's chunk-fn LRU) key
+#: on this so a re-tune invalidates them instead of silently running stale
+#: tiles.
+_TUNED_VERSION = 0
+
+#: Bump on ANY change to the kernel bodies, the VMEM model, or the
+#: candidate space — persisted measurements from an older kernel must
+#: re-tune, not load.
+AUTOTUNE_SCHEMA = 1
+
+
+def tuned_token() -> int:
+    """Current override-install counter (cache-key ingredient)."""
+    return _TUNED_VERSION
+
+
+#: Backend signature each in-memory entry was measured under: an
+#: interpret-mode plan must not satisfy a TPU run of the same shape.
+_TUNED_BACKEND: Dict[Tuple[int, int, int], str] = {}
+
+
+def _install_tuned(m: int, g: int, h: int,
+                   plans: Dict[str, Tuple[int, int]],
+                   backend_tag: str = "") -> None:
+    global _TUNED_VERSION
+    _TUNED[(m, g, h)] = {d: (int(rb), int(bpg))
+                         for d, (rb, bpg) in plans.items()}
+    _TUNED_BACKEND[(m, g, h)] = backend_tag
+    _TUNED_VERSION += 1
+
+
+def reset_tuned() -> None:
+    """Drop every measured override (tests; heuristic-only runs)."""
+    global _TUNED_VERSION
+    _TUNED.clear()
+    _TUNED_BACKEND.clear()
+    _TUNED_VERSION += 1
+
+
+def _tile_plan(m: int, g: int, h: int, direction: str) -> Tuple[int, int]:
+    """(row_block, genes_per_grid_block) for this problem+direction:
+    the measured override when one was installed, else the heuristic."""
+    ent = _TUNED.get((m, g, h))
+    if ent and direction in ent:
+        rb, bpg = ent[direction]
+        return rb, bpg * LANE_BLOCK
+    return _row_block(h), _blocks_per_group(g, h) * LANE_BLOCK
+
+
+def tile_candidates(m: int, g: int, h: int) -> list:
+    """Legal (row_block, blocks_per_group) pairs for the autotune sweep.
+
+    row_block must divide the caller padding quantum ROW_BLOCK (so any
+    padded m stays aligned); blocks_per_group must divide the slab count
+    (the grid floor-divides) and the whole per-step working set must fit
+    the VMEM budget.
+    """
+    n_blocks = g // LANE_BLOCK
+    out = []
+    for rb in (128, 256, 512):
+        if ROW_BLOCK % rb or m % rb:
+            continue
+        for bpg in range(1, n_blocks + 1):
+            if n_blocks % bpg:
+                continue
+            if _vmem_step_bytes(bpg * LANE_BLOCK, h, rb) > _VMEM_STEP_BUDGET:
+                break
+            out.append((rb, bpg))
+    return out
+
+
+def _fwd_call(packed: jax.Array, w: jax.Array, interpret: bool,
+              plan: Optional[Tuple[int, int]] = None) -> jax.Array:
     _check_aligned(packed, w)
     m, nb = packed.shape
     g, h = w.shape
-    gb = _blocks_per_group(g, h) * LANE_BLOCK    # genes per grid block
-    rb = _row_block(h)                           # m % 512 == 0 => m % rb == 0
+    rb, gb = plan if plan is not None else _tile_plan(m, g, h, "fwd")
     return pl.pallas_call(
-        _fwd_kernel,
+        functools.partial(_fwd_kernel, interpret=interpret),
         grid=(m // rb, g // gb),                 # gene blocks innermost
         in_specs=[
             pl.BlockSpec((rb, gb // 8), lambda i, j: (i, j),
@@ -212,13 +315,13 @@ def _fwd_call(packed: jax.Array, w: jax.Array, interpret: bool) -> jax.Array:
     )(packed, w.astype(jnp.bfloat16))
 
 
-def _bwd_call(packed: jax.Array, g_out: jax.Array, interpret: bool) -> jax.Array:
+def _bwd_call(packed: jax.Array, g_out: jax.Array, interpret: bool,
+              plan: Optional[Tuple[int, int]] = None) -> jax.Array:
     m, nb = packed.shape
     g, h = nb * 8, g_out.shape[1]
-    gb = _blocks_per_group(g, h) * LANE_BLOCK
-    rb = _row_block(h)
+    rb, gb = plan if plan is not None else _tile_plan(m, g, h, "bwd")
     return pl.pallas_call(
-        _bwd_kernel,
+        functools.partial(_bwd_kernel, interpret=interpret),
         grid=(g // gb, m // rb),                 # row tiles innermost
         in_specs=[
             pl.BlockSpec((rb, gb // 8), lambda j, i: (i, j),
@@ -307,3 +410,158 @@ def pad_rows_packed(packed: np.ndarray, row_block: int = ROW_BLOCK) -> np.ndarra
         return packed
     pad = np.zeros((target - m, packed.shape[1]), dtype=packed.dtype)
     return np.concatenate([packed, pad], axis=0)
+
+
+# ---------------------------------------------------------------------------
+# Measured autotune (the --kernel-autotune flag): sweep the legal
+# (row_block, blocks_per_group) pairs at the trainer's exact shapes and
+# install the fastest, instead of trusting the VMEM-model heuristic's
+# hardcoded 512/256 row tile. Results persist in the --cache-dir tier
+# (<dir>/autotune/packed_matmul.json) so repeat runs skip the sweep.
+# ---------------------------------------------------------------------------
+
+def _autotune_backend_tag(interpret: bool) -> str:
+    """Backend signature baked into every persisted key: CPU-interpret
+    timings must never be served to a TPU run (or across TPU gens)."""
+    if interpret:
+        return "interpret"
+    return f"tpu:{os.environ.get('PALLAS_AXON_TPU_GEN', 'unknown')}"
+
+
+def _autotune_key(m: int, g: int, h: int, interpret: bool) -> str:
+    return (f"schema={AUTOTUNE_SCHEMA};m={m};g={g};h={h};"
+            f"backend={_autotune_backend_tag(interpret)}")
+
+
+def _read_tune_file(path: str) -> dict:
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    if rec.get("schema") != AUTOTUNE_SCHEMA:
+        return {}        # stale layout/kernel generation: re-tune
+    entries = rec.get("entries")
+    return entries if isinstance(entries, dict) else {}
+
+
+def load_tuned(cache_path: Optional[str], m: int, g: int, h: int,
+               interpret: bool = False) -> Optional[dict]:
+    """Install the persisted plan for this exact problem+backend, if any.
+
+    Returns the entry (with ``source="cache"``) on a hit, None on a miss
+    or any stale/unreadable record — the caller then measures afresh.
+    """
+    if not cache_path or not os.path.exists(cache_path):
+        return None
+    ent = _read_tune_file(cache_path).get(_autotune_key(m, g, h, interpret))
+    if not isinstance(ent, dict) or "fwd" not in ent or "bwd" not in ent:
+        return None
+    try:
+        plans = {d: (int(ent[d][0]), int(ent[d][1])) for d in ("fwd", "bwd")}
+    except (TypeError, ValueError, IndexError, KeyError):
+        return None
+    legal = set(tile_candidates(m, g, h))
+    if any(p not in legal for p in plans.values()):
+        return None      # e.g. recorded against a different VMEM budget
+    _install_tuned(m, g, h, plans, _autotune_backend_tag(interpret))
+    return {**ent, "source": "cache"}
+
+
+def autotune_packed_matmul(m: int, g: int, h: int, *,
+                           interpret: bool = False, iters: int = 5,
+                           cache_path: Optional[str] = None,
+                           force: bool = False) -> dict:
+    """Measure every legal tile plan at (m, g, h), install + persist the best.
+
+    ``m`` must already be padded to a ROW_BLOCK multiple and ``g`` to a
+    LANE_BLOCK multiple (the trainer's _plan_layout numbers). Returns
+    ``{"fwd": (rb, bpg), "bwd": (rb, bpg), "ms": {...}, "source": ...}``.
+    A verified persisted entry short-circuits the sweep unless ``force``.
+    """
+    if m % ROW_BLOCK or g % LANE_BLOCK or h % 128:
+        raise ValueError(
+            f"autotune needs padded shapes (m%{ROW_BLOCK}, g%{LANE_BLOCK}, "
+            f"h%128 all zero), got m={m} g={g} h={h}")
+    if not force:
+        # In-memory hit FIRST, and WITHOUT a token bump: the overlap warm
+        # path already swept this shape in this process, and bumping the
+        # token here would invalidate the very executable it warmed.
+        ent = _TUNED.get((m, g, h))
+        if ent is not None and _TUNED_BACKEND.get((m, g, h)) \
+                == _autotune_backend_tag(interpret) \
+                and {"fwd", "bwd"} <= set(ent):
+            return {"fwd": list(ent["fwd"]), "bwd": list(ent["bwd"]),
+                    "source": "memory"}
+        hit = load_tuned(cache_path, m, g, h, interpret)
+        if hit is not None:
+            return hit
+
+    cands = tile_candidates(m, g, h)
+    if not cands:
+        raise ValueError(f"no legal tile plan fits the VMEM budget at "
+                         f"m={m} g={g} h={h}")
+    rng = np.random.default_rng(0)
+    packed = jnp.asarray(rng.integers(0, 256, size=(m, g // 8),
+                                      dtype=np.uint8))
+    w = jnp.asarray(rng.standard_normal((g, h)).astype(np.float32))
+    g_out = jnp.asarray(rng.standard_normal((m, h)).astype(np.float32)
+                        ).astype(jnp.bfloat16)
+
+    def clock(fn) -> float:
+        jax.block_until_ready(fn())          # compile outside the window
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(iters):
+            out = fn()
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    ms: Dict[str, float] = {}
+    best = {}
+    for direction, run in (
+            ("fwd", lambda plan: jax.jit(
+                lambda p, ww: _fwd_call(p, ww, interpret, plan))(packed, w)),
+            ("bwd", lambda plan: jax.jit(
+                lambda p, gg: _bwd_call(p, gg, interpret, plan))(packed,
+                                                                 g_out))):
+        best_ms, best_plan = None, None
+        for rb, bpg in cands:
+            plan = (rb, bpg * LANE_BLOCK)
+            t = clock(lambda: run(plan))
+            ms[f"{direction}:rb{rb}:bpg{bpg}"] = round(t, 4)
+            if best_ms is None or t < best_ms:
+                best_ms, best_plan = t, (rb, bpg)
+        best[direction] = best_plan
+        ms[f"{direction}:best_ms"] = round(best_ms, 4)
+
+    _install_tuned(m, g, h, best, _autotune_backend_tag(interpret))
+    entry = {"fwd": list(best["fwd"]), "bwd": list(best["bwd"]), "ms": ms,
+             "heuristic": {
+                 "fwd": [_row_block(h), _blocks_per_group(g, h)],
+                 "bwd": [_row_block(h), _blocks_per_group(g, h)]},
+             "source": "measured"}
+    if cache_path:
+        entries = _read_tune_file(cache_path) if os.path.exists(cache_path) \
+            else {}
+        entries[_autotune_key(m, g, h, interpret)] = {
+            k: v for k, v in entry.items() if k != "source"}
+        from g2vec_tpu.utils.integrity import write_json_atomic
+
+        os.makedirs(os.path.dirname(cache_path) or ".", exist_ok=True)
+        write_json_atomic(cache_path,
+                          {"schema": AUTOTUNE_SCHEMA, "entries": entries})
+    return entry
+
+
+def describe_tiles(m: int, g: int, h: int) -> dict:
+    """The tile plan the next (m, g, h) kernel call will actually use —
+    for the bench breakdown's ``kernel_tiles`` attribution field."""
+    tuned = _TUNED.get((m, g, h))
+    out = {}
+    for direction in ("fwd", "bwd"):
+        rb, gb = _tile_plan(m, g, h, direction)
+        out[direction] = {"row_block": rb, "blocks_per_group": gb // LANE_BLOCK,
+                          "source": ("autotuned" if tuned
+                                     and direction in tuned else "heuristic")}
+    return out

@@ -178,14 +178,42 @@ def host_allgather(name: str, arr) -> "np.ndarray":  # noqa: F821
         name, lambda: np.asarray(multihost_utils.process_allgather(arr)))
 
 
+def plan_hybrid_mesh(devices, data: int, model: int):
+    """Hybrid-mesh factorization for a (possibly) multi-slice topology.
+
+    Pure planning — unit-testable with mock devices carrying
+    ``slice_index`` — shared by :func:`make_global_mesh`:
+
+    - single-slice (or no slice metadata): returns None — the caller uses
+      ``create_device_mesh``, which picks an ICI-contiguous layout;
+    - N > 1 slices: returns ``(per_slice_mesh, dcn_mesh)`` for
+      ``create_hybrid_device_mesh``. The MODEL axis (an all-reduce inside
+      every forward/backward matmul) stays entirely inside a slice on
+      ICI — ``dcn_mesh`` is (n_slices, 1), never sharding model across
+      DCN — and the DATA axis (one gradient psum per step) is the one
+      that crosses slices, factored as n_slices x (data // n_slices).
+      The data axis must divide by the slice count or no such assignment
+      exists; the error names the constraint.
+    """
+    n_slices = len({getattr(d, "slice_index", 0) for d in devices})
+    if n_slices <= 1:
+        return None
+    if data % n_slices:
+        raise ValueError(
+            f"data axis {data} must be divisible by the slice count "
+            f"{n_slices} so the model axis stays on ICI")
+    return (data // n_slices, model), (n_slices, 1)
+
+
 def make_global_mesh(mesh_shape: Tuple[int, int],
                      allow_hybrid: bool = True) -> MeshContext:
     """('data', 'model') MeshContext over all global devices.
 
     ``mesh_shape=(data, model)`` must multiply to the global device count.
     Multi-slice topologies get a hybrid mesh (model inside a slice on ICI,
-    data across slices on DCN); single-slice falls back to
-    ``create_device_mesh`` which picks an ICI-contiguous layout.
+    data across slices on DCN — :func:`plan_hybrid_mesh`); single-slice
+    falls back to ``create_device_mesh`` which picks an ICI-contiguous
+    layout.
     """
     import jax
     from jax.experimental import mesh_utils
@@ -198,16 +226,11 @@ def make_global_mesh(mesh_shape: Tuple[int, int],
             f"mesh {mesh_shape} needs {data * model} devices; the global "
             f"runtime has {len(devices)} "
             f"(processes: {jax.process_count()})")
-    n_slices = len({getattr(d, "slice_index", 0) for d in devices})
-    if allow_hybrid and n_slices > 1:
-        if data % n_slices:
-            raise ValueError(
-                f"data axis {data} must be divisible by the slice count "
-                f"{n_slices} so the model axis stays on ICI")
+    plan = plan_hybrid_mesh(devices, data, model) if allow_hybrid else None
+    if plan is not None:
+        per_slice, dcn = plan
         grid = mesh_utils.create_hybrid_device_mesh(
-            mesh_shape=(data // n_slices, model),
-            dcn_mesh_shape=(n_slices, 1),
-            devices=devices)
+            mesh_shape=per_slice, dcn_mesh_shape=dcn, devices=devices)
     else:
         grid = mesh_utils.create_device_mesh((data, model), devices=devices)
     return MeshContext(mesh=Mesh(grid, (DATA_AXIS, MODEL_AXIS)))

@@ -142,10 +142,11 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
                     straggler_factor=cfg.fleet_straggler_factor)
     if cfg.debug_nans:
         jax.config.update("jax_debug_nans", True)
-    from g2vec_tpu.cache import resolve_cache_tiers
+    from g2vec_tpu.cache import autotune_cache_path, resolve_cache_tiers
 
     xla_cache_dir, walk_cache = resolve_cache_tiers(
         cfg.cache_dir, cfg.compilation_cache, cfg.walk_cache)
+    autotune_path = autotune_cache_path(cfg.cache_dir)
     if cfg.distributed:
         # The artifact tier is per-host files; in a multi-process run the
         # ranks would race identical writes and the sharded native walk
@@ -156,10 +157,25 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
         # Persistent XLA cache: a warm repeat run skips the compiles that
         # dominate a cold pipeline's wall (the TPU acceptance run spends
         # most of its train/lgroups/biomarkers stage time compiling).
+        prev_cache_dir = jax.config.jax_compilation_cache_dir
         jax.config.update("jax_compilation_cache_dir", xla_cache_dir)
         # Persist every program: a pipeline run compiles a bounded set of
         # programs, so cache-write cost is trivial next to ANY compile.
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        if prev_cache_dir != xla_cache_dir:
+            # The persistent-cache object binds to whatever config the
+            # FIRST compile saw — a different dir, or (measured) NO dir
+            # at all: enabling the cache after any uncached compile is a
+            # silent no-op, and changing --cache-dir mid-process (an
+            # in-process supervisor restart, test suites) keeps writing
+            # the OLD location. Reset so the next compile re-initializes
+            # against the dir just configured.
+            try:
+                from jax._src import compilation_cache as _cc
+
+                _cc.reset_cache()
+            except Exception:  # noqa: BLE001 — private API; cache staying
+                pass           # stale only costs warm-run speed
     if cfg.distributed:
         # Worker processes compute shards but neither narrate nor write:
         # transcript, metrics stream, profiler trace, and the three outputs
@@ -407,6 +423,11 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
                 from g2vec_tpu.train.trainer import warm_train_compile
 
                 n_paths_known = int(paths.shape[0])
+                # The warm must predict the REAL chunk program — the
+                # fused/superstep/donate trainer modes and the autotuner's
+                # tile installs are all part of its cache key, so they ride
+                # along here (a warm that swept the autotune shapes also
+                # spares the foreground the measurement sweep).
                 overlap.submit("warm_trainer", _background_warm(
                     lambda: warm_train_compile(
                         n_paths_known, n_genes, hidden=cfg.sizeHiddenlayer,
@@ -417,7 +438,12 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
                         compute_dtype=cfg.compute_dtype,
                         param_dtype=cfg.param_dtype, mesh_ctx=mesh_ctx,
                         checkpoint_dir=cfg.checkpoint_dir,
-                        checkpoint_every=cfg.checkpoint_every), console))
+                        checkpoint_every=cfg.checkpoint_every,
+                        fused_eval=cfg.fused_eval,
+                        epoch_superstep=cfg.epoch_superstep,
+                        donate=cfg.donate_state,
+                        kernel_autotune=cfg.kernel_autotune,
+                        autotune_cache_path=autotune_path), console))
             gene_freq = count_gene_freq(paths, labels, data.gene, packed=True)
         _stage_edge("paths")
         n_paths = paths.shape[0]
@@ -458,6 +484,11 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
                 checkpoint_dir=cfg.checkpoint_dir, resume=cfg.resume,
                 checkpoint_every=cfg.checkpoint_every,
                 checkpoint_layout=cfg.checkpoint_layout,
+                fused_eval=cfg.fused_eval,
+                epoch_superstep=cfg.epoch_superstep,
+                donate=cfg.donate_state,
+                kernel_autotune=cfg.kernel_autotune,
+                autotune_cache_path=autotune_path,
                 # Joins the background chunk-program warm right before the
                 # trainer requests the executable (after the host-side
                 # packing it overlapped); None = compile in line.

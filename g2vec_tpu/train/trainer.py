@@ -29,19 +29,34 @@ amortized. The previous-epoch snapshot stays on device (a per-epoch
 after training). On a single chip the X@W_ih matmuls run through the fused
 bit-packed Pallas kernel (ops/packed_matmul.py) so X stays packed in HBM.
 
-The eval-train FOLD: the reference re-runs a full train-split forward per
+The eval FOLDS: the reference re-runs a full train-split forward per
 epoch just to report ACC[tr] at the updated weights — but those weights
 are exactly the next epoch's entry weights, so that forward is recomputed
 verbatim by the next epoch's gradient pass. The chunk body reads the
 previous epoch's ACC[tr] out of its own grad forward (``has_aux``) and a
 single per-chunk eval backfills the last epoch's; per-epoch train-split
 matmul passes drop 3 -> 2 (~31% of epoch FLOPs at the 80/20 split). The
-history is the same computation at the same params/inputs as the unfused
-3-pass epoch — bitwise so in float32 (test-pinned); under bfloat16 XLA may
-compile the grad-forward and the standalone eval to different programs, so
-the chunk-boundary backfill can differ from the in-chunk value in low bits
-(accuracies stay correct and the early stop reads only acc_val, so
-training behavior is unaffected).
+FUSED-EVAL mode (default; --no-fused-eval restores the shipping shape)
+extends the same argument to the val split: the val eval rides the SAME
+program as the train grad pass — on the packed path the val rows join
+the train rows' single kernel launch — so the standalone per-epoch val
+program disappears too. One fused program per epoch, with epoch i's
+val/train accuracies read out of epoch i+1's entry forward and the
+early-stop dip test run there, before epoch i+1's update is applied (see
+_make_chunk_fn). Parity contract, float32, measured and test-pinned
+(tests/test_trainer_modes.py): every accuracy, every early-stop
+decision, and the epoch count are BITWISE the shipping loop's — the
+accuracy arithmetic is exact 0/1 counting, immune to scheduling — while
+losses and the final embeddings may sit within ~2 ulp on XLA:CPU,
+because the fused body is a DIFFERENT program and XLA decides fma
+contraction per program (same jaxpr, different codegen; barriers and
+hand-pinned Adam arithmetic were both tried and do not close it — the
+drift enters through the grad gemm's context). The packed kernel's
+forward is M-invariant by construction (fixed per-row-tile fori
+accumulation), so the production TPU path does not even pay that. The
+superstep and donation modes are fully bitwise vs shipping: selects and
+buffer renaming do not touch the arithmetic (pinned across a shape
+battery).
 """
 from __future__ import annotations
 
@@ -56,7 +71,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from g2vec_tpu.models.cbow import (CBOWParams, forward, init_params,
+from g2vec_tpu.models.cbow import (CBOWParams, accuracy_from_logits,
+                                   forward, init_params, masked_bce_loss,
                                    output_logits)
 from g2vec_tpu.ops import packed_matmul as pm
 from g2vec_tpu.parallel.mesh import MeshContext, make_mesh_context
@@ -98,9 +114,22 @@ class TrainResult:
     params: Optional[CBOWParams] = None  # device params (for checkpointing)
 
 
-def _make_chunk_fn(tx: optax.GradientTransformation, compute_dtype,
+def _tree_select(pred, on_true, on_false):
+    """Elementwise ``jnp.where`` over a whole pytree (scalar predicate)."""
+    return jax.tree.map(lambda a, b: jnp.where(pred, a, b),
+                        on_true, on_false)
+
+
+#: Adam hyperparameters, TF1 defaults (ref: G2Vec.py:246). Fixed for the
+#: whole repo; only the learning rate is configurable.
+_ADAM_B1, _ADAM_B2, _ADAM_EPS = 0.9, 0.999, 1e-8
+
+
+def _make_chunk_fn(learning_rate: float, compute_dtype,
                    decision_threshold: float, ctx: MeshContext, chunk: int,
-                   packed: bool = False, interpret: bool = False):
+                   packed: bool = False, interpret: bool = False,
+                   fused: bool = True, superstep: int = 1,
+                   donate: bool = True):
     """Compile a device-resident loop over up to ``chunk`` epochs.
 
     The reference syncs with the host three times per epoch (optimizer run +
@@ -111,6 +140,34 @@ def _make_chunk_fn(tx: optax.GradientTransformation, compute_dtype,
     a ``lax.while_loop``; the host sees one transfer of (state, per-epoch
     accuracy history) per ``chunk`` epochs, and the loop exits on the first
     val-accuracy dip no matter where in the chunk it falls.
+
+    Three orthogonal modes, all parity-pinned against the shipping loop
+    (float32 — tests/test_trainer_modes.py; superstep/donate bitwise,
+    fused bitwise on accuracies/decisions with losses and params within
+    ~2 ulp on XLA:CPU — the module docstring has the full contract):
+
+    - ``fused`` (the fused-eval fold): the val split rides the SAME
+      program as the train grad pass — a single [tr|val] kernel launch on
+      the packed path, per-split gemms inside the one program on the XLA
+      path (the bitwise contract note at run_chunk_fused explains the
+      asymmetry) — and epoch i's val/train accuracies are read out of
+      epoch i+1's entry forward (entry params of epoch i+1 ARE epoch i's
+      post-update params). One fused program per epoch instead of grad +
+      standalone val eval; a single per-chunk boundary eval backfills the
+      final epoch's pair and runs its dip test. Data signature:
+      (xall, ytr, wtr, yval, wval).
+    - ``superstep`` K: the while_loop body executes K epochs per
+      iteration (Python-unrolled), each masked by the live
+      ``i < limit & ~stopped`` predicate, so the loop's per-iteration
+      dispatch/cond overhead amortizes over K epochs. ``jnp.where`` with a
+      true predicate is the identity, so active epochs compute exactly
+      the K=1 program's values; the early stop still lands ON the dip
+      (post-dip epochs in the same superstep are select-masked out, at
+      most K-1 wasted epoch computes on the final iteration).
+    - ``donate``: the (params, opt_state, snapshot, hist) carry buffers
+      are donated to the chunk program, so Adam's fp32 read/write set
+      updates in place instead of double-buffering in HBM
+      (jit(..., donate_argnums=(0, 1, 2, 3))).
     """
     logit_threshold = float(np.log(decision_threshold / (1.0 - decision_threshold)))
 
@@ -145,20 +202,45 @@ def _make_chunk_fn(tx: optax.GradientTransformation, compute_dtype,
             return forward(params, x, compute_dtype)
 
     # ``w`` is a [batch, 1] 1/0 mask: 1 for real rows, 0 for shard-even
-    # padding rows (see train_cbow). Weighted means make the padded program
-    # numerically identical to the unpadded one.
+    # padding rows (see train_cbow) — and, in the fused program, 0 for the
+    # val rows riding the train forward. Weighted means make the masked
+    # program numerically identical to the unmasked one.
     def loss_fn(params, x, y, w):
         logits = logits_fn(params, x)
         logits = ctx.constrain(logits, ctx.label_spec)
-        bce = optax.sigmoid_binary_cross_entropy(logits, y)
-        return jnp.sum(bce * w) / jnp.sum(w), logits
+        return masked_bce_loss(logits, y, w), logits
 
     def acc_from_logits(logits, y, w):
-        pred = (logits > logit_threshold).astype(jnp.float32)
-        return jnp.sum((pred == y).astype(jnp.float32) * w) / jnp.sum(w)
+        return accuracy_from_logits(logits, y, w, logit_threshold)
 
     def accuracy(params, x, y, w):
         return acc_from_logits(logits_fn(params, x), y, w)
+
+    tx = optax.adam(learning_rate, b1=_ADAM_B1, b2=_ADAM_B2, eps=_ADAM_EPS)
+
+    def adam_step(grads, opt_state, params):
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    def superstepped(cond, body):
+        """Unroll ``superstep`` masked epochs into one while_loop body.
+
+        Each unrolled step recomputes the live loop predicate from the
+        CURRENT carry and select-masks the whole next carry with it:
+        active steps are the identity on the K=1 program's values
+        (jnp.where with a true scalar), epochs past ``limit`` or past a
+        dip freeze the carry. K=1 returns ``body`` untouched — the
+        shipping program, no extra selects.
+        """
+        if superstep <= 1:
+            return body
+
+        def k_body(carry):
+            for _ in range(superstep):
+                carry = _tree_select(cond(carry), body(carry), carry)
+            return carry
+
+        return k_body
 
     # Eval-train fold (the MFU work, VERDICT r3 task 4): the reference's
     # epoch runs THREE full train-split matmul passes — grad fwd, dW, and a
@@ -175,8 +257,7 @@ def _make_chunk_fn(tx: optax.GradientTransformation, compute_dtype,
         (loss, logits_tr), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params, xtr, ytr, wtr)
         acc_tr_prev = acc_from_logits(logits_tr, ytr, wtr)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
+        params, opt_state = adam_step(grads, opt_state, params)
         if ctx.mesh is not None:
             params = CBOWParams(
                 w_ih=ctx.constrain(params.w_ih, ctx.w_ih_spec),
@@ -185,13 +266,18 @@ def _make_chunk_fn(tx: optax.GradientTransformation, compute_dtype,
         acc_val = accuracy(params, xval, yval, wval)
         return params, opt_state, acc_val, acc_tr_prev, loss
 
-    def run_chunk(params, opt_state, snapshot, before_val, before_tr, limit,
-                  xtr, ytr, wtr, xval, yval, wval):
-        hist = jnp.zeros((chunk, 3), jnp.float32)   # [acc_val, acc_tr, loss]
-
+    def cond_of(limit):
         def cond(carry):
             _, _, _, _, _, i, stopped, _ = carry
             return jnp.logical_and(i < limit, jnp.logical_not(stopped))
+        return cond
+
+    def run_chunk(params, opt_state, snapshot, hist, before_val, before_tr,
+                  limit, xtr, ytr, wtr, xval, yval, wval):
+        # hist [chunk, 3] = [acc_val, acc_tr, loss]: a donated carry buffer
+        # the host hands back each chunk. Rows are written before any read
+        # the host performs (it slices [:count]), so it is never zeroed.
+        cond = cond_of(limit)
 
         def body(carry):
             params, opt_state, snapshot, before_val, before_tr, i, _, hist = carry
@@ -222,7 +308,7 @@ def _make_chunk_fn(tx: optax.GradientTransformation, compute_dtype,
                 jnp.float32(before_val), jnp.float32(before_tr),
                 jnp.int32(0), jnp.bool_(False), hist)
         (params, opt_state, snapshot, before_val, before_tr, count, dip,
-         hist) = jax.lax.while_loop(cond, body, init)
+         hist) = jax.lax.while_loop(cond, superstepped(cond, body), init)
         # Backfill the final executed epoch's acc_tr: one eval forward per
         # CHUNK (the fold's only residual cost), at that epoch's post-update
         # params — including a dip epoch's (whose update params still sit in
@@ -235,7 +321,193 @@ def _make_chunk_fn(tx: optax.GradientTransformation, compute_dtype,
         return (params, opt_state, snapshot, before_val, before_tr, count,
                 dip, hist)
 
-    return jax.jit(run_chunk)
+    # ---- fused-eval chunk: one fused program per epoch --------------------
+    # Epoch i's entry forward computes logits for BOTH splits at epoch
+    # i-1's post-update params — exactly the values the reference reports
+    # for epoch i-1 (ref: evals at the UPDATED weights, G2Vec.py:264-267).
+    # The dip test for epoch i-1 therefore runs at the TOP of body i,
+    # BEFORE update i is applied: on a dip, update i is select-discarded
+    # (shipping never ran epoch i), params stay at the dip epoch's
+    # post-update value (shipping applied the dip epoch's update to params
+    # — only the snapshot excludes it), and the loop exits. The standalone
+    # per-epoch val program disappears entirely.
+    #
+    # Bitwise contract, per kernel path:
+    #
+    # - PACKED (Pallas): the val rows ride the train rows' SINGLE kernel
+    #   launch on the concatenated [tr|val] matrix. The kernel is
+    #   M-invariant by construction — each row tile accumulates its gene
+    #   chunks in a fixed fori order, independent of how many other row
+    #   tiles the grid has — so the train rows' bits cannot change. The
+    #   backward is sliced to the train rows via custom_vjp (jax.vjp on
+    #   the shipping sub-program; the val logits feed only the
+    #   non-differentiated accuracies, so they carry no cotangent).
+    # - XLA (dense): the two splits are computed as per-split matmuls
+    #   INSIDE the one fused program. A concatenated-contraction gemm is
+    #   NOT row-stable on this path — XLA:CPU picks its K-blocking per
+    #   shape, and appending val rows measurably drifts the train rows'
+    #   low bits — while per-split shapes are exactly shipping's, so
+    #   every value is bitwise shipping's. The fold still deletes the
+    #   standalone eval program: one launch, one schedule.
+    def _base_mm(x, w):
+        if packed:
+            return pm.packed_matmul(x, w.astype(compute_dtype), interpret)
+        return jax.lax.dot_general(
+            x.astype(compute_dtype), w.astype(compute_dtype),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    def _make_fused_mm(tr_rows: int):
+        @jax.custom_vjp
+        def mm(x, w):
+            return _base_mm(x, w)
+
+        def fwd(x, w):
+            return _base_mm(x, w), (x, w)
+
+        def bwd(res, dh):
+            x, w = res
+            _, vjp = jax.vjp(
+                lambda ww: _base_mm(
+                    jax.lax.slice_in_dim(x, 0, tr_rows), ww), w)
+            (dw,) = vjp(jax.lax.slice_in_dim(dh, 0, tr_rows))
+            # x is path data, never trained (ref: G2Vec.py:264): float0
+            # for the integer packed rows, a dead zero tree otherwise.
+            dx = (np.zeros(x.shape, dtype=jax.dtypes.float0) if packed
+                  else jnp.zeros_like(x))
+            return dx, dw
+
+        mm.defvjp(fwd, bwd)
+        return mm
+
+    def run_chunk_fused(params, opt_state, snapshot, hist, before_val,
+                        before_tr, limit, xall, ytr, wtr, yval, wval):
+        cond = cond_of(limit)
+        tr_rows = ytr.shape[0]          # static at trace time
+        fused_mm = _make_fused_mm(tr_rows)
+
+        def split_x():
+            # Barrier-opaque split views: the slices become plain inputs
+            # to the downstream graph, so the grad/eval subgraphs are the
+            # SAME jaxpr as shipping's loss_fn/logits_fn on standalone
+            # arrays — XLA cannot fold the concatenation context into
+            # their arithmetic (the bitwise contract's load-bearing op on
+            # the XLA path, where the gemm's compilation is not
+            # row-stable under shape changes).
+            x_tr = jax.lax.optimization_barrier(
+                jax.lax.slice_in_dim(xall, 0, tr_rows))
+            x_val = jax.lax.optimization_barrier(
+                jax.lax.slice_in_dim(xall, tr_rows, xall.shape[0]))
+            return x_tr, x_val
+
+        def fused_loss(params):
+            # Packed path only: one [tr|val] kernel launch (M-invariant
+            # per row tile), backward sliced to the train rows.
+            h_all = fused_mm(xall, params.w_ih.astype(compute_dtype))
+            h_tr = jax.lax.slice_in_dim(h_all, 0, tr_rows)
+            h_val = jax.lax.slice_in_dim(h_all, tr_rows, h_all.shape[0])
+            logits_tr = output_logits(h_tr, params.w_ho, compute_dtype)
+            logits_val = output_logits(h_val, params.w_ho, compute_dtype)
+            return (masked_bce_loss(logits_tr, ytr, wtr),
+                    (logits_tr, logits_val))
+
+        def fused_epoch_forward(params):
+            if packed:
+                (loss, (logits_tr, logits_val)), grads = jax.value_and_grad(
+                    fused_loss, has_aux=True)(params)
+            else:
+                # XLA path: differentiate EXACTLY shipping's loss_fn on
+                # the barriered train slice; the val eval is the same
+                # logits_fn forward, outside the autodiff graph, in the
+                # same fused program.
+                x_tr, x_val = split_x()
+                (loss, logits_tr), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params, x_tr, ytr, wtr)
+                logits_val = logits_fn(params, x_val)
+            return (loss, grads,
+                    acc_from_logits(logits_val, yval, wval),
+                    acc_from_logits(logits_tr, ytr, wtr))
+
+        def split_logits(params, mm):
+            # Boundary (non-differentiated) eval, same split rules.
+            if packed:
+                h_all = mm(xall, params.w_ih)
+                h_tr = jax.lax.slice_in_dim(h_all, 0, tr_rows)
+                h_val = jax.lax.slice_in_dim(h_all, tr_rows, h_all.shape[0])
+                return (output_logits(h_tr, params.w_ho, compute_dtype),
+                        output_logits(h_val, params.w_ho, compute_dtype))
+            x_tr, x_val = split_x()
+            return logits_fn(params, x_tr), logits_fn(params, x_val)
+
+        def body(carry):
+            (params, opt_state, snapshot, before_val, before_tr, i, _,
+             hist) = carry
+            loss, grads, acc_val_prev, acc_tr_prev = fused_epoch_forward(
+                params)
+            # i == 0: the entry params' accuracies were already reported
+            # (and dip-tested) by the previous chunk's boundary eval — or
+            # are the init params', never reported. Skip the test then.
+            first = i == 0
+            dip = jnp.logical_and(jnp.logical_not(first),
+                                  acc_val_prev < before_val)
+            prev = jnp.maximum(i - 1, 0)
+            hist = hist.at[prev, 0].set(
+                jnp.where(first, hist[prev, 0], acc_val_prev))
+            hist = hist.at[prev, 1].set(
+                jnp.where(first, hist[prev, 1], acc_tr_prev))
+            # Epoch i-1 survived its dip test: accept its post-update
+            # params (the CURRENT entry params) as the snapshot and its
+            # accuracies as the best pair (ref: the fetch-after-break
+            # ordering at G2Vec.py:276-283).
+            keep = jnp.logical_or(first, dip)
+            snapshot = _tree_select(keep, snapshot, params)
+            before_val = jnp.where(keep, before_val, acc_val_prev)
+            before_tr = jnp.where(keep, before_tr, acc_tr_prev)
+            # Apply update i unless epoch i-1 just dipped (epoch i then
+            # never happens; shipping's loop had already exited).
+            new_params, new_opt = adam_step(grads, opt_state, params)
+            if ctx.mesh is not None:
+                new_params = CBOWParams(
+                    w_ih=ctx.constrain(new_params.w_ih, ctx.w_ih_spec),
+                    w_ho=ctx.constrain(new_params.w_ho, ctx.w_ho_spec))
+            params = _tree_select(dip, params, new_params)
+            opt_state = _tree_select(dip, opt_state, new_opt)
+            # The loss belongs to epoch i (entry-params forward), exactly
+            # the value shipping records from its grad pass — unless epoch
+            # i never ran.
+            hist = hist.at[i, 2].set(jnp.where(dip, hist[i, 2], loss))
+            return (params, opt_state, snapshot, before_val, before_tr,
+                    jnp.where(dip, i, i + 1), dip, hist)
+
+        init = (params, opt_state, snapshot,
+                jnp.float32(before_val), jnp.float32(before_tr),
+                jnp.int32(0), jnp.bool_(False), hist)
+        (params, opt_state, snapshot, before_val, before_tr, count, stopped,
+         hist) = jax.lax.while_loop(cond, superstepped(cond, body), init)
+        # Boundary eval: ONE fused forward per chunk backfills the final
+        # executed epoch's accuracy pair and runs its dip test (the fold's
+        # only residual cost — the next chunk's body 0 recomputes these
+        # logits and discards them). Masked out when a mid-chunk dip
+        # already closed the books, and on the limit=0 warm call.
+        logits_tr_last, logits_val_last = split_logits(params, _base_mm)
+        acc_val_last = acc_from_logits(logits_val_last, yval, wval)
+        acc_tr_last = acc_from_logits(logits_tr_last, ytr, wtr)
+        valid = jnp.logical_and(count > 0, jnp.logical_not(stopped))
+        last = jnp.maximum(count - 1, 0)
+        hist = hist.at[last, 0].set(
+            jnp.where(valid, acc_val_last, hist[last, 0]))
+        hist = hist.at[last, 1].set(
+            jnp.where(valid, acc_tr_last, hist[last, 1]))
+        dip = jnp.logical_and(valid, acc_val_last < before_val)
+        accept = jnp.logical_and(valid, jnp.logical_not(dip))
+        snapshot = _tree_select(accept, params, snapshot)
+        before_val = jnp.where(accept, acc_val_last, before_val)
+        before_tr = jnp.where(accept, acc_tr_last, before_tr)
+        return (params, opt_state, snapshot, before_val, before_tr, count,
+                jnp.logical_or(stopped, dip), hist)
+
+    fn = run_chunk_fused if fused else run_chunk
+    return jax.jit(fn, donate_argnums=(0, 1, 2, 3) if donate else ())
 
 
 # jit caches live on the function object, so the compiled chunk must be
@@ -289,14 +561,19 @@ def _lru_get(cache: "OrderedDict", key, limit: int, make):
 
 def _get_chunk_fn(learning_rate: float, compute_dtype, decision_threshold: float,
                   ctx: MeshContext, chunk: int, packed: bool = False,
-                  interpret: bool = False):
+                  interpret: bool = False, fused: bool = True,
+                  superstep: int = 1, donate: bool = True):
+    # A packed program embeds its kernel tile plan at trace time: key on
+    # the autotuner's install counter so a re-tune compiles fresh tiles
+    # instead of silently serving the stale executable.
     key = (learning_rate, jnp.dtype(compute_dtype).name, decision_threshold,
-           ctx.mesh, chunk, packed, interpret)
+           ctx.mesh, chunk, packed, interpret, fused, superstep, donate,
+           pm.tuned_token() if packed else 0)
 
     def make():
-        tx = optax.adam(learning_rate, b1=0.9, b2=0.999, eps=1e-8)
-        return _make_chunk_fn(tx, compute_dtype, decision_threshold, ctx,
-                              chunk, packed, interpret)
+        return _make_chunk_fn(learning_rate, compute_dtype,
+                              decision_threshold, ctx, chunk, packed,
+                              interpret, fused, superstep, donate)
 
     return _lru_get(_CHUNK_FN_CACHE, key, _CHUNK_FN_CACHE_MAX, make)
 
@@ -330,6 +607,18 @@ def _pad_rows(arr: np.ndarray, n_rows: int) -> np.ndarray:
 
 
 _DTYPES = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}
+
+
+def _autotune_packed_shapes(row_counts, n_genes_pad: int, hidden: int,
+                            interpret: bool,
+                            cache_path: Optional[str]) -> None:
+    """Measure (or cache-load) packed-kernel tile plans for the trainer's
+    exact matmul shapes. In-memory hits return without bumping the tuned
+    token, so a foreground call after the overlap warm path is free."""
+    for m in sorted(set(int(m) for m in row_counts)):
+        pm.autotune_packed_matmul(m, n_genes_pad, hidden,
+                                  interpret=interpret,
+                                  cache_path=cache_path)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -416,6 +705,9 @@ def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
                packed_genes: Optional[int] = None,
                checkpoint_layout: str = "single",
                pre_compile_hook: Optional[Callable[[], None]] = None,
+               fused_eval: bool = True, epoch_superstep: int = 1,
+               donate: bool = True, kernel_autotune: bool = False,
+               autotune_cache_path: Optional[str] = None,
                ) -> TrainResult:
     """Train the modified CBOW; returns the embedding table and history.
 
@@ -425,9 +717,21 @@ def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
     the dense matrix is then never materialized whole on the host.
     ``labels``: [n_paths] in {0, 1}. ``on_epoch(step, acc_val, acc_tr, secs)``
     fires every epoch so the CLI can render the reference's log cadence.
+
+    ``fused_eval``/``epoch_superstep``/``donate`` select the chunk-program
+    variants documented at :func:`_make_chunk_fn`, parity-pinned against
+    the shipping loop (module docstring has the float32 contract).
+    ``kernel_autotune`` sweeps
+    the packed kernel's tile plans at this run's exact shapes before the
+    chunk program compiles (persisted under ``autotune_cache_path`` —
+    cache.py's --cache-dir autotune tier — so repeat runs skip the sweep);
+    it is a no-op on the XLA (non-Pallas) path.
     """
     if paths.shape[0] < 2:
         raise ValueError(f"need at least 2 paths to split, got {paths.shape[0]}")
+    if epoch_superstep < 1:
+        raise ValueError(
+            f"epoch_superstep must be >= 1, got {epoch_superstep}")
     ctx = mesh_ctx if mesh_ctx is not None else make_mesh_context(None)
     if compute_dtype not in _DTYPES:
         raise ValueError(
@@ -474,7 +778,7 @@ def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
     if not use_pallas:
         unpack_fn = _get_unpack_fn(ctx, cdtype)
 
-    def _prep(idx):
+    def _pack_host(idx):
         # The multi-hot crosses the host->device boundary as packed bits
         # (8 genes/byte) and — in the XLA path — is unpacked + cast on
         # device: a ~13x smaller transfer than shipping bf16, and no
@@ -506,18 +810,46 @@ def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
                 packed[lo:lo + len(sel)] = (
                     pm.pack_blockwise(xb) if use_pallas
                     else np.packbits(xb, axis=1))
-        y_dev = ctx.put(_pad_rows(y, n_pad), ctx.label_spec)
-        w_dev = ctx.put(w, ctx.label_spec)
-        if use_pallas:
-            return ctx.put(packed, ctx.packed_batch_spec), y_dev, w_dev
-        return unpack_fn(ctx.put(packed, ctx.batch_spec)), y_dev, w_dev
+        return packed, _pad_rows(y, n_pad), w
 
-    xtr, ytr, wtr = _prep(tr_idx)
-    xval, yval, wval = _prep(vl_idx)
+    def _put_x(packed_np):
+        if use_pallas:
+            return ctx.put(packed_np, ctx.packed_batch_spec)
+        return unpack_fn(ctx.put(packed_np, ctx.batch_spec))
+
+    ptr_np, ytr_np, wtr_np = _pack_host(tr_idx)
+    pval_np, yval_np, wval_np = _pack_host(vl_idx)
+    # Fused eval is a single-device program shape: the [tr|val] row
+    # concatenation does not align with data-shard boundaries, so a mesh
+    # run would reshard the hidden activations every epoch just to split
+    # them back. Meshes keep the shipping split program (bitwise-identical
+    # history in float32 anyway — the parity contract).
+    fused = fused_eval and ctx.mesh is None
+    if fused:
+        # Fused-eval layout: ONE [tr_pad + val_pad] path matrix — the two
+        # padded blocks concatenated, train rows keeping their exact
+        # offsets (per-row forward results cannot regroup). Labels and
+        # masks stay per-split: the chunk program slices the hidden
+        # activations back apart, and the custom-vjp backward never sees
+        # the val block at all.
+        data = (_put_x(np.concatenate([ptr_np, pval_np], axis=0)),
+                ctx.put(ytr_np, ctx.label_spec),
+                ctx.put(wtr_np, ctx.label_spec),
+                ctx.put(yval_np, ctx.label_spec),
+                ctx.put(wval_np, ctx.label_spec))
+    else:
+        data = (_put_x(ptr_np), ctx.put(ytr_np, ctx.label_spec),
+                ctx.put(wtr_np, ctx.label_spec),
+                _put_x(pval_np), ctx.put(yval_np, ctx.label_spec),
+                ctx.put(wval_np, ctx.label_spec))
 
     # ---- params + optimizer ----
     key = jax.random.key(seed)
-    params = init_params(key, n_genes_pad, hidden, param_dtype=pdtype)
+    # pad_to: the draw covers the real genes only, so the same seed gives
+    # the same trajectory under ANY layout's padding (pallas vs XLA, any
+    # mesh shape) — the parity tests compare runs across layouts.
+    params = init_params(key, n_genes, hidden, param_dtype=pdtype,
+                         pad_to=n_genes_pad)
     if ctx.mesh is not None:
         params = CBOWParams(w_ih=ctx.put(params.w_ih, ctx.w_ih_spec),
                             w_ho=ctx.put(params.w_ho, ctx.w_ho_spec))
@@ -543,14 +875,28 @@ def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
     # host round trip over DEFAULT_CHUNK epochs.
     chunk = checkpoint_every if checkpoint_dir else DEFAULT_CHUNK
     chunk = max(1, min(chunk, max_epochs))
+    superstep = max(1, min(epoch_superstep, chunk))
     if pre_compile_hook is not None:
         # The overlap scheduler joins its background warm_train_compile
         # here — AFTER the host-side _prep packing it overlapped, right
         # before the chunk-fn request that wants the warmed executable.
         pre_compile_hook()
+    if kernel_autotune and use_pallas:
+        # Measure tile plans at THIS run's exact matmul shapes before the
+        # chunk program traces (an install bumps pm.tuned_token(), which
+        # the chunk-fn key embeds). When the overlap warm path already
+        # swept these shapes, the in-memory hit returns without touching
+        # the token — the warmed executable stays valid. Fused mode runs
+        # its fwd at [tr+val] rows and its bwd at [tr] rows; unfused runs
+        # fwd+bwd at [tr] and an eval fwd at [val].
+        _autotune_packed_shapes(
+            [ptr_np.shape[0] + pval_np.shape[0], ptr_np.shape[0]] if fused
+            else [ptr_np.shape[0], pval_np.shape[0]],
+            n_genes_pad, hidden, pallas_interpret, autotune_cache_path)
     chunk_fn = _get_chunk_fn(learning_rate, cdtype, decision_threshold, ctx,
                              chunk, packed=use_pallas,
-                             interpret=pallas_interpret)
+                             interpret=pallas_interpret, fused=fused,
+                             superstep=superstep, donate=donate)
 
     # ---- epoch loop with first-val-dip early stopping ----
     history: List[dict] = []
@@ -622,17 +968,29 @@ def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
                     acc_val=before_val, acc_tr=before_tr,
                     history=[], params=snapshot)
             start_epoch = last_epoch + 1
+    from jax.sharding import PartitionSpec as P
+
+    if donate:
+        # Donated arguments must be distinct buffers: the fresh-init
+        # snapshot aliases params (and a restored one may share leaves).
+        # One small copy up front; every later chunk hands back fresh
+        # outputs whose buffers the next call donates again.
+        snapshot = jax.tree.map(jnp.copy, snapshot)
+    # The per-chunk history buffer is part of the donated carry: allocated
+    # once, updated in place on device, device_get'd (a host copy) after
+    # each chunk, then handed straight back.
+    hist_dev = ctx.put(np.zeros((chunk, 3), np.float32), P())
     t0 = time.time()
     step = step_start = start_epoch
     while step < max_epochs and not stopped_early:
         limit = min(chunk, max_epochs - step)
-        (params, opt_state, snapshot, bv_d, bt_d, count_d, dip_d, hist_d
-         ) = chunk_fn(params, opt_state, snapshot, before_val, before_tr,
-                      limit, xtr, ytr, wtr, xval, yval, wval)
+        (params, opt_state, snapshot, bv_d, bt_d, count_d, dip_d, hist_dev
+         ) = chunk_fn(params, opt_state, snapshot, hist_dev, before_val,
+                      before_tr, limit, *data)
         count = int(count_d)                     # the only host sync per chunk
         stopped_early = bool(dip_d)
         before_val, before_tr = float(bv_d), float(bt_d)
-        hist = np.asarray(jax.device_get(hist_d))[:count]
+        hist = np.asarray(jax.device_get(hist_dev))[:count]
         secs = (time.time() - t0) / max(count, 1)
         t0 = time.time()
         from g2vec_tpu.resilience.faults import fault_point
@@ -686,7 +1044,10 @@ def warm_train_compile(n_paths: int, n_genes: int, *, hidden: int,
                        mesh_ctx: Optional[MeshContext] = None,
                        checkpoint_dir: Optional[str] = None,
                        checkpoint_every: int = 25,
-                       use_pallas: Optional[bool] = None) -> bool:
+                       use_pallas: Optional[bool] = None,
+                       fused_eval: bool = True, epoch_superstep: int = 1,
+                       donate: bool = True, kernel_autotune: bool = False,
+                       autotune_cache_path: Optional[str] = None) -> bool:
     """Compile the chunk (and unpack) programs train_cbow will run at
     these shapes, without training anything.
 
@@ -723,38 +1084,58 @@ def warm_train_compile(n_paths: int, n_genes: int, *, hidden: int,
                         use_pallas)
     chunk = checkpoint_every if checkpoint_dir else DEFAULT_CHUNK
     chunk = max(1, min(chunk, max_epochs))
+    superstep = max(1, min(epoch_superstep, chunk))
+    tr_pad = pad_to_multiple(pivot, plan.row_multiple)
+    val_pad = pad_to_multiple(n_paths - pivot, plan.row_multiple)
+    fused = fused_eval and ctx.mesh is None     # same gate as train_cbow
+    if kernel_autotune and plan.use_pallas:
+        # Sweep (or cache-load) the tile plans FIRST: the chunk-fn key
+        # embeds pm.tuned_token(), so warming before the install would
+        # compile an executable the real run can never hit.
+        _autotune_packed_shapes(
+            [tr_pad + val_pad, tr_pad] if fused else [tr_pad, val_pad],
+            plan.n_genes_pad, hidden, plan.interpret, autotune_cache_path)
     chunk_fn = _get_chunk_fn(learning_rate, cdtype, decision_threshold, ctx,
                              chunk, packed=plan.use_pallas,
-                             interpret=plan.interpret)
+                             interpret=plan.interpret, fused=fused,
+                             superstep=superstep, donate=donate)
 
-    def dummy(n_rows):
-        n_pad = pad_to_multiple(n_rows, plan.row_multiple)
-        y = ctx.put(np.zeros((n_pad, 1), np.float32), ctx.label_spec)
-        w = ctx.put(_pad_rows(np.ones((n_rows, 1), np.float32), n_pad),
-                    ctx.label_spec)
+    def dummy_x(n_pad):
         packed = np.zeros((n_pad, plan.n_genes_pad // 8), dtype=np.uint8)
         if plan.use_pallas:
-            return ctx.put(packed, ctx.packed_batch_spec), y, w
-        return _get_unpack_fn(ctx, cdtype)(
-            ctx.put(packed, ctx.batch_spec)), y, w
+            return ctx.put(packed, ctx.packed_batch_spec)
+        return _get_unpack_fn(ctx, cdtype)(ctx.put(packed, ctx.batch_spec))
 
-    xtr, ytr, wtr = dummy(pivot)
-    xval, yval, wval = dummy(n_paths - pivot)
-    params = init_params(jax.random.key(0), plan.n_genes_pad, hidden,
-                         param_dtype=pdtype)
+    def dummy_yw(n_rows, n_pad):
+        return (ctx.put(np.zeros((n_pad, 1), np.float32), ctx.label_spec),
+                ctx.put(_pad_rows(np.ones((n_rows, 1), np.float32), n_pad),
+                        ctx.label_spec))
+
+    if fused:
+        data = (dummy_x(tr_pad + val_pad),
+                *dummy_yw(pivot, tr_pad),
+                *dummy_yw(n_paths - pivot, val_pad))
+    else:
+        data = (dummy_x(tr_pad), *dummy_yw(pivot, tr_pad),
+                dummy_x(val_pad), *dummy_yw(n_paths - pivot, val_pad))
+    params = init_params(jax.random.key(0), n_genes, hidden,
+                         param_dtype=pdtype, pad_to=plan.n_genes_pad)
     if ctx.mesh is not None:
         params = CBOWParams(w_ih=ctx.put(params.w_ih, ctx.w_ih_spec),
                             w_ho=ctx.put(params.w_ho, ctx.w_ho_spec))
     tx = optax.adam(learning_rate, b1=0.9, b2=0.999, eps=1e-8)
     opt_state = tx.init(params)
-    if ctx.mesh is not None:
-        from jax.sharding import PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
+    if ctx.mesh is not None:
         opt_state = jax.tree.map(
             lambda sub: (sub if isinstance(sub, CBOWParams)
                          else ctx.put(sub, P())),
             opt_state, is_leaf=lambda x: isinstance(x, CBOWParams))
-    out = chunk_fn(params, opt_state, params, -1.0, -1.0, 0,
-                   xtr, ytr, wtr, xval, yval, wval)
+    # Donation wants distinct buffers per donated argument (params is
+    # reused as the snapshot here).
+    snapshot = jax.tree.map(jnp.copy, params) if donate else params
+    hist = ctx.put(np.zeros((chunk, 3), np.float32), P())
+    out = chunk_fn(params, opt_state, snapshot, hist, -1.0, -1.0, 0, *data)
     jax.block_until_ready(out[5])      # the epoch count — compile is done
     return True

@@ -25,11 +25,24 @@ def test_breakdown_pieces_and_roofline(bench_mod):
     rng = np.random.default_rng(0)
     paths, labels = bench_mod.make_paths(rng, 96, 256)
     bd = bench_mod._bench_epoch_breakdown(paths, labels, 16, 0.01,
-                                          interpret=True)
+                                          interpret=True, superstep_k=4)
     for k in ("grad_update_ms", "eval_val_ms", "eval_tr_ms",
-              "eval_tr_amortized_ms", "epoch_ms", "residual_ms"):
+              "eval_tr_amortized_ms", "epoch_ms", "residual_ms",
+              "fused_grad_eval_ms", "fused_eval_saved_ms"):
         assert isinstance(bd[k], float), k
     assert bd["grad_update_ms"] > 0 and bd["eval_val_ms"] > 0
+    # PR-4 extended terms: the superstep A/B ran both arms, and the tile
+    # attribution names a legal plan per shape/direction.
+    ss = bd["superstep"]
+    assert ss["k"] == 4
+    for k in ("epoch_ms_k1", "epoch_ms_k", "residual_recovered_ms"):
+        assert isinstance(ss[k], float), k
+    assert ss["epoch_ms_k1"] > 0 and ss["epoch_ms_k"] > 0
+    for shape in ("tr", "tr_val"):
+        for d in ("fwd", "bwd"):
+            tile = bd["kernel_tiles"][shape][d]
+            assert tile["row_block"] > 0 and tile["blocks_per_group"] > 0
+            assert tile["source"] in ("heuristic", "autotuned")
 
     rl = bd["roofline"]
     assert rl["hbm_peak_gbps"] == bench_mod._peak_hbm_bytes_per_sec() / 1e9
@@ -55,3 +68,10 @@ def test_breakdown_pieces_and_roofline(bench_mod):
         abs=1e-3)
     # Implied bandwidths exist whenever the piece was timed.
     assert rl["grad_implied_gbps"] is not None
+    # Fused-epoch floor: the standalone eval's W read is gone, so the
+    # fused floor must sit strictly below shipping's (plus the amortized
+    # boundary eval, which cannot flip the inequality at these shapes).
+    assert rl["fused_epoch_min_bytes"] < rl["epoch_min_bytes"]
+    assert rl["fused_bandwidth_bound_epoch_ms_floor"] <= \
+        rl["bandwidth_bound_epoch_ms_floor"]
+    assert rl["donate_double_buffer_bytes"] > 0

@@ -15,7 +15,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH_REQUIRED = ("cbow_train_paths_per_sec_per_chip",
                   "packed_matmul_vs_xla_dense", "cbow_epoch_breakdown",
                   "cbow_train_xla_dense_sec_per_epoch",
-                  "config2_train_paths_per_sec_per_chip")
+                  "config2_train_paths_per_sec_per_chip",
+                  "walker_restricted_walks_per_sec")
 BENCH_OK_LINES = [{"metric": m, "value": 1.0} for m in BENCH_REQUIRED]
 
 

@@ -57,7 +57,7 @@ def _cli(tsv_paths, result, ckpt, liveness, extra=()):
     args = [sys.executable, "-m", "g2vec_tpu",
             tsv_paths["expression"], tsv_paths["clinical"],
             tsv_paths["network"], result,
-            "-p", "8", "-r", "2", "-s", "16", "-e", "12", "-l", "0.01",
+            "-p", "8", "-r", "2", "-s", "16", "-e", "12", "-l", "0.002",
             "-n", "5", "--seed", "0", "--compute-dtype", "float32",
             "--platform", "cpu", "--mesh", "4x1", "--fleet-size", "2",
             "--checkpoint-dir", ckpt, "--checkpoint-every", "3",

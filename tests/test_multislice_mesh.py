@@ -1,0 +1,74 @@
+"""Hybrid multi-slice mesh planning (parallel/distributed.plan_hybrid_mesh).
+
+VERDICT item 5: the ICI/DCN axis assignment had no regression test — a
+future refactor could silently put the per-matmul model all-reduce on DCN
+(an order-of-magnitude collective slowdown on a real multi-slice pod) and
+every CPU test would still pass. Here a 2-slice topology is faked with
+mock devices carrying ``slice_index`` and the planning contract is pinned:
+model stays inside a slice (ICI), data crosses slices (DCN), and a data
+axis that cannot divide over the slices is a loud config error.
+"""
+from types import SimpleNamespace
+
+import pytest
+
+from g2vec_tpu.parallel.distributed import plan_hybrid_mesh
+
+
+def _fake_pod(n_slices: int, per_slice: int):
+    """Mock device objects: the only attribute the planner reads is
+    slice_index (absent on CPU/older libtpu — covered below)."""
+    return [SimpleNamespace(slice_index=s, id=s * per_slice + i)
+            for s in range(n_slices) for i in range(per_slice)]
+
+
+def test_two_slice_assignment_model_on_ici():
+    # 2 slices x 4 chips, --mesh 4x2: the model axis (2) must stay whole
+    # inside a slice; the data axis (4) factors as 2 slices x 2 chips.
+    devices = _fake_pod(2, 4)
+    per_slice, dcn = plan_hybrid_mesh(devices, data=4, model=2)
+    assert per_slice == (2, 2)
+    # DCN mesh shards ONLY the data axis — a model entry > 1 here would
+    # put the per-matmul all-reduce on the slow cross-slice fabric.
+    assert dcn == (2, 1)
+
+
+def test_four_slice_pure_dp():
+    devices = _fake_pod(4, 2)
+    per_slice, dcn = plan_hybrid_mesh(devices, data=8, model=1)
+    assert per_slice == (2, 1)
+    assert dcn == (4, 1)
+
+
+def test_divisibility_error_names_the_constraint():
+    devices = _fake_pod(2, 4)
+    with pytest.raises(ValueError, match="divisible by the slice count 2"):
+        plan_hybrid_mesh(devices, data=3, model=2)  # 3 % 2 != 0
+
+
+def test_single_slice_returns_none():
+    # One slice -> no hybrid plan; the caller takes the ICI-contiguous
+    # create_device_mesh path.
+    assert plan_hybrid_mesh(_fake_pod(1, 8), data=4, model=2) is None
+
+
+def test_no_slice_metadata_returns_none():
+    # CPU devices / older libtpu expose no slice_index at all; getattr
+    # defaults every device to slice 0 -> single-slice path.
+    devices = [SimpleNamespace(id=i) for i in range(8)]
+    assert plan_hybrid_mesh(devices, data=8, model=1) is None
+
+
+def test_real_cpu_devices_take_single_slice_path():
+    # End-to-end on the 8 virtual CPU devices: make_global_mesh must
+    # build a working ('data','model') mesh through the non-hybrid
+    # branch (CPU devices carry no slice metadata).
+    import jax
+
+    from g2vec_tpu.parallel.distributed import make_global_mesh
+
+    ctx = make_global_mesh((4, 2))
+    assert ctx.mesh is not None
+    assert dict(zip(ctx.mesh.axis_names, ctx.mesh.devices.shape)) == {
+        "data": 4, "model": 2}
+    assert plan_hybrid_mesh(jax.devices(), 4, 2) is None

@@ -205,16 +205,17 @@ def test_supervised_recovers_from_train_loop_crash(tsv_paths, tmp_path):
     byte-identical to an uninterrupted checkpointed run."""
     from g2vec_tpu.pipeline import run
 
-    # learningRate=0.01 trains ~9 epochs before the early stop at this
-    # scale — enough room for two checkpoint intervals before the crash.
+    # learningRate=0.002 trains ~10 epochs before the early stop at this
+    # scale (under the padding-invariant init, models/cbow.py) — enough
+    # room for two checkpoint intervals before the crash.
     clean = run(_cfg(tsv_paths, tmp_path, result_name=str(tmp_path / "a"),
-                     learningRate=0.01, checkpoint_dir=str(tmp_path / "cka"),
+                     learningRate=0.002, checkpoint_dir=str(tmp_path / "cka"),
                      checkpoint_every=3),
                 console=_quiet)
     assert clean.train_history[-1]["epoch"] >= 7, "config trains too briefly"
     mj = str(tmp_path / "m.jsonl")
     cfg = _cfg(tsv_paths, tmp_path, result_name=str(tmp_path / "b"),
-               learningRate=0.01, checkpoint_dir=str(tmp_path / "ckb"),
+               learningRate=0.002, checkpoint_dir=str(tmp_path / "ckb"),
                checkpoint_every=3, metrics_jsonl=mj,
                fault_plan="stage=train,epoch=6,kind=crash")
     recovered = supervise(cfg, policy=_FAST, console=_quiet, sleep=_nosleep)
@@ -237,7 +238,7 @@ def test_supervised_survives_corrupt_latest_checkpoint(tsv_paths, tmp_path):
     from g2vec_tpu.pipeline import run
 
     clean = run(_cfg(tsv_paths, tmp_path, result_name=str(tmp_path / "a"),
-                     learningRate=0.01, checkpoint_dir=str(tmp_path / "cka"),
+                     learningRate=0.002, checkpoint_dir=str(tmp_path / "cka"),
                      checkpoint_every=3),
                 console=_quiet)
     mj = str(tmp_path / "m.jsonl")
@@ -245,7 +246,7 @@ def test_supervised_survives_corrupt_latest_checkpoint(tsv_paths, tmp_path):
     # crash at epoch 6 forces a resume that must detect it and fall back
     # to the good epoch-2 generation.
     cfg = _cfg(tsv_paths, tmp_path, result_name=str(tmp_path / "b"),
-               learningRate=0.01, checkpoint_dir=str(tmp_path / "ckb"),
+               learningRate=0.002, checkpoint_dir=str(tmp_path / "ckb"),
                checkpoint_every=3, metrics_jsonl=mj,
                fault_plan="stage=checkpoint_finalize,kind=corrupt,skip=1;"
                           "stage=train,epoch=6,kind=crash")

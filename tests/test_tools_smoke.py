@@ -1,0 +1,126 @@
+"""CPU smoke tests for the window-critical tools (VERDICT item 9).
+
+The r5 chip window lost a whole battery stage to a tool failure that a
+10-second CPU run would have caught. These tests drive
+``tools/profile_walker.py``, ``tools/profile_ops.py`` and
+``tools/calibrate_real.py`` as REAL subprocesses at env-shrunk tiny
+shapes: argv handling, the JSON line schema, and the failure modes a chip
+window cannot afford to discover (typo'd variant names used to be a
+silent exit-0 no-op; a missing reference mount used to be a mid-sweep
+traceback) are all pinned here.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script, *args, env_extra=None, timeout=240):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(env_extra or {})
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", script), *args],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO)
+
+
+def _json_lines(stdout):
+    return [json.loads(line) for line in stdout.splitlines() if line.strip()]
+
+
+@pytest.fixture(scope="module")
+def tiny_network(tmp_path_factory):
+    """A small connected edge list + matching clinical file on disk."""
+    d = tmp_path_factory.mktemp("toolnet")
+    from g2vec_tpu.data.synthetic import SyntheticSpec, write_synthetic_tsv
+
+    spec = SyntheticSpec(n_good=10, n_poor=10, module_size=8,
+                         n_background=16, n_expr_only=2, n_net_only=2,
+                         module_chords=2, background_edges=30, seed=1)
+    return write_synthetic_tsv(spec, str(d))
+
+
+def test_profile_walker_schema(tiny_network):
+    res = _run("profile_walker.py", "new_1rep",
+               env_extra={"G2VEC_PROFILE_NETWORK": tiny_network["network"],
+                          "G2VEC_PROFILE_LEN_PATH": "6",
+                          "G2VEC_PROFILE_REPS": "2"})
+    assert res.returncode == 0, res.stderr[-2000:]
+    lines = _json_lines(res.stdout)
+    variants = [ln for ln in lines if "variant" in ln]
+    assert [ln["variant"] for ln in variants] == ["new_1rep"]
+    assert "walks_per_sec" in variants[0] or "error" in variants[0]
+    summary = lines[-1]
+    assert {"backend", "G", "D", "len_path", "variants"} <= set(summary)
+    assert summary["backend"] == "cpu" and summary["len_path"] == 6
+
+
+def test_profile_walker_unknown_variant_fails_loudly(tiny_network):
+    res = _run("profile_walker.py", "new_1repp",  # typo
+               env_extra={"G2VEC_PROFILE_NETWORK": tiny_network["network"],
+                          "G2VEC_PROFILE_LEN_PATH": "6",
+                          "G2VEC_PROFILE_REPS": "1"})
+    assert res.returncode == 2
+    err = _json_lines(res.stdout)[-1]
+    assert "new_1repp" in err["error"] and "new_1rep" in err["error"]
+
+
+def test_profile_ops_schema():
+    res = _run("profile_ops.py", "visited_scatter",
+               env_extra={"G2VEC_PROFILE_G": "64", "G2VEC_PROFILE_W": "16",
+                          "G2VEC_PROFILE_D": "8", "G2VEC_PROFILE_ITERS": "2"})
+    assert res.returncode == 0, res.stderr[-2000:]
+    lines = _json_lines(res.stdout)
+    ops = [ln for ln in lines if "op" in ln]
+    assert [ln["op"] for ln in ops] == ["visited_scatter"]
+    summary = lines[-1]
+    assert {"backend", "W", "G", "D", "ms_per_iter"} <= set(summary)
+    assert summary["G"] == 64 and summary["W"] == 16
+
+
+def test_profile_ops_unknown_op_fails_loudly():
+    res = _run("profile_ops.py", "no_such_op",
+               env_extra={"G2VEC_PROFILE_G": "64", "G2VEC_PROFILE_W": "16",
+                          "G2VEC_PROFILE_D": "8"})
+    assert res.returncode == 2
+    assert "no_such_op" in _json_lines(res.stdout)[-1]["error"]
+
+
+@pytest.mark.skipif(__import__("shutil").which("g++") is None,
+                    reason="calibrate_real drives the native sampler")
+def test_calibrate_real_tiny_sweep(tiny_network):
+    # A tiny two-point sweep end to end: spec-arg parsing, the native
+    # walk, and the per-spec JSON schema the calibration notes cite.
+    env = {"G2VEC_CALIBRATE_NETWORK": tiny_network["network"],
+           "G2VEC_CALIBRATE_CLINICAL": tiny_network["clinical"]}
+    spec = ("tiny=n_common=24, target_edges=60, n_active_per_group=8, "
+            "n_shared=4, seed=1")
+    res = _run("calibrate_real.py", "--no-baseline", spec, env_extra=env,
+               timeout=300)
+    assert res.returncode == 0, (res.stdout[-1500:], res.stderr[-1500:])
+    lines = _json_lines(res.stdout)
+    assert [ln["spec"] for ln in lines] == ["tiny"]
+    for ln in lines:
+        assert {"n_paths", "n_path_genes", "transcript"} <= set(ln), ln
+
+
+def test_calibrate_real_bad_spec_arg(tiny_network):
+    env = {"G2VEC_CALIBRATE_NETWORK": tiny_network["network"],
+           "G2VEC_CALIBRATE_CLINICAL": tiny_network["clinical"]}
+    res = _run("calibrate_real.py", "garbage-without-equals", env_extra=env)
+    assert res.returncode == 2
+    assert "bad spec arg" in _json_lines(res.stdout)[-1]["error"]
+
+
+def test_calibrate_real_missing_inputs_fail_fast(tmp_path):
+    env = {"G2VEC_CALIBRATE_NETWORK": str(tmp_path / "nope.txt"),
+           "G2VEC_CALIBRATE_CLINICAL": str(tmp_path / "also_nope.txt")}
+    res = _run("calibrate_real.py", env_extra=env)
+    assert res.returncode == 2
+    err = _json_lines(res.stdout)[-1]["error"]
+    assert "G2VEC_CALIBRATE_NETWORK" in err

@@ -34,8 +34,10 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-NET = "/root/reference/ex_NETWORK.txt"
-CLIN = "/root/reference/ex_CLINICAL.txt"
+NET = os.environ.get("G2VEC_CALIBRATE_NETWORK",
+                     "/root/reference/ex_NETWORK.txt")
+CLIN = os.environ.get("G2VEC_CALIBRATE_CLINICAL",
+                      "/root/reference/ex_CLINICAL.txt")
 TRANSCRIPT = {"n_paths": 45402, "n_path_genes": 3773}
 
 
@@ -155,13 +157,39 @@ def main() -> None:
         return
     from g2vec_tpu.data.realistic import RealExampleSpec
 
-    specs = {
-        "baseline": RealExampleSpec(),
-    }
-    for field in sys.argv[1:]:
+    argv = sys.argv[1:]
+    specs = {}
+    if "--no-baseline" in argv:
+        # Sweep only the named specs — the default baseline is sized for
+        # the real 7,523-gene network and cannot run on a tiny stand-in
+        # (the CPU smoke tests drive exactly that shape).
+        argv = [a for a in argv if a != "--no-baseline"]
+    else:
+        specs["baseline"] = RealExampleSpec()
+    if not os.path.exists(NET) or not os.path.exists(CLIN):
+        # Fail before any work with the fix in the message — a missing
+        # reference mount must not surface as a mid-sweep traceback.
+        print(json.dumps({"error": f"reference inputs missing ({NET!r} / "
+                                   f"{CLIN!r}); point "
+                                   f"G2VEC_CALIBRATE_NETWORK/_CLINICAL at "
+                                   f"an edge list + clinical TSV"}),
+              flush=True)
+        sys.exit(2)
+    for field in argv:
+        if "=" not in field:
+            print(json.dumps({"error": f"bad spec arg {field!r}; expected "
+                                       f"'name=<RealExampleSpec kwargs>'"}),
+                  flush=True)
+            sys.exit(2)
         name, expr = field.split("=", 1)
-        specs[name] = eval(  # noqa: S307 — operator-supplied sweep points
-            f"RealExampleSpec({expr})", {"RealExampleSpec": RealExampleSpec})
+        try:
+            specs[name] = eval(  # noqa: S307 — operator-supplied sweep points
+                f"RealExampleSpec({expr})", {"RealExampleSpec": RealExampleSpec})
+        except Exception as e:  # noqa: BLE001 — argv error, not a run error
+            print(json.dumps({"error": f"bad spec {field!r}: "
+                                       f"{type(e).__name__}: {e}"}),
+                  flush=True)
+            sys.exit(2)
     for name, spec in specs.items():
         out = run_trial(spec)
         print(json.dumps({"spec": name, **out,

@@ -269,9 +269,14 @@ def battery(info: dict) -> None:
     # in "incomplete" forever.
     required = {s[3]: ("cbow_train_paths_per_sec_per_chip",
                        "packed_matmul_vs_xla_dense",
+                       # Extended PR-4 breakdown: fused-eval term,
+                       # superstep A/B, kernel tile attribution.
                        "cbow_epoch_breakdown",
                        "cbow_train_xla_dense_sec_per_epoch",
-                       "config2_train_paths_per_sec_per_chip")
+                       "config2_train_paths_per_sec_per_chip",
+                       # The apples-to-apples 7,523-gene stage-3 walker
+                       # line (VERDICT item 8) — both backends.
+                       "walker_restricted_walks_per_sec")
                 for s in stages if s[0] == "bench"}
     done = []
     aborted = False

@@ -18,10 +18,12 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-G = 9904
-W = 9904
-D = 1024
-ITERS = 20
+# Bench scale by default; env-shrinkable so the CPU smoke tests can walk
+# the full battery (arg parsing, schema, alarm plumbing) at toy cost.
+G = int(os.environ.get("G2VEC_PROFILE_G", "9904"))
+W = int(os.environ.get("G2VEC_PROFILE_W", "9904"))
+D = int(os.environ.get("G2VEC_PROFILE_D", "1024"))
+ITERS = int(os.environ.get("G2VEC_PROFILE_ITERS", "20"))
 COMPILE_TIMEOUT = int(os.environ.get("PROFILE_COMPILE_TIMEOUT", "150"))
 # Separate bound for the timed run (same knob as profile_walker.py).
 RUN_TIMEOUT = int(os.environ.get("PROFILE_RUN_TIMEOUT", "240"))
@@ -185,6 +187,13 @@ def main():
     ops["pathlist_update"] = (scan20(pathlist_update), cand0)
 
     only = sys.argv[1:] or list(ops)
+    unknown = [n for n in only if n not in ops]
+    if unknown:
+        # Fail loudly on a typo'd op name — the silent skip exited 0
+        # having measured nothing (VERDICT item 9).
+        print(json.dumps({"error": f"unknown op(s) {unknown}; "
+                                   f"valid: {sorted(ops)}"}), flush=True)
+        sys.exit(2)
     results = {}
     contaminated = False
     for name, (fn, arg) in ops.items():

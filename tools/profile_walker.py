@@ -30,8 +30,8 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-LEN_PATH = 80
-REPS = 10
+LEN_PATH = int(os.environ.get("G2VEC_PROFILE_LEN_PATH", "80"))
+REPS = int(os.environ.get("G2VEC_PROFILE_REPS", "10"))
 NEG_INF = -1e30
 NETWORK = os.environ.get("G2VEC_PROFILE_NETWORK",
                          "/root/reference/ex_NETWORK.txt")
@@ -174,6 +174,15 @@ def main():
                       n_genes * REPS),
     }
     only = sys.argv[1:] or list(variants)
+    unknown = [n for n in only if n not in variants]
+    if unknown:
+        # A typo'd variant name must FAIL HERE, loudly — the old silent
+        # skip ran nothing, exited 0, and would burn a chip window on a
+        # battery that measured nothing (VERDICT item 9).
+        print(json.dumps({"error": f"unknown variant(s) {unknown}; "
+                                   f"valid: {sorted(variants)}"}),
+              flush=True)
+        sys.exit(2)
     results = {}
     contaminated = False
     for name, (fn, n_walks) in variants.items():
