@@ -127,6 +127,16 @@ TOTAL_BUDGET = int(os.environ.get("G2VEC_BENCH_TOTAL_BUDGET", "520"))
 # Soft deadline inside the measurement child for the optional stages.
 CHILD_BUDGET = int(os.environ.get("G2VEC_BENCH_CHILD_BUDGET", "400"))
 
+# Batched-vs-sequential runs/hour A/B (batch/engine.py): variants in the
+# seed-sweep manifest, min-of-N reps, trainer epochs, and a synthetic
+# gene-scale multiplier. Defaults are CPU-safe tiny shapes; the
+# subprocess tests shrink further via these envs.
+BATCH_AB_VARIANTS = int(os.environ.get("G2VEC_BENCH_BATCH_VARIANTS", "8"))
+BATCH_AB_REPS = int(os.environ.get("G2VEC_BENCH_BATCH_REPS", "3"))
+BATCH_AB_EPOCHS = int(os.environ.get("G2VEC_BENCH_BATCH_EPOCHS", "30"))
+BATCH_AB_SCALE = int(os.environ.get("G2VEC_BENCH_BATCH_SCALE", "1"))
+BATCH_AB_ARTIFACT = "BENCH_BATCH_AB.json"
+
 # Peak bf16 matmul throughput per chip, for the MFU estimate.
 _PEAK_FLOPS = {"v4": 275e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12}
 # HBM bandwidth per chip (bytes/s): the roofline's other axis. This
@@ -664,6 +674,18 @@ def _hostonly() -> None:
             {"metric": "walker_native_mt_speedup", "value": None,
              "unit": "x", "vs_baseline": None, "chip_free_fallback": True,
              "error": f"{type(e).__name__}: {e}"[:400]}), flush=True)
+    # Batch-engine throughput A/B (runs/hour): live when armed, else the
+    # committed artifact with provenance, else an honest null — before
+    # the headline line either way (the driver parses the last line).
+    try:
+        print(json.dumps({**_batch_ab_hostonly_line(note),
+                          "chip_free_fallback": True}), flush=True)
+    except Exception as e:  # noqa: BLE001 — headline line must still print
+        print(json.dumps(
+            {"metric": "batch_runs_per_hour", "value": None,
+             "unit": "runs/h", "vs_baseline": None,
+             "chip_free_fallback": True,
+             "error": f"{type(e).__name__}: {e}"[:400]}), flush=True)
     line = _native_walker_line(
         src, dst, w, n_genes, baseline, note,
         {"baseline_host_walks_per_sec": round(baseline, 2),
@@ -681,6 +703,168 @@ def _hostonly() -> None:
     headline = landed.get("cbow_train_paths_per_sec_per_chip")
     if headline:
         print(json.dumps(_relay_line(*headline)), flush=True)
+
+
+def _batch_ab_line(note) -> dict:
+    """Batched-vs-sequential runs/hour A/B — the batch engine's headline.
+
+    Sequential baseline = the PRE-ENGINE workflow for N validation runs:
+    one fresh ``python -m g2vec_tpu`` process per variant (each re-pays
+    interpreter+jax startup and every XLA compile, with the device idle
+    between jobs — exactly the N-runs-cost-Nx shape the engine exists to
+    kill). Batched side = ONE process running the same N variants as a
+    ``--seeds N`` manifest. Both sides min-of-``BATCH_AB_REPS``; the
+    variants are the amortized seed sweep (train/k-means seeds vary, one
+    shared walk product), at tiny CPU-safe synthetic shapes
+    (env-shrinkable like the PR 4 nets). On-the-spot honesty check: the
+    batched lanes' output files must be BYTE-IDENTICAL to the sequential
+    runs' — a speedup that changes results would be worthless.
+
+    Runs with no jax in THIS process (children import it); usable from
+    the --_hostonly child.
+    """
+    import shutil
+    import tempfile
+
+    from g2vec_tpu.data.synthetic import SyntheticSpec, write_synthetic_tsv
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    n, reps = BATCH_AB_VARIANTS, BATCH_AB_REPS
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", "")}
+
+    def child(args, timeout=600):
+        proc = subprocess.run([sys.executable, "-m", "g2vec_tpu"] + args,
+                              capture_output=True, text=True, env=env,
+                              timeout=timeout)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"bench batch child rc={proc.returncode}: "
+                f"{(proc.stderr or proc.stdout)[-400:]}")
+
+    with tempfile.TemporaryDirectory() as td:
+        spec = SyntheticSpec(
+            n_good=24, n_poor=20, module_size=12 * BATCH_AB_SCALE,
+            n_background=24 * BATCH_AB_SCALE, n_expr_only=4, n_net_only=4,
+            module_chords=2, background_edges=40 * BATCH_AB_SCALE, seed=7)
+        paths = write_synthetic_tsv(spec, td)
+        base = [paths["expression"], paths["clinical"], paths["network"],
+                "RESULT", "-p", "8", "-r", "2", "-s", "16",
+                "-e", str(BATCH_AB_EPOCHS), "-l", "0.05", "-n", "5",
+                "--compute-dtype", "float32", "--platform", "cpu",
+                "--seed", "0"]
+
+        def seq_rep(rep: int) -> float:
+            out = os.path.join(td, f"seq{rep}")
+            os.makedirs(out, exist_ok=True)
+            t0 = time.time()
+            for k in range(n):
+                args = list(base)
+                args[3] = os.path.join(out, f"s{k}")
+                child(args + ["--train-seed", str(k),
+                              "--kmeans-seed", str(k)])
+            return time.time() - t0
+
+        def bat_rep(rep: int):
+            out = os.path.join(td, f"bat{rep}")
+            os.makedirs(out, exist_ok=True)
+            args = list(base)
+            args[3] = os.path.join(out, "m")
+            mj = os.path.join(out, "metrics.jsonl")
+            t0 = time.time()
+            child(args + ["--seeds", str(n), "--metrics-jsonl", mj])
+            wall = time.time() - t0
+            done = {}
+            with open(mj) as f:
+                for line in f:
+                    ev = json.loads(line)
+                    if ev.get("event") == "done" and "lane" not in ev:
+                        done = ev
+            return wall, done
+
+        seq_walls, bat_walls, done = [], [], {}
+        for rep in range(reps):
+            seq_walls.append(seq_rep(rep))
+            note(f"batch A/B rep {rep}: sequential {n} runs in "
+                 f"{seq_walls[-1]:.1f}s")
+            wall, done = bat_rep(rep)
+            bat_walls.append(wall)
+            note(f"batch A/B rep {rep}: batched {n} lanes in {wall:.1f}s")
+        # Honesty check on the LAST rep's artifacts: every lane file ==
+        # the sequential twin's file.
+        identical = True
+        for k in range(n):
+            for suffix in ("biomarkers", "lgroups", "vectors"):
+                fa = os.path.join(td, f"seq{reps - 1}", f"s{k}_{suffix}.txt")
+                fb = os.path.join(td, f"bat{reps - 1}",
+                                  f"m.s{k}_{suffix}.txt")
+                with open(fa, "rb") as a, open(fb, "rb") as b:
+                    if a.read() != b.read():
+                        identical = False
+                        note(f"batch A/B MISMATCH: lane s{k} {suffix}")
+        shutil.rmtree(td, ignore_errors=True)
+
+    seq_rph = n / min(seq_walls) * 3600.0
+    bat_rph = n / min(bat_walls) * 3600.0
+    return {
+        "metric": "batch_runs_per_hour", "value": round(bat_rph, 1),
+        "unit": "runs/h", "vs_baseline": round(bat_rph / seq_rph, 2),
+        "sequential_runs_per_hour": round(seq_rph, 1),
+        "sequential_wall_s": round(min(seq_walls), 2),
+        "batched_wall_s": round(min(bat_walls), 2),
+        "lanes": n, "reps": reps, "epochs": BATCH_AB_EPOCHS,
+        "scale": BATCH_AB_SCALE, "bit_identical": identical,
+        "walk_stats": done.get("walk_stats"),
+        "buckets": done.get("buckets"),
+        "sequential_mode": "one fresh process per run (re-paid "
+                           "imports+compiles, device idle between jobs — "
+                           "the pre-engine repeated-validation workflow)",
+        "note": "amortized --seeds sweep: train/kmeans seeds vary, ONE "
+                "shared stage-3 walk product; lane outputs verified "
+                "byte-identical to the sequential runs on the spot",
+    }
+
+
+def _batch_ab_hostonly_line(note) -> dict:
+    """The batch A/B's appearance in a --_hostonly round: measured live
+    when G2VEC_BENCH_BATCH_AB=1 (several minutes of children), else
+    relayed from the committed BENCH_BATCH_AB.json artifact (produced by
+    ``bench.py --_batch_ab``) with provenance, else an explicit honest
+    null naming the arming command."""
+    if os.environ.get("G2VEC_BENCH_BATCH_AB") == "1":
+        return _batch_ab_line(note)
+    art_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            BATCH_AB_ARTIFACT)
+    try:
+        with open(art_path) as f:
+            art = json.load(f)
+        line = dict(art["line"])
+        line["from_artifact"] = (
+            f"{BATCH_AB_ARTIFACT} (code_key {art.get('code_key')}; rerun "
+            f"'python bench.py --_batch_ab' to refresh)")
+        return line
+    except (OSError, ValueError, KeyError):
+        return {"metric": "batch_runs_per_hour", "value": None,
+                "unit": "runs/h", "vs_baseline": None,
+                "error": "no committed BENCH_BATCH_AB.json and "
+                         "G2VEC_BENCH_BATCH_AB unset; arm with "
+                         "'python bench.py --_batch_ab'"}
+
+
+def _batch_ab() -> None:
+    """Standalone mode: measure the batch A/B and (with
+    G2VEC_BENCH_BATCH_WRITE=1) refresh the committed artifact."""
+    def note(msg):
+        print(f"# {msg}", file=sys.stderr, flush=True)
+
+    line = _batch_ab_line(note)
+    print(json.dumps(line), flush=True)
+    if os.environ.get("G2VEC_BENCH_BATCH_WRITE") == "1":
+        repo = os.path.dirname(os.path.abspath(__file__))
+        with open(os.path.join(repo, BATCH_AB_ARTIFACT), "w") as f:
+            json.dump({"line": line, "code_key": _current_code_key(repo),
+                       "written_by": "bench.py --_batch_ab"}, f, indent=1)
+        note(f"wrote {BATCH_AB_ARTIFACT}")
 
 
 def _run_measure_child(budget: int, child_env: dict,
@@ -1611,5 +1795,7 @@ if __name__ == "__main__":
         _measure()
     elif "--_hostonly" in sys.argv:
         _hostonly()
+    elif "--_batch_ab" in sys.argv:
+        _batch_ab()
     else:
         main()

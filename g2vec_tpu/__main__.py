@@ -70,6 +70,15 @@ def main(argv=None) -> int:
         from g2vec_tpu.parallel.distributed import initialize
 
         initialize(cfg.coordinator, cfg.process_id, cfg.num_processes)
+    if cfg.manifest or cfg.batch_seeds:
+        # Batch engine: N manifest lanes as shape-bucketed batched device
+        # programs in THIS process (batch/engine.py). Validated
+        # incompatible with --distributed/--supervise/--fleet-size above,
+        # so the plain run path below never sees these flags.
+        from g2vec_tpu.batch.engine import run_batch
+
+        run_batch(cfg)
+        return 0
     from g2vec_tpu.pipeline import run
 
     try:

@@ -21,11 +21,145 @@ reproduce the degenerate behavior under ``compat_tiebreak=True``
 """
 from __future__ import annotations
 
-from typing import Dict, List, Sequence, Tuple
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
-from g2vec_tpu.ops.stats import dscores, minmax, tscores
+from g2vec_tpu.ops.stats import dscores, masked_minmax, minmax, tscores
+
+
+def freq_index(genes: Sequence[str], gene_freq: Dict[str, int]) -> np.ndarray:
+    """``gene_freq`` dict -> the dense [G] int32 vote vector (absent genes
+    default to 2 / "other", ref: G2Vec.py:172). Shared by the solo and
+    lane-batched stage-5 paths."""
+    return np.array([gene_freq.get(g, 2) for g in genes], dtype=np.int32)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _vote_counts(km_idx: jax.Array, freq_idx: jax.Array, k: int):
+    """Per-cluster [k] tallies: member count, good-majority members,
+    poor-majority members — the ONLY values the L-group vote needs, so
+    they are the only bytes that cross to the host (the [G] embeddings
+    and assignments stay on device)."""
+    onehot = jax.nn.one_hot(km_idx, k, dtype=jnp.int32)         # [G, k]
+    counts = onehot.sum(axis=0)
+    good = (onehot * (freq_idx == 0)[:, None]).sum(axis=0)
+    poor = (onehot * (freq_idx == 1)[:, None]).sum(axis=0)
+    return counts, good, poor
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _vote_counts_lanes(km: jax.Array, freq_stack: jax.Array, k: int):
+    """Per-lane vote tallies: [B, k] stacks of :func:`_vote_counts`."""
+    return jax.vmap(lambda a, b: _vote_counts(a, b, k))(km, freq_stack)
+
+
+def _pick_clusters(counts: np.ndarray, good_counts: np.ndarray,
+                   poor_counts: np.ndarray, k: int,
+                   compat_tiebreak: bool) -> Tuple[int, int]:
+    """The good/poor cluster vote on host ints (exact arithmetic on three
+    [k] vectors — the heavy [G] work stays on device).
+
+    Largest cluster = "other/init"; ties -> lowest cluster index, matching
+    the reference's strict-> scan (G2Vec.py:174-180).
+    """
+    largest = int(np.argmax(counts))
+    remaining = [i for i in range(k) if i != largest]
+    if compat_tiebreak:
+        # Reference bug: the vote always reads 0-0, and the strict '>' sends
+        # it down the else branch: good = second remaining, poor = first
+        # (ref: G2Vec.py:189-194 with gpDiff identically zero).
+        return remaining[1], remaining[0]
+    # Vote: the remaining cluster whose members the path-frequency
+    # majority marked good most strongly is "good", the one marked poor
+    # most strongly is "poor"; with k > 3 any further clusters fall to
+    # "other" below.
+    gp_diff = {i: int(good_counts[i]) - int(poor_counts[i])
+               for i in remaining}
+    good_cluster = max(remaining, key=lambda i: (gp_diff[i], i))
+    poor_cluster = min((i for i in remaining if i != good_cluster),
+                       key=lambda i: (gp_diff[i], -i))
+    return good_cluster, poor_cluster
+
+
+@partial(jax.jit, static_argnames=("k", "n_init", "iters"))
+def _kmeans_lanes(x: jax.Array, keys: jax.Array, k: int, n_init: int,
+                  iters: int):
+    """vmapped multi-restart k-means over a [B, G, H] lane stack.
+
+    Wrapped in its OWN jit so the batched executable caches on
+    shapes/statics like every other program here (a bare vmap-of-jit
+    re-traces per call); the compile is shared by the engine's warm and
+    the real stage-5 call.
+    """
+    from g2vec_tpu.ops.kmeans import kmeans
+
+    return jax.vmap(
+        lambda xx, kk: kmeans(xx, k, kk, n_init=n_init, iters=iters)
+    )(x, keys)
+
+
+@jax.jit
+def _renumber(km_idx: jax.Array, good: jax.Array, poor: jax.Array) -> jax.Array:
+    """Cluster labels -> {0: good, 1: poor, 2: other} (any extra k > 3
+    clusters fall to 2). Broadcasts over leading lane axes."""
+    return jnp.where(km_idx == good, 0,
+                     jnp.where(km_idx == poor, 1, 2)).astype(jnp.int32)
+
+
+def find_lgroups_device(embeddings, freq_idx: np.ndarray, *, key,
+                        k: int = 3, compat_tiebreak: bool = False,
+                        n_init: int = 10, iters: int = 50) -> jax.Array:
+    """:func:`find_lgroups` staying ON DEVICE end to end.
+
+    ``embeddings`` may be a device array (the trainer's snapshot slice) or
+    host numpy; the result is a device [G] int32 the caller materializes
+    only at the writer boundary. The former host round trip (np.asarray
+    before the jitted k-means, np.bincount/count_nonzero after) now moves
+    three [k]-int vectors instead of three [G]-sized arrays.
+    """
+    from g2vec_tpu.ops.kmeans import kmeans
+
+    if k < 3:
+        raise ValueError(f"find_lgroups needs k >= 3 (good/poor/other), got {k}")
+    km_idx, _, _ = kmeans(embeddings, k, key, n_init=n_init, iters=iters)
+    counts, good, poor = _vote_counts(km_idx, jnp.asarray(freq_idx), k)
+    good_cluster, poor_cluster = _pick_clusters(
+        np.asarray(counts), np.asarray(good), np.asarray(poor), k,
+        compat_tiebreak)
+    return _renumber(km_idx, good_cluster, poor_cluster)
+
+
+def find_lgroups_lanes(emb_stack, freq_stack: np.ndarray,
+                       kmeans_seeds: Sequence[int], *, k: int = 3,
+                       compat_tiebreak: bool = False, n_init: int = 10,
+                       iters: int = 50) -> jax.Array:
+    """Lane-batched stage 5: one vmapped k-means program over the [B, G, H]
+    embedding stack (every lane shares the gene axis, so the batched shape
+    is manifest-invariant), per-lane k-means keys, the host vote per lane
+    on the tiny [B, k] tallies, and a device [B, G] result.
+
+    Per-lane bitwise parity with :func:`find_lgroups_device` is the lane
+    contract (batched matmul/argmin/scan reproduce the per-example
+    programs on this backend; tests/test_batch_engine.py pins it through
+    the output files).
+    """
+    if k < 3:
+        raise ValueError(f"find_lgroups needs k >= 3 (good/poor/other), got {k}")
+    keys = jax.vmap(jax.random.key)(
+        jnp.asarray(list(kmeans_seeds), dtype=jnp.uint32))
+    km, _, _ = _kmeans_lanes(emb_stack, keys, k, n_init, iters)  # [B, G]
+    counts, good, poor = _vote_counts_lanes(km, jnp.asarray(freq_stack), k)
+    counts, good, poor = (np.asarray(counts), np.asarray(good),
+                          np.asarray(poor))
+    picks = np.array([_pick_clusters(counts[b], good[b], poor[b], k,
+                                     compat_tiebreak)
+                      for b in range(km.shape[0])], dtype=np.int32)
+    return _renumber(km, jnp.asarray(picks[:, 0:1]),
+                     jnp.asarray(picks[:, 1:2]))
 
 
 def find_lgroups(embeddings: np.ndarray, genes: Sequence[str],
@@ -36,45 +170,82 @@ def find_lgroups(embeddings: np.ndarray, genes: Sequence[str],
 
     ``gene_freq`` maps gene -> 0/1/2 as produced by path-frequency voting
     (ref: count_geneFreq, G2Vec.py:288-308); genes absent from it default to
-    2 (ref: G2Vec.py:172).
+    2 (ref: G2Vec.py:172). Host-convenience wrapper over
+    :func:`find_lgroups_device` (same bytes, one materialization).
     """
-    from g2vec_tpu.ops.kmeans import kmeans
+    return np.asarray(find_lgroups_device(
+        embeddings, freq_index(genes, gene_freq), key=key, k=k,
+        compat_tiebreak=compat_tiebreak, n_init=n_init, iters=iters))
 
-    if k < 3:
-        raise ValueError(f"find_lgroups needs k >= 3 (good/poor/other), got {k}")
-    km_idx, _, _ = kmeans(np.asarray(embeddings), k, key, n_init=n_init, iters=iters)
-    km_idx = np.asarray(km_idx)
-    freq_idx = np.array([gene_freq.get(g, 2) for g in genes], dtype=np.int32)
 
-    # Largest cluster = "other/init"; ties -> lowest cluster index, matching
-    # the reference's strict-> scan (G2Vec.py:174-180).
-    counts = np.bincount(km_idx, minlength=k)
-    largest = int(np.argmax(counts))
-    remaining = [i for i in range(k) if i != largest]
+def biomarker_scores_device(embeddings, expr_good, expr_poor, lgroup_idx,
+                            score_mix: float = 0.5) -> jax.Array:
+    """Mixed d/t gene scores for both L-groups, device-resident: a [2, G]
+    stack over the FULL gene axis (masked-minmax views instead of host
+    boolean gathers — ops/stats.py has the bitwise argument). Row 0 is the
+    good group's scores, row 1 the poor group's; positions outside a row's
+    L-group are rescaled garbage the host-side top-N never reads.
 
-    if compat_tiebreak:
-        # Reference bug: the vote always reads 0-0, and the strict '>' sends
-        # it down the else branch: good = second remaining, poor = first
-        # (ref: G2Vec.py:189-194 with gpDiff identically zero).
-        good_cluster, poor_cluster = remaining[1], remaining[0]
-    else:
-        # Vote: the remaining cluster whose members the path-frequency
-        # majority marked good most strongly is "good", the one marked poor
-        # most strongly is "poor"; with k > 3 any further clusters fall to
-        # "other" below.
-        gp_diff = {}
-        for i in remaining:
-            n_moregood = int(np.count_nonzero((km_idx == i) & (freq_idx == 0)))
-            n_morepoor = int(np.count_nonzero((km_idx == i) & (freq_idx == 1)))
-            gp_diff[i] = n_moregood - n_morepoor
-        good_cluster = max(remaining, key=lambda i: (gp_diff[i], i))
-        poor_cluster = min((i for i in remaining if i != good_cluster),
-                           key=lambda i: (gp_diff[i], -i))
+    Every op is the solo path's own jitted kernel called op-by-op (no
+    enclosing mega-jit): per-program fma contraction is what broke
+    bitwise parity in the trainer's fused fold, so stage 6 keeps each
+    arithmetic step in the exact program it always ran in.
+    """
+    d_full = dscores(embeddings)
+    t_full = tscores(expr_good, expr_poor)
+    rows = []
+    for group in (0, 1):
+        mask = lgroup_idx == group
+        d = masked_minmax(d_full, mask)
+        t = masked_minmax(t_full, mask)
+        rows.append(score_mix * d + (1.0 - score_mix) * t)
+    return jnp.stack(rows)
 
-    result = np.full(len(km_idx), 2, dtype=np.int32)
-    result[km_idx == good_cluster] = 0
-    result[km_idx == poor_cluster] = 1
-    return result
+
+def biomarker_scores_lanes(emb_stack, expr_good, expr_poor, lgroup_stack,
+                           score_mix: float = 0.5) -> jax.Array:
+    """Lane-batched :func:`biomarker_scores_device`: [B, 2, G] scores for
+    lanes SHARING one expression identity (the engine groups lanes by
+    subsample identity first — the t-score input must match the lane's
+    solo run). The t-scores are lane-invariant and computed ONCE through
+    the exact solo program; the per-lane d-score/minmax ops run batched
+    (bitwise per lane on this backend, pinned end to end by the engine
+    parity tests)."""
+    t_full = tscores(expr_good, expr_poor)          # [G], shared by lanes
+
+    def one(emb, lg):
+        d_full = dscores(emb)
+        rows = []
+        for group in (0, 1):
+            mask = lg == group
+            rows.append(score_mix * masked_minmax(d_full, mask)
+                        + (1.0 - score_mix) * masked_minmax(t_full, mask))
+        return jnp.stack(rows)
+
+    return jax.vmap(one)(emb_stack, lgroup_stack)
+
+
+def top_biomarkers(scores2: np.ndarray, lgroup_idx: np.ndarray,
+                   genes: np.ndarray, num_biomarker: int
+                   ) -> Tuple[List[str], Dict[str, np.ndarray]]:
+    """The host half of biomarker selection: top-N symbols per L-group from
+    the [2, G] score stack (ref sort semantics: G2Vec.py:104-109). ONE
+    definition shared by :func:`select_biomarkers` and the batch engine's
+    writer boundary, so a lane's list is selected by the byte-exact solo
+    logic."""
+    biomarkers: List[str] = []
+    detail: Dict[str, np.ndarray] = {}
+    for group in (0, 1):
+        mask = lgroup_idx == group
+        group_genes = genes[mask]
+        if group_genes.size == 0:
+            continue
+        scores = scores2[group][mask]
+        order = np.argsort(-scores, kind="stable")      # ties keep gene order
+        top = sorted(group_genes[order[:num_biomarker]].tolist())
+        biomarkers += top
+        detail["good" if group == 0 else "poor"] = scores
+    return sorted(biomarkers), detail
 
 
 def select_biomarkers(embeddings: np.ndarray, expr: np.ndarray,
@@ -92,29 +263,24 @@ def select_biomarkers(embeddings: np.ndarray, expr: np.ndarray,
     Final list = good block + poor block, sorted alphabetically again
     (ref: G2Vec.py:104-109).
 
+    ``embeddings`` and ``lgroup_idx`` may be device arrays (the pipeline
+    feeds the trainer snapshot and stage 5's device result straight
+    through); the scores and the L-group vector are materialized exactly
+    once, here at the selection boundary.
+
     Returns (biomarker list, per-group score dict for metrics/inspection).
     """
-    expr_good = expr[labels == 0]
-    expr_poor = expr[labels == 1]
-    biomarkers: List[str] = []
-    detail: Dict[str, np.ndarray] = {}
-    for group in (0, 1):
-        mask = lgroup_idx == group
-        group_genes = genes[mask]
-        if group_genes.size == 0:
-            continue
-        d = minmax(dscores(embeddings[mask]))
-        t = minmax(tscores(expr_good[:, mask], expr_poor[:, mask]))
-        scores = np.asarray(score_mix * d + (1.0 - score_mix) * t)
-        order = np.argsort(-scores, kind="stable")      # ties keep gene order
-        top = sorted(group_genes[order[:num_biomarker]].tolist())
-        biomarkers += top
-        detail["good" if group == 0 else "poor"] = scores
-    return sorted(biomarkers), detail
+    labels = np.asarray(labels)
+    scores2 = np.asarray(biomarker_scores_device(
+        embeddings, expr[labels == 0], expr[labels == 1], lgroup_idx,
+        score_mix))
+    return top_biomarkers(scores2, np.asarray(lgroup_idx), genes,
+                          num_biomarker)
 
 
 def warm_lgroups_compile(n_genes: int, hidden: int, *, k: int = 3,
-                         iters: int = 50, n_init: int = 10) -> bool:
+                         iters: int = 50, n_init: int = 10,
+                         lanes: int = 0) -> bool:
     """Compile (and once-execute) the k-means program find_lgroups will
     run at [n_genes, hidden].
 
@@ -126,13 +292,22 @@ def warm_lgroups_compile(n_genes: int, hidden: int, *, k: int = 3,
     keys on shapes/statics, never values, so stage 5's real call is a
     pure cache hit. Keep the statics in lockstep with find_lgroups's
     kmeans call or the warm compiles a program nobody uses.
-    """
-    import jax
 
+    ``lanes=B`` warms the batch engine's vmapped program instead — the
+    [B, n_genes, hidden] stack find_lgroups_lanes will run (the batched
+    stage-5 shape is manifest-invariant, so this warm is submitted the
+    moment the lane count is known, before any walk finishes).
+    """
     from g2vec_tpu.ops.kmeans import kmeans
 
-    x = np.zeros((n_genes, hidden), dtype=np.float32)
-    labels_d, _, _ = kmeans(x, k, jax.random.key(0), n_init=n_init,
-                            iters=iters)
+    if lanes:
+        x = np.zeros((lanes, n_genes, hidden), dtype=np.float32)
+        keys = jax.vmap(jax.random.key)(
+            jnp.zeros(lanes, dtype=jnp.uint32))
+        labels_d, _, _ = _kmeans_lanes(x, keys, k, n_init, iters)
+    else:
+        x = np.zeros((n_genes, hidden), dtype=np.float32)
+        labels_d, _, _ = kmeans(x, k, jax.random.key(0), n_init=n_init,
+                                iters=iters)
     jax.block_until_ready(labels_d)
     return True

@@ -1,0 +1,7 @@
+"""Batched multi-cohort execution engine (see batch/engine.py)."""
+from g2vec_tpu.batch.engine import (BatchResult, LaneVariant, ManifestError,
+                                    lane_config, load_manifest,
+                                    plan_variants, run_batch)
+
+__all__ = ["BatchResult", "LaneVariant", "ManifestError", "lane_config",
+           "load_manifest", "plan_variants", "run_batch"]

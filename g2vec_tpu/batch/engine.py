@@ -1,0 +1,694 @@
+"""Batched multi-cohort execution engine — runs/hour is the metric.
+
+The paper's workflow is never one run: biomarker discovery is validated
+by repeated runs over seeds and patient resamples, and after PR 3/4
+closed the single-run rooflines, N runs still cost N x — serial stages,
+re-paid compiles, the device idle between jobs. Throughput-first
+embedding systems (GraphVite, arXiv:1903.00757; HUGE's TPU-resident
+pipeline, arXiv:2307.14490) get their headline numbers by batching
+independent work into one device program and amortizing everything
+shared. This engine does that for whole pipeline runs:
+
+- A **manifest** enumerates variants of one base config — seeds, k-means
+  seeds, hyperparameters, patient subsamples (``--manifest`` JSON, or
+  ``--seeds N`` for the canonical amortized seed sweep).
+- The **lane planner** deduplicates everything content-identical across
+  variants: stages 1-2 run once; each distinct (expression identity,
+  group, walk seed) produces ONE stage-3 walk task on the PR 3 overlap
+  pool (lanes sharing a product split the bill; the sha256 disk tier
+  underneath still serves cross-run hits — cache.SharedWalkTier); each
+  lane's integration runs as a pool task the moment its two walk
+  products land.
+- Lanes whose realized trainer shapes and hyperparameters coincide form
+  a **shape bucket**, executed as ONE batched device program: the
+  chunked while_loop trainer vmapped over a lane axis (params/opt-state
+  ``[B, ...]``; per-lane early stop rides the select-mask machinery, so
+  a finished lane freezes without recompiling anything —
+  train/trainer.py ``train_cbow_lanes``). Bucket chunk programs warm
+  CONCURRENTLY on the pool while earlier buckets train — B distinct
+  shapes pay max(compile) wall, not sum.
+- Stages 5-6 batch across ALL lanes regardless of trainer bucketing
+  (the [B, genes, hidden] k-means stack is manifest-invariant):
+  vmapped k-means / t-scores / minmax, host top-N at the writer
+  boundary only (analysis.py lanes variants).
+
+Contract: every lane's three output files are BITWISE the files the
+same config produces through ``pipeline.run`` solo (float32, same
+backend) — ``lane_config`` builds that solo config, and
+tests/test_batch_engine.py holds the engine to it byte-for-byte.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import re
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from g2vec_tpu.config import G2VecConfig
+
+
+class ManifestError(ValueError):
+    """A malformed run manifest — names the offending variant and key."""
+
+
+#: Per-variant override keys a manifest may set; anything else is a typo
+#: the engine refuses to guess about.
+_VARIANT_KEYS = ("name", "seed", "train_seed", "kmeans_seed",
+                 "learningRate", "epoch", "patient_subsample",
+                 "subsample_seed")
+_NAME_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
+
+
+@dataclasses.dataclass(frozen=True)
+class LaneVariant:
+    """One manifest lane: the variant axes over the base config."""
+
+    index: int
+    name: str
+    seed: int
+    train_seed: int
+    kmeans_seed: int
+    learningRate: float
+    epoch: int
+    patient_subsample: float
+    subsample_seed: int
+
+    def fingerprint(self) -> str:
+        payload = json.dumps({k: getattr(self, k) for k in _VARIANT_KEYS},
+                             sort_keys=True)
+        return hashlib.sha256(payload.encode()).hexdigest()[:8]
+
+    def tag(self) -> str:
+        """The metrics ``lane`` field: manifest index + variant
+        fingerprint (utils/metrics.py bind_lane)."""
+        return f"{self.index}:{self.fingerprint()}"
+
+    def expr_key(self) -> Optional[Tuple[float, int]]:
+        """Expression identity: lanes sharing it see byte-identical
+        expression matrices (None = the full un-subsampled data)."""
+        if not self.patient_subsample:
+            return None
+        return (self.patient_subsample, self.subsample_seed)
+
+
+def _variant_from_dict(index: int, obj, cfg: G2VecConfig) -> LaneVariant:
+    if not isinstance(obj, dict):
+        raise ManifestError(
+            f"manifest variant {index} must be an object, got "
+            f"{type(obj).__name__}")
+    unknown = sorted(set(obj) - set(_VARIANT_KEYS))
+    if unknown:
+        raise ManifestError(
+            f"manifest variant {index} has unknown key(s) {unknown}; "
+            f"allowed: {sorted(_VARIANT_KEYS)}")
+
+    def _int(k, default, lo=0):
+        v = obj.get(k, default)
+        if not isinstance(v, int) or isinstance(v, bool) or v < lo:
+            raise ManifestError(
+                f"manifest variant {index}: {k!r} must be an int >= {lo}, "
+                f"got {v!r}")
+        return v
+
+    lr = obj.get("learningRate", cfg.learningRate)
+    if not isinstance(lr, (int, float)) or isinstance(lr, bool) or lr <= 0:
+        raise ManifestError(
+            f"manifest variant {index}: 'learningRate' must be > 0, "
+            f"got {lr!r}")
+    sub = obj.get("patient_subsample", cfg.patient_subsample)
+    if not isinstance(sub, (int, float)) or isinstance(sub, bool) \
+            or not (0.0 <= float(sub) <= 1.0):
+        raise ManifestError(
+            f"manifest variant {index}: 'patient_subsample' must be 0 "
+            f"(off) or in (0,1], got {sub!r}")
+    name = obj.get("name", f"lane{index}")
+    if not isinstance(name, str) or not _NAME_RE.match(name):
+        raise ManifestError(
+            f"manifest variant {index}: 'name' must match "
+            f"{_NAME_RE.pattern}, got {name!r}")
+    seed = _int("seed", cfg.seed)
+    return LaneVariant(
+        index=index, name=name, seed=seed,
+        train_seed=_int("train_seed",
+                        cfg.train_seed if cfg.train_seed is not None
+                        else seed),
+        kmeans_seed=_int("kmeans_seed", cfg.kmeans_seed),
+        learningRate=float(lr),
+        epoch=_int("epoch", cfg.epoch, lo=1),
+        patient_subsample=float(sub),
+        subsample_seed=_int("subsample_seed", cfg.subsample_seed))
+
+
+def load_manifest(path: str, cfg: G2VecConfig) -> List[LaneVariant]:
+    """Parse + validate a JSON manifest against the base config.
+
+    Format: a JSON LIST of variant objects (keys: ``_VARIANT_KEYS``;
+    every key optional, defaults come from the base config). Validation
+    failures raise :class:`ManifestError` naming the variant index and
+    key — a manifest typo must die before any walk samples.
+    """
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except OSError as e:
+        raise ManifestError(f"cannot read manifest {path!r}: {e}") from e
+    except ValueError as e:
+        raise ManifestError(f"manifest {path!r} is not valid JSON: {e}") from e
+    if not isinstance(doc, list) or not doc:
+        raise ManifestError(
+            f"manifest {path!r} must be a non-empty JSON list of variant "
+            f"objects, got {type(doc).__name__}")
+    variants = [_variant_from_dict(i, obj, cfg) for i, obj in enumerate(doc)]
+    names = [v.name for v in variants]
+    dupes = sorted({n for n in names if names.count(n) > 1})
+    if dupes:
+        raise ManifestError(
+            f"manifest {path!r} has duplicate variant name(s) {dupes} — "
+            f"lane outputs would overwrite each other")
+    return variants
+
+
+def seed_sweep_variants(cfg: G2VecConfig, n: int) -> List[LaneVariant]:
+    """The canonical amortized seed sweep (``--seeds N``): train/k-means
+    seeds vary per lane, the WALK seed stays the base config's — all N
+    lanes share one stage-3 product and re-train under fresh splits and
+    inits (the validation protocol's repeat-runs axis)."""
+    base_train = cfg.train_seed if cfg.train_seed is not None else cfg.seed
+    return [_variant_from_dict(
+        k, {"name": f"s{k}", "train_seed": base_train + k,
+            "kmeans_seed": cfg.kmeans_seed + k}, cfg)
+        for k in range(n)]
+
+
+def plan_variants(cfg: G2VecConfig) -> List[LaneVariant]:
+    """The run's lane list from whichever batch flag is set."""
+    if cfg.manifest and cfg.batch_seeds:
+        raise ManifestError("--manifest and --seeds are mutually exclusive")
+    if cfg.manifest:
+        return load_manifest(cfg.manifest, cfg)
+    if cfg.batch_seeds:
+        return seed_sweep_variants(cfg, cfg.batch_seeds)
+    raise ManifestError("batch engine needs --manifest or --seeds")
+
+
+def lane_config(cfg: G2VecConfig, v: LaneVariant) -> G2VecConfig:
+    """The SOLO config equivalent to lane ``v`` — the parity contract's
+    other side: ``pipeline.run(lane_config(cfg, v))`` must produce
+    byte-identical outputs to the engine's lane."""
+    lane = dataclasses.replace(
+        cfg, seed=v.seed, train_seed=v.train_seed,
+        kmeans_seed=v.kmeans_seed, learningRate=v.learningRate,
+        epoch=v.epoch, patient_subsample=v.patient_subsample,
+        subsample_seed=v.subsample_seed,
+        result_name=f"{cfg.result_name}.{v.name}",
+        manifest=None, batch_seeds=0, metrics_jsonl=None)
+    lane.validate()
+    return lane
+
+
+@dataclasses.dataclass
+class BatchResult:
+    """All lanes' results plus the batch-level attribution."""
+
+    lanes: List                       # per-lane pipeline.PipelineResult
+    variants: List[LaneVariant]
+    wall_seconds: float
+    runs_per_hour: float
+    walk_stats: Dict[str, int]        # memo_hits / disk_hits / walked
+    buckets: List[Dict]               # per-bucket {n_paths, lanes, mode}
+    stage_seconds: Dict[str, float]
+
+
+def run_batch(cfg: G2VecConfig,
+              console: Callable[[str], None] = print) -> BatchResult:
+    """Plan the manifest into lanes and execute them batched."""
+    import jax
+
+    from g2vec_tpu.analysis import (biomarker_scores_lanes, freq_index,
+                                    find_lgroups_lanes, top_biomarkers,
+                                    warm_lgroups_compile)
+    from g2vec_tpu.cache import (DEVICE_FAMILY, NATIVE_FAMILY,
+                                 SharedWalkTier, configure_xla_cache,
+                                 resolve_cache_tiers, walk_cache_key)
+    from g2vec_tpu.io.readers import (load_clinical, load_expression,
+                                      load_network)
+    from g2vec_tpu.io.writers import (write_biomarkers, write_lgroups,
+                                      write_vectors)
+    from g2vec_tpu.ops.backend import resolve_walker_backend
+    from g2vec_tpu.ops.graph import neighbor_table, thresholded_edges
+    from g2vec_tpu.ops.host_walker import resolve_sampler_threads
+    from g2vec_tpu.ops.walker import (count_gene_freq, generate_path_set,
+                                      integrate_path_sets)
+    from g2vec_tpu.parallel.mesh import make_mesh_context
+    from g2vec_tpu.parallel.overlap import OverlapScheduler
+    from g2vec_tpu.pipeline import PipelineResult, _background_warm
+    from g2vec_tpu.preprocess import (edges_to_indices, find_common_genes,
+                                      make_gene2idx, match_labels,
+                                      restrict_data, restrict_network,
+                                      subsample_patients)
+    from g2vec_tpu.resilience.faults import fault_point, install_plan
+    from g2vec_tpu.train.trainer import (LaneTrainSpec, train_cbow,
+                                         train_cbow_lanes,
+                                         warm_train_compile)
+    from g2vec_tpu.utils.metrics import MetricsWriter
+    from g2vec_tpu.utils.timing import StageTimer
+    import jax.numpy as jnp
+
+    cfg.validate()
+    variants = plan_variants(cfg)
+    n_lanes = len(variants)
+    if cfg.fault_plan:
+        install_plan(cfg.fault_plan)
+    xla_cache_dir, disk_walk_cache = resolve_cache_tiers(
+        cfg.cache_dir, cfg.compilation_cache, cfg.walk_cache)
+    configure_xla_cache(xla_cache_dir)
+    walk_tier = SharedWalkTier(disk=disk_walk_cache)
+
+    # A manifest run fans one result_name into 3N files — create the
+    # parent dirs up front (the metrics stream opens before stage 7).
+    for parent in {os.path.dirname(cfg.result_name),
+                   os.path.dirname(cfg.metrics_jsonl or "")}:
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+    timer = StageTimer()
+    metrics = MetricsWriter(cfg.metrics_jsonl)
+    lane_metrics = [metrics.bind_lane(v.tag()) for v in variants]
+    t_start = time.time()
+
+    console(">>> [batch] 0. Manifest")
+    console(f"    {n_lanes} lane(s) over base config "
+            f"{os.path.basename(cfg.expression_file)!r}; "
+            f"lanes/bucket cap {cfg.lanes}")
+    metrics.emit("batch_config", n_lanes=n_lanes, lanes_cap=cfg.lanes,
+                 variants=[dataclasses.asdict(v) for v in variants])
+    for v, lm in zip(variants, lane_metrics):
+        lm.emit("lane_variant", **dataclasses.asdict(v))
+
+    overlap = None
+    try:
+        console(">>> [batch] 1-2. Load + preprocess (shared)")
+        fault_point("load")
+        with timer.stage("load"):
+            data = load_expression(cfg.expression_file,
+                                   use_native=cfg.use_native_io)
+            clinical = load_clinical(cfg.clinical_file)
+            network = load_network(cfg.network_file)
+        fault_point("preprocess")
+        with timer.stage("preprocess"):
+            data.label = match_labels(clinical, data.sample)
+            common = find_common_genes(network.genes, data.gene)
+            network = restrict_network(network, common)
+            data = restrict_data(data, common)
+            gene2idx = make_gene2idx(data.gene)
+            src, dst = edges_to_indices(network, gene2idx)
+        n_genes = data.expr.shape[1]
+        n_edges = len(network.edges)
+        console(f"    n_genes {n_genes}, n_edges {n_edges}, "
+                f"n_samples {data.expr.shape[0]} (base)")
+
+        # Per-lane expression identity (subsample lanes fork rows; the
+        # gene axis — and therefore every device shape downstream of it —
+        # is manifest-invariant).
+        lane_data = {}
+        for v in variants:
+            ek = v.expr_key()
+            if ek not in lane_data:
+                lane_data[ek] = (data if ek is None else subsample_patients(
+                    data, v.patient_subsample, v.subsample_seed))
+
+        walker_backend = resolve_walker_backend(cfg)
+        sampler_threads = (resolve_sampler_threads(cfg.sampler_threads)
+                           if walker_backend == "native" else 0)
+        mesh_ctx = make_mesh_context(cfg.mesh_shape)
+        # Pool width: the walk tasks fan into the sampler's own range
+        # pool, so this bounds CONCURRENT tasks (walks, integrations,
+        # compile warms), not sampler threads.
+        overlap = OverlapScheduler(max_workers=max(4, min(8, n_lanes + 2)))
+
+        # Stage-5's batched shape is known NOW — warm the vmapped k-means
+        # before any walk finishes (it hides under stages 3-4 entirely).
+        warm_kmeans_lanes = min(n_lanes, cfg.lanes)
+        overlap.submit("warm_lgroups", _background_warm(
+            lambda: warm_lgroups_compile(
+                n_genes, cfg.sizeHiddenlayer, k=cfg.n_lgroups,
+                iters=cfg.kmeans_iters,
+                lanes=warm_kmeans_lanes if n_lanes > 1 else 0), console))
+
+        console(">>> [batch] 3. Plan + sample walks (amortized)")
+        fault_point("paths")
+        # ---- walk planning: one task per distinct product ----
+        edges_memo: Dict = {}          # (expr_key, group) -> (s, d, w)
+        walk_of_key: Dict[str, str] = {}      # cache key -> task name
+        lane_walks: List[List[str]] = [[] for _ in range(n_lanes)]
+        share_count: Dict[str, int] = {}
+        with timer.stage("walk_plan"):
+            for li, v in enumerate(variants):
+                ldata = lane_data[v.expr_key()]
+                for gi, group in enumerate(["g", "p"]):
+                    ekey = (v.expr_key(), gi)
+                    if ekey not in edges_memo:
+                        expr_group = ldata.expr[ldata.label == gi]
+                        edges_memo[ekey] = thresholded_edges(
+                            expr_group, src, dst,
+                            threshold=cfg.pcc_threshold)
+                    s_k, d_k, w_k = edges_memo[ekey]
+                    ckey = walk_cache_key(
+                        np.asarray(s_k), np.asarray(d_k), np.asarray(w_k),
+                        n_genes, len_path=cfg.lenPath,
+                        reps=cfg.numRepetition, seed=(v.seed << 1) | gi,
+                        family=(NATIVE_FAMILY if walker_backend == "native"
+                                else DEVICE_FAMILY))
+                    if ckey not in walk_of_key:
+                        task = f"walk:{group}:{ckey[:12]}"
+                        walk_of_key[ckey] = task
+                        share_count[task] = 0
+                        overlap.submit(task, _make_walk_task(
+                            cfg, np.asarray(s_k), np.asarray(d_k),
+                            np.asarray(w_k), n_genes,
+                            seed=(v.seed << 1) | gi,
+                            backend=walker_backend, tier=walk_tier,
+                            ckey=ckey, group=group, mesh_ctx=mesh_ctx,
+                            neighbor_table=neighbor_table,
+                            generate_path_set=generate_path_set))
+                    share_count[walk_of_key[ckey]] += 1
+                    lane_walks[li].append(walk_of_key[ckey])
+        n_walk_tasks = len(walk_of_key)
+        console(f"    {2 * n_lanes} lane-walks -> {n_walk_tasks} distinct "
+                f"product(s) on the pool "
+                f"({walker_backend}, {sampler_threads} sampler thread(s))")
+
+        # ---- per-lane integration, as walks land ----
+        def _integrate(li: int):
+            def fn():
+                ps = [overlap.result(n) for n in lane_walks[li]]
+                paths, labels = integrate_path_sets(ps[0], ps[1], n_genes,
+                                                    packed=True)
+                if paths.shape[0] < 2:
+                    raise ValueError(
+                        f"lane {variants[li].name!r}: fewer than 2 distinct "
+                        f"group-specific paths — the |PCC| > "
+                        f"{cfg.pcc_threshold:.2f} graphs are too sparse; "
+                        f"lower --pcc-threshold or raise -r")
+                gene_freq = count_gene_freq(paths, labels, data.gene,
+                                            packed=True)
+                return paths, labels, gene_freq
+            return fn
+
+        for li in range(n_lanes):
+            overlap.submit(f"integrate:{li}", _integrate(li),
+                           deps=lane_walks[li])
+
+        payloads: List = [None] * n_lanes
+        with timer.stage("paths"):
+            for name, result in overlap.as_completed(
+                    [f"integrate:{li}" for li in range(n_lanes)]):
+                li = int(name.split(":")[1])
+                payloads[li] = result
+                paths, labels, gene_freq = result
+                lane_metrics[li].emit(
+                    "paths", n_paths=int(paths.shape[0]),
+                    n_path_genes=len(gene_freq),
+                    walker_backend=walker_backend,
+                    sampler_threads=sampler_threads)
+        walk_stats = walk_tier.stats()
+        # Task-level dedup (lanes pointing at one product) is the third
+        # share tier: lane_shared counts lane-walks served by another
+        # lane's task, on top of the tier's memo/disk hits.
+        walk_stats["lane_shared"] = 2 * n_lanes - n_walk_tasks
+        metrics.emit("batch_walks", n_walk_tasks=n_walk_tasks,
+                     lane_walks=2 * n_lanes, **walk_stats)
+
+        # ---- shape buckets ----
+        console(">>> [batch] 4. Train (shape-bucketed lanes)")
+        fault_point("train")
+        buckets: Dict[Tuple, List[int]] = {}
+        for li, v in enumerate(variants):
+            bkey = (payloads[li][0].shape, v.learningRate, v.epoch)
+            buckets.setdefault(bkey, []).append(li)
+        # Deterministic order, capped chunks. A meshed run pins every
+        # bucket to the solo trainer (the vmapped lane program is
+        # single-device by contract — train_cbow_lanes docstring).
+        lane_cap = 1 if cfg.mesh_shape else cfg.lanes
+        bucket_list: List[Tuple[Tuple, List[int]]] = []
+        for bkey in sorted(buckets, key=lambda k: min(buckets[k])):
+            lis = sorted(buckets[bkey])
+            for lo in range(0, len(lis), lane_cap):
+                bucket_list.append((bkey, lis[lo:lo + lane_cap]))
+        console("    " + ", ".join(
+            f"bucket[{i}]: {len(lis)} lane(s) @ n_paths={bkey[0][0]}"
+            for i, (bkey, lis) in enumerate(bucket_list)))
+
+        # Warm every bucket's chunk program CONCURRENTLY on the pool: B
+        # distinct shapes pay max(compile) wall, not sum — the first
+        # bucket joins its warm immediately, later buckets' compiles hide
+        # under earlier buckets' training.
+        for bi, (bkey, lis) in enumerate(bucket_list):
+            shape, lr, epochs = bkey
+            n_paths_b = int(shape[0])
+            overlap.submit(f"warm_bucket:{bi}", _background_warm(
+                lambda n=n_paths_b, lr=lr, e=epochs, B=len(lis):
+                warm_train_compile(
+                    n, n_genes, hidden=cfg.sizeHiddenlayer,
+                    learning_rate=lr, max_epochs=e,
+                    val_fraction=cfg.val_fraction,
+                    decision_threshold=cfg.decision_threshold,
+                    compute_dtype=cfg.compute_dtype,
+                    param_dtype=cfg.param_dtype,
+                    fused_eval=cfg.fused_eval,
+                    epoch_superstep=cfg.epoch_superstep,
+                    donate=cfg.donate_state,
+                    lanes=B if B > 1 else 0), console))
+
+        lane_results: List = [None] * n_lanes
+        lane_emb: List = [None] * n_lanes     # device [G, hidden] each
+        bucket_report = []
+        with timer.stage("train"):
+            for bi, (bkey, lis) in enumerate(bucket_list):
+                shape, lr, epochs = bkey
+                join_warm = (lambda bi=bi:
+                             overlap.result(f"warm_bucket:{bi}"))
+                if len(lis) == 1:
+                    li = lis[0]
+                    v = variants[li]
+                    paths, labels, _ = payloads[li]
+                    lm = lane_metrics[li]
+                    res = train_cbow(
+                        paths, labels, packed_genes=n_genes,
+                        hidden=cfg.sizeHiddenlayer, learning_rate=lr,
+                        max_epochs=epochs, val_fraction=cfg.val_fraction,
+                        decision_threshold=cfg.decision_threshold,
+                        compute_dtype=cfg.compute_dtype,
+                        param_dtype=cfg.param_dtype, seed=v.train_seed,
+                        mesh_ctx=mesh_ctx,
+                        on_epoch=lambda s, av, at, secs, lm=lm: lm.emit(
+                            "epoch", step=s, acc_val=av, acc_tr=at,
+                            secs=secs),
+                        fused_eval=cfg.fused_eval,
+                        epoch_superstep=cfg.epoch_superstep,
+                        donate=cfg.donate_state,
+                        pre_compile_hook=join_warm)
+                    lane_results[li] = res
+                    if res.params is not None:
+                        lane_emb[li] = res.params.w_ih.astype(
+                            jnp.float32)[:n_genes]
+                    else:
+                        lane_emb[li] = res.w_ih
+                    mode = "solo"
+                else:
+                    specs = [LaneTrainSpec(paths=payloads[li][0],
+                                           labels=payloads[li][1],
+                                           seed=variants[li].train_seed)
+                             for li in lis]
+
+                    def on_epoch(lane_b, s, av, at, secs, lis=lis):
+                        lane_metrics[lis[lane_b]].emit(
+                            "epoch", step=s, acc_val=av, acc_tr=at,
+                            secs=secs)
+
+                    results, emb_stack = train_cbow_lanes(
+                        specs, packed_genes=n_genes,
+                        hidden=cfg.sizeHiddenlayer, learning_rate=lr,
+                        max_epochs=epochs, val_fraction=cfg.val_fraction,
+                        decision_threshold=cfg.decision_threshold,
+                        compute_dtype=cfg.compute_dtype,
+                        param_dtype=cfg.param_dtype, on_epoch=on_epoch,
+                        fused_eval=cfg.fused_eval,
+                        epoch_superstep=cfg.epoch_superstep,
+                        donate=cfg.donate_state,
+                        pre_compile_hook=join_warm)
+                    for b, li in enumerate(lis):
+                        lane_results[li] = results[b]
+                        lane_emb[li] = emb_stack[b]
+                    mode = "vmap"
+                bucket_report.append({"n_paths": int(shape[0]),
+                                      "lanes": len(lis), "mode": mode,
+                                      "learning_rate": lr,
+                                      "max_epochs": epochs})
+                for li in lis:
+                    r = lane_results[li]
+                    lane_metrics[li].emit(
+                        "train_done", stop_epoch=r.stop_epoch,
+                        acc_val=r.acc_val, acc_tr=r.acc_tr,
+                        stopped_early=r.stopped_early, bucket=bi,
+                        bucket_mode=mode)
+                    console(f"    [lane {variants[li].name}] "
+                            f"stop epoch {r.stop_epoch:3d}  "
+                            f"ACC[val]={r.acc_val:.4f}  "
+                            f"ACC[tr]={r.acc_tr:.4f}"
+                            + ("  (early)" if r.stopped_early else ""))
+
+        console(">>> [batch] 5. Find L-groups (vmapped across lanes)")
+        fault_point("lgroups")
+        overlap.result("warm_lgroups")
+        freq_stack = np.stack([freq_index(data.gene, payloads[li][2])
+                               for li in range(n_lanes)])
+        lgroup_host = [None] * n_lanes
+        lg_dev: List = [None] * n_lanes
+        with timer.stage("lgroups"):
+            for lo in range(0, n_lanes, cfg.lanes):
+                idx = list(range(lo, min(lo + cfg.lanes, n_lanes)))
+                if len(idx) == 1 and n_lanes == 1:
+                    from g2vec_tpu.analysis import find_lgroups_device
+
+                    lg = find_lgroups_device(
+                        lane_emb[idx[0]], freq_stack[idx[0]],
+                        key=jax.random.key(variants[idx[0]].kmeans_seed),
+                        k=cfg.n_lgroups,
+                        compat_tiebreak=cfg.compat_lgroup_tiebreak,
+                        iters=cfg.kmeans_iters)
+                    lg_dev[idx[0]] = lg
+                    continue
+                stack = jnp.stack([lane_emb[li] for li in idx])
+                lg = find_lgroups_lanes(
+                    stack, freq_stack[idx],
+                    [variants[li].kmeans_seed for li in idx],
+                    k=cfg.n_lgroups,
+                    compat_tiebreak=cfg.compat_lgroup_tiebreak,
+                    iters=cfg.kmeans_iters)
+                for b, li in enumerate(idx):
+                    lg_dev[li] = lg[b]
+
+        console(">>> [batch] 6. Select biomarkers (vmapped per cohort)")
+        fault_point("biomarkers")
+        scores_host = [None] * n_lanes
+        with timer.stage("biomarkers"):
+            by_expr: Dict = {}
+            for li, v in enumerate(variants):
+                by_expr.setdefault(v.expr_key(), []).append(li)
+            for ek, lis in by_expr.items():
+                ldata = lane_data[ek]
+                expr_good = ldata.expr[ldata.label == 0]
+                expr_poor = ldata.expr[ldata.label == 1]
+                for lo in range(0, len(lis), cfg.lanes):
+                    idx = lis[lo:lo + cfg.lanes]
+                    scores = biomarker_scores_lanes(
+                        jnp.stack([lane_emb[li] for li in idx]),
+                        expr_good, expr_poor,
+                        jnp.stack([lg_dev[li] for li in idx]),
+                        score_mix=cfg.score_mix)
+                    sh = np.asarray(scores)   # writer-boundary transfer
+                    for b, li in enumerate(idx):
+                        scores_host[li] = sh[b]
+
+        console(">>> [batch] 7. Save results (per lane)")
+        fault_point("save")
+        results: List[PipelineResult] = []
+        out_dir = os.path.dirname(cfg.result_name)
+        if out_dir:
+            os.makedirs(out_dir, exist_ok=True)
+        with timer.stage("save"):
+            for li, v in enumerate(variants):
+                lgroup_host[li] = np.asarray(lg_dev[li])
+                biomarkers, _ = top_biomarkers(
+                    scores_host[li], lgroup_host[li], data.gene,
+                    cfg.numBiomarker)
+                name = f"{cfg.result_name}.{v.name}"
+                emb_host = np.asarray(lane_emb[li])
+                outputs = [
+                    write_biomarkers(name, biomarkers),
+                    write_lgroups(name, lgroup_host[li], data.gene),
+                    write_vectors(name, emb_host, data.gene),
+                ]
+                r = lane_results[li]
+                ldata = lane_data[v.expr_key()]
+                results.append(PipelineResult(
+                    genes=data.gene, embeddings=emb_host,
+                    lgroup_idx=lgroup_host[li], biomarkers=biomarkers,
+                    output_files=outputs,
+                    n_samples=int(ldata.expr.shape[0]), n_genes=n_genes,
+                    n_edges=n_edges, n_paths=int(payloads[li][0].shape[0]),
+                    n_path_genes=len(payloads[li][2]),
+                    train_history=r.history, acc_val=r.acc_val,
+                    walker_backend=walker_backend,
+                    sampler_threads=sampler_threads))
+                lane_metrics[li].emit("done", outputs=outputs,
+                                      stop_epoch=r.stop_epoch)
+                for path in outputs:
+                    console(f"    {path}")
+
+        wall = time.time() - t_start
+        rph = n_lanes / wall * 3600.0
+        console(f"    [batch] {n_lanes} run(s) in {wall:.2f}s = "
+                f"{rph:.1f} runs/hour  "
+                f"(walks: {walk_stats['walked']} sampled, "
+                f"{walk_stats['lane_shared']} lane-shared, "
+                f"{walk_stats['disk_hits']} cache hits; "
+                f"buckets: {[b['lanes'] for b in bucket_report]})")
+        metrics.emit(
+            "done", n_lanes=n_lanes, wall_seconds=round(wall, 3),
+            runs_per_hour=round(rph, 2),
+            stop_epochs={variants[li].tag(): lane_results[li].stop_epoch
+                         for li in range(n_lanes)},
+            walk_stats=walk_stats, buckets=bucket_report,
+            stage_seconds=timer.as_dict())
+        return BatchResult(
+            lanes=results, variants=variants, wall_seconds=wall,
+            runs_per_hour=rph, walk_stats=walk_stats,
+            buckets=bucket_report, stage_seconds=timer.as_dict())
+    finally:
+        if overlap is not None:
+            overlap.close()
+        metrics.close()
+
+
+def _make_walk_task(cfg, s, d, w, n_genes, *, seed, backend, tier, ckey,
+                    group, mesh_ctx, neighbor_table, generate_path_set):
+    """One distinct walk product: tier lookup (in-process memo, then the
+    sha256-verified disk tier), else sample through the lane-shared
+    backend and store. Runs on the overlap pool; the native sampler fans
+    out into its own range pool exactly as in the solo pipeline."""
+
+    def task():
+        cached = tier.load(ckey)
+        if cached is not None:
+            return cached
+        if backend == "native":
+            from g2vec_tpu.ops.host_walker import generate_path_set_native
+
+            ps = generate_path_set_native(
+                s, d, w, n_genes, len_path=cfg.lenPath,
+                reps=cfg.numRepetition, seed=seed,
+                n_threads=cfg.sampler_threads)
+        else:
+            import jax
+
+            table = neighbor_table(s, d, w, n_genes)
+            # Matches the solo pipeline's stream: key(seed) folded by the
+            # group index — ``seed`` here is (lane_seed << 1) | group, so
+            # recover the fold the solo path applies.
+            ps = generate_path_set(
+                table, jax.random.fold_in(jax.random.key(seed >> 1),
+                                          seed & 1),
+                len_path=cfg.lenPath, reps=cfg.numRepetition,
+                walker_batch=cfg.walker_batch,
+                walker_hbm_budget=cfg.walker_hbm_budget,
+                mesh_ctx=mesh_ctx)
+        tier.store(ckey, ps, n_genes, meta={"group": group})
+        return ps
+
+    return task

@@ -150,6 +150,54 @@ class WalkCache:
         return art
 
 
+@dataclasses.dataclass
+class SharedWalkTier:
+    """An in-process memo stacked ABOVE the on-disk :class:`WalkCache`.
+
+    The batch engine (batch/engine.py) runs B manifest lanes in one
+    process; lanes whose walk inputs coincide — a seed sweep that varies
+    only train/k-means seeds shares BOTH groups' products, subsample
+    lanes share nothing — must pay each distinct product once and split
+    the bill. The memo holds this run's products by the same
+    content-addressed key the disk tier uses, so sharing needs no byte
+    verification (the object never left the process); the disk tier
+    underneath still serves cross-run hits and receives every store.
+    Accounting distinguishes the three outcomes (``memo_hits`` /
+    ``disk_hits`` / ``walked``) so the bench A/B can attribute its
+    speedup honestly.
+    """
+
+    disk: Optional[WalkCache] = None
+    memo: Dict[str, Set[bytes]] = dataclasses.field(default_factory=dict)
+    memo_hits: int = 0
+    disk_hits: int = 0
+    walked: int = 0
+
+    def load(self, key: str) -> Optional[Set[bytes]]:
+        hit = self.memo.get(key)
+        if hit is not None:
+            self.memo_hits += 1
+            return hit
+        if self.disk is not None:
+            hit = self.disk.load(key)
+            if hit is not None:
+                self.disk_hits += 1
+                self.memo[key] = hit
+                return hit
+        return None
+
+    def store(self, key: str, path_set: Set[bytes], n_genes: int,
+              meta: Optional[Dict] = None) -> None:
+        self.walked += 1
+        self.memo[key] = path_set
+        if self.disk is not None:
+            self.disk.store(key, path_set, n_genes, meta=meta)
+
+    def stats(self) -> Dict[str, int]:
+        return {"memo_hits": self.memo_hits, "disk_hits": self.disk_hits,
+                "walked": self.walked}
+
+
 def autotune_cache_path(cache_dir: Optional[str]) -> Optional[str]:
     """The kernel-autotune tier's record file under ``--cache-dir``.
 
@@ -163,6 +211,36 @@ def autotune_cache_path(cache_dir: Optional[str]) -> Optional[str]:
     if not cache_dir:
         return None
     return os.path.join(cache_dir, "autotune", "packed_matmul.json")
+
+
+def configure_xla_cache(xla_cache_dir: Optional[str]) -> None:
+    """Point jax's persistent compilation cache at ``xla_cache_dir``.
+
+    Extracted from the pipeline so the batch engine configures the tier
+    identically (jax imported inside — this module stays importable with
+    no backend). The reset dance: the persistent-cache object binds to
+    whatever config the FIRST compile saw — a different dir, or
+    (measured) NO dir at all — so enabling the cache after any uncached
+    compile is a silent no-op and changing --cache-dir mid-process keeps
+    writing the OLD location; reset so the next compile re-initializes
+    against the dir just configured.
+    """
+    if not xla_cache_dir:
+        return
+    import jax
+
+    prev_cache_dir = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", xla_cache_dir)
+    # Persist every program: a pipeline run compiles a bounded set of
+    # programs, so cache-write cost is trivial next to ANY compile.
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    if prev_cache_dir != xla_cache_dir:
+        try:
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception:  # noqa: BLE001 — private API; cache staying
+            pass           # stale only costs warm-run speed
 
 
 def resolve_cache_tiers(cache_dir: Optional[str],

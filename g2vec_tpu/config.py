@@ -56,6 +56,18 @@ class G2VecConfig:
 
     # ---- new framework flags ----
     seed: int = 0                    # global PRNG seed (reference is unseeded)
+    train_seed: Optional[int] = None  # trainer split/init seed; None = seed.
+                                     # Splitting it from the walk seed lets a
+                                     # validation sweep re-train under fresh
+                                     # splits/inits while REUSING one walk
+                                     # product (the batch engine's amortized
+                                     # seed sweep — batch/engine.py)
+    patient_subsample: float = 0.0   # fraction of patients kept per label
+                                     # class (stratified, seeded; 0 = off).
+                                     # The paper validates biomarkers over
+                                     # patient resamples; this makes one
+                                     # resample a first-class run config
+    subsample_seed: int = 0          # PRNG seed for --patient-subsample
     compat_lgroup_tiebreak: bool = False
     compute_dtype: str = "bfloat16"  # matmul dtype on TPU ("float32" for parity tests)
     param_dtype: str = "float32"
@@ -155,6 +167,24 @@ class G2VecConfig:
     fleet_straggler_factor: float = 0.0    # warn when a rank exceeds this
                                      # x median stage time (0 = off)
 
+    # ---- batch execution engine (batch/engine.py) ----
+    manifest: Optional[str] = None   # JSON run manifest: a list of variant
+                                     # objects (seed/train_seed/kmeans_seed/
+                                     # learningRate/epoch/patient_subsample/
+                                     # subsample_seed/name overrides of this
+                                     # base config); the engine plans them
+                                     # into shape-bucketed lanes and runs
+                                     # each bucket as one batched device
+                                     # program
+    batch_seeds: int = 0             # --seeds N: generate an N-variant
+                                     # seed-sweep manifest (train_seed and
+                                     # kmeans_seed vary, the WALK seed stays
+                                     # fixed so all lanes share one stage-3
+                                     # product; 0 = off)
+    lanes: int = 8                   # max lanes batched into one vmapped
+                                     # trainer program (a bucket larger than
+                                     # this splits into chunks)
+
     # ---- multi-host (parallel/distributed.py) ----
     distributed: bool = False        # join the multi-process JAX runtime
     coordinator: Optional[str] = None    # host:port of process 0 (or env/auto)
@@ -250,6 +280,30 @@ class G2VecConfig:
                     f"--fleet-size {self.fleet_size} cannot evenly host the "
                     f"{total}-device mesh {self.mesh_shape} "
                     f"({per} devices/rank)")
+        if not (0.0 <= self.patient_subsample <= 1.0):
+            raise ValueError(
+                f"patient_subsample must be 0 (off) or in (0,1], "
+                f"got {self.patient_subsample}")
+        if self.batch_seeds < 0:
+            raise ValueError(
+                f"--seeds must be >= 0, got {self.batch_seeds}")
+        if self.lanes < 1:
+            raise ValueError(f"--lanes must be >= 1, got {self.lanes}")
+        if self.manifest and self.batch_seeds:
+            raise ValueError(
+                "--manifest and --seeds are mutually exclusive (a manifest "
+                "already enumerates its variants)")
+        if self.manifest or self.batch_seeds:
+            for flag, name in ((self.distributed, "--distributed"),
+                               (self.fleet_size, "--fleet-size"),
+                               (self.supervise, "--supervise"),
+                               (self.checkpoint_dir, "--checkpoint-dir"),
+                               (self.resume, "--resume")):
+                if flag:
+                    raise ValueError(
+                        f"the batch engine (--manifest/--seeds) does not "
+                        f"compose with {name} yet — run lanes as separate "
+                        f"supervised jobs instead")
         if self.fault_plan:
             # Fail at config time with the offending token, not mid-run.
             from g2vec_tpu.resilience.faults import parse_plan
@@ -293,6 +347,39 @@ def build_parser() -> argparse.ArgumentParser:
                         version=f"%(prog)s {_version()}")
     parser.add_argument("--seed", type=int, default=0,
                         help="Global PRNG seed (the reference is unseeded).")
+    parser.add_argument("--train-seed", type=int, default=None,
+                        help="Trainer split/init seed (default: --seed). "
+                             "Decoupling it from the walk seed lets a "
+                             "validation sweep re-train under fresh splits "
+                             "while reusing one stage-3 walk product.")
+    parser.add_argument("--kmeans-seed", type=int, default=0,
+                        help="Stage-5 k-means seed (ref: random_state=0).")
+    parser.add_argument("--patient-subsample", type=float, default=0.0,
+                        metavar="FRAC",
+                        help="Keep this fraction of patients per label "
+                             "class (stratified, seeded by "
+                             "--subsample-seed; 0 = off). One patient "
+                             "resample as a first-class run config.")
+    parser.add_argument("--subsample-seed", type=int, default=0)
+    parser.add_argument("--manifest", type=str, default=None, metavar="JSON",
+                        help="Batch run manifest: a JSON list of variant "
+                             "objects (seed/train_seed/kmeans_seed/"
+                             "learningRate/epoch/patient_subsample/"
+                             "subsample_seed/name overrides of this base "
+                             "config). The batch engine plans the variants "
+                             "into shape-bucketed lanes and executes each "
+                             "bucket as one batched device program; every "
+                             "lane's outputs are bitwise identical to the "
+                             "same config run solo.")
+    parser.add_argument("--seeds", type=int, default=0, metavar="N",
+                        dest="batch_seeds",
+                        help="Generate an N-variant seed-sweep manifest "
+                             "(train_seed/kmeans_seed vary; the walk seed "
+                             "stays fixed so all lanes amortize one "
+                             "stage-3 walk product).")
+    parser.add_argument("--lanes", type=int, default=8, metavar="B",
+                        help="Max lanes batched into one vmapped trainer "
+                             "program (default 8); larger buckets split.")
     parser.add_argument("--pcc-threshold", type=float, default=0.5)
     parser.add_argument("--val-fraction", type=float, default=0.2)
     parser.add_argument("--compat-lgroup-tiebreak", action="store_true",
@@ -466,6 +553,13 @@ def config_from_args(argv=None) -> G2VecConfig:
         learningRate=args.learningRate,
         numBiomarker=args.numBiomarker,
         seed=args.seed,
+        train_seed=args.train_seed,
+        kmeans_seed=args.kmeans_seed,
+        patient_subsample=args.patient_subsample,
+        subsample_seed=args.subsample_seed,
+        manifest=args.manifest,
+        batch_seeds=args.batch_seeds,
+        lanes=args.lanes,
         pcc_threshold=args.pcc_threshold,
         val_fraction=args.val_fraction,
         compat_lgroup_tiebreak=args.compat_lgroup_tiebreak,

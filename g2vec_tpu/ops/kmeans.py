@@ -49,6 +49,30 @@ def _kmeanspp_init(x: jax.Array, k: int, key: jax.Array) -> jax.Array:
     return centers
 
 
+def _update_centers(onehot: jax.Array, x: jax.Array,
+                    centers: jax.Array) -> jax.Array:
+    """One Lloyd center update — with the EMPTY-CLUSTER path explicit.
+
+    A cluster with no members keeps its previous center VERBATIM (no
+    respawn, no perturbation — sklearn would relocate it; we deliberately
+    do not, to stay one data-independent compiled program). Empty
+    clusters arise systematically from degenerate inputs: with N <= k, or
+    with identical rows, k-means++'s all-zero-D^2 fallback
+    (:func:`_kmeanspp_init`) seeds DUPLICATE centers; ``argmin`` then
+    resolves the tie to the lowest cluster index, the higher-indexed
+    duplicates get zero members, and this ``where`` freezes them in
+    place. That behavior is a pinned contract
+    (tests/test_kmeans_lgroups.py degenerate-input battery): downstream
+    L-group renumbering tolerates empty clusters, and the frozen-center
+    choice keeps the program deterministic per seed.
+    """
+    counts = onehot.sum(axis=0)                         # [k]
+    sums = onehot.T @ x                                 # [k, d]
+    return jnp.where(counts[:, None] > 0,
+                     sums / jnp.maximum(counts, 1.0)[:, None],
+                     centers)
+
+
 def _lloyd(x: jax.Array, centers0: jax.Array, iters: int
            ) -> Tuple[jax.Array, jax.Array]:
     """Fixed-iteration Lloyd's algorithm; returns (centers, inertia)."""
@@ -58,11 +82,7 @@ def _lloyd(x: jax.Array, centers0: jax.Array, iters: int
         d2 = _pairwise_sq_dists(x, centers)             # [N, k]
         assign = jnp.argmin(d2, axis=1)                 # [N]
         onehot = jax.nn.one_hot(assign, k, dtype=x.dtype)   # [N, k]
-        counts = onehot.sum(axis=0)                     # [k]
-        sums = onehot.T @ x                             # [k, d]
-        new = jnp.where(counts[:, None] > 0, sums / jnp.maximum(counts, 1.0)[:, None],
-                        centers)                        # keep empty clusters put
-        return new, None
+        return _update_centers(onehot, x, centers), None
 
     centers, _ = jax.lax.scan(body, centers0, None, length=iters)
     d2 = _pairwise_sq_dists(x, centers)
@@ -77,7 +97,20 @@ def kmeans(x: jax.Array, k: int, key: jax.Array, n_init: int = 10,
 
     ``iters`` is a fixed budget rather than a tolerance check — data-independent
     control flow keeps the whole thing one compiled XLA program.
+
+    Degenerate inputs are defined behavior, pinned by regression tests
+    (tests/test_kmeans_lgroups.py): N <= k or all-identical rows seed
+    duplicate centers through k-means++'s all-zero-D^2 fallback
+    (``idx=0`` in :func:`_kmeanspp_init`); argmin ties assign members to
+    the LOWEST duplicate index, the other duplicates stay empty and keep
+    their center verbatim (:func:`_update_centers`). N == 0 is the one
+    rejected input — there is no point to seed from.
     """
+    if x.ndim != 2 or x.shape[0] < 1:
+        raise ValueError(
+            f"kmeans needs a non-empty [N, d] matrix, got shape {x.shape}")
+    if k < 1:
+        raise ValueError(f"kmeans needs k >= 1, got {k}")
     x = x.astype(jnp.float32)
     keys = jax.random.split(key, n_init)
     centers0 = jax.vmap(lambda kk: _kmeanspp_init(x, k, kk))(keys)

@@ -61,6 +61,31 @@ def minmax(scores: jax.Array, new_min: float = 0.0, new_max: float = 1.0) -> jax
 
 
 @jax.jit
+def masked_minmax(scores: jax.Array, mask: jax.Array,
+                  new_min: float = 0.0, new_max: float = 1.0) -> jax.Array:
+    """:func:`minmax` over the ``mask``-selected subset, WITHOUT gathering.
+
+    The device-resident stage-6 path (analysis.py) scores each L-group as
+    a masked view of the full gene axis instead of bouncing through a
+    host-side boolean gather: min/max are order-independent and exact, so
+    the masked reduction sees exactly the gathered subset's extrema, and
+    the rescale below is the same per-element expression :func:`minmax`
+    applies — masked positions therefore carry bitwise the values the
+    gathered call produced (pinned by the byte-golden e2e fixtures).
+    Unmasked positions are rescaled garbage the caller must never read;
+    an all-False mask or a constant subset degrades to all-new_min, the
+    same guard as :func:`minmax`.
+    """
+    old_min = jnp.min(jnp.where(mask, scores, jnp.inf))
+    old_max = jnp.max(jnp.where(mask, scores, -jnp.inf))
+    span = old_max - old_min
+    safe = jnp.where(span > 0.0, span, 1.0)
+    return jnp.where(span > 0.0,
+                     (new_max - new_min) / safe * (scores - old_min) + new_min,
+                     jnp.full_like(scores, new_min))
+
+
+@jax.jit
 def dscores(embeddings: jax.Array) -> jax.Array:
     """Row-wise L2 norm of embedding rows (ref: G2Vec.py:96)."""
     return jnp.sqrt(jnp.sum(embeddings * embeddings, axis=1))

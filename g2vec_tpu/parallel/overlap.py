@@ -71,6 +71,7 @@ class OverlapScheduler:
         self._tasks: Dict[str, _Task] = {}
         self._order: list = []
         self._lock = threading.Lock()
+        self._done_cv = threading.Condition()
 
     # ---- submission -------------------------------------------------------
 
@@ -109,6 +110,8 @@ class OverlapScheduler:
             if task.started_at is not None:
                 task.duration = time.perf_counter() - task.started_at
             task.done.set()
+            with self._done_cv:
+                self._done_cv.notify_all()
 
     # ---- joining ----------------------------------------------------------
 
@@ -128,6 +131,36 @@ class OverlapScheduler:
         if task.error is not None:
             raise task.error
         return task.result
+
+    def as_completed(self, names: Iterable[str]):
+        """Yield ``(name, result)`` over ``names`` in COMPLETION order —
+        the batch engine integrates each lane's walk product the moment
+        it lands instead of joining in submission order, so host-side
+        integration overlaps the pool's remaining sampling. Ties (several
+        tasks already done) yield in submission order for determinism. A
+        failed task re-raises its exception when reached, like
+        :meth:`result`; wait time is charged to the task yielded next
+        (the one the caller actually blocked for)."""
+        pending = list(names)
+        for n in pending:
+            if n not in self._tasks:
+                raise KeyError(f"unknown overlap task {n!r}")
+        index = {t.name: i for i, t in enumerate(self._order)}
+        t0 = time.perf_counter()
+        while pending:
+            ready = [n for n in pending if self._tasks[n].done.is_set()]
+            if not ready:
+                with self._done_cv:
+                    self._done_cv.wait(timeout=0.05)
+                continue
+            name = min(ready, key=index.__getitem__)
+            pending.remove(name)
+            task = self._tasks[name]
+            task.waited += time.perf_counter() - t0
+            t0 = time.perf_counter()
+            if task.error is not None:
+                raise task.error
+            yield name, task.result
 
     def drain(self, raise_errors: bool = True) -> None:
         """Join every submitted task (dependency-cancelled ones included —

@@ -107,7 +107,7 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
     # chance to set platform env vars (see __main__.py).
     import jax
 
-    from g2vec_tpu.analysis import find_lgroups, select_biomarkers
+    from g2vec_tpu.analysis import select_biomarkers
     from g2vec_tpu.io.readers import load_clinical, load_expression, load_network
     from g2vec_tpu.io.writers import write_biomarkers, write_lgroups, write_vectors
     from g2vec_tpu.ops.graph import neighbor_table, thresholded_edges
@@ -116,7 +116,8 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
     from g2vec_tpu.parallel.mesh import make_mesh_context
     from g2vec_tpu.preprocess import (edges_to_indices, find_common_genes,
                                       make_gene2idx, match_labels,
-                                      restrict_data, restrict_network)
+                                      restrict_data, restrict_network,
+                                      subsample_patients)
     from g2vec_tpu.train.trainer import train_cbow
     from g2vec_tpu.utils.metrics import MetricsWriter
     from g2vec_tpu.utils.timing import StageTimer
@@ -142,7 +143,8 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
                     straggler_factor=cfg.fleet_straggler_factor)
     if cfg.debug_nans:
         jax.config.update("jax_debug_nans", True)
-    from g2vec_tpu.cache import autotune_cache_path, resolve_cache_tiers
+    from g2vec_tpu.cache import (autotune_cache_path, configure_xla_cache,
+                                 resolve_cache_tiers)
 
     xla_cache_dir, walk_cache = resolve_cache_tiers(
         cfg.cache_dir, cfg.compilation_cache, cfg.walk_cache)
@@ -153,29 +155,10 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
         # would cache only this rank's shard under a full-set key. Keep
         # multi-process runs uncached until the tier learns rank scoping.
         walk_cache = None
-    if xla_cache_dir:
-        # Persistent XLA cache: a warm repeat run skips the compiles that
-        # dominate a cold pipeline's wall (the TPU acceptance run spends
-        # most of its train/lgroups/biomarkers stage time compiling).
-        prev_cache_dir = jax.config.jax_compilation_cache_dir
-        jax.config.update("jax_compilation_cache_dir", xla_cache_dir)
-        # Persist every program: a pipeline run compiles a bounded set of
-        # programs, so cache-write cost is trivial next to ANY compile.
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-        if prev_cache_dir != xla_cache_dir:
-            # The persistent-cache object binds to whatever config the
-            # FIRST compile saw — a different dir, or (measured) NO dir
-            # at all: enabling the cache after any uncached compile is a
-            # silent no-op, and changing --cache-dir mid-process (an
-            # in-process supervisor restart, test suites) keeps writing
-            # the OLD location. Reset so the next compile re-initializes
-            # against the dir just configured.
-            try:
-                from jax._src import compilation_cache as _cc
-
-                _cc.reset_cache()
-            except Exception:  # noqa: BLE001 — private API; cache staying
-                pass           # stale only costs warm-run speed
+    # Persistent XLA cache: a warm repeat run skips the compiles that
+    # dominate a cold pipeline's wall (the TPU acceptance run spends
+    # most of its train/lgroups/biomarkers stage time compiling).
+    configure_xla_cache(xla_cache_dir)
     if cfg.distributed:
         # Worker processes compute shards but neither narrate nor write:
         # transcript, metrics stream, profiler trace, and the three outputs
@@ -245,6 +228,14 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
         fleet.note_phase("preprocess")
         with timer.stage("preprocess"):
             data.label = match_labels(clinical, data.sample)
+            if cfg.patient_subsample:
+                n_before = data.expr.shape[0]
+                data = subsample_patients(data, cfg.patient_subsample,
+                                          cfg.subsample_seed)
+                console("    patient subsample: kept %d/%d samples "
+                        "(fraction=%.3f, seed=%d)"
+                        % (data.expr.shape[0], n_before,
+                           cfg.patient_subsample, cfg.subsample_seed))
             common = find_common_genes(network.genes, data.gene)
             network = restrict_network(network, common)
             data = restrict_data(data, common)
@@ -480,7 +471,8 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
                 max_epochs=cfg.epoch, val_fraction=cfg.val_fraction,
                 decision_threshold=cfg.decision_threshold,
                 compute_dtype=cfg.compute_dtype, param_dtype=cfg.param_dtype,
-                seed=cfg.seed, mesh_ctx=mesh_ctx, on_epoch=on_epoch,
+                seed=(cfg.seed if cfg.train_seed is None else cfg.train_seed),
+                mesh_ctx=mesh_ctx, on_epoch=on_epoch,
                 checkpoint_dir=cfg.checkpoint_dir, resume=cfg.resume,
                 checkpoint_every=cfg.checkpoint_every,
                 checkpoint_layout=cfg.checkpoint_layout,
@@ -511,9 +503,24 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
             overlap.result("warm_lgroups")
         fault_point("lgroups")
         fleet.note_phase("lgroups")
+        # Device residency through stages 5-6: the trainer snapshot's
+        # embedding table feeds k-means / t-scores / minmax WITHOUT the
+        # former host bounce (np.asarray before the jitted kmeans and
+        # back); only the tiny per-cluster vote tallies and the final
+        # lgroup/score vectors cross to the host, at the selection/writer
+        # boundary. A distributed run's snapshot may be host-gathered
+        # already (fetch_global) — result.w_ih is then the same bytes.
+        from g2vec_tpu.analysis import find_lgroups_device, freq_index
+
+        import jax.numpy as jnp
+
+        if result.params is not None and not cfg.distributed:
+            emb = result.params.w_ih.astype(jnp.float32)[:n_genes]
+        else:
+            emb = result.w_ih
         with timer.stage("lgroups"):
-            lgroup_idx = find_lgroups(
-                result.w_ih, data.gene, gene_freq,
+            lgroup_dev = find_lgroups_device(
+                emb, freq_index(data.gene, gene_freq),
                 key=jax.random.key(cfg.kmeans_seed), k=cfg.n_lgroups,
                 compat_tiebreak=cfg.compat_lgroup_tiebreak, iters=cfg.kmeans_iters)
         _stage_edge("lgroups")
@@ -523,8 +530,9 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
         fleet.note_phase("biomarkers")
         with timer.stage("biomarkers"):
             biomarkers, _ = select_biomarkers(
-                result.w_ih, data.expr, data.label, data.gene, lgroup_idx,
+                emb, data.expr, data.label, data.gene, lgroup_dev,
                 cfg.numBiomarker, score_mix=cfg.score_mix)
+            lgroup_idx = np.asarray(lgroup_dev)   # writer-boundary copy
         _stage_edge("biomarkers")
 
         console(">>> 7. Save results")

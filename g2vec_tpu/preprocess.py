@@ -70,6 +70,44 @@ def restrict_data(data: ExpressionData, common_genes: List[str]) -> ExpressionDa
     )
 
 
+def subsample_patients(data: ExpressionData, fraction: float,
+                       seed: int) -> ExpressionData:
+    """Keep a stratified, seeded ``fraction`` of patients per label class.
+
+    The paper's biomarker validation protocol repeats the pipeline over
+    patient resamples; this makes one resample a deterministic function of
+    (fraction, seed) so a manifest lane and a solo run agree byte-for-byte.
+    Per label class, ``max(2, round(fraction * n_class))`` patients are
+    kept (2 is the floor the ddof=1 t-score needs), chosen by a seeded
+    permutation of the class's positions in file order; the kept rows stay
+    in their original relative order, so downstream per-column statistics
+    see a pure row subset.
+    """
+    if data.label is None:
+        raise ValueError("subsample_patients needs matched labels "
+                         "(call match_labels first)")
+    if not (0.0 < fraction <= 1.0):
+        raise ValueError(f"subsample fraction must be in (0,1], got {fraction}")
+    rng = np.random.default_rng(seed)
+    keep = np.zeros(len(data.label), dtype=bool)
+    for cls in (0, 1):
+        pos = np.nonzero(data.label == cls)[0]
+        if pos.size < 2:
+            raise ValueError(
+                f"label class {cls} has only {pos.size} patient(s); "
+                f"cannot subsample")
+        n_keep = min(pos.size, max(2, int(round(fraction * pos.size))))
+        # One rng consumed in class order (0 then 1): deterministic and
+        # independent of the other class's size changing.
+        keep[np.sort(rng.permutation(pos)[:n_keep])] = True
+    return ExpressionData(
+        sample=data.sample[keep].copy(),
+        gene=data.gene,
+        expr=np.ascontiguousarray(data.expr[keep]),
+        label=data.label[keep].copy(),
+    )
+
+
 def make_gene2idx(genes: np.ndarray) -> Dict[str, int]:
     """Gene symbol -> global index (ref: G2Vec.py:414-418)."""
     return {g: i for i, g in enumerate(genes)}

@@ -120,6 +120,35 @@ def _tree_select(pred, on_true, on_false):
                         on_true, on_false)
 
 
+def _ensure_optbar_batching() -> None:
+    """Register a vmap batching rule for ``lax.optimization_barrier``.
+
+    The pinned jax 0.4.x ships none, and the lane-batched trainer vmaps
+    the fused chunk program — whose bitwise-parity contract rests on
+    exactly that barrier (the split views must stay opaque per lane, the
+    same isolation the solo program gets). The correct rule is the
+    identity one: barrier the batched operands as-is and pass the batch
+    dims through — an optimization barrier constrains scheduling, not
+    values, so batching it over a leading axis barriers a superset of
+    what the per-example programs barrier. No-op when a newer jax already
+    registered one.
+    """
+    try:
+        from jax._src.lax import lax as _lax_internal
+        from jax.interpreters import batching
+
+        p = _lax_internal.optimization_barrier_p
+        if p in batching.primitive_batchers:
+            return
+
+        def rule(args, dims):
+            return p.bind(*args), dims
+
+        batching.primitive_batchers[p] = rule
+    except Exception:  # noqa: BLE001 — private API; a drifted jax that
+        pass           # still lacks the rule fails loudly at vmap time
+
+
 #: Adam hyperparameters, TF1 defaults (ref: G2Vec.py:246). Fixed for the
 #: whole repo; only the learning rate is configurable.
 _ADAM_B1, _ADAM_B2, _ADAM_EPS = 0.9, 0.999, 1e-8
@@ -129,7 +158,7 @@ def _make_chunk_fn(learning_rate: float, compute_dtype,
                    decision_threshold: float, ctx: MeshContext, chunk: int,
                    packed: bool = False, interpret: bool = False,
                    fused: bool = True, superstep: int = 1,
-                   donate: bool = True):
+                   donate: bool = True, lanes: int = 0):
     """Compile a device-resident loop over up to ``chunk`` epochs.
 
     The reference syncs with the host three times per epoch (optimizer run +
@@ -507,6 +536,23 @@ def _make_chunk_fn(learning_rate: float, compute_dtype,
                 jnp.logical_or(stopped, dip), hist)
 
     fn = run_chunk_fused if fused else run_chunk
+    if lanes:
+        _ensure_optbar_batching()
+        # Lane batching (batch/engine.py): the SAME chunk program lifted
+        # over a leading lane axis on every argument — params/opt-state
+        # [B, ...], per-lane before/limit scalars [B], per-lane data
+        # blocks. vmap's while_loop batching runs the loop while ANY
+        # lane's cond holds and select-masks finished lanes' carries, so
+        # a lane that early-stops mid-bucket freezes bitwise while its
+        # peers keep training — the per-lane values are the solo
+        # program's exactly (measured bitwise on XLA:CPU: batched
+        # dot_general/reductions/scatters reproduce the per-example
+        # programs bit-for-bit; tests/test_batch_engine.py pins it
+        # end to end). A finished lane re-entering with limit=0 runs
+        # zero epochs and, in the fused path, masks its boundary eval
+        # with ``valid`` — the host keeps authoritative per-lane
+        # (before_val, before_tr) either way.
+        fn = jax.vmap(fn)
     return jax.jit(fn, donate_argnums=(0, 1, 2, 3) if donate else ())
 
 
@@ -562,18 +608,18 @@ def _lru_get(cache: "OrderedDict", key, limit: int, make):
 def _get_chunk_fn(learning_rate: float, compute_dtype, decision_threshold: float,
                   ctx: MeshContext, chunk: int, packed: bool = False,
                   interpret: bool = False, fused: bool = True,
-                  superstep: int = 1, donate: bool = True):
+                  superstep: int = 1, donate: bool = True, lanes: int = 0):
     # A packed program embeds its kernel tile plan at trace time: key on
     # the autotuner's install counter so a re-tune compiles fresh tiles
     # instead of silently serving the stale executable.
     key = (learning_rate, jnp.dtype(compute_dtype).name, decision_threshold,
            ctx.mesh, chunk, packed, interpret, fused, superstep, donate,
-           pm.tuned_token() if packed else 0)
+           lanes, pm.tuned_token() if packed else 0)
 
     def make():
         return _make_chunk_fn(learning_rate, compute_dtype,
                               decision_threshold, ctx, chunk, packed,
-                              interpret, fused, superstep, donate)
+                              interpret, fused, superstep, donate, lanes)
 
     return _lru_get(_CHUNK_FN_CACHE, key, _CHUNK_FN_CACHE_MAX, make)
 
@@ -604,6 +650,65 @@ def _pad_rows(arr: np.ndarray, n_rows: int) -> np.ndarray:
         return arr
     pad = np.zeros((n_rows - arr.shape[0],) + arr.shape[1:], dtype=arr.dtype)
     return np.concatenate([arr, pad], axis=0)
+
+
+def _split_indices(n_paths: int, seed: int, val_fraction: float):
+    """The shuffled 80/20 hold-out split (ref: G2Vec.py:219-226), seeded.
+
+    ONE definition shared by :func:`train_cbow` and the lane-batched
+    :func:`train_cbow_lanes` — a lane's split must be the byte-exact split
+    the same seed produces solo."""
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(n_paths)
+    pivot = int(n_paths * (1.0 - val_fraction))
+    if pivot in (0, n_paths):
+        raise ValueError(
+            f"val_fraction={val_fraction} leaves an empty split for "
+            f"{n_paths} paths")
+    return perm[:pivot], perm[pivot:]
+
+
+def _pack_split(paths: np.ndarray, labels: np.ndarray, idx: np.ndarray, *,
+                packed_genes: Optional[int], n_genes: int, n_genes_pad: int,
+                row_multiple: int, use_pallas: bool):
+    """Host-side packing of one split into the device layout.
+
+    The multi-hot crosses the host->device boundary as packed bits
+    (8 genes/byte) and — in the XLA path — is unpacked + cast on device: a
+    ~13x smaller transfer than shipping bf16, and no host-side ml_dtypes
+    cast of a third of a billion elements. In the pallas path it
+    additionally STAYS packed in HBM. Shared verbatim by train_cbow and
+    train_cbow_lanes (a lane's packed rows must be the solo run's bytes).
+    """
+    from g2vec_tpu.parallel.mesh import pad_to_multiple
+
+    n_rows = len(idx)
+    y = labels[idx].astype(np.float32).reshape(-1, 1)
+    n_pad = pad_to_multiple(n_rows, row_multiple)
+    w = _pad_rows(np.ones((n_rows, 1), np.float32), n_pad)
+    # Repack row chunks into the device layout; host temp memory stays
+    # bounded (one chunk of dense bools) even at pod-scale path counts.
+    packed = np.zeros((n_pad, n_genes_pad // 8), dtype=np.uint8)
+    if (packed_genes is not None and not use_pallas
+            and paths.shape[1] == n_genes_pad // 8):
+        # Input packbits layout == device layout (single-chip XLA path):
+        # no bit round-trip at all, just a row gather.
+        packed[:n_rows] = paths[idx]
+    else:
+        chunk_rows = 8192
+        for lo in range(0, n_rows, chunk_rows):
+            sel = idx[lo:lo + chunk_rows]
+            if packed_genes is not None:
+                rows = np.unpackbits(paths[sel], axis=1)[:, :n_genes] != 0
+            else:
+                rows = paths[sel] != 0
+            # One zeroed buffer provides the gene padding.
+            xb = np.zeros((len(sel), n_genes_pad), dtype=bool)
+            xb[:, :n_genes] = rows
+            packed[lo:lo + len(sel)] = (
+                pm.pack_blockwise(xb) if use_pallas
+                else np.packbits(xb, axis=1))
+    return packed, _pad_rows(y, n_pad), w
 
 
 _DTYPES = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}
@@ -752,13 +857,7 @@ def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
         n_paths, n_genes = paths.shape
 
     # ---- shuffled hold-out split (ref: G2Vec.py:219-226) ----
-    rng = np.random.default_rng(seed)
-    perm = rng.permutation(n_paths)
-    pivot = int(n_paths * (1.0 - val_fraction))
-    if pivot in (0, n_paths):
-        raise ValueError(
-            f"val_fraction={val_fraction} leaves an empty split for {n_paths} paths")
-    tr_idx, vl_idx = perm[:pivot], perm[pivot:]
+    tr_idx, vl_idx = _split_indices(n_paths, seed, val_fraction)
 
     # ---- shard-even padding (SPMD needs dims divisible by mesh axes) ----
     # Rows pad to a multiple of the data axis, the gene axis to a multiple of
@@ -767,8 +866,6 @@ def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
     # exactly zero gradient and are sliced off before returning. The whole
     # kernel/padding decision lives in _plan_layout — shared with
     # warm_train_compile, which must predict this run's programs exactly.
-    from g2vec_tpu.parallel.mesh import pad_to_multiple
-
     plan = _plan_layout(n_paths, n_genes, hidden, compute_dtype, ctx,
                         use_pallas)
     use_pallas = plan.use_pallas
@@ -779,38 +876,9 @@ def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
         unpack_fn = _get_unpack_fn(ctx, cdtype)
 
     def _pack_host(idx):
-        # The multi-hot crosses the host->device boundary as packed bits
-        # (8 genes/byte) and — in the XLA path — is unpacked + cast on
-        # device: a ~13x smaller transfer than shipping bf16, and no
-        # host-side ml_dtypes cast of a third of a billion elements. In the
-        # pallas path it additionally STAYS packed in HBM.
-        n_rows = len(idx)
-        y = labels[idx].astype(np.float32).reshape(-1, 1)
-        n_pad = pad_to_multiple(n_rows, row_multiple)
-        w = _pad_rows(np.ones((n_rows, 1), np.float32), n_pad)
-        # Repack row chunks into the device layout; host temp memory stays
-        # bounded (one chunk of dense bools) even at pod-scale path counts.
-        packed = np.zeros((n_pad, n_genes_pad // 8), dtype=np.uint8)
-        if (packed_genes is not None and not use_pallas
-                and paths.shape[1] == n_genes_pad // 8):
-            # Input packbits layout == device layout (single-chip XLA path):
-            # no bit round-trip at all, just a row gather.
-            packed[:n_rows] = paths[idx]
-        else:
-            chunk_rows = 8192
-            for lo in range(0, n_rows, chunk_rows):
-                sel = idx[lo:lo + chunk_rows]
-                if packed_genes is not None:
-                    rows = np.unpackbits(paths[sel], axis=1)[:, :n_genes] != 0
-                else:
-                    rows = paths[sel] != 0
-                # One zeroed buffer provides the gene padding.
-                xb = np.zeros((len(sel), n_genes_pad), dtype=bool)
-                xb[:, :n_genes] = rows
-                packed[lo:lo + len(sel)] = (
-                    pm.pack_blockwise(xb) if use_pallas
-                    else np.packbits(xb, axis=1))
-        return packed, _pad_rows(y, n_pad), w
+        return _pack_split(paths, labels, idx, packed_genes=packed_genes,
+                           n_genes=n_genes, n_genes_pad=n_genes_pad,
+                           row_multiple=row_multiple, use_pallas=use_pallas)
 
     def _put_x(packed_np):
         if use_pallas:
@@ -1035,6 +1103,217 @@ def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
                        history=history, params=snapshot)
 
 
+@dataclasses.dataclass
+class LaneTrainSpec:
+    """One lane's inputs for :func:`train_cbow_lanes` — the per-lane data
+    plus the split/init seed; every other hyperparameter is bucket-shared
+    (it is baked into the one batched program)."""
+    paths: np.ndarray
+    labels: np.ndarray
+    seed: int
+
+
+def train_cbow_lanes(lanes, *, packed_genes: Optional[int] = None,
+                     hidden: int, learning_rate: float, max_epochs: int,
+                     val_fraction: float = 0.2,
+                     decision_threshold: float = 0.5,
+                     compute_dtype: str = "float32",
+                     param_dtype: str = "float32",
+                     on_epoch: Optional[Callable[[int, int, float, float, float], None]] = None,
+                     fused_eval: bool = True, epoch_superstep: int = 1,
+                     donate: bool = True,
+                     pre_compile_hook: Optional[Callable[[], None]] = None,
+                     ):
+    """Train B same-shape CBOW lanes as ONE batched device program.
+
+    The batch engine's trainer half (batch/engine.py): ``lanes`` is a
+    bucket of :class:`LaneTrainSpec` whose path matrices share one shape
+    and whose hyperparameters are identical — only the (split, init) seed
+    and the data bits differ per lane. The chunk program is the solo
+    trainer's, lifted over a leading lane axis by ``jax.vmap``
+    (_make_chunk_fn ``lanes=B``): params/opt-state/snapshot carry
+    ``[B, ...]`` leaves, the while_loop runs while ANY lane is live, and
+    finished lanes freeze through vmap's select masking. Per-lane early
+    stop needs no recompile — a stopped lane re-enters later chunks with
+    ``limit=0`` and executes zero epochs.
+
+    Parity contract (tested end to end in tests/test_batch_engine.py):
+    in float32 on a given backend, every lane's history, early-stop
+    decision, stop epoch, and final embedding table are BITWISE the solo
+    :func:`train_cbow` run's at the same config — batched dot_general /
+    reductions / scatters on this backend reproduce the per-example
+    programs bit-for-bit, and the host-side split/pack/init code is
+    shared verbatim. Lanes always run the XLA (non-Pallas) path: the
+    batched program is shape-uniform across backends, and the parity
+    target is the solo XLA run.
+
+    Returns ``(results, emb_stack)``: per-lane :class:`TrainResult`s
+    (their ``w_ih`` are views of ONE stacked host transfer) and the
+    ``[B, n_genes, hidden]`` float32 embedding stack still ON DEVICE —
+    stage 5 consumes it without a host round trip (analysis.py).
+
+    ``on_epoch(lane, step, acc_val, acc_tr, secs)`` fires per lane per
+    epoch; ``secs`` is the chunk's wall divided by the epochs the whole
+    bucket executed in it (per-lane wall is not separable inside one
+    batched dispatch).
+    """
+    B = len(lanes)
+    if B < 1:
+        raise ValueError("train_cbow_lanes needs at least one lane")
+    if epoch_superstep < 1:
+        raise ValueError(
+            f"epoch_superstep must be >= 1, got {epoch_superstep}")
+    if compute_dtype not in _DTYPES or param_dtype not in _DTYPES:
+        raise ValueError(
+            f"dtypes must be one of {sorted(_DTYPES)}, got "
+            f"{compute_dtype!r}/{param_dtype!r}")
+    shapes = {spec.paths.shape for spec in lanes}
+    if len(shapes) != 1:
+        raise ValueError(
+            f"train_cbow_lanes is one shape bucket: all lanes must share "
+            f"one path-matrix shape, got {sorted(shapes)}")
+    if lanes[0].paths.shape[0] < 2:
+        raise ValueError(
+            f"need at least 2 paths to split, got {lanes[0].paths.shape[0]}")
+    ctx = make_mesh_context(None)
+    cdtype = _DTYPES[compute_dtype]
+    pdtype = _DTYPES[param_dtype]
+    if packed_genes is not None:
+        n_paths, nb_in = lanes[0].paths.shape
+        n_genes = packed_genes
+        if nb_in != (n_genes + 7) // 8 or lanes[0].paths.dtype != np.uint8:
+            raise ValueError(
+                f"packed_genes={n_genes} expects uint8 paths of width "
+                f"{(n_genes + 7) // 8}, got {lanes[0].paths.dtype} width "
+                f"{nb_in}")
+    else:
+        n_paths, n_genes = lanes[0].paths.shape
+
+    plan = _plan_layout(n_paths, n_genes, hidden, compute_dtype, ctx,
+                        use_pallas=False)
+    n_genes_pad, row_multiple = plan.n_genes_pad, plan.row_multiple
+    unpack_fn = _get_unpack_fn(ctx, cdtype)
+    fused = bool(fused_eval)
+
+    def _unpack_stack(stack: np.ndarray):
+        # [B, rows, nb] uint8 -> [B, rows, G_pad] compute dtype, via the
+        # SAME jitted unpack program the solo path uses (flattened over
+        # the lane axis — bit expansion is elementwise, values exact).
+        b, rows, nb = stack.shape
+        dense = unpack_fn(ctx.put(stack.reshape(b * rows, nb),
+                                  ctx.batch_spec))
+        return dense.reshape(b, rows, nb * 8)
+
+    # ---- per-lane split + pack (the solo code, per lane), then stack ----
+    packed_tr, y_tr, w_tr = [], [], []
+    packed_vl, y_vl, w_vl = [], [], []
+    for spec in lanes:
+        tr_idx, vl_idx = _split_indices(n_paths, spec.seed, val_fraction)
+        p, y, w = _pack_split(spec.paths, spec.labels, tr_idx,
+                              packed_genes=packed_genes, n_genes=n_genes,
+                              n_genes_pad=n_genes_pad,
+                              row_multiple=row_multiple, use_pallas=False)
+        packed_tr.append(p), y_tr.append(y), w_tr.append(w)
+        p, y, w = _pack_split(spec.paths, spec.labels, vl_idx,
+                              packed_genes=packed_genes, n_genes=n_genes,
+                              n_genes_pad=n_genes_pad,
+                              row_multiple=row_multiple, use_pallas=False)
+        packed_vl.append(p), y_vl.append(y), w_vl.append(w)
+    ytr = ctx.put(np.stack(y_tr), None)
+    wtr = ctx.put(np.stack(w_tr), None)
+    yval = ctx.put(np.stack(y_vl), None)
+    wval = ctx.put(np.stack(w_vl), None)
+    if fused:
+        xall = _unpack_stack(np.concatenate(
+            [np.stack(packed_tr), np.stack(packed_vl)], axis=1))
+        data = (xall, ytr, wtr, yval, wval)
+    else:
+        data = (_unpack_stack(np.stack(packed_tr)), ytr, wtr,
+                _unpack_stack(np.stack(packed_vl)), yval, wval)
+
+    # ---- stacked params + optimizer state ----
+    per_lane = [init_params(jax.random.key(spec.seed), n_genes, hidden,
+                            param_dtype=pdtype, pad_to=n_genes_pad)
+                for spec in lanes]
+    params = CBOWParams(w_ih=jnp.stack([p.w_ih for p in per_lane]),
+                        w_ho=jnp.stack([p.w_ho for p in per_lane]))
+    tx = optax.adam(learning_rate, b1=_ADAM_B1, b2=_ADAM_B2, eps=_ADAM_EPS)
+    opt_state = jax.vmap(tx.init)(params)
+
+    chunk = max(1, min(DEFAULT_CHUNK, max_epochs))
+    superstep = max(1, min(epoch_superstep, chunk))
+    if pre_compile_hook is not None:
+        pre_compile_hook()
+    chunk_fn = _get_chunk_fn(learning_rate, cdtype, decision_threshold, ctx,
+                             chunk, packed=False, interpret=False,
+                             fused=fused, superstep=superstep,
+                             donate=donate, lanes=B)
+
+    snapshot = jax.tree.map(jnp.copy, params) if donate else params
+    hist_dev = jnp.zeros((B, chunk, 3), jnp.float32)
+
+    # ---- per-lane host bookkeeping (authoritative across chunks) ----
+    step = np.zeros(B, dtype=np.int64)
+    alive = np.ones(B, dtype=bool)
+    stopped = np.zeros(B, dtype=bool)
+    before_val = np.full(B, -1.0, dtype=np.float32)
+    before_tr = np.full(B, -1.0, dtype=np.float32)
+    stop_epoch = np.full(B, max_epochs - 1, dtype=np.int64)
+    histories: List[List[dict]] = [[] for _ in range(B)]
+    t0 = time.time()
+    while alive.any():
+        limits = np.where(alive,
+                          np.minimum(chunk, max_epochs - step),
+                          0).astype(np.int32)
+        (params, opt_state, snapshot, bv_d, bt_d, count_d, dip_d, hist_dev
+         ) = chunk_fn(params, opt_state, snapshot, hist_dev,
+                      jnp.asarray(before_val), jnp.asarray(before_tr),
+                      jnp.asarray(limits), *data)
+        counts = np.asarray(count_d)             # one host sync per chunk
+        dips = np.asarray(dip_d)
+        bv, bt = np.asarray(bv_d), np.asarray(bt_d)
+        hist = np.asarray(jax.device_get(hist_dev))
+        wall = time.time() - t0
+        t0 = time.time()
+        secs = wall / max(int(counts[alive].sum()), 1)
+        for b in np.nonzero(alive)[0]:
+            c = int(counts[b])
+            # A finished lane's device-side (before_val, before_tr) may be
+            # scribbled by the unfused backfill on later limit=0 chunks —
+            # the HOST copy is only refreshed while the lane is alive.
+            before_val[b], before_tr[b] = float(bv[b]), float(bt[b])
+            for j in range(c):
+                av, at, ls = (float(hist[b, j, 0]), float(hist[b, j, 1]),
+                              float(hist[b, j, 2]))
+                histories[b].append(
+                    {"epoch": int(step[b]) + j, "acc_val": av,
+                     "acc_tr": at, "loss": ls, "secs": secs})
+                if on_epoch is not None:
+                    on_epoch(int(b), int(step[b]) + j, av, at, secs)
+            step[b] += c
+            if dips[b]:
+                stopped[b] = True
+                alive[b] = False
+                stop_epoch[b] = step[b] - 2      # dip epoch minus one
+            elif step[b] >= max_epochs:
+                alive[b] = False
+
+    # ONE stacked device cast/slice; the single host transfer below is the
+    # writer-boundary materialization every lane shares.
+    emb_stack = snapshot.w_ih.astype(jnp.float32)[:, :n_genes]
+    emb_host = np.asarray(emb_stack)
+    results = []
+    for b in range(B):
+        results.append(TrainResult(
+            w_ih=emb_host[b], stop_epoch=int(stop_epoch[b]),
+            stopped_early=bool(stopped[b]),
+            acc_val=float(before_val[b]), acc_tr=float(before_tr[b]),
+            history=histories[b],
+            params=CBOWParams(w_ih=snapshot.w_ih[b],
+                              w_ho=snapshot.w_ho[b])))
+    return results, emb_stack
+
+
 def warm_train_compile(n_paths: int, n_genes: int, *, hidden: int,
                        learning_rate: float, max_epochs: int,
                        val_fraction: float = 0.2,
@@ -1047,7 +1326,8 @@ def warm_train_compile(n_paths: int, n_genes: int, *, hidden: int,
                        use_pallas: Optional[bool] = None,
                        fused_eval: bool = True, epoch_superstep: int = 1,
                        donate: bool = True, kernel_autotune: bool = False,
-                       autotune_cache_path: Optional[str] = None) -> bool:
+                       autotune_cache_path: Optional[str] = None,
+                       lanes: int = 0) -> bool:
     """Compile the chunk (and unpack) programs train_cbow will run at
     these shapes, without training anything.
 
@@ -1081,7 +1361,7 @@ def warm_train_compile(n_paths: int, n_genes: int, *, hidden: int,
     from g2vec_tpu.parallel.mesh import pad_to_multiple
 
     plan = _plan_layout(n_paths, n_genes, hidden, compute_dtype, ctx,
-                        use_pallas)
+                        False if lanes else use_pallas)
     chunk = checkpoint_every if checkpoint_dir else DEFAULT_CHUNK
     chunk = max(1, min(chunk, max_epochs))
     superstep = max(1, min(epoch_superstep, chunk))
@@ -1098,18 +1378,28 @@ def warm_train_compile(n_paths: int, n_genes: int, *, hidden: int,
     chunk_fn = _get_chunk_fn(learning_rate, cdtype, decision_threshold, ctx,
                              chunk, packed=plan.use_pallas,
                              interpret=plan.interpret, fused=fused,
-                             superstep=superstep, donate=donate)
+                             superstep=superstep, donate=donate,
+                             lanes=lanes)
+
+    def _stack(x):
+        # lanes > 0 warms the vmapped bucket program: every argument gains
+        # a leading [B] axis (values are irrelevant — the jit executable
+        # cache keys on shapes/dtypes/shardings only).
+        return jnp.broadcast_to(x[None], (lanes,) + x.shape) + 0 \
+            if lanes else x
 
     def dummy_x(n_pad):
         packed = np.zeros((n_pad, plan.n_genes_pad // 8), dtype=np.uint8)
         if plan.use_pallas:
-            return ctx.put(packed, ctx.packed_batch_spec)
-        return _get_unpack_fn(ctx, cdtype)(ctx.put(packed, ctx.batch_spec))
+            return _stack(ctx.put(packed, ctx.packed_batch_spec))
+        return _stack(_get_unpack_fn(ctx, cdtype)(
+            ctx.put(packed, ctx.batch_spec)))
 
     def dummy_yw(n_rows, n_pad):
-        return (ctx.put(np.zeros((n_pad, 1), np.float32), ctx.label_spec),
-                ctx.put(_pad_rows(np.ones((n_rows, 1), np.float32), n_pad),
-                        ctx.label_spec))
+        return (_stack(ctx.put(np.zeros((n_pad, 1), np.float32),
+                               ctx.label_spec)),
+                _stack(ctx.put(_pad_rows(np.ones((n_rows, 1), np.float32),
+                                         n_pad), ctx.label_spec)))
 
     if fused:
         data = (dummy_x(tr_pad + val_pad),
@@ -1124,7 +1414,11 @@ def warm_train_compile(n_paths: int, n_genes: int, *, hidden: int,
         params = CBOWParams(w_ih=ctx.put(params.w_ih, ctx.w_ih_spec),
                             w_ho=ctx.put(params.w_ho, ctx.w_ho_spec))
     tx = optax.adam(learning_rate, b1=0.9, b2=0.999, eps=1e-8)
-    opt_state = tx.init(params)
+    if lanes:
+        params = jax.tree.map(_stack, params)
+        opt_state = jax.vmap(tx.init)(params)
+    else:
+        opt_state = tx.init(params)
     from jax.sharding import PartitionSpec as P
 
     if ctx.mesh is not None:
@@ -1135,7 +1429,14 @@ def warm_train_compile(n_paths: int, n_genes: int, *, hidden: int,
     # Donation wants distinct buffers per donated argument (params is
     # reused as the snapshot here).
     snapshot = jax.tree.map(jnp.copy, params) if donate else params
-    hist = ctx.put(np.zeros((chunk, 3), np.float32), P())
-    out = chunk_fn(params, opt_state, snapshot, hist, -1.0, -1.0, 0, *data)
+    hist = ctx.put(np.zeros(((lanes, chunk, 3) if lanes else (chunk, 3)),
+                            np.float32), P())
+    if lanes:
+        zero = (np.full(lanes, -1.0, np.float32),
+                np.full(lanes, -1.0, np.float32),
+                np.zeros(lanes, np.int32))
+    else:
+        zero = (-1.0, -1.0, 0)
+    out = chunk_fn(params, opt_state, snapshot, hist, *zero, *data)
     jax.block_until_ready(out[5])      # the epoch count — compile is done
     return True

@@ -49,8 +49,32 @@ class MetricsWriter:
                 self._fout.close()
                 self._fout = None
 
+    def bind_lane(self, lane: str) -> "LaneMetrics":
+        """A view of this writer that stamps every event with ``lane`` —
+        the batch engine gives each manifest lane one, so B interleaving
+        runs stay per-run parseable inside ONE chronological JSONL stream
+        (filter on the ``lane`` field; events without it are batch-scoped).
+        ``lane`` is '<manifest index>:<variant fingerprint>'."""
+        return LaneMetrics(self, lane)
+
     def __enter__(self) -> "MetricsWriter":
         return self
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+class LaneMetrics:
+    """A lane-bound emit() facade over a shared :class:`MetricsWriter`.
+
+    Deliberately NOT a subclass and NOT closable: the engine owns the
+    writer's lifecycle; lanes only decorate events. Thread-safety is the
+    writer's (lanes may emit from overlap-pool threads).
+    """
+
+    def __init__(self, writer: MetricsWriter, lane: str):
+        self._writer = writer
+        self.lane = lane
+
+    def emit(self, event: str, **fields) -> None:
+        self._writer.emit(event, lane=self.lane, **fields)

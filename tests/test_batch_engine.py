@@ -1,0 +1,350 @@
+"""Batch engine (batch/engine.py): per-lane BITWISE parity vs solo runs,
+per-lane early stop, manifest validation, and walk share-vs-rewalk
+accounting.
+
+The engine's whole contract is that batching is a pure wall-clock
+optimization: every lane's three output files must be byte-for-byte the
+files ``pipeline.run(lane_config(cfg, v))`` writes solo (float32, same
+backend). These tests hold it to that through every batching tier —
+vmapped trainer buckets, vmapped k-means/scores, shared walk products,
+subsample cohorts."""
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from g2vec_tpu.config import G2VecConfig
+
+pytestmark = pytest.mark.batch
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def tsv_paths(tmp_path_factory):
+    from g2vec_tpu.data.synthetic import SyntheticSpec, write_synthetic_tsv
+
+    spec = SyntheticSpec(n_good=24, n_poor=20, module_size=12,
+                         n_background=24, n_expr_only=4, n_net_only=4,
+                         module_chords=2, background_edges=40, seed=7)
+    out = tmp_path_factory.mktemp("syn")
+    return write_synthetic_tsv(spec, str(out))
+
+
+def _cfg(tsv_paths, tmp_path, **overrides):
+    defaults = dict(
+        expression_file=tsv_paths["expression"],
+        clinical_file=tsv_paths["clinical"],
+        network_file=tsv_paths["network"],
+        result_name=os.path.join(str(tmp_path), "batch", "out"),
+        lenPath=8, numRepetition=2, sizeHiddenlayer=16, epoch=30,
+        learningRate=0.05, numBiomarker=5, compute_dtype="float32",
+        kmeans_iters=50, seed=0, walker_backend="device",
+    )
+    defaults.update(overrides)
+    return G2VecConfig(**defaults)
+
+
+def _assert_lane_parity(cfg, res, tmp_path, sub=""):
+    """Every lane's files == the solo twin's files, byte for byte."""
+    from g2vec_tpu.batch.engine import lane_config
+    from g2vec_tpu.pipeline import run as solo_run
+
+    os.makedirs(os.path.join(str(tmp_path), f"solo{sub}"), exist_ok=True)
+    for v, lane in zip(res.variants, res.lanes):
+        solo_cfg = lane_config(dataclasses.replace(
+            cfg, manifest=None, batch_seeds=0, cache_dir=None,
+            metrics_jsonl=None,
+            result_name=os.path.join(str(tmp_path), f"solo{sub}", "out")), v)
+        sr = solo_run(solo_cfg, console=lambda s: None)
+        assert len(lane.output_files) == len(sr.output_files) == 3
+        for fa, fb in zip(lane.output_files, sr.output_files):
+            with open(fa, "rb") as a, open(fb, "rb") as b:
+                assert a.read() == b.read(), \
+                    f"lane {v.name!r}: {fa} differs from solo {fb}"
+        yield v, lane, sr
+
+
+def test_seed_sweep_bitwise_parity_and_walk_sharing(tsv_paths, tmp_path):
+    """The headline path: an amortized seed sweep — ONE walk product pair
+    shared by every lane, one vmapped trainer bucket — and every lane
+    byte-identical to its solo twin."""
+    from g2vec_tpu.batch.engine import run_batch
+
+    cfg = _cfg(tsv_paths, tmp_path, batch_seeds=4)
+    res = run_batch(cfg, console=lambda s: None)
+    assert len(res.lanes) == 4
+    # Walk amortization: 8 lane-walks collapse to the 2 group products.
+    assert res.walk_stats["walked"] == 2
+    assert res.walk_stats["lane_shared"] == 6
+    # One shape bucket, vmapped (same walks -> same n_paths for all).
+    assert len(res.buckets) == 1
+    assert res.buckets[0]["lanes"] == 4
+    assert res.buckets[0]["mode"] == "vmap"
+    solos = list(_assert_lane_parity(cfg, res, tmp_path))
+    # The sweep actually varies: not all lanes produced identical vectors.
+    vec_bytes = {open(lane.output_files[2], "rb").read()
+                 for _, lane, _ in solos}
+    assert len(vec_bytes) == 4
+
+
+def test_per_lane_early_stop_matches_solo(tsv_paths, tmp_path):
+    """Lanes stop at DIFFERENT epochs inside one vmapped bucket; each
+    lane's stop epoch, accuracies, and history length are the solo
+    run's."""
+    from g2vec_tpu.batch.engine import run_batch
+
+    cfg = _cfg(tsv_paths, tmp_path, batch_seeds=4)
+    res = run_batch(cfg, console=lambda s: None)
+    stops = []
+    for v, lane, solo in _assert_lane_parity(cfg, res, tmp_path, sub="es"):
+        assert len(lane.train_history) == len(solo.train_history)
+        assert [h["acc_val"] for h in lane.train_history] \
+            == [h["acc_val"] for h in solo.train_history]
+        assert lane.acc_val == solo.acc_val
+        stops.append(len(lane.train_history))
+    # The point of per-lane masking: the bucket is NOT lockstep.
+    assert len(set(stops)) > 1, f"want differing stop epochs, got {stops}"
+
+
+def test_subsample_variants_parity_and_buckets(tsv_paths, tmp_path):
+    """Patient-subsample lanes re-walk their own cohort (distinct
+    products), may land in different shape buckets, and still match
+    their solo twins byte-for-byte."""
+    from g2vec_tpu.batch.engine import run_batch
+
+    manifest = [
+        {"name": "full", "train_seed": 1},
+        {"name": "subA", "patient_subsample": 0.8, "subsample_seed": 3},
+        {"name": "subB", "patient_subsample": 0.8, "subsample_seed": 9,
+         "learningRate": 0.03},
+    ]
+    mpath = str(tmp_path / "manifest.json")
+    with open(mpath, "w") as f:
+        json.dump(manifest, f)
+    cfg = _cfg(tsv_paths, tmp_path, manifest=mpath)
+    res = run_batch(cfg, console=lambda s: None)
+    # Each distinct cohort walked its own two group products.
+    assert res.walk_stats["walked"] == 6
+    assert sum(b["lanes"] for b in res.buckets) == 3
+    list(_assert_lane_parity(cfg, res, tmp_path, sub="sub"))
+
+
+def test_walk_cache_share_vs_rewalk_accounting(tsv_paths, tmp_path):
+    """share-vs-rewalk over the three tiers: task dedup within a run,
+    disk hits across runs, honest 'walked' when seeds force a rewalk."""
+    from g2vec_tpu.batch.engine import run_batch
+
+    cache = str(tmp_path / "cache")
+    cfg = _cfg(tsv_paths, tmp_path, batch_seeds=3, cache_dir=cache)
+    cold = run_batch(cfg, console=lambda s: None)
+    assert cold.walk_stats == {"memo_hits": 0, "disk_hits": 0, "walked": 2,
+                               "lane_shared": 4}
+    warm = run_batch(cfg, console=lambda s: None)
+    assert warm.walk_stats["walked"] == 0
+    assert warm.walk_stats["disk_hits"] == 2
+    for la, lb in zip(cold.lanes, warm.lanes):
+        for fa, fb in zip(la.output_files, lb.output_files):
+            with open(fa, "rb") as a, open(fb, "rb") as b:
+                assert a.read() == b.read()
+    # A walk-seed variant cannot share: it must rewalk BOTH its products.
+    mpath = str(tmp_path / "rewalk.json")
+    with open(mpath, "w") as f:
+        json.dump([{"name": "base"}, {"name": "other", "seed": 5}], f)
+    mixed = run_batch(
+        _cfg(tsv_paths, tmp_path, manifest=mpath, cache_dir=cache,
+             result_name=str(tmp_path / "rw" / "out")),
+        console=lambda s: None)
+    assert mixed.walk_stats["disk_hits"] == 2     # base lane, from run 1
+    assert mixed.walk_stats["walked"] == 2        # seed-5 lane, fresh
+
+
+def test_manifest_validation_errors(tsv_paths, tmp_path):
+    from g2vec_tpu.batch.engine import ManifestError, load_manifest
+
+    cfg = _cfg(tsv_paths, tmp_path)
+
+    def write(doc):
+        p = str(tmp_path / "m.json")
+        with open(p, "w") as f:
+            json.dump(doc, f)
+        return p
+
+    with pytest.raises(ManifestError, match="unknown key.*learning_rate"):
+        load_manifest(write([{"learning_rate": 0.1}]), cfg)
+    with pytest.raises(ManifestError, match="variant 1.*train_seed"):
+        load_manifest(write([{}, {"train_seed": -1}]), cfg)
+    with pytest.raises(ManifestError, match="learningRate.*> 0"):
+        load_manifest(write([{"learningRate": 0}]), cfg)
+    with pytest.raises(ManifestError, match="patient_subsample"):
+        load_manifest(write([{"patient_subsample": 1.5}]), cfg)
+    with pytest.raises(ManifestError, match="non-empty JSON list"):
+        load_manifest(write({"variants": []}), cfg)
+    with pytest.raises(ManifestError, match="duplicate variant name"):
+        load_manifest(write([{"name": "a"}, {"name": "a"}]), cfg)
+    with pytest.raises(ManifestError, match="'name' must match"):
+        load_manifest(write([{"name": "bad name!"}]), cfg)
+    with pytest.raises(ManifestError, match="cannot read"):
+        load_manifest(str(tmp_path / "missing.json"), cfg)
+    bad = str(tmp_path / "bad.json")
+    with open(bad, "w") as f:
+        f.write("{not json")
+    with pytest.raises(ManifestError, match="not valid JSON"):
+        load_manifest(bad, cfg)
+
+
+def test_batch_flags_config_validation(tsv_paths, tmp_path):
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        _cfg(tsv_paths, tmp_path, manifest="m.json",
+             batch_seeds=2).validate()
+    with pytest.raises(ValueError, match="--lanes"):
+        _cfg(tsv_paths, tmp_path, batch_seeds=2, lanes=0).validate()
+    with pytest.raises(ValueError, match="does not compose"):
+        _cfg(tsv_paths, tmp_path, batch_seeds=2, supervise=True).validate()
+    with pytest.raises(ValueError, match="does not compose"):
+        _cfg(tsv_paths, tmp_path, batch_seeds=2,
+             checkpoint_dir="/tmp/x").validate()
+    with pytest.raises(ValueError, match="patient_subsample"):
+        _cfg(tsv_paths, tmp_path, patient_subsample=1.5).validate()
+
+
+def test_cli_flags_reach_config():
+    from g2vec_tpu.config import config_from_args
+
+    cfg = config_from_args([
+        "E", "C", "N", "R", "--seeds", "4", "--lanes", "3",
+        "--train-seed", "9", "--kmeans-seed", "2",
+        "--patient-subsample", "0.5", "--subsample-seed", "11"])
+    assert (cfg.batch_seeds, cfg.lanes, cfg.train_seed, cfg.kmeans_seed,
+            cfg.patient_subsample, cfg.subsample_seed) == (4, 3, 9, 2,
+                                                           0.5, 11)
+
+
+def test_lane_metrics_jsonl_parseable(tsv_paths, tmp_path):
+    """B interleaving lanes in ONE JSONL stream stay per-run parseable
+    through the lane field; the done event reports per-lane stop
+    epochs."""
+    from g2vec_tpu.batch.engine import run_batch
+
+    mj = str(tmp_path / "metrics.jsonl")
+    cfg = _cfg(tsv_paths, tmp_path, batch_seeds=3, metrics_jsonl=mj)
+    res = run_batch(cfg, console=lambda s: None)
+    with open(mj) as f:
+        events = [json.loads(line) for line in f]
+    assert events, "no metrics emitted"
+    seqs = [e["seq"] for e in events]
+    assert seqs == sorted(seqs)
+    tags = {v.tag() for v in res.variants}
+    lane_events = [e for e in events if "lane" in e]
+    assert {e["lane"] for e in lane_events} == tags
+    for tag in tags:
+        kinds = {e["event"] for e in lane_events if e["lane"] == tag}
+        assert {"lane_variant", "paths", "epoch", "train_done",
+                "done"} <= kinds
+    done = [e for e in events if e["event"] == "done" and "lane" not in e]
+    assert len(done) == 1
+    assert set(done[0]["stop_epochs"]) == tags
+    assert done[0]["runs_per_hour"] > 0
+    # Per-lane stop epochs in the done event match the train_done events.
+    for e in lane_events:
+        if e["event"] == "train_done":
+            assert done[0]["stop_epochs"][e["lane"]] == e["stop_epoch"]
+
+
+def test_train_cbow_lanes_unit_parity():
+    """Unit-level: the vmapped lane trainer is bitwise the solo trainer
+    per lane — embeddings, history, early-stop decisions — across the
+    fused/unfused, superstep, and donate modes."""
+    from g2vec_tpu.train.trainer import (LaneTrainSpec, train_cbow,
+                                         train_cbow_lanes)
+
+    n_paths, n_genes, hidden = 50, 68, 16
+
+    def make_lane(s):
+        r = np.random.default_rng(100 + s)
+        dense = r.random((n_paths, n_genes)) < 0.15
+        labels = r.integers(0, 2, n_paths).astype(np.int32)
+        return np.packbits(dense, axis=1), labels
+
+    specs = [LaneTrainSpec(*make_lane(k), seed=seed)
+             for k, seed in enumerate([3, 7, 11])]
+    for modes in ({}, {"fused_eval": False, "epoch_superstep": 4,
+                       "donate": False}):
+        solo = [train_cbow(sp.paths, sp.labels, packed_genes=n_genes,
+                           hidden=hidden, learning_rate=0.05,
+                           max_epochs=40, compute_dtype="float32",
+                           param_dtype="float32", seed=sp.seed, **modes)
+                for sp in specs]
+        results, emb = train_cbow_lanes(
+            specs, packed_genes=n_genes, hidden=hidden, learning_rate=0.05,
+            max_epochs=40, compute_dtype="float32", param_dtype="float32",
+            **modes)
+        assert np.asarray(emb).shape == (3, n_genes, hidden)
+        for s, l in zip(solo, results):
+            assert np.array_equal(s.w_ih, l.w_ih)
+            assert s.stop_epoch == l.stop_epoch
+            assert s.stopped_early == l.stopped_early
+            assert [h["loss"] for h in s.history] \
+                == [h["loss"] for h in l.history]
+
+
+def test_masked_minmax_matches_gathered_minmax(rng):
+    from g2vec_tpu.ops.stats import masked_minmax, minmax
+
+    x = rng.normal(size=200).astype(np.float32)
+    mask = rng.random(200) < 0.3
+    got = np.asarray(masked_minmax(x, mask))[mask]
+    want = np.asarray(minmax(x[mask]))
+    assert np.array_equal(got, want)
+    # Degenerate guards: constant subset and empty mask -> all new_min.
+    const = np.full(8, 3.3, np.float32)
+    assert np.all(np.asarray(masked_minmax(const, np.ones(8, bool))) == 0.0)
+    assert np.all(np.asarray(
+        masked_minmax(const, np.zeros(8, bool))) == 0.0)
+
+
+def test_bench_batch_ab_smoke():
+    """bench.py --_batch_ab at ultra-toy scale emits a real
+    batch_runs_per_hour line whose on-the-spot bit-identity check
+    passed (the A/B's honesty gate: a speedup that changed any lane's
+    bytes would be reported as bit_identical=false)."""
+    env = {**os.environ, "G2VEC_BENCH_BATCH_VARIANTS": "2",
+           "G2VEC_BENCH_BATCH_REPS": "1", "G2VEC_BENCH_BATCH_EPOCHS": "5"}
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--_batch_ab"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert proc.returncode == 0, proc.stderr[-800:]
+    lines = [json.loads(ln) for ln in proc.stdout.splitlines()
+             if ln.strip().startswith("{")]
+    assert len(lines) == 1
+    line = lines[0]
+    assert line["metric"] == "batch_runs_per_hour"
+    assert line["value"] and line["value"] > 0
+    assert line["bit_identical"] is True
+    assert line["lanes"] == 2
+    assert line["walk_stats"]["lane_shared"] == 2
+
+
+def test_subsample_patients_stratified_and_deterministic(tsv_paths):
+    from g2vec_tpu.io.readers import (load_clinical, load_expression)
+    from g2vec_tpu.preprocess import match_labels, subsample_patients
+
+    data = load_expression(tsv_paths["expression"], use_native=False)
+    data.label = match_labels(load_clinical(tsv_paths["clinical"]),
+                              data.sample)
+    sub1 = subsample_patients(data, 0.5, seed=3)
+    sub2 = subsample_patients(data, 0.5, seed=3)
+    assert np.array_equal(sub1.expr, sub2.expr)
+    assert np.array_equal(sub1.sample, sub2.sample)
+    for cls in (0, 1):
+        n_cls = int((data.label == cls).sum())
+        want = min(n_cls, max(2, int(round(0.5 * n_cls))))
+        assert int((sub1.label == cls).sum()) == want
+    other = subsample_patients(data, 0.5, seed=4)
+    assert not np.array_equal(sub1.sample, other.sample)
+    with pytest.raises(ValueError, match="fraction"):
+        subsample_patients(data, 0.0, seed=0)

@@ -69,6 +69,86 @@ def test_find_lgroups_compat_ignores_vote(rng, key):
     assert np.all(lg_compat[member == 0] == 2)  # "other" unaffected by the bug
 
 
+class TestKmeansDegenerateInputs:
+    """Regression pins for k-means on degenerate inputs (the batch
+    engine's subsample lanes can legally shrink a group to a handful of
+    rows). The CONTRACT (ops/kmeans.py): k-means++'s all-zero-D^2
+    fallback seeds duplicate centers when N <= k or rows are identical;
+    argmin ties resolve to the lowest duplicate index, the other
+    duplicates stay empty and keep their center verbatim
+    (_update_centers). These tests pin that behavior so any future
+    empty-cluster 'fix' has to change them consciously."""
+
+    def test_identical_rows_collapse_to_cluster_zero(self, key):
+        from g2vec_tpu.ops.kmeans import kmeans
+
+        x = np.ones((17, 4), dtype=np.float32) * 2.5
+        labels, centers, inertia = kmeans(x, 3, key, n_init=3, iters=10)
+        labels, centers = np.asarray(labels), np.asarray(centers)
+        # All-zero D^2 -> every center is row 0's point; ties -> cluster 0.
+        assert np.all(labels == 0)
+        assert np.allclose(centers, 2.5)
+        assert float(inertia) == 0.0
+
+    def test_fewer_points_than_clusters(self, key):
+        from g2vec_tpu.ops.kmeans import kmeans
+
+        x = np.array([[0.0, 0.0], [10.0, 10.0]], dtype=np.float32)
+        labels, centers, inertia = kmeans(x, 3, key, n_init=4, iters=10)
+        labels = np.asarray(labels)
+        # Both points are exact centers of their own cluster; the third
+        # (duplicate-seeded) cluster is empty.
+        assert labels.shape == (2,)
+        assert set(labels.tolist()) <= {0, 1, 2}
+        assert labels[0] != labels[1]
+        assert float(inertia) == 0.0
+        assert np.all(np.isfinite(np.asarray(centers)))
+
+    def test_n_equals_k(self, key):
+        from g2vec_tpu.ops.kmeans import kmeans
+
+        x = np.array([[0.0, 0], [5.0, 0], [0, 5.0]], dtype=np.float32)
+        labels, _, inertia = kmeans(x, 3, key, n_init=10, iters=25)
+        labels = np.asarray(labels)
+        # Perfect assignment is reachable and multi-restart finds it.
+        assert len(set(labels.tolist())) == 3
+        assert float(inertia) == 0.0
+
+    def test_single_point(self, key):
+        from g2vec_tpu.ops.kmeans import kmeans
+
+        x = np.array([[1.0, 2.0, 3.0]], dtype=np.float32)
+        labels, centers, inertia = kmeans(x, 3, key)
+        assert np.asarray(labels).tolist() == [0]
+        assert float(inertia) == 0.0
+        # Empty duplicates froze on the only point.
+        assert np.allclose(np.asarray(centers), x[0])
+
+    def test_empty_input_rejected(self, key):
+        from g2vec_tpu.ops.kmeans import kmeans
+
+        with pytest.raises(ValueError, match="non-empty"):
+            kmeans(np.zeros((0, 4), dtype=np.float32), 3, key)
+
+    def test_degenerate_is_deterministic(self, key):
+        from g2vec_tpu.ops.kmeans import kmeans
+
+        x = np.ones((5, 3), dtype=np.float32)
+        a = [np.asarray(v) for v in kmeans(x, 3, key, n_init=2, iters=5)]
+        b = [np.asarray(v) for v in kmeans(x, 3, key, n_init=2, iters=5)]
+        for va, vb in zip(a, b):
+            assert np.array_equal(va, vb)
+
+    def test_find_lgroups_survives_degenerate_embeddings(self, key):
+        # All-identical embeddings: one giant cluster 0 (-> "other"), two
+        # empty remaining clusters voted 0-0 -> deterministic good/poor
+        # pick by index; every gene lands in "other".
+        x = np.zeros((30, 4), dtype=np.float32)
+        genes = np.array([f"G{i}" for i in range(30)])
+        lg = find_lgroups(x, genes, {g: 0 for g in genes[:5]}, key=key)
+        assert np.all(lg == 2)
+
+
 def test_select_biomarkers_order_and_ties(rng):
     # 6 genes: 3 in good group, 3 in poor group; engineered scores.
     genes = np.array(["GB", "GA", "GC", "PZ", "PA", "PM"])
