@@ -137,6 +137,17 @@ BATCH_AB_EPOCHS = int(os.environ.get("G2VEC_BENCH_BATCH_EPOCHS", "30"))
 BATCH_AB_SCALE = int(os.environ.get("G2VEC_BENCH_BATCH_SCALE", "1"))
 BATCH_AB_ARTIFACT = "BENCH_BATCH_AB.json"
 
+# Resident-service A/B (serve/daemon.py): Poisson job arrivals against the
+# warm daemon vs a fresh process per job at the SAME arrival schedule.
+# Defaults are CPU-safe tiny shapes; the subprocess tests shrink further.
+SERVE_AB_JOBS = int(os.environ.get("G2VEC_BENCH_SERVE_JOBS", "8"))
+SERVE_AB_REPS = int(os.environ.get("G2VEC_BENCH_SERVE_REPS", "3"))
+SERVE_AB_EPOCHS = int(os.environ.get("G2VEC_BENCH_SERVE_EPOCHS", "30"))
+SERVE_AB_MEAN_ARRIVAL_S = float(
+    os.environ.get("G2VEC_BENCH_SERVE_ARRIVAL", "1.0"))
+SERVE_AB_SCALE = int(os.environ.get("G2VEC_BENCH_SERVE_SCALE", "1"))
+SERVE_AB_ARTIFACT = "BENCH_SERVE_AB.json"
+
 # Peak bf16 matmul throughput per chip, for the MFU estimate.
 _PEAK_FLOPS = {"v4": 275e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12}
 # HBM bandwidth per chip (bytes/s): the roofline's other axis. This
@@ -865,6 +876,226 @@ def _batch_ab() -> None:
             json.dump({"line": line, "code_key": _current_code_key(repo),
                        "written_by": "bench.py --_batch_ab"}, f, indent=1)
         note(f"wrote {BATCH_AB_ARTIFACT}")
+
+
+def _serve_ab_line(note) -> dict:
+    """Resident-daemon-vs-fresh-process A/B under Poisson job arrivals —
+    the serve subsystem's headline.
+
+    Both arms see the SAME seeded arrival schedule (exponential
+    interarrivals, mean ``SERVE_AB_MEAN_ARRIVAL_S``) of N single-run jobs
+    (train/k-means seed k — shape-compatible, so the daemon's scheduler
+    may join backed-up jobs into one lane bucket). Baseline = the
+    pre-serve workflow: a fresh ``python -m g2vec_tpu`` process per job,
+    FIFO on the one device (each re-pays interpreter+jax startup and
+    every compile; latency includes queue wait). Served = ONE daemon
+    owning the device and every warm cache; jobs stream over its socket.
+    Reported from the best of ``SERVE_AB_REPS`` reps per arm: sustained
+    runs/hour over the window (first arrival -> last completion) and the
+    p50/p99 of per-job latency (completion - arrival). On-the-spot
+    honesty check: every served job's output files must be BYTE-IDENTICAL
+    to the fresh-process baseline's — the daemon's whole contract.
+
+    Runs with no jax in THIS process (daemon and children import it).
+    """
+    import random
+    import shutil
+    import tempfile
+    import threading
+
+    from g2vec_tpu.data.synthetic import SyntheticSpec, write_synthetic_tsv
+    from g2vec_tpu.serve import client as sclient
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    n, reps, epochs = SERVE_AB_JOBS, SERVE_AB_REPS, SERVE_AB_EPOCHS
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", "")}
+    rng = random.Random(0)
+    arrivals, t = [], 0.0
+    for _ in range(n):
+        arrivals.append(t)
+        t += rng.expovariate(1.0 / SERVE_AB_MEAN_ARRIVAL_S)
+
+    def _pct(lat, q):
+        s = sorted(lat)
+        return round(s[min(len(s) - 1, int(round(q * (len(s) - 1))))], 3)
+
+    with tempfile.TemporaryDirectory() as td:
+        spec = SyntheticSpec(
+            n_good=24, n_poor=20, module_size=12 * SERVE_AB_SCALE,
+            n_background=24 * SERVE_AB_SCALE, n_expr_only=4, n_net_only=4,
+            module_chords=2, background_edges=40 * SERVE_AB_SCALE, seed=7)
+        paths = write_synthetic_tsv(spec, td)
+        base_args = [paths["expression"], paths["clinical"],
+                     paths["network"], "RESULT", "-p", "8", "-r", "2",
+                     "-s", "16", "-e", str(epochs), "-l", "0.05", "-n", "5",
+                     "--compute-dtype", "float32", "--platform", "cpu",
+                     "--seed", "0"]
+        job_base = {"expression_file": paths["expression"],
+                    "clinical_file": paths["clinical"],
+                    "network_file": paths["network"],
+                    "lenPath": 8, "numRepetition": 2, "sizeHiddenlayer": 16,
+                    "epoch": epochs, "learningRate": 0.05, "numBiomarker": 5,
+                    "compute_dtype": "float32", "seed": 0}
+
+        def solo_child(result: str, k: int) -> None:
+            args = list(base_args)
+            args[3] = result
+            proc = subprocess.run(
+                [sys.executable, "-m", "g2vec_tpu"] + args
+                + ["--train-seed", str(k), "--kmeans-seed", str(k)],
+                capture_output=True, text=True, env=env, timeout=600)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"serve A/B solo child rc={proc.returncode}: "
+                    f"{(proc.stderr or proc.stdout)[-400:]}")
+
+        def window_stats(done):
+            lat = [done[k] - arrivals[k] for k in range(n)]
+            window = max(done) - arrivals[0]
+            return n / window * 3600.0, lat
+
+        def baseline_rep(rep: int):
+            out = os.path.join(td, f"base{rep}")
+            os.makedirs(out, exist_ok=True)
+            done = [0.0] * n
+            t0 = time.time()
+            for k in range(n):
+                now = time.time() - t0
+                if now < arrivals[k]:
+                    time.sleep(arrivals[k] - now)
+                solo_child(os.path.join(out, f"job{k}"), k)
+                done[k] = time.time() - t0
+            return window_stats(done)
+
+        def served_rep(rep: int):
+            out = os.path.join(td, f"serve{rep}")
+            os.makedirs(out, exist_ok=True)
+            sock = os.path.join(td, f"s{rep}.sock")
+            log = open(os.path.join(out, "daemon.log"), "w")
+            daemon = subprocess.Popen(
+                [sys.executable, "-m", "g2vec_tpu", "serve",
+                 "--socket", sock,
+                 "--state-dir", os.path.join(out, "state"),
+                 "--platform", "cpu",
+                 "--cache-dir", os.path.join(out, "cache"),
+                 "--max-join", "8"],
+                env=env, stdout=log, stderr=subprocess.STDOUT)
+            try:
+                if not sclient.wait_ready(sock, 120):
+                    raise RuntimeError("serve daemon never became ready "
+                                       f"(log: {log.name})")
+                done = [0.0] * n
+                errs: list = []
+                t0 = time.time()
+
+                def submit(k: int) -> None:
+                    try:
+                        evs = sclient.submit_job(
+                            sock, {**job_base,
+                                   "result_name": os.path.join(
+                                       out, f"job{k}"),
+                                   "train_seed": k, "kmeans_seed": k})
+                        if evs[-1].get("event") != "job_done":
+                            errs.append(f"job{k}: {evs[-1]}")
+                        done[k] = time.time() - t0
+                    except Exception as e:  # noqa: BLE001 — reported below
+                        errs.append(f"job{k}: {type(e).__name__}: {e}")
+
+                threads = []
+                for k in range(n):
+                    now = time.time() - t0
+                    if now < arrivals[k]:
+                        time.sleep(arrivals[k] - now)
+                    th = threading.Thread(target=submit, args=(k,))
+                    th.start()
+                    threads.append(th)
+                for th in threads:
+                    th.join()
+                if errs:
+                    raise RuntimeError("serve A/B job failure(s): "
+                                       + "; ".join(errs[:3]))
+                return window_stats(done)
+            finally:
+                try:
+                    sclient.shutdown(sock)
+                except OSError:
+                    pass
+                try:
+                    daemon.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    daemon.kill()
+                log.close()
+
+        base_best, serve_best = None, None
+        for rep in range(reps):
+            rph_b, lat_b = baseline_rep(rep)
+            note(f"serve A/B rep {rep}: baseline {n} jobs "
+                 f"-> {rph_b:.0f} runs/h (p50 {_pct(lat_b, 0.5)}s)")
+            if base_best is None or rph_b > base_best[0]:
+                base_best = (rph_b, lat_b)
+            rph_s, lat_s = served_rep(rep)
+            note(f"serve A/B rep {rep}: served   {n} jobs "
+                 f"-> {rph_s:.0f} runs/h (p50 {_pct(lat_s, 0.5)}s)")
+            if serve_best is None or rph_s > serve_best[0]:
+                serve_best = (rph_s, lat_s)
+
+        # Honesty check on the LAST rep's artifacts: every served job's
+        # three files == the fresh-process baseline twin's, byte for byte.
+        identical = True
+        for k in range(n):
+            for suffix in ("biomarkers", "lgroups", "vectors"):
+                fa = os.path.join(td, f"base{reps - 1}",
+                                  f"job{k}_{suffix}.txt")
+                fb = os.path.join(td, f"serve{reps - 1}",
+                                  f"job{k}.v_{suffix}.txt")
+                with open(fa, "rb") as a, open(fb, "rb") as b:
+                    if a.read() != b.read():
+                        identical = False
+                        note(f"serve A/B MISMATCH: job{k} {suffix}")
+        shutil.rmtree(td, ignore_errors=True)
+
+    rph_base, lat_base = base_best
+    rph_serve, lat_serve = serve_best
+    return {
+        "metric": "serve_runs_per_hour", "value": round(rph_serve, 1),
+        "unit": "runs/h", "vs_baseline": round(rph_serve / rph_base, 2),
+        "baseline_runs_per_hour": round(rph_base, 1),
+        "p50_latency_s": _pct(lat_serve, 0.5),
+        "p99_latency_s": _pct(lat_serve, 0.99),
+        "baseline_p50_latency_s": _pct(lat_base, 0.5),
+        "baseline_p99_latency_s": _pct(lat_base, 0.99),
+        "jobs": n, "reps": reps, "epochs": epochs,
+        "mean_interarrival_s": SERVE_AB_MEAN_ARRIVAL_S,
+        "scale": SERVE_AB_SCALE, "bit_identical": identical,
+        "arrival_model": "seeded Poisson (exponential interarrivals), "
+                         "identical schedule both arms; window = first "
+                         "arrival -> last completion",
+        "baseline_mode": "fresh python -m g2vec_tpu process per job, FIFO "
+                         "on the device (re-paid imports+compiles per job, "
+                         "latency includes queue wait — the pre-serve "
+                         "workflow)",
+        "note": "one resident daemon owns the device: warm jit/XLA/walk "
+                "caches across jobs, shape-compatible backed-up jobs join "
+                "one lane bucket; served outputs verified byte-identical "
+                "to the fresh-process baseline on the spot",
+    }
+
+
+def _serve_ab() -> None:
+    """Standalone mode: measure the serve A/B and (with
+    G2VEC_BENCH_SERVE_WRITE=1) refresh the committed artifact."""
+    def note(msg):
+        print(f"# {msg}", file=sys.stderr, flush=True)
+
+    line = _serve_ab_line(note)
+    print(json.dumps(line), flush=True)
+    if os.environ.get("G2VEC_BENCH_SERVE_WRITE") == "1":
+        repo = os.path.dirname(os.path.abspath(__file__))
+        with open(os.path.join(repo, SERVE_AB_ARTIFACT), "w") as f:
+            json.dump({"line": line, "code_key": _current_code_key(repo),
+                       "written_by": "bench.py --_serve_ab"}, f, indent=1)
+        note(f"wrote {SERVE_AB_ARTIFACT}")
 
 
 def _run_measure_child(budget: int, child_env: dict,
@@ -1797,5 +2028,7 @@ if __name__ == "__main__":
         _hostonly()
     elif "--_batch_ab" in sys.argv:
         _batch_ab()
+    elif "--_serve_ab" in sys.argv:
+        _serve_ab()
     else:
         main()

@@ -12,6 +12,15 @@ import sys
 
 
 def main(argv=None) -> int:
+    argv = list(argv) if argv is not None else sys.argv[1:]
+    if argv and argv[0] == "serve":
+        # Resident service mode: `g2vec serve --socket ... --state-dir ...`
+        # (serve/cli.py). Dispatched BEFORE the classic parser — the
+        # daemon has its own flag surface and, like the supervisors below,
+        # must own platform/env setup before any jax import.
+        from g2vec_tpu.serve.cli import serve_main
+
+        return serve_main(argv[1:])
     from g2vec_tpu.config import config_from_args
 
     cfg = config_from_args(argv)
@@ -24,8 +33,7 @@ def main(argv=None) -> int:
         # accelerator state.
         from g2vec_tpu.resilience.fleet import supervise_fleet
 
-        return supervise_fleet(cfg, list(argv) if argv is not None
-                               else sys.argv[1:])
+        return supervise_fleet(cfg, argv)
     if cfg.supervise:
         # Child-process supervision: the supervisor re-invokes this module
         # (minus its own flags, plus --resume) so even a SIGKILL'd child —
@@ -34,8 +42,7 @@ def main(argv=None) -> int:
         # process itself must hold no accelerator state.
         from g2vec_tpu.resilience.supervisor import supervise_cli
 
-        return supervise_cli(cfg, list(argv) if argv is not None
-                             else sys.argv[1:])
+        return supervise_cli(cfg, argv)
     if cfg.compilation_cache or cfg.cache_dir:
         # Persistent-compile tier, wired through the env BEFORE jax comes
         # up anywhere in this process: the pipeline re-applies it via

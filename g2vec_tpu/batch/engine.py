@@ -36,6 +36,13 @@ Contract: every lane's three output files are BITWISE the files the
 same config produces through ``pipeline.run`` solo (float32, same
 backend) — ``lane_config`` builds that solo config, and
 tests/test_batch_engine.py holds the engine to it byte-for-byte.
+
+Since the serve refactor, lane execution is split from process lifetime:
+:class:`ResidentEngine` owns the warm state (walk-tier memo, overlap
+pool, dataset memo, program caches) and accepts any number of
+``execute`` calls; ``run_batch`` wraps one ephemeral instance for the
+one-shot CLI, and ``serve/daemon.py`` keeps one alive for the daemon
+lifetime (ARCHITECTURE.md §11).
 """
 from __future__ import annotations
 
@@ -226,17 +233,151 @@ class BatchResult:
 
 def run_batch(cfg: G2VecConfig,
               console: Callable[[str], None] = print) -> BatchResult:
-    """Plan the manifest into lanes and execute them batched."""
+    """Plan the manifest into lanes and execute them batched — the one-shot
+    CLI shape: an ephemeral :class:`ResidentEngine` is built from the
+    config, executes the manifest, and is torn down with the process."""
+    cfg.validate()
+    variants = plan_variants(cfg)
+    with ResidentEngine(cache_dir=cfg.cache_dir,
+                        compilation_cache=cfg.compilation_cache,
+                        walk_cache=cfg.walk_cache) as engine:
+        return engine.execute(cfg, variants, console=console)
+
+
+class ResidentEngine:
+    """The lane execution core with its warm state split OUT of the process
+    lifetime.
+
+    ``run_batch`` used to own everything — caches, pool, data, device
+    programs — for exactly one manifest, so every invocation re-paid
+    startup, loads, and compiles. This class holds the warm inventory and
+    accepts any number of :meth:`execute` calls against it:
+
+    - the **SharedWalkTier memo** (cache.py): walk products stay resident,
+      so a later job over the same cohort/seed shares stage 3 in-process;
+    - the **overlap pool** (parallel/overlap.py): one executor for walk
+      tasks and background compile warms across all batches (per-batch
+      task-name prefixes + :meth:`OverlapScheduler.prune` keep it bounded);
+    - the **dataset memo**: loaded + preprocessed inputs keyed by file
+      identity (path, mtime, size), so repeat jobs skip stages 1-2;
+    - the **program caches**: jit/LRU chunk programs and the persistent
+      XLA tier are process-level — keeping the process alive is what makes
+      them warm; this class is why a process worth keeping alive exists.
+
+    ``serve/daemon.py`` keeps ONE instance for the daemon lifetime; the
+    engine itself knows nothing about sockets, queues, or jobs beyond the
+    optional per-lane ``lane_jobs`` metrics attribution.
+    """
+
+    def __init__(self, *, cache_dir: Optional[str] = None,
+                 compilation_cache: Optional[str] = None,
+                 walk_cache: bool = True, max_workers: int = 8,
+                 dataset_cap: int = 4):
+        from collections import OrderedDict
+
+        from g2vec_tpu.cache import SharedWalkTier, resolve_cache_tiers
+        from g2vec_tpu.parallel.overlap import OverlapScheduler
+
+        xla_dir, disk_walk_cache = resolve_cache_tiers(
+            cache_dir, compilation_cache, walk_cache)
+        self._xla_cache_dir = xla_dir
+        self.walk_tier = SharedWalkTier(disk=disk_walk_cache)
+        self.overlap = OverlapScheduler(max_workers=max_workers)
+        self._datasets: "OrderedDict" = OrderedDict()
+        self._dataset_cap = dataset_cap
+        self._serial = 0
+        self.batches_executed = 0
+        self.lanes_executed = 0
+        self.warm_shapes: List[Dict] = []
+
+    def execute(self, cfg: G2VecConfig,
+                variants: Optional[List[LaneVariant]] = None, *,
+                console: Callable[[str], None] = print,
+                metrics=None,
+                lane_jobs: Optional[List[str]] = None) -> BatchResult:
+        """Run ``variants`` (default: plan from ``cfg``) as one batch on
+        this engine's warm state. ``metrics`` may be a caller-owned
+        MetricsWriter/BoundMetrics view (the daemon's lifetime stream);
+        None builds one from ``cfg.metrics_jsonl`` for this call.
+        ``lane_jobs`` stamps lane i's events with ``job_id`` so joined
+        jobs stay attributable (utils/metrics.py ``bind_job``)."""
+        return _execute_lanes(self, cfg, variants, console=console,
+                              metrics=metrics, lane_jobs=lane_jobs)
+
+    def status(self) -> Dict:
+        """The warm-state inventory (the serve /status currency)."""
+        return {
+            "batches_executed": self.batches_executed,
+            "lanes_executed": self.lanes_executed,
+            "datasets_resident": len(self._datasets),
+            "walk_tier": self.walk_tier.stats(),
+            "walk_products_resident": len(self.walk_tier.memo),
+            "warm_shapes": [dict(s) for s in self.warm_shapes],
+        }
+
+    def _dataset_key(self, cfg: G2VecConfig) -> Tuple:
+        def ident(path):
+            st = os.stat(path)
+            return (os.path.abspath(path), st.st_mtime_ns, st.st_size)
+        return (ident(cfg.expression_file), ident(cfg.clinical_file),
+                ident(cfg.network_file), cfg.use_native_io)
+
+    def dataset(self, cfg: G2VecConfig) -> Tuple[Dict, bool]:
+        """The loaded + preprocessed bundle for ``cfg``'s input files,
+        memoized on file identity (path, mtime_ns, size — an edited input
+        re-loads instead of silently serving stale genes). Returns
+        ``(bundle, was_resident)``."""
+        from g2vec_tpu.io.readers import (load_clinical, load_expression,
+                                          load_network)
+        from g2vec_tpu.preprocess import (edges_to_indices,
+                                          find_common_genes, make_gene2idx,
+                                          match_labels, restrict_data,
+                                          restrict_network)
+
+        key = self._dataset_key(cfg)
+        hit = self._datasets.get(key)
+        if hit is not None:
+            self._datasets.move_to_end(key)
+            return hit, True
+        data = load_expression(cfg.expression_file,
+                               use_native=cfg.use_native_io)
+        clinical = load_clinical(cfg.clinical_file)
+        network = load_network(cfg.network_file)
+        data.label = match_labels(clinical, data.sample)
+        common = find_common_genes(network.genes, data.gene)
+        network = restrict_network(network, common)
+        data = restrict_data(data, common)
+        gene2idx = make_gene2idx(data.gene)
+        src, dst = edges_to_indices(network, gene2idx)
+        bundle = {"data": data, "src": src, "dst": dst,
+                  "n_genes": int(data.expr.shape[1]),
+                  "n_edges": len(network.edges)}
+        self._datasets[key] = bundle
+        while len(self._datasets) > self._dataset_cap:
+            self._datasets.popitem(last=False)
+        return bundle, False
+
+    def close(self) -> None:
+        self.overlap.close()
+
+    def __enter__(self) -> "ResidentEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _execute_lanes(engine: ResidentEngine, cfg: G2VecConfig,
+                   variants: Optional[List[LaneVariant]], *,
+                   console: Callable[[str], None],
+                   metrics, lane_jobs: Optional[List[str]]) -> BatchResult:
     import jax
 
     from g2vec_tpu.analysis import (biomarker_scores_lanes, freq_index,
                                     find_lgroups_lanes, top_biomarkers,
                                     warm_lgroups_compile)
     from g2vec_tpu.cache import (DEVICE_FAMILY, NATIVE_FAMILY,
-                                 SharedWalkTier, configure_xla_cache,
-                                 resolve_cache_tiers, walk_cache_key)
-    from g2vec_tpu.io.readers import (load_clinical, load_expression,
-                                      load_network)
+                                 configure_xla_cache, walk_cache_key)
     from g2vec_tpu.io.writers import (write_biomarkers, write_lgroups,
                                       write_vectors)
     from g2vec_tpu.ops.backend import resolve_walker_backend
@@ -245,12 +386,8 @@ def run_batch(cfg: G2VecConfig,
     from g2vec_tpu.ops.walker import (count_gene_freq, generate_path_set,
                                       integrate_path_sets)
     from g2vec_tpu.parallel.mesh import make_mesh_context
-    from g2vec_tpu.parallel.overlap import OverlapScheduler
     from g2vec_tpu.pipeline import PipelineResult, _background_warm
-    from g2vec_tpu.preprocess import (edges_to_indices, find_common_genes,
-                                      make_gene2idx, match_labels,
-                                      restrict_data, restrict_network,
-                                      subsample_patients)
+    from g2vec_tpu.preprocess import subsample_patients
     from g2vec_tpu.resilience.faults import fault_point, install_plan
     from g2vec_tpu.train.trainer import (LaneTrainSpec, train_cbow,
                                          train_cbow_lanes,
@@ -260,14 +397,19 @@ def run_batch(cfg: G2VecConfig,
     import jax.numpy as jnp
 
     cfg.validate()
-    variants = plan_variants(cfg)
+    if variants is None:
+        variants = plan_variants(cfg)
     n_lanes = len(variants)
+    if lane_jobs is not None and len(lane_jobs) != n_lanes:
+        raise ValueError(f"lane_jobs has {len(lane_jobs)} entries for "
+                         f"{n_lanes} lane(s)")
     if cfg.fault_plan:
         install_plan(cfg.fault_plan)
-    xla_cache_dir, disk_walk_cache = resolve_cache_tiers(
-        cfg.cache_dir, cfg.compilation_cache, cfg.walk_cache)
-    configure_xla_cache(xla_cache_dir)
-    walk_tier = SharedWalkTier(disk=disk_walk_cache)
+    configure_xla_cache(engine._xla_cache_dir)
+    walk_tier = engine.walk_tier
+    tier_stats0 = walk_tier.stats()
+    engine._serial += 1
+    pfx = f"b{engine._serial}:"       # per-batch overlap task namespace
 
     # A manifest run fans one result_name into 3N files — create the
     # parent dirs up front (the metrics stream opens before stage 7).
@@ -276,8 +418,14 @@ def run_batch(cfg: G2VecConfig,
         if parent:
             os.makedirs(parent, exist_ok=True)
     timer = StageTimer()
-    metrics = MetricsWriter(cfg.metrics_jsonl)
-    lane_metrics = [metrics.bind_lane(v.tag()) for v in variants]
+    own_metrics = None
+    if metrics is None:
+        own_metrics = metrics = MetricsWriter(cfg.metrics_jsonl)
+    if lane_jobs is not None:
+        lane_metrics = [metrics.bind_job(lane_jobs[i]).bind_lane(v.tag())
+                        for i, v in enumerate(variants)]
+    else:
+        lane_metrics = [metrics.bind_lane(v.tag()) for v in variants]
     t_start = time.time()
 
     console(">>> [batch] 0. Manifest")
@@ -285,29 +433,22 @@ def run_batch(cfg: G2VecConfig,
             f"{os.path.basename(cfg.expression_file)!r}; "
             f"lanes/bucket cap {cfg.lanes}")
     metrics.emit("batch_config", n_lanes=n_lanes, lanes_cap=cfg.lanes,
+                 batch_serial=engine._serial,
                  variants=[dataclasses.asdict(v) for v in variants])
     for v, lm in zip(variants, lane_metrics):
         lm.emit("lane_variant", **dataclasses.asdict(v))
 
-    overlap = None
+    overlap = engine.overlap
     try:
-        console(">>> [batch] 1-2. Load + preprocess (shared)")
+        console(">>> [batch] 1-2. Load + preprocess (shared, resident)")
         fault_point("load")
-        with timer.stage("load"):
-            data = load_expression(cfg.expression_file,
-                                   use_native=cfg.use_native_io)
-            clinical = load_clinical(cfg.clinical_file)
-            network = load_network(cfg.network_file)
         fault_point("preprocess")
-        with timer.stage("preprocess"):
-            data.label = match_labels(clinical, data.sample)
-            common = find_common_genes(network.genes, data.gene)
-            network = restrict_network(network, common)
-            data = restrict_data(data, common)
-            gene2idx = make_gene2idx(data.gene)
-            src, dst = edges_to_indices(network, gene2idx)
-        n_genes = data.expr.shape[1]
-        n_edges = len(network.edges)
+        with timer.stage("load"):
+            bundle, was_resident = engine.dataset(cfg)
+        data, src, dst = bundle["data"], bundle["src"], bundle["dst"]
+        n_genes, n_edges = bundle["n_genes"], bundle["n_edges"]
+        if was_resident:
+            console("    dataset resident (stages 1-2 served from memo)")
         console(f"    n_genes {n_genes}, n_edges {n_edges}, "
                 f"n_samples {data.expr.shape[0]} (base)")
 
@@ -325,15 +466,11 @@ def run_batch(cfg: G2VecConfig,
         sampler_threads = (resolve_sampler_threads(cfg.sampler_threads)
                            if walker_backend == "native" else 0)
         mesh_ctx = make_mesh_context(cfg.mesh_shape)
-        # Pool width: the walk tasks fan into the sampler's own range
-        # pool, so this bounds CONCURRENT tasks (walks, integrations,
-        # compile warms), not sampler threads.
-        overlap = OverlapScheduler(max_workers=max(4, min(8, n_lanes + 2)))
 
         # Stage-5's batched shape is known NOW — warm the vmapped k-means
         # before any walk finishes (it hides under stages 3-4 entirely).
         warm_kmeans_lanes = min(n_lanes, cfg.lanes)
-        overlap.submit("warm_lgroups", _background_warm(
+        overlap.submit(pfx + "warm_lgroups", _background_warm(
             lambda: warm_lgroups_compile(
                 n_genes, cfg.sizeHiddenlayer, k=cfg.n_lgroups,
                 iters=cfg.kmeans_iters,
@@ -364,7 +501,7 @@ def run_batch(cfg: G2VecConfig,
                         family=(NATIVE_FAMILY if walker_backend == "native"
                                 else DEVICE_FAMILY))
                     if ckey not in walk_of_key:
-                        task = f"walk:{group}:{ckey[:12]}"
+                        task = f"{pfx}walk:{group}:{ckey[:12]}"
                         walk_of_key[ckey] = task
                         share_count[task] = 0
                         overlap.submit(task, _make_walk_task(
@@ -400,14 +537,14 @@ def run_batch(cfg: G2VecConfig,
             return fn
 
         for li in range(n_lanes):
-            overlap.submit(f"integrate:{li}", _integrate(li),
+            overlap.submit(f"{pfx}integrate:{li}", _integrate(li),
                            deps=lane_walks[li])
 
         payloads: List = [None] * n_lanes
         with timer.stage("paths"):
             for name, result in overlap.as_completed(
-                    [f"integrate:{li}" for li in range(n_lanes)]):
-                li = int(name.split(":")[1])
+                    [f"{pfx}integrate:{li}" for li in range(n_lanes)]):
+                li = int(name.rsplit(":", 1)[1])
                 payloads[li] = result
                 paths, labels, gene_freq = result
                 lane_metrics[li].emit(
@@ -415,7 +552,10 @@ def run_batch(cfg: G2VecConfig,
                     n_path_genes=len(gene_freq),
                     walker_backend=walker_backend,
                     sampler_threads=sampler_threads)
-        walk_stats = walk_tier.stats()
+        # Per-batch deltas: the tier is engine-resident, so its raw
+        # counters span every batch this process has run.
+        walk_stats = {k: v - tier_stats0[k]
+                      for k, v in walk_tier.stats().items()}
         # Task-level dedup (lanes pointing at one product) is the third
         # share tier: lane_shared counts lane-walks served by another
         # lane's task, on top of the tier's memo/disk hits.
@@ -450,7 +590,12 @@ def run_batch(cfg: G2VecConfig,
         for bi, (bkey, lis) in enumerate(bucket_list):
             shape, lr, epochs = bkey
             n_paths_b = int(shape[0])
-            overlap.submit(f"warm_bucket:{bi}", _background_warm(
+            wshape = {"n_paths": n_paths_b, "lanes": len(lis),
+                      "hidden": cfg.sizeHiddenlayer, "learning_rate": lr,
+                      "max_epochs": epochs}
+            if wshape not in engine.warm_shapes:
+                engine.warm_shapes.append(wshape)
+            overlap.submit(f"{pfx}warm_bucket:{bi}", _background_warm(
                 lambda n=n_paths_b, lr=lr, e=epochs, B=len(lis):
                 warm_train_compile(
                     n, n_genes, hidden=cfg.sizeHiddenlayer,
@@ -471,7 +616,7 @@ def run_batch(cfg: G2VecConfig,
             for bi, (bkey, lis) in enumerate(bucket_list):
                 shape, lr, epochs = bkey
                 join_warm = (lambda bi=bi:
-                             overlap.result(f"warm_bucket:{bi}"))
+                             overlap.result(f"{pfx}warm_bucket:{bi}"))
                 if len(lis) == 1:
                     li = lis[0]
                     v = variants[li]
@@ -544,7 +689,7 @@ def run_batch(cfg: G2VecConfig,
 
         console(">>> [batch] 5. Find L-groups (vmapped across lanes)")
         fault_point("lgroups")
-        overlap.result("warm_lgroups")
+        overlap.result(pfx + "warm_lgroups")
         freq_stack = np.stack([freq_index(data.gene, payloads[li][2])
                                for li in range(n_lanes)])
         lgroup_host = [None] * n_lanes
@@ -646,14 +791,19 @@ def run_batch(cfg: G2VecConfig,
                          for li in range(n_lanes)},
             walk_stats=walk_stats, buckets=bucket_report,
             stage_seconds=timer.as_dict())
+        engine.batches_executed += 1
+        engine.lanes_executed += n_lanes
         return BatchResult(
             lanes=results, variants=variants, wall_seconds=wall,
             runs_per_hour=rph, walk_stats=walk_stats,
             buckets=bucket_report, stage_seconds=timer.as_dict())
     finally:
-        if overlap is not None:
-            overlap.close()
-        metrics.close()
+        # The engine (and its pool) outlives this batch; forget only this
+        # batch's tasks — waiting out any still in flight so the engine
+        # returns to service with a quiet pool even on the failure path.
+        overlap.prune(pfx)
+        if own_metrics is not None:
+            own_metrics.close()
 
 
 def _make_walk_task(cfg, s, d, w, n_genes, *, seed, backend, tier, ckey,

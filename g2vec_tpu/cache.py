@@ -35,6 +35,7 @@ import dataclasses
 import hashlib
 import json
 import os
+import threading
 import warnings
 from typing import Dict, Optional, Set
 
@@ -45,6 +46,61 @@ from g2vec_tpu.utils.integrity import sha256_file, write_json_atomic
 
 SCHEMA_VERSION = 1
 MANIFEST_SUFFIX = ".manifest.json"
+
+# ---------------------------------------------------------------------------
+# Tier-wide hit/miss accounting (the serve daemon's /status currency).
+#
+# Every cache tier used to report its outcomes only as scattered event
+# extras (walk_cache metrics events, autotune "source" fields, nothing at
+# all for the in-process program LRUs) — fine for one run, useless for a
+# long-lived daemon that needs "how warm am I?" as a single answer. Each
+# tier records its outcomes here; :func:`cache_stats` snapshots them all.
+# Counters are process-global and monotonically increasing (like the seq
+# numbers in the metrics stream); callers needing per-window deltas
+# snapshot twice and subtract.
+# ---------------------------------------------------------------------------
+
+_STATS_LOCK = threading.Lock()
+_TIER_STATS: Dict[str, Dict[str, int]] = {}
+
+
+def record_cache_event(tier: str, outcome: str, n: int = 1) -> None:
+    """Count one cache outcome, e.g. ``("walk", "disk_hit")``,
+    ``("compile", "program_hit")``, ``("autotune", "miss")``."""
+    with _STATS_LOCK:
+        _TIER_STATS.setdefault(tier, {})
+        _TIER_STATS[tier][outcome] = _TIER_STATS[tier].get(outcome, 0) + n
+
+
+def cache_stats() -> Dict[str, Dict]:
+    """Snapshot of every tier's counters since process start.
+
+    Tiers: ``walk`` (memo_hit / disk_hit / miss / verify_failed / store),
+    ``compile`` (program_hit / program_miss — the in-process chunk/unpack
+    program LRUs in train/trainer.py; plus ``xla_dir`` and its on-disk
+    entry count when the persistent XLA tier is configured), ``autotune``
+    (hit / miss / sweep — ops/packed_matmul.py's measured-plan tier).
+    """
+    with _STATS_LOCK:
+        snap: Dict[str, Dict] = {t: dict(c) for t, c in _TIER_STATS.items()}
+    snap.setdefault("walk", {})
+    snap.setdefault("compile", {})
+    snap.setdefault("autotune", {})
+    xla_dir = _configured_xla_dir()
+    if xla_dir:
+        snap["compile"]["xla_dir"] = xla_dir
+        try:
+            snap["compile"]["xla_entries"] = len(os.listdir(xla_dir))
+        except OSError:
+            snap["compile"]["xla_entries"] = 0
+    return snap
+
+
+_configured_xla: Optional[str] = None
+
+
+def _configured_xla_dir() -> Optional[str]:
+    return _configured_xla or os.environ.get("JAX_COMPILATION_CACHE_DIR")
 
 #: PRNG-family tags baked into every key. Version them on ANY change to
 #: the corresponding sampler's stream derivation — a stale artifact from
@@ -90,6 +146,7 @@ class WalkCache:
         and the next store overwrites the bad entry)."""
         art, man_path = self._paths(key)
         if not os.path.exists(art) or not os.path.exists(man_path):
+            record_cache_event("walk", "miss")
             return None
         try:
             with open(man_path) as f:
@@ -97,6 +154,7 @@ class WalkCache:
         except (OSError, ValueError) as e:
             warnings.warn(f"walk cache manifest {man_path} unreadable "
                           f"({e!r}); recomputing walks", RuntimeWarning)
+            record_cache_event("walk", "verify_failed")
             return None
         if manifest.get("schema") != SCHEMA_VERSION \
                 or manifest.get("key") != key:
@@ -104,6 +162,7 @@ class WalkCache:
                 f"walk cache entry {art} is stale (schema/key mismatch — "
                 f"a truncated key collision or an older cache layout); "
                 f"recomputing walks", RuntimeWarning)
+            record_cache_event("walk", "verify_failed")
             return None
         actual = sha256_file(art)
         if actual != manifest.get("sha256"):
@@ -112,6 +171,7 @@ class WalkCache:
                 f"(manifest {str(manifest.get('sha256'))[:12]}... vs file "
                 f"{actual[:12]}...) — corrupt or torn entry; recomputing "
                 f"walks", RuntimeWarning)
+            record_cache_event("walk", "verify_failed")
             return None
         try:
             with np.load(art) as z:
@@ -119,7 +179,9 @@ class WalkCache:
         except Exception as e:  # noqa: BLE001 — any unreadable npz = miss
             warnings.warn(f"walk cache entry {art} unreadable ({e!r}); "
                           f"recomputing walks", RuntimeWarning)
+            record_cache_event("walk", "verify_failed")
             return None
+        record_cache_event("walk", "disk_hit")
         return {row.tobytes() for row in rows}
 
     def store(self, key: str, path_set: Set[bytes], n_genes: int,
@@ -147,6 +209,7 @@ class WalkCache:
         # before the hash would give the bad bytes a matching manifest
         # and the cache would serve them as truth.)
         fault_point("walk_cache", path=art)
+        record_cache_event("walk", "store")
         return art
 
 
@@ -177,6 +240,7 @@ class SharedWalkTier:
         hit = self.memo.get(key)
         if hit is not None:
             self.memo_hits += 1
+            record_cache_event("walk", "memo_hit")
             return hit
         if self.disk is not None:
             hit = self.disk.load(key)
@@ -184,6 +248,8 @@ class SharedWalkTier:
                 self.disk_hits += 1
                 self.memo[key] = hit
                 return hit
+        else:
+            record_cache_event("walk", "miss")
         return None
 
     def store(self, key: str, path_set: Set[bytes], n_genes: int,
@@ -229,6 +295,8 @@ def configure_xla_cache(xla_cache_dir: Optional[str]) -> None:
         return
     import jax
 
+    global _configured_xla
+    _configured_xla = xla_cache_dir
     prev_cache_dir = jax.config.jax_compilation_cache_dir
     jax.config.update("jax_compilation_cache_dir", xla_cache_dir)
     # Persist every program: a pipeline run compiles a bounded set of

@@ -311,6 +311,56 @@ class G2VecConfig:
             parse_plan(self.fault_plan)
 
 
+#: G2VecConfig fields a serve job's ``base`` object may set. Everything
+#: else — device/mesh/platform choice, cache roots, checkpointing,
+#: supervision, fleet/distributed wiring — is daemon infrastructure a
+#: tenant must not reach through a job submission (the daemon owns the
+#: device and the persistent tiers; serve/daemon.py builds the execution
+#: config from ITS flags plus exactly these per-job fields).
+SERVE_JOB_KEYS = (
+    "expression_file", "clinical_file", "network_file", "result_name",
+    "lenPath", "numRepetition", "sizeHiddenlayer", "epoch", "learningRate",
+    "numBiomarker", "pcc_threshold", "val_fraction", "display_step",
+    "n_lgroups", "kmeans_seed", "kmeans_iters", "decision_threshold",
+    "score_mix", "seed", "train_seed", "patient_subsample",
+    "subsample_seed", "compat_lgroup_tiebreak", "compute_dtype",
+    "param_dtype", "walker_batch", "walker_hbm_budget", "walker_backend",
+    "sampler_threads", "fused_eval", "epoch_superstep", "donate_state",
+    "use_native_io", "lanes")
+
+_SERVE_JOB_REQUIRED = ("expression_file", "clinical_file", "network_file",
+                       "result_name")
+
+
+def config_from_job(base: dict, defaults: Optional[G2VecConfig] = None
+                    ) -> G2VecConfig:
+    """A validated :class:`G2VecConfig` from a serve job's ``base`` dict.
+
+    Only :data:`SERVE_JOB_KEYS` may appear; an unknown or infrastructure
+    key raises ``ValueError`` naming it (a job typo must be rejected at
+    admission, not die mid-batch). ``defaults`` seeds the non-job fields
+    (the daemon passes its own flag-derived config so jobs inherit e.g.
+    the walker backend policy it was launched with).
+    """
+    if not isinstance(base, dict):
+        raise ValueError(
+            f"job base must be an object, got {type(base).__name__}")
+    unknown = sorted(set(base) - set(SERVE_JOB_KEYS))
+    if unknown:
+        raise ValueError(
+            f"job base has unknown/forbidden key(s) {unknown}; "
+            f"allowed: {sorted(SERVE_JOB_KEYS)}")
+    missing = [k for k in _SERVE_JOB_REQUIRED
+               if not base.get(k) or not isinstance(base.get(k), str)]
+    if missing:
+        raise ValueError(
+            f"job base must set non-empty string(s) for {missing}")
+    cfg = dataclasses.replace(defaults if defaults is not None
+                              else G2VecConfig(), **base)
+    cfg.validate()
+    return cfg
+
+
 def _version() -> str:
     from g2vec_tpu import __version__
     return __version__

@@ -452,19 +452,26 @@ def load_tuned(cache_path: Optional[str], m: int, g: int, h: int,
     Returns the entry (with ``source="cache"``) on a hit, None on a miss
     or any stale/unreadable record — the caller then measures afresh.
     """
-    if not cache_path or not os.path.exists(cache_path):
+    from g2vec_tpu.cache import record_cache_event
+
+    def _miss():
+        record_cache_event("autotune", "miss")
         return None
+
+    if not cache_path or not os.path.exists(cache_path):
+        return _miss()
     ent = _read_tune_file(cache_path).get(_autotune_key(m, g, h, interpret))
     if not isinstance(ent, dict) or "fwd" not in ent or "bwd" not in ent:
-        return None
+        return _miss()
     try:
         plans = {d: (int(ent[d][0]), int(ent[d][1])) for d in ("fwd", "bwd")}
     except (TypeError, ValueError, IndexError, KeyError):
-        return None
+        return _miss()
     legal = set(tile_candidates(m, g, h))
     if any(p not in legal for p in plans.values()):
-        return None      # e.g. recorded against a different VMEM budget
+        return _miss()   # e.g. recorded against a different VMEM budget
     _install_tuned(m, g, h, plans, _autotune_backend_tag(interpret))
+    record_cache_event("autotune", "hit")
     return {**ent, "source": "cache"}
 
 
@@ -483,6 +490,8 @@ def autotune_packed_matmul(m: int, g: int, h: int, *,
         raise ValueError(
             f"autotune needs padded shapes (m%{ROW_BLOCK}, g%{LANE_BLOCK}, "
             f"h%128 all zero), got m={m} g={g} h={h}")
+    from g2vec_tpu.cache import record_cache_event
+
     if not force:
         # In-memory hit FIRST, and WITHOUT a token bump: the overlap warm
         # path already swept this shape in this process, and bumping the
@@ -491,11 +500,13 @@ def autotune_packed_matmul(m: int, g: int, h: int, *,
         if ent is not None and _TUNED_BACKEND.get((m, g, h)) \
                 == _autotune_backend_tag(interpret) \
                 and {"fwd", "bwd"} <= set(ent):
+            record_cache_event("autotune", "hit")
             return {"fwd": list(ent["fwd"]), "bwd": list(ent["bwd"]),
                     "source": "memory"}
         hit = load_tuned(cache_path, m, g, h, interpret)
         if hit is not None:
             return hit
+    record_cache_event("autotune", "sweep")
 
     cands = tile_candidates(m, g, h)
     if not cands:

@@ -180,6 +180,28 @@ class OverlapScheduler:
                                                          TaskCancelled):
                 raise task.error
 
+    def prune(self, prefix: str) -> int:
+        """Forget every task whose name starts with ``prefix``, waiting
+        first for any still in flight. A resident engine (batch/engine.py
+        ``ResidentEngine``, the serve daemon) pushes unbounded batches
+        through ONE scheduler; without pruning, each batch's walk/warm
+        tasks — results included — would accumulate for the process
+        lifetime. Per-batch name prefixes keep this safe: nothing outside
+        the batch can depend on a pruned task. Returns the number
+        removed."""
+        with self._lock:
+            victims = [t for t in self._order if t.name.startswith(prefix)]
+        for t in victims:
+            t.done.wait()
+        with self._lock:
+            for t in victims:
+                self._tasks.pop(t.name, None)
+                try:
+                    self._order.remove(t)
+                except ValueError:
+                    pass
+        return len(victims)
+
     def close(self) -> None:
         """Drain without raising, then shut the executor down. Safe in a
         ``finally``: a pipeline failing in a foreground stage must not

@@ -1,0 +1,14 @@
+"""Resident service mode — the ``g2vec serve`` warm-state job daemon.
+
+- protocol.py — the newline-delimited-JSON wire format over a local UNIX
+  stream socket (plus plain-HTTP ``GET /status`` on the same socket).
+- daemon.py — :class:`ServeDaemon`: admission control, tenant-fair queue,
+  shape-bucket-aware job joining, journaled crash recovery, per-job JSONL
+  result streaming, all over ONE resident
+  :class:`~g2vec_tpu.batch.engine.ResidentEngine`.
+- client.py — the submit/status/shutdown client the CLI, bench, and tests
+  speak.
+- cli.py — the ``g2vec serve`` subcommand (daemon + client modes, and the
+  ``--supervise`` watchdog entry).
+"""
+from g2vec_tpu.serve.daemon import ServeDaemon, ServeOptions  # noqa: F401

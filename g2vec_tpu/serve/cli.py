@@ -1,0 +1,159 @@
+"""The ``g2vec serve`` subcommand: daemon, watchdog, and client modes.
+
+Daemon::
+
+    g2vec serve --socket /tmp/g2vec.sock --state-dir /tmp/g2vec-serve \\
+        [--queue-depth 16] [--max-join 4] [--cache-dir DIR] \\
+        [--metrics-jsonl F] [--platform cpu] [--supervise]
+
+Client (same flag, a client op instead of --state-dir)::
+
+    g2vec serve --socket /tmp/g2vec.sock --submit job.json [--tenant me]
+    g2vec serve --socket /tmp/g2vec.sock --status | --ping | --shutdown
+
+``--submit`` streams the job's JSONL events to stdout and exits 0 on
+``job_done``, 4 on ``rejected``, 5 on ``job_failed``, 6 when the daemon
+connection is lost mid-job (the job is journaled — poll
+``<state-dir>/results/<job_id>.json`` or resubmit --status later).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+
+def build_serve_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="g2vec serve",
+        description="Resident g2vec service: a long-lived daemon owning "
+                    "the device and every warm cache, accepting streaming "
+                    "job manifests over a local UNIX socket with admission "
+                    "control and shape-bucket-aware scheduling.")
+    p.add_argument("--socket", required=True, metavar="PATH",
+                   help="UNIX socket path the daemon listens on (clients "
+                        "connect here; curl --unix-socket works for "
+                        "/status).")
+    p.add_argument("--state-dir", default=None, metavar="DIR",
+                   help="Daemon state root: jobs/ (journal of accepted, "
+                        "unfinished jobs — re-queued on restart), "
+                        "results/ (durable per-job terminal records), "
+                        "spool/ (in-flight lane outputs before routing).")
+    p.add_argument("--queue-depth", type=int, default=16, metavar="N",
+                   help="Max queued jobs before admission rejects with a "
+                        "structured queue_full error (default 16).")
+    p.add_argument("--max-join", type=int, default=4, metavar="K",
+                   help="Max shape-compatible jobs merged into one engine "
+                        "batch per scheduling cycle (default 4).")
+    p.add_argument("--job-retries", type=int, default=1, metavar="N",
+                   help="In-process re-queues for a job whose batch failed "
+                        "retryably (default 1).")
+    p.add_argument("--cache-dir", type=str, default=None, metavar="DIR",
+                   help="Persistent cache root (XLA compile + walk "
+                        "artifacts) — what makes a supervised relaunch "
+                        "warm-start instead of cold.")
+    p.add_argument("--metrics-jsonl", type=str, default=None,
+                   help="Daemon-lifetime JSONL metrics stream; every "
+                        "job-scoped event carries job_id (and lane).")
+    p.add_argument("--platform", type=str, default=None,
+                   help="Force a jax platform (e.g. cpu) before first "
+                        "device use.")
+    p.add_argument("--fault-plan", type=str, default=None, metavar="SPEC",
+                   help="Fault-injection spec for chaos drills "
+                        "(resilience/faults.py grammar).")
+    # watchdog
+    p.add_argument("--supervise", action="store_true",
+                   help="Run the daemon under the relaunch watchdog: a "
+                        "crash/SIGKILL restarts it, the journal re-queues "
+                        "in-flight jobs, --cache-dir restores warm state.")
+    p.add_argument("--supervise-retries", type=int, default=3)
+    p.add_argument("--supervise-backoff", type=float, default=1.0)
+    # client ops
+    p.add_argument("--submit", type=str, default=None, metavar="JOB.json",
+                   help="Client mode: submit this job file and stream its "
+                        "events to stdout ('-' reads stdin).")
+    p.add_argument("--tenant", type=str, default="default",
+                   help="Tenant name for --submit (fair-share unit).")
+    p.add_argument("--status", action="store_true",
+                   help="Client mode: print the daemon status JSON.")
+    p.add_argument("--ping", action="store_true",
+                   help="Client mode: liveness probe (exit 0 iff alive).")
+    p.add_argument("--shutdown", action="store_true",
+                   help="Client mode: stop the daemon after its current "
+                        "batch; queued jobs stay journaled.")
+    return p
+
+
+def serve_main(argv: Optional[List[str]] = None) -> int:
+    args = build_serve_parser().parse_args(argv)
+    from g2vec_tpu.serve import client
+
+    if args.status or args.ping or args.shutdown or args.submit:
+        try:
+            if args.status:
+                print(json.dumps(client.status(args.socket), indent=1))
+                return 0
+            if args.ping:
+                print(json.dumps(client.ping(args.socket)))
+                return 0
+            if args.shutdown:
+                print(json.dumps(client.shutdown(args.socket)))
+                return 0
+            src = sys.stdin if args.submit == "-" else open(args.submit)
+            with src:
+                job = json.load(src)
+            try:
+                events = client.submit_job(args.socket, job,
+                                           tenant=args.tenant)
+            except client.ServeConnectionLost as e:
+                print(json.dumps({"event": "connection_lost",
+                                  "job_id": e.job_id, "error": str(e)}))
+                return 6
+            for ev in events:
+                print(json.dumps(ev))
+            last = events[-1].get("event") if events else None
+            return {"job_done": 0, "rejected": 4}.get(last, 5)
+        except OSError as e:
+            print(json.dumps({"event": "error",
+                              "error": f"cannot reach daemon at "
+                                       f"{args.socket}: {e}"}),
+                  file=sys.stderr)
+            return 3
+
+    if not args.state_dir:
+        build_serve_parser().error(
+            "daemon mode needs --state-dir (or pass a client op: "
+            "--submit/--status/--ping/--shutdown)")
+    if args.supervise:
+        from g2vec_tpu.resilience.supervisor import supervise_serve
+
+        return supervise_serve(
+            list(argv) if argv is not None else sys.argv[2:],
+            retries=args.supervise_retries,
+            backoff=args.supervise_backoff,
+            metrics_jsonl=args.metrics_jsonl,
+            state_dir=args.state_dir)
+    if args.cache_dir:
+        # Persistent-compile tier via env BEFORE any jax import, same
+        # rationale as __main__.py's plain-run path.
+        from g2vec_tpu.cache import resolve_cache_tiers
+
+        xla_dir, _ = resolve_cache_tiers(args.cache_dir, None,
+                                         walk_cache_enabled=False)
+        if xla_dir:
+            os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", xla_dir)
+    if args.platform:
+        os.environ["JAX_PLATFORMS"] = args.platform
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+    from g2vec_tpu.serve.daemon import ServeDaemon, ServeOptions
+
+    opts = ServeOptions(
+        socket_path=args.socket, state_dir=args.state_dir,
+        queue_depth=args.queue_depth, max_join=args.max_join,
+        job_retries=args.job_retries, cache_dir=args.cache_dir,
+        metrics_jsonl=args.metrics_jsonl, fault_plan=args.fault_plan)
+    return ServeDaemon(opts).serve_forever()

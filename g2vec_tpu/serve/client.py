@@ -1,0 +1,123 @@
+"""Client for the ``g2vec serve`` daemon (CLI, bench, and test currency).
+
+Talks the protocol.py JSONL dialect over the daemon's UNIX socket. The
+one failure mode worth a dedicated type: the daemon dying mid-job
+(SIGKILL, preemption) closes the stream without a terminal event —
+:class:`ServeConnectionLost` carries the job_id so the caller can fall
+back to :func:`poll_result`, which reads the result record the RELAUNCHED
+daemon writes after the journal re-queues the job.
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import time
+from typing import Iterator, List, Optional
+
+from g2vec_tpu.serve import protocol
+
+
+class ServeConnectionLost(RuntimeError):
+    """The daemon's stream closed before the job's terminal event."""
+
+    def __init__(self, msg: str, job_id: Optional[str] = None):
+        super().__init__(msg)
+        self.job_id = job_id
+
+
+def request(socket_path: str, payload: dict,
+            timeout: Optional[float] = None) -> Iterator[dict]:
+    """Send one request; yield the daemon's JSONL events until it closes
+    the stream. ``timeout`` bounds each socket read, not the whole job."""
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.settimeout(timeout)
+    try:
+        s.connect(socket_path)
+        f = s.makefile("rwb")
+        protocol.write_event(f, payload)
+        while True:
+            ev = protocol.read_event(f)
+            if ev is None:
+                return
+            yield ev
+    finally:
+        s.close()
+
+
+_TERMINAL = ("job_done", "job_failed")
+
+
+def submit_job(socket_path: str, job: dict, tenant: str = "default",
+               timeout: Optional[float] = None) -> List[dict]:
+    """Submit ``job`` and stream its events to completion. Returns every
+    event received ([..., job_done|job_failed] on success/failure, or
+    [rejected] on admission refusal). Raises :class:`ServeConnectionLost`
+    if the stream dies first (daemon killed mid-job — poll_result picks
+    the job back up after the supervisor relaunch)."""
+    events: List[dict] = []
+    job_id = None
+    for ev in request(socket_path,
+                      {"op": "submit", "tenant": tenant, "job": job},
+                      timeout=timeout):
+        events.append(ev)
+        kind = ev.get("event")
+        if kind == "accepted":
+            job_id = ev.get("job_id")
+        if kind == "rejected" or kind in _TERMINAL:
+            return events
+    raise ServeConnectionLost(
+        f"daemon stream closed before job "
+        f"{job_id or '<unacknowledged>'} finished", job_id=job_id)
+
+
+def _one(socket_path: str, op: str, timeout: Optional[float]) -> dict:
+    for ev in request(socket_path, {"op": op}, timeout=timeout):
+        return ev
+    raise ServeConnectionLost(f"no response to {op!r}")
+
+
+def status(socket_path: str, timeout: Optional[float] = 10.0) -> dict:
+    return _one(socket_path, "status", timeout)
+
+
+def ping(socket_path: str, timeout: Optional[float] = 5.0) -> dict:
+    return _one(socket_path, "ping", timeout)
+
+
+def shutdown(socket_path: str, timeout: Optional[float] = 10.0) -> dict:
+    return _one(socket_path, "shutdown", timeout)
+
+
+def wait_ready(socket_path: str, deadline_s: float = 60.0,
+               interval: float = 0.2) -> bool:
+    """Poll until the daemon answers ``ping`` (socket may not exist yet
+    during startup). True when ready, False at the deadline."""
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        try:
+            if ping(socket_path).get("event") == "pong":
+                return True
+        except (OSError, ServeConnectionLost, protocol.ProtocolError):
+            pass
+        time.sleep(interval)
+    return False
+
+
+def poll_result(state_dir: str, job_id: str, deadline_s: float = 300.0,
+                interval: float = 0.25) -> dict:
+    """Wait for ``<state_dir>/results/<job_id>.json`` — the durable
+    terminal record, written even when no client is connected (and the
+    recovery path after :class:`ServeConnectionLost`)."""
+    path = os.path.join(state_dir, "results", f"{job_id}.json")
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    return json.load(f)
+            except (OSError, ValueError):
+                pass        # mid-write; atomic rename makes this brief
+        time.sleep(interval)
+    raise TimeoutError(f"no result record for job {job_id} within "
+                       f"{deadline_s:.0f}s ({path})")

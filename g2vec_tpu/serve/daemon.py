@@ -1,0 +1,675 @@
+"""The resident job daemon: one warm device owner, many jobs.
+
+Every pre-serve invocation — even a PR 5 batched manifest — pays process
+startup, jax init, and cold compiles before its first useful FLOP. HUGE
+(arXiv:2307.14490) keeps a TPU embedding pipeline resident across jobs
+for exactly this reason, and GraphVite (arXiv:1903.00757) overlaps
+CPU-side sampling with accelerator work inside one long-lived process.
+This daemon is that shape for g2vec:
+
+- **One ResidentEngine** (batch/engine.py) owns the device for the daemon
+  lifetime: the jit/LRU chunk programs, the persistent XLA tier, the
+  SharedWalkTier memo, and the preprocessed-dataset memo all stay warm
+  across jobs; newly seen shapes warm in the background on the engine's
+  overlap pool while earlier buckets train.
+- **Admission control**: a bounded multi-tenant queue. A full queue
+  rejects with a structured ``queue_full`` error (back-pressure belongs
+  at the edge, not as an OOM three stages later); malformed jobs reject
+  at submit time with the offending key named.
+- **Shape-bucket-aware scheduling**: when a job is popped, every queued
+  job whose non-variant config coincides (``_join_key``) joins the same
+  batch — their lanes plan into the engine's shape buckets together, so
+  K compatible single-run jobs cost one walk product set and one vmapped
+  trainer program instead of K solo runs.
+- **Tenant fairness**: the queue pops round-robin across tenants, so one
+  tenant's burst cannot starve another's single job.
+- **Per-job JSONL result streaming**: a submitting client holds its
+  connection and receives the job's events (accepted/started/lane_done/
+  job_done) as they happen; a disconnected client loses nothing — the
+  terminal record is also written to ``<state-dir>/results/<job_id>.json``.
+- **Crash recovery**: accepted jobs are journaled to
+  ``<state-dir>/jobs/<job_id>.json`` and un-journaled on completion. A
+  relaunch (the ``--supervise`` watchdog, resilience/supervisor.py
+  ``supervise_serve``) re-queues every journaled job; the persistent
+  ``--cache-dir`` tiers restore the compile and walk caches, so the
+  re-run is warm-start, not cold.
+
+Outputs are BYTE-IDENTICAL to the same config run solo (float32, same
+backend): jobs execute through the engine's lane machinery, whose parity
+contract tests/test_batch_engine.py pins; the daemon only renames the
+spool files to each job's requested ``result_name``.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import queue
+import shutil
+import socket
+import threading
+import time
+import uuid
+from collections import OrderedDict, deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+from g2vec_tpu.batch.engine import (LaneVariant, ManifestError,
+                                    ResidentEngine, _variant_from_dict,
+                                    seed_sweep_variants)
+from g2vec_tpu.config import G2VecConfig, config_from_job
+from g2vec_tpu.serve import protocol
+from g2vec_tpu.utils.integrity import write_json_atomic
+from g2vec_tpu.utils.metrics import MetricsWriter
+
+_TENANT_MAX = 64
+#: Lanes one job may submit; a bigger sweep should be several jobs (the
+#: scheduler joins them anyway) so admission stays per-tenant fair.
+MAX_JOB_LANES = 64
+
+#: Config fields EXCLUDED from the job-join key: per-lane variant axes
+#: (concrete on each LaneVariant by plan time, so the base default is
+#: irrelevant), output/stream locations, and daemon-owned infrastructure.
+#: Everything else must coincide for two jobs to share one engine batch.
+_JOIN_EXCLUDE = frozenset({
+    "result_name", "metrics_jsonl", "manifest", "batch_seeds",
+    "seed", "train_seed", "kmeans_seed", "learningRate", "epoch",
+    "patient_subsample", "subsample_seed",
+    "cache_dir", "compilation_cache", "profile_dir", "fault_plan"})
+
+
+def _join_key(cfg: G2VecConfig) -> Tuple:
+    return tuple((f.name, repr(getattr(cfg, f.name)))
+                 for f in dataclasses.fields(cfg)
+                 if f.name not in _JOIN_EXCLUDE)
+
+
+class QueueFull(RuntimeError):
+    """Admission rejected: the bounded job queue is at capacity."""
+
+
+@dataclasses.dataclass
+class ServeOptions:
+    """Daemon configuration (the ``g2vec serve`` flag surface)."""
+
+    socket_path: str
+    state_dir: str
+    queue_depth: int = 16        # max jobs queued (not yet executing)
+    max_join: int = 4            # max jobs merged into one engine batch
+    job_retries: int = 1         # in-process retries for retryable failures
+    cache_dir: Optional[str] = None
+    metrics_jsonl: Optional[str] = None
+    fault_plan: Optional[str] = None
+
+
+@dataclasses.dataclass
+class ServeJob:
+    """One admitted job: a validated config + planned lanes + routing."""
+
+    job_id: str
+    tenant: str
+    cfg: G2VecConfig
+    variants: List[LaneVariant]
+    raw: dict                    # the submit payload, journal currency
+    submitted_at: float
+    join_key: Tuple = ()
+    attempts: int = 0
+    subscriber: Optional["queue.Queue"] = None
+
+
+class _FairQueue:
+    """Bounded multi-tenant FIFO with round-robin pop.
+
+    Per-tenant deques; ``pop`` serves the first tenant with work and
+    rotates it to the back, so a tenant submitting N jobs waits behind
+    every other tenant once per own job, not zero times.
+    ``take_compatible`` pulls additional queued jobs with a matching join
+    key (any tenant, FIFO within each) for batch joining — those jobs
+    would only have waited longer by staying queued.
+    """
+
+    def __init__(self, depth: int):
+        self._depth = depth
+        self._tenants: "OrderedDict[str, deque]" = OrderedDict()
+        self._n = 0
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+
+    def depth(self) -> int:
+        with self._lock:
+            return self._n
+
+    def push(self, job: ServeJob) -> None:
+        with self._lock:
+            if self._n >= self._depth:
+                raise QueueFull(
+                    f"job queue is full ({self._n}/{self._depth})")
+            self._tenants.setdefault(job.tenant, deque()).append(job)
+            self._n += 1
+            self._not_empty.notify()
+
+    def pop(self, timeout: Optional[float] = None) -> Optional[ServeJob]:
+        with self._not_empty:
+            if not self._n:
+                self._not_empty.wait(timeout)
+            for name, dq in list(self._tenants.items()):
+                if dq:
+                    self._tenants.move_to_end(name)
+                    self._n -= 1
+                    return dq.popleft()
+            return None
+
+    def take_compatible(self, key: Tuple, limit: int) -> List[ServeJob]:
+        out: List[ServeJob] = []
+        if limit <= 0:
+            return out
+        with self._lock:
+            for name, dq in list(self._tenants.items()):
+                keep: deque = deque()
+                while dq:
+                    j = dq.popleft()
+                    if len(out) < limit and j.join_key == key:
+                        out.append(j)
+                    else:
+                        keep.append(j)
+                self._tenants[name] = keep
+            self._n -= len(out)
+        return out
+
+
+class ServeDaemon:
+    """See the module docstring. Scheduling (:meth:`step`) and admission
+    (:meth:`admit`) are plain methods so tests drive them in-process;
+    :meth:`serve_forever` adds the socket front-end and the scheduler
+    thread for the real daemon."""
+
+    def __init__(self, opts: ServeOptions,
+                 console: Callable[[str], None] = print):
+        if opts.queue_depth < 1:
+            raise ValueError(f"--queue-depth must be >= 1, "
+                             f"got {opts.queue_depth}")
+        if opts.max_join < 1:
+            raise ValueError(f"--max-join must be >= 1, "
+                             f"got {opts.max_join}")
+        if opts.job_retries < 0:
+            raise ValueError(f"--job-retries must be >= 0, "
+                             f"got {opts.job_retries}")
+        self.opts = opts
+        self.console = console
+        self._jobs_dir = os.path.join(opts.state_dir, "jobs")
+        self._results_dir = os.path.join(opts.state_dir, "results")
+        self._spool_dir = os.path.join(opts.state_dir, "spool")
+        for d in (self._jobs_dir, self._results_dir, self._spool_dir):
+            os.makedirs(d, exist_ok=True)
+        self.metrics = MetricsWriter(opts.metrics_jsonl, append=True)
+        self.engine = ResidentEngine(cache_dir=opts.cache_dir)
+        self._queue = _FairQueue(opts.queue_depth)
+        self._defaults = G2VecConfig()
+        self._running: Dict[str, ServeJob] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._t0 = time.time()
+        self._serial = 0
+        self._batches = 0
+        self.jobs_done = 0
+        self.jobs_failed = 0
+        if opts.fault_plan:
+            from g2vec_tpu.resilience.faults import install_plan
+
+            install_plan(opts.fault_plan)
+        self._recover_journal()
+
+    # ---- admission --------------------------------------------------------
+
+    def _new_job_id(self) -> str:
+        self._serial += 1
+        return f"j{self._serial:04d}-{uuid.uuid4().hex[:8]}"
+
+    def _plan_job(self, payload: dict, job_id: Optional[str] = None,
+                  submitted_at: Optional[float] = None) -> ServeJob:
+        """Validate a submit payload into a ServeJob (raises ValueError /
+        ManifestError naming the problem — rejection happens at admission,
+        never mid-batch)."""
+        if not isinstance(payload, dict):
+            raise ValueError(
+                f"submit payload must be an object, got "
+                f"{type(payload).__name__}")
+        tenant = payload.get("tenant", "default")
+        if not isinstance(tenant, str) or not tenant \
+                or len(tenant) > _TENANT_MAX:
+            raise ValueError(f"'tenant' must be a 1-{_TENANT_MAX} char "
+                             f"string, got {tenant!r}")
+        jobd = payload.get("job")
+        if not isinstance(jobd, dict):
+            raise ValueError("submit needs a 'job' object")
+        base = dict(jobd)
+        variants_spec = base.pop("variants", None)
+        seeds = base.pop("seeds", 0)
+        cfg = config_from_job(base, self._defaults)
+        if variants_spec is not None and seeds:
+            raise ValueError("job sets both 'variants' and 'seeds' — "
+                             "pick one")
+        if seeds:
+            if not isinstance(seeds, int) or isinstance(seeds, bool) \
+                    or not (1 <= seeds <= MAX_JOB_LANES):
+                raise ValueError(f"'seeds' must be an int in "
+                                 f"[1, {MAX_JOB_LANES}], got {seeds!r}")
+            variants = seed_sweep_variants(cfg, seeds)
+        elif variants_spec is not None:
+            if not isinstance(variants_spec, list) or not variants_spec:
+                raise ValueError("'variants' must be a non-empty list of "
+                                 "variant objects")
+            if len(variants_spec) > MAX_JOB_LANES:
+                raise ValueError(
+                    f"job has {len(variants_spec)} variants; the per-job "
+                    f"cap is {MAX_JOB_LANES} (submit several jobs — the "
+                    f"scheduler joins compatible ones anyway)")
+            variants = [_variant_from_dict(i, o, cfg)
+                        for i, o in enumerate(variants_spec)]
+            names = [v.name for v in variants]
+            dupes = sorted({n for n in names if names.count(n) > 1})
+            if dupes:
+                raise ValueError(f"duplicate variant name(s) {dupes} — "
+                                 f"lane outputs would overwrite each other")
+        else:
+            variants = [_variant_from_dict(0, {"name": "v"}, cfg)]
+        job = ServeJob(job_id=job_id or self._new_job_id(), tenant=tenant,
+                       cfg=cfg, variants=variants, raw=payload,
+                       submitted_at=(time.time() if submitted_at is None
+                                     else submitted_at))
+        job.join_key = _join_key(cfg)
+        return job
+
+    def admit(self, payload: dict,
+              subscriber: Optional["queue.Queue"] = None) -> dict:
+        """Admission control: validate + enqueue, or reject with a
+        structured error. Returns the ``accepted``/``rejected`` event."""
+        try:
+            job = self._plan_job(payload)
+        except (ValueError, TypeError, ManifestError) as e:
+            self.metrics.emit("job_rejected", error="bad_job",
+                              detail=str(e)[:300])
+            return {"event": "rejected", "error": "bad_job",
+                    "detail": str(e)[:500]}
+        if self._stop.is_set():
+            return {"event": "rejected", "error": "shutting_down",
+                    "job_id": job.job_id}
+        job.subscriber = subscriber
+        try:
+            self._queue.push(job)
+        except QueueFull:
+            self.metrics.bind_job(job.job_id).emit(
+                "job_rejected", error="queue_full", tenant=job.tenant)
+            return {"event": "rejected", "error": "queue_full",
+                    "detail": f"admission queue is at its "
+                              f"--queue-depth cap ({self.opts.queue_depth})",
+                    "queue_depth": self.opts.queue_depth,
+                    "job_id": job.job_id}
+        self._journal(job)
+        self.metrics.bind_job(job.job_id).emit(
+            "job_accepted", tenant=job.tenant, n_lanes=len(job.variants),
+            queued=self._queue.depth())
+        return {"event": "accepted", "job_id": job.job_id,
+                "tenant": job.tenant, "n_lanes": len(job.variants),
+                "state_dir": self.opts.state_dir}
+
+    # ---- journal / crash recovery ----------------------------------------
+
+    def _journal(self, job: ServeJob) -> None:
+        write_json_atomic(
+            os.path.join(self._jobs_dir, f"{job.job_id}.json"),
+            {"job_id": job.job_id, "tenant": job.tenant,
+             "submitted_at": job.submitted_at, "payload": job.raw})
+
+    def _unjournal(self, job: ServeJob) -> None:
+        try:
+            os.unlink(os.path.join(self._jobs_dir, f"{job.job_id}.json"))
+        except OSError:
+            pass
+
+    def _recover_journal(self) -> None:
+        """Re-queue every journaled (accepted, unfinished) job — the
+        supervisor relaunch path. Jobs whose payload no longer validates
+        (input files gone) fail with a result record instead of wedging
+        the daemon."""
+        import json
+
+        recs = []
+        for fn in os.listdir(self._jobs_dir):
+            if not fn.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(self._jobs_dir, fn)) as f:
+                    recs.append(json.load(f))
+            except (OSError, ValueError):
+                self.console(f"[serve] dropping unreadable journal {fn}")
+                os.unlink(os.path.join(self._jobs_dir, fn))
+        for rec in sorted(recs, key=lambda r: r.get("submitted_at", 0.0)):
+            job_id = rec.get("job_id", "?")
+            self._serial += 1          # keep new ids monotonic-ish
+            try:
+                job = self._plan_job(rec["payload"], job_id=job_id,
+                                     submitted_at=rec.get("submitted_at"))
+                self._queue.push(job)
+            except (KeyError, ValueError, TypeError, ManifestError,
+                    QueueFull) as e:
+                self._finish_failed(
+                    ServeJob(job_id=job_id, tenant=rec.get("tenant", "?"),
+                             cfg=self._defaults, variants=[],
+                             raw=rec.get("payload", {}),
+                             submitted_at=rec.get("submitted_at", 0.0)),
+                    f"requeue failed: {type(e).__name__}: {e}",
+                    classified="fatal")
+                continue
+            self.metrics.bind_job(job_id).emit("job_requeued",
+                                               tenant=job.tenant)
+            self.console(f"[serve] re-queued journaled job {job_id} "
+                         f"(tenant {job.tenant!r})")
+
+    # ---- scheduling / execution ------------------------------------------
+
+    def step(self, timeout: float = 0.2) -> int:
+        """One scheduling cycle: pop the next job (tenant-fair), join every
+        shape-compatible queued job into the same engine batch, execute,
+        route results. Returns the number of jobs completed (0 = idle)."""
+        job = self._queue.pop(timeout=timeout)
+        if job is None:
+            return 0
+        batch = [job] + self._queue.take_compatible(
+            job.join_key, self.opts.max_join - 1)
+        return self._run_jobs(batch)
+
+    def _notify(self, job: ServeJob, event: Optional[dict]) -> None:
+        q = job.subscriber
+        if q is not None:
+            q.put(event)
+
+    def _run_jobs(self, batch: List[ServeJob]) -> int:
+        self._batches += 1
+        bid = self._batches
+        with self._lock:
+            self._running.update({j.job_id: j for j in batch})
+        merged: List[LaneVariant] = []
+        lane_jobs: List[str] = []
+        lane_owner: List[Tuple[ServeJob, LaneVariant]] = []
+        for j in batch:
+            for v in j.variants:
+                merged.append(dataclasses.replace(
+                    v, index=len(merged), name=f"{j.job_id}.{v.name}"))
+                lane_jobs.append(j.job_id)
+                lane_owner.append((j, v))
+        spool = os.path.join(self._spool_dir, f"batch{bid}")
+        exec_cfg = dataclasses.replace(
+            batch[0].cfg, result_name=os.path.join(spool, "out"),
+            metrics_jsonl=None, manifest=None, batch_seeds=0)
+        self.metrics.emit("batch_start", batch=bid,
+                          jobs=[j.job_id for j in batch],
+                          n_lanes=len(merged))
+        for j in batch:
+            self._notify(j, {"event": "started", "job_id": j.job_id,
+                             "batch": bid, "joined_jobs": len(batch),
+                             "n_lanes": len(j.variants)})
+        t0 = time.time()
+        try:
+            res = self.engine.execute(exec_cfg, merged,
+                                      console=self.console,
+                                      metrics=self.metrics,
+                                      lane_jobs=lane_jobs)
+        except BaseException as e:  # noqa: BLE001 — classified below
+            if isinstance(e, (KeyboardInterrupt, SystemExit)):
+                raise
+            from g2vec_tpu.resilience.supervisor import classify_exception
+
+            verdict = classify_exception(e)
+            err = f"{type(e).__name__}: {e}"[:500]
+            self.console(f"[serve] batch {bid} failed ({verdict}): {err}")
+            for j in batch:
+                self._fail_or_requeue(j, err, verdict)
+            shutil.rmtree(spool, ignore_errors=True)
+            with self._lock:
+                for j in batch:
+                    self._running.pop(j.job_id, None)
+            return 0
+
+        wall = time.time() - t0
+        by_job: Dict[str, Dict] = {}
+        for (j, v), lane in zip(lane_owner, res.lanes):
+            outs = self._route_outputs(j, v, lane)
+            by_job.setdefault(j.job_id, {})[v.name] = {
+                "outputs": outs, "stop_epoch": len(lane.train_history),
+                "acc_val": lane.acc_val}
+            self._notify(j, {"event": "lane_done", "job_id": j.job_id,
+                             "variant": v.name, "outputs": outs,
+                             "acc_val": lane.acc_val})
+        shutil.rmtree(spool, ignore_errors=True)
+        now = time.time()
+        for j in batch:
+            record = {"event": "job_done", "job_id": j.job_id,
+                      "tenant": j.tenant, "status": "done",
+                      "variants": by_job.get(j.job_id, {}),
+                      "batch": bid, "joined_jobs": len(batch),
+                      "batch_wall_seconds": round(wall, 3),
+                      "latency_seconds": round(now - j.submitted_at, 3),
+                      "submitted_at": j.submitted_at, "finished_at": now}
+            write_json_atomic(
+                os.path.join(self._results_dir, f"{j.job_id}.json"), record)
+            self._unjournal(j)
+            self.jobs_done += 1
+            self.metrics.bind_job(j.job_id).emit(
+                "job_done", tenant=j.tenant, batch=bid,
+                joined_jobs=len(batch),
+                latency_seconds=record["latency_seconds"])
+            self._notify(j, record)
+            self._notify(j, None)
+        with self._lock:
+            for j in batch:
+                self._running.pop(j.job_id, None)
+        self.console(f"[serve] batch {bid}: {len(batch)} job(s), "
+                     f"{len(merged)} lane(s) in {wall:.2f}s "
+                     f"({res.runs_per_hour:.0f} runs/hour)")
+        return len(batch)
+
+    def _route_outputs(self, job: ServeJob, v: LaneVariant, lane) -> List[str]:
+        """Move a lane's spool files to the job's requested result_name —
+        a rename, so served bytes ARE the engine's lane bytes."""
+        dest_dir = os.path.dirname(job.cfg.result_name)
+        if dest_dir:
+            os.makedirs(dest_dir, exist_ok=True)
+        outs = []
+        for f in lane.output_files:
+            suffix = f.rsplit("_", 1)[1]        # biomarkers|lgroups|vectors
+            dest = f"{job.cfg.result_name}.{v.name}_{suffix}"
+            shutil.move(f, dest)
+            outs.append(dest)
+        return outs
+
+    def _fail_or_requeue(self, job: ServeJob, err: str,
+                         classified: str) -> None:
+        if classified == "retryable" and job.attempts < self.opts.job_retries:
+            job.attempts += 1
+            try:
+                self._queue.push(job)
+            except QueueFull:
+                self._finish_failed(job, f"{err} (retry queue full)",
+                                    classified)
+                return
+            self.metrics.bind_job(job.job_id).emit(
+                "job_retry", attempt=job.attempts, error=err)
+            self._notify(job, {"event": "job_retry", "job_id": job.job_id,
+                               "attempt": job.attempts, "error": err})
+            return
+        self._finish_failed(job, err, classified)
+
+    def _finish_failed(self, job: ServeJob, err: str,
+                       classified: str) -> None:
+        record = {"event": "job_failed", "job_id": job.job_id,
+                  "tenant": job.tenant, "status": "failed", "error": err,
+                  "classified": classified,
+                  "submitted_at": job.submitted_at,
+                  "finished_at": time.time()}
+        write_json_atomic(
+            os.path.join(self._results_dir, f"{job.job_id}.json"), record)
+        self._unjournal(job)
+        self.jobs_failed += 1
+        self.metrics.bind_job(job.job_id).emit("job_failed", error=err,
+                                               classified=classified)
+        self._notify(job, record)
+        self._notify(job, None)
+
+    # ---- status -----------------------------------------------------------
+
+    def status(self) -> dict:
+        """The warm-state + queue inventory (the ``/status`` payload)."""
+        from g2vec_tpu.cache import cache_stats
+
+        with self._lock:
+            running = sorted(self._running)
+        return {"event": "status", "pid": os.getpid(),
+                "uptime_s": round(time.time() - self._t0, 1),
+                "socket": self.opts.socket_path,
+                "state_dir": self.opts.state_dir,
+                "queued": self._queue.depth(), "running": running,
+                "queue_depth_limit": self.opts.queue_depth,
+                "max_join": self.opts.max_join,
+                "jobs_done": self.jobs_done,
+                "jobs_failed": self.jobs_failed,
+                "engine": self.engine.status(),
+                "cache": cache_stats()}
+
+    # ---- socket front-end -------------------------------------------------
+
+    def _handle_conn(self, conn: "socket.socket") -> None:
+        f = conn.makefile("rwb")
+        try:
+            first = f.readline(protocol.MAX_LINE_BYTES)
+            if not first:
+                return
+            if first.startswith(b"GET "):
+                self._serve_http(f, first)
+                return
+            import json
+
+            try:
+                req = json.loads(first)
+                if not isinstance(req, dict):
+                    raise ValueError("request must be a JSON object")
+            except ValueError as e:
+                protocol.write_event(f, {"event": "error",
+                                         "error": f"bad request: {e}"})
+                return
+            op = req.get("op")
+            if op == "submit":
+                sub: "queue.Queue" = queue.Queue()
+                resp = self.admit(req, subscriber=sub)
+                protocol.write_event(f, resp)
+                if resp["event"] != "accepted":
+                    return
+                while True:
+                    ev = sub.get()
+                    if ev is None:
+                        break
+                    protocol.write_event(f, ev)
+            elif op == "status":
+                protocol.write_event(f, self.status())
+            elif op == "ping":
+                protocol.write_event(f, {"event": "pong",
+                                         "pid": os.getpid()})
+            elif op == "shutdown":
+                protocol.write_event(
+                    f, {"event": "shutting_down",
+                        "queued": self._queue.depth(),
+                        "note": "queued jobs stay journaled and re-queue "
+                                "on the next start"})
+                self._stop.set()
+            else:
+                protocol.write_event(f, {"event": "error",
+                                         "error": f"unknown op {op!r}"})
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass      # client went away; any running job continues
+        finally:
+            try:
+                f.close()
+                conn.close()
+            except OSError:
+                pass
+
+    def _serve_http(self, f, first: bytes) -> None:
+        import json
+
+        parts = first.split()
+        path = parts[1].decode("latin-1") if len(parts) >= 2 else "/"
+        if path in ("/status", "/status/"):
+            body = json.dumps(self.status()).encode()
+            head = b"HTTP/1.0 200 OK\r\n"
+        else:
+            body = json.dumps({"error": f"unknown path {path!r}; "
+                                        f"try /status"}).encode()
+            head = b"HTTP/1.0 404 Not Found\r\n"
+        f.write(head + b"Content-Type: application/json\r\n"
+                + f"Content-Length: {len(body)}\r\n\r\n".encode() + body)
+        f.flush()
+
+    def serve_forever(self) -> int:
+        """Bind the socket, run the scheduler thread, serve until a
+        ``shutdown`` op or SIGTERM. Returns the process exit code."""
+        import signal
+
+        def _sched():
+            while not self._stop.is_set():
+                try:
+                    self.step(timeout=0.2)
+                except Exception as e:  # noqa: BLE001 — daemon must live
+                    self.console(f"[serve] scheduler error: "
+                                 f"{type(e).__name__}: {e}")
+                    self.metrics.emit("scheduler_error",
+                                      error=f"{type(e).__name__}: {e}"[:300])
+
+        sched = threading.Thread(target=_sched, name="g2v-serve-sched",
+                                 daemon=True)
+        sched.start()
+        try:
+            signal.signal(signal.SIGTERM,
+                          lambda *_: self._stop.set())
+        except ValueError:
+            pass      # not the main thread (tests) — SIGTERM unhandled
+        if os.path.exists(self.opts.socket_path):
+            os.unlink(self.opts.socket_path)    # stale socket from a kill
+        srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        srv.bind(self.opts.socket_path)
+        srv.listen(16)
+        srv.settimeout(0.25)
+        self.metrics.emit("serve_start", pid=os.getpid(),
+                          socket=self.opts.socket_path,
+                          state_dir=self.opts.state_dir,
+                          queued=self._queue.depth())
+        self.console(f"[serve] listening on {self.opts.socket_path} "
+                     f"(state {self.opts.state_dir}, queue depth "
+                     f"{self.opts.queue_depth}, max join "
+                     f"{self.opts.max_join})")
+        try:
+            while not self._stop.is_set():
+                try:
+                    conn, _ = srv.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    break
+                threading.Thread(target=self._handle_conn, args=(conn,),
+                                 name="g2v-serve-conn", daemon=True).start()
+        finally:
+            srv.close()
+            try:
+                os.unlink(self.opts.socket_path)
+            except OSError:
+                pass
+            sched.join(timeout=600.0)
+            self.metrics.emit("serve_stop", jobs_done=self.jobs_done,
+                              jobs_failed=self.jobs_failed,
+                              queued=self._queue.depth())
+            self.console(f"[serve] stopped ({self.jobs_done} job(s) done, "
+                         f"{self._queue.depth()} still queued/journaled)")
+            self.close()
+        return 0
+
+    def close(self) -> None:
+        self._stop.set()
+        self.engine.close()
+        self.metrics.close()

@@ -1,0 +1,56 @@
+"""Wire protocol for ``g2vec serve``: JSONL over a local UNIX socket.
+
+One request object per connection, newline-terminated; the daemon answers
+with a stream of newline-delimited JSON events and closes the stream after
+the terminal event (``accepted``/``rejected`` + per-job progress ending in
+``job_done``/``job_failed`` for submits; a single event for
+``status``/``ping``/``shutdown``). Line-delimited JSON keeps both sides
+trivially incremental — the daemon can stream a job's events as they
+happen and a shell client is one ``nc -U`` away.
+
+The same socket also answers plain HTTP ``GET /status`` (detected from the
+request's first bytes), so ``curl --unix-socket <sock> http://g2vec/status``
+works without a client library.
+
+Requests::
+
+    {"op": "submit", "tenant": "alice", "job": {...}}   # see daemon.py
+    {"op": "status"} | {"op": "ping"} | {"op": "shutdown"}
+"""
+from __future__ import annotations
+
+import json
+from typing import IO, Optional
+
+#: One line must fit a submit with a large manifest, with headroom; a
+#: longer line is a protocol error, not an OOM.
+MAX_LINE_BYTES = 8 << 20
+
+
+class ProtocolError(ValueError):
+    """A malformed request/response line."""
+
+
+def write_event(f: IO[bytes], obj: dict) -> None:
+    """One JSONL record, flushed — event streams must not sit in buffers."""
+    f.write(json.dumps(obj).encode() + b"\n")
+    f.flush()
+
+
+def read_event(f: IO[bytes]) -> Optional[dict]:
+    """The next JSONL record, or None on a closed stream."""
+    line = f.readline(MAX_LINE_BYTES)
+    if not line:
+        return None
+    if len(line) >= MAX_LINE_BYTES and not line.endswith(b"\n"):
+        raise ProtocolError(
+            f"line exceeds {MAX_LINE_BYTES} bytes — truncated or not a "
+            f"g2vec serve peer")
+    try:
+        obj = json.loads(line)
+    except ValueError as e:
+        raise ProtocolError(f"not a JSON line: {e}") from e
+    if not isinstance(obj, dict):
+        raise ProtocolError(
+            f"expected a JSON object per line, got {type(obj).__name__}")
+    return obj

@@ -579,12 +579,15 @@ def _lru_get(cache: "OrderedDict", key, limit: int, make):
     the overlap scheduler warms the chunk fn in the background while the
     foreground trainer may request the identical key, and two distinct
     jitted wrappers would compile the same program twice."""
+    from g2vec_tpu.cache import record_cache_event
+
     pending_key = (id(cache), key)
     while True:
         with _CACHE_LOCK:
             fn = cache.get(key)
             if fn is not None:
                 cache.move_to_end(key)
+                record_cache_event("compile", "program_hit")
                 return fn
             ev = _CACHE_PENDING.get(pending_key)
             if ev is None:
@@ -593,6 +596,7 @@ def _lru_get(cache: "OrderedDict", key, limit: int, make):
                 break
         ev.wait()
     try:
+        record_cache_event("compile", "program_miss")
         fn = make()
         with _CACHE_LOCK:
             while len(cache) >= limit:

@@ -49,13 +49,21 @@ class MetricsWriter:
                 self._fout.close()
                 self._fout = None
 
-    def bind_lane(self, lane: str) -> "LaneMetrics":
+    def bind_lane(self, lane: str) -> "BoundMetrics":
         """A view of this writer that stamps every event with ``lane`` —
         the batch engine gives each manifest lane one, so B interleaving
         runs stay per-run parseable inside ONE chronological JSONL stream
         (filter on the ``lane`` field; events without it are batch-scoped).
         ``lane`` is '<manifest index>:<variant fingerprint>'."""
-        return LaneMetrics(self, lane)
+        return BoundMetrics(self, lane=lane)
+
+    def bind_job(self, job_id: str) -> "BoundMetrics":
+        """A view stamping every event with ``job_id`` — the serve daemon
+        binds one per admitted job, so the lanes of interleaved (and
+        bucket-joined) jobs inside ONE daemon stream stay attributable to
+        the job that submitted them. Chains with :meth:`bind_lane`:
+        ``writer.bind_job(j).bind_lane(l)`` stamps both fields."""
+        return BoundMetrics(self, job_id=job_id)
 
     def __enter__(self) -> "MetricsWriter":
         return self
@@ -64,17 +72,38 @@ class MetricsWriter:
         self.close()
 
 
-class LaneMetrics:
-    """A lane-bound emit() facade over a shared :class:`MetricsWriter`.
+class BoundMetrics:
+    """A field-stamping emit() facade over a shared :class:`MetricsWriter`.
 
-    Deliberately NOT a subclass and NOT closable: the engine owns the
-    writer's lifecycle; lanes only decorate events. Thread-safety is the
-    writer's (lanes may emit from overlap-pool threads).
+    Deliberately NOT a subclass and NOT closable: the engine (or the serve
+    daemon) owns the writer's lifecycle; bound views only decorate events.
+    Views chain — ``bind_job(...).bind_lane(...)`` — each returning a new
+    view with the union of stamped fields. Thread-safety is the writer's
+    (views may emit from overlap-pool threads).
     """
 
-    def __init__(self, writer: MetricsWriter, lane: str):
+    def __init__(self, writer: MetricsWriter, **fields):
         self._writer = writer
-        self.lane = lane
+        self._fields = fields
+
+    @property
+    def lane(self) -> Optional[str]:
+        return self._fields.get("lane")
+
+    @property
+    def job_id(self) -> Optional[str]:
+        return self._fields.get("job_id")
+
+    def bind_lane(self, lane: str) -> "BoundMetrics":
+        return BoundMetrics(self._writer, **{**self._fields, "lane": lane})
+
+    def bind_job(self, job_id: str) -> "BoundMetrics":
+        return BoundMetrics(self._writer,
+                            **{**self._fields, "job_id": job_id})
 
     def emit(self, event: str, **fields) -> None:
-        self._writer.emit(event, lane=self.lane, **fields)
+        self._writer.emit(event, **{**self._fields, **fields})
+
+
+#: Back-compat name: lane-bound views predate the job dimension.
+LaneMetrics = BoundMetrics

@@ -1,0 +1,440 @@
+"""Serve subsystem (serve/): admission control, tenant fairness, job
+joining, served-vs-solo byte parity, job-scoped metrics, cache_stats,
+and the daemon lifecycle (warm-latency smoke, supervisor SIGKILL
+re-queue) over real subprocesses.
+
+The daemon's contract mirrors the batch engine's: residency is a pure
+wall-clock optimization — a served job's output files must be byte-for-
+byte what the same config produces solo. The in-process tests drive
+ServeDaemon.admit/step directly (no sockets, no threads) so scheduling
+decisions are deterministic and assertable; the subprocess tests cover
+the socket front-end, the watchdog, and the crash-recovery journal.
+"""
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from g2vec_tpu.resilience import faults
+
+pytestmark = pytest.mark.serve
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv(faults.ENV_PLAN, raising=False)
+    monkeypatch.delenv(faults.ENV_STATE, raising=False)
+    faults._reset_for_tests()
+    yield
+    faults._reset_for_tests()
+
+
+@pytest.fixture(scope="module")
+def tsv_paths(tmp_path_factory):
+    from g2vec_tpu.data.synthetic import SyntheticSpec, write_synthetic_tsv
+
+    spec = SyntheticSpec(n_good=24, n_poor=20, module_size=12,
+                         n_background=24, n_expr_only=4, n_net_only=4,
+                         module_chords=2, background_edges=40, seed=7)
+    out = tmp_path_factory.mktemp("syn")
+    return write_synthetic_tsv(spec, str(out))
+
+
+def _job(tsv_paths, tmp_path, name, **overrides):
+    job = dict(
+        expression_file=tsv_paths["expression"],
+        clinical_file=tsv_paths["clinical"],
+        network_file=tsv_paths["network"],
+        result_name=os.path.join(str(tmp_path), "out", name),
+        lenPath=8, numRepetition=2, sizeHiddenlayer=16, epoch=30,
+        learningRate=0.05, numBiomarker=5, compute_dtype="float32",
+        walker_backend="device")
+    job.update(overrides)
+    return job
+
+
+def _daemon(tmp_path, **opt_overrides):
+    from g2vec_tpu.serve.daemon import ServeDaemon, ServeOptions
+
+    opts = ServeOptions(
+        socket_path=os.path.join(str(tmp_path), "serve.sock"),
+        state_dir=os.path.join(str(tmp_path), "state"), **opt_overrides)
+    return ServeDaemon(opts, console=lambda s: None)
+
+
+def _result(daemon, job_id):
+    path = os.path.join(daemon.opts.state_dir, "results", f"{job_id}.json")
+    with open(path) as f:
+        return json.load(f)
+
+
+# ---------------------------------------------------------------------------
+# Admission control
+# ---------------------------------------------------------------------------
+
+def test_queue_full_rejects_with_structured_error(tsv_paths, tmp_path):
+    d = _daemon(tmp_path, queue_depth=2)
+    try:
+        ok1 = d.admit({"tenant": "a",
+                       "job": _job(tsv_paths, tmp_path, "a1")})
+        ok2 = d.admit({"tenant": "b",
+                       "job": _job(tsv_paths, tmp_path, "b1")})
+        assert ok1["event"] == ok2["event"] == "accepted"
+        rej = d.admit({"tenant": "c",
+                       "job": _job(tsv_paths, tmp_path, "c1")})
+        assert rej["event"] == "rejected"
+        assert rej["error"] == "queue_full"
+        assert rej["queue_depth"] == 2
+        # Rejected jobs are NOT journaled — a restart must not resurrect
+        # work the client was told to resubmit.
+        journaled = os.listdir(os.path.join(d.opts.state_dir, "jobs"))
+        assert len(journaled) == 2
+    finally:
+        d.close()
+
+
+def test_bad_jobs_reject_at_admission_naming_the_problem(
+        tsv_paths, tmp_path):
+    d = _daemon(tmp_path)
+    try:
+        for payload, needle in [
+            ({"job": {**_job(tsv_paths, tmp_path, "x"),
+                      "cache_dir": "/tmp/x"}}, "cache_dir"),
+            ({"job": {**_job(tsv_paths, tmp_path, "x"),
+                      "mesh_shape": "2x1"}}, "mesh_shape"),
+            ({"job": {**_job(tsv_paths, tmp_path, "x"),
+                      "learningRate": -1}}, "learningRate"),
+            ({"job": {**_job(tsv_paths, tmp_path, "x"),
+                      "variants": [{"train_seed": -2}]}}, "train_seed"),
+            ({"job": {**_job(tsv_paths, tmp_path, "x"),
+                      "variants": [], }}, "variants"),
+            ({"job": {**_job(tsv_paths, tmp_path, "x"),
+                      "variants": [{}], "seeds": 2}}, "seeds"),
+            ({"job": "nope"}, "object"),
+            ({"tenant": "", "job": _job(tsv_paths, tmp_path, "x")},
+             "tenant"),
+        ]:
+            rej = d.admit(payload)
+            assert rej["event"] == "rejected", payload
+            assert rej["error"] == "bad_job"
+            assert needle in rej["detail"], (needle, rej["detail"])
+        assert d._queue.depth() == 0
+    finally:
+        d.close()
+
+
+def test_config_from_job_whitelist():
+    from g2vec_tpu.config import SERVE_JOB_KEYS, config_from_job
+
+    base = {"expression_file": "E", "clinical_file": "C",
+            "network_file": "N", "result_name": "R"}
+    cfg = config_from_job({**base, "epoch": 40, "train_seed": 7})
+    assert (cfg.epoch, cfg.train_seed) == (40, 7)
+    # Infrastructure fields are not job-settable, by whitelist.
+    for infra in ("cache_dir", "supervise", "fleet_size", "distributed",
+                  "checkpoint_dir", "manifest", "batch_seeds", "platform"):
+        assert infra not in SERVE_JOB_KEYS
+        with pytest.raises(ValueError, match=infra):
+            config_from_job({**base, infra: 1})
+    with pytest.raises(ValueError, match="result_name"):
+        config_from_job({k: v for k, v in base.items()
+                         if k != "result_name"})
+
+
+# ---------------------------------------------------------------------------
+# Scheduling: fairness + shape-compatible joining + parity
+# ---------------------------------------------------------------------------
+
+def test_fair_queue_round_robin_and_take_compatible():
+    from g2vec_tpu.serve.daemon import QueueFull, ServeJob, _FairQueue
+
+    def mk(tenant, i, key=("k",)):
+        j = ServeJob(job_id=f"{tenant}{i}", tenant=tenant, cfg=None,
+                     variants=[], raw={}, submitted_at=float(i))
+        j.join_key = key
+        return j
+
+    q = _FairQueue(depth=8)
+    for j in [mk("a", 0), mk("a", 1), mk("a", 2), mk("b", 0), mk("c", 0)]:
+        q.push(j)
+    order = [q.pop(timeout=0).job_id for _ in range(5)]
+    # Round-robin across tenants: a burst from 'a' cannot starve b/c.
+    assert order == ["a0", "b0", "c0", "a1", "a2"]
+    assert q.pop(timeout=0) is None
+
+    q = _FairQueue(depth=3)
+    q.push(mk("a", 0))
+    q.push(mk("a", 1, key=("other",)))
+    q.push(mk("b", 0))
+    with pytest.raises(QueueFull):
+        q.push(mk("c", 9))
+    first = q.pop(timeout=0)
+    taken = q.take_compatible(first.join_key, limit=4)
+    # Only the compatible job joins; the other stays queued in order.
+    assert [j.job_id for j in taken] == ["b0"]
+    assert q.pop(timeout=0).job_id == "a1"
+
+
+def test_join_compatible_jobs_parity_and_job_metrics(tsv_paths, tmp_path):
+    """Two shape-compatible jobs from different tenants coalesce into ONE
+    engine batch (one walk product set, one vmapped bucket); an
+    incompatible job runs in its own batch; every served output is
+    byte-identical to its solo twin; every lane event in the daemon
+    stream carries job_id."""
+    mj = os.path.join(str(tmp_path), "serve-metrics.jsonl")
+    d = _daemon(tmp_path, metrics_jsonl=mj, max_join=4)
+    try:
+        a = d.admit({"tenant": "alice",
+                     "job": {**_job(tsv_paths, tmp_path, "a"),
+                             "variants": [{"name": "v0", "train_seed": 1}]}})
+        b = d.admit({"tenant": "bob",
+                     "job": {**_job(tsv_paths, tmp_path, "b"),
+                             "variants": [{"name": "v0", "train_seed": 2}]}})
+        c = d.admit({"tenant": "alice",
+                     "job": {**_job(tsv_paths, tmp_path, "c",
+                                    sizeHiddenlayer=24)}})
+        assert {a["event"], b["event"], c["event"]} == {"accepted"}
+        assert d.step() == 2          # a + b joined (same join key)
+        assert d.step() == 1          # c alone (different trainer shape)
+        ra, rb, rc = (_result(d, r["job_id"]) for r in (a, b, c))
+        assert ra["batch"] == rb["batch"] and ra["joined_jobs"] == 2
+        assert rc["joined_jobs"] == 1 and rc["batch"] != ra["batch"]
+        # One walk product pair for the joined batch, shared.
+        st = d.status()
+        assert st["jobs_done"] == 3
+        assert st["engine"]["batches_executed"] == 2
+        assert st["engine"]["warm_shapes"], "warm-shape inventory empty"
+        assert st["cache"]["walk"].get("store", 0) >= 0  # tiers present
+        assert {"walk", "compile", "autotune"} <= set(st["cache"])
+
+        # Byte parity: every served lane == its solo twin.
+        from g2vec_tpu.batch.engine import _variant_from_dict, lane_config
+        from g2vec_tpu.config import config_from_job
+        from g2vec_tpu.pipeline import run as solo_run
+
+        os.makedirs(os.path.join(str(tmp_path), "solo"), exist_ok=True)
+        for rec, jobd, vobj in [
+                (ra, _job(tsv_paths, tmp_path, "a"),
+                 {"name": "v0", "train_seed": 1}),
+                (rb, _job(tsv_paths, tmp_path, "b"),
+                 {"name": "v0", "train_seed": 2}),
+                (rc, _job(tsv_paths, tmp_path, "c", sizeHiddenlayer=24),
+                 {"name": "v"})]:
+            cfg = config_from_job(
+                {**jobd, "result_name": os.path.join(
+                    str(tmp_path), "solo", rec["job_id"])})
+            v = _variant_from_dict(0, vobj, cfg)
+            sr = solo_run(lane_config(cfg, v), console=lambda s: None)
+            served = sorted(rec["variants"][v.name]["outputs"])
+            for fa, fb in zip(served, sorted(sr.output_files)):
+                with open(fa, "rb") as x, open(fb, "rb") as y:
+                    assert x.read() == y.read(), \
+                        f"{rec['job_id']}: {fa} differs from solo {fb}"
+
+        # Job attribution in ONE daemon stream: every lane-scoped event
+        # names its job; seq stays monotonic across interleaved jobs.
+        with open(mj) as f:
+            events = [json.loads(line) for line in f]
+        seqs = [e["seq"] for e in events]
+        assert seqs == sorted(seqs)
+        lane_events = [e for e in events if "lane" in e]
+        assert lane_events and all("job_id" in e for e in lane_events)
+        ids = {r["job_id"] for r in (ra, rb, rc)}
+        assert {e["job_id"] for e in lane_events} == ids
+        for kind in ("job_accepted", "job_done"):
+            assert {e["job_id"] for e in events
+                    if e["event"] == kind} == ids
+    finally:
+        d.close()
+
+
+def test_retryable_batch_failure_requeues_job_in_process(
+        tsv_paths, tmp_path):
+    """A retryable failure (injected crash) re-queues the job; the next
+    cycle completes it. A fatal failure fails it with a classified
+    record."""
+    d = _daemon(tmp_path, job_retries=1,
+                fault_plan="stage=train,kind=crash")
+    try:
+        ok = d.admit({"job": _job(tsv_paths, tmp_path, "r1")})
+        assert d.step() == 0              # crash -> re-queued
+        assert d.step() == 1              # once-only fault spent -> done
+        rec = _result(d, ok["job_id"])
+        assert rec["status"] == "done"
+
+        faults.install_plan("stage=train,kind=fatal")
+        bad = d.admit({"job": _job(tsv_paths, tmp_path, "r2")})
+        assert d.step() == 0
+        rec2 = _result(d, bad["job_id"])
+        assert rec2["status"] == "failed"
+        assert rec2["classified"] == "fatal"
+        assert "InjectedFatal" in rec2["error"]
+    finally:
+        d.close()
+
+
+# ---------------------------------------------------------------------------
+# Subprocess lifecycle: socket front-end, warm latency, SIGKILL recovery
+# ---------------------------------------------------------------------------
+
+def _spawn_daemon(tmp_path, tsv_paths, extra=()):
+    sock = os.path.join(str(tmp_path), "g.sock")
+    state = os.path.join(str(tmp_path), "state")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": REPO + os.pathsep + os.environ.get(
+               "PYTHONPATH", "")}
+    log = open(os.path.join(str(tmp_path), "daemon.log"), "w")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "g2vec_tpu", "serve", "--socket", sock,
+         "--state-dir", state, "--platform", "cpu",
+         "--cache-dir", os.path.join(str(tmp_path), "cache"), *extra],
+        env=env, stdout=log, stderr=subprocess.STDOUT)
+    return proc, sock, state, env
+
+
+def test_serve_smoke_first_result_beats_cold_solo(tsv_paths, tmp_path):
+    """The daemon zero->aha: start, submit (cold), submit again (warm) —
+    the warm job's first-result latency beats a whole cold solo process —
+    /status answers over both dialects, clean shutdown exits 0."""
+    from g2vec_tpu.serve import client
+
+    proc, sock, state, env = _spawn_daemon(tmp_path, tsv_paths)
+    try:
+        assert client.wait_ready(sock, 120), "daemon never became ready"
+        job = {**_job(tsv_paths, tmp_path, "smoke1"), "epoch": 10}
+        evs = client.submit_job(sock, job, timeout=300)
+        assert evs[-1]["event"] == "job_done"
+        t0 = time.time()
+        evs2 = client.submit_job(
+            sock, {**job, "result_name": os.path.join(
+                str(tmp_path), "out", "smoke2"), "train_seed": 5},
+            timeout=300)
+        warm_latency = time.time() - t0
+        assert evs2[-1]["event"] == "job_done"
+
+        # Cold solo baseline: a fresh process for the SAME config pays
+        # startup + compiles; the warm daemon must beat the whole run.
+        t0 = time.time()
+        cold = subprocess.run(
+            [sys.executable, "-m", "g2vec_tpu", job["expression_file"],
+             job["clinical_file"], job["network_file"],
+             os.path.join(str(tmp_path), "out", "cold"), "-p", "8",
+             "-r", "2", "-s", "16", "-e", "10", "-l", "0.05", "-n", "5",
+             "--compute-dtype", "float32", "--platform", "cpu",
+             "--walker-backend", "device", "--train-seed", "5"],
+            capture_output=True, text=True, env=env, timeout=300)
+        cold_wall = time.time() - t0
+        assert cold.returncode == 0, cold.stderr[-500:]
+        assert warm_latency < cold_wall, \
+            f"warm served {warm_latency:.2f}s !< cold solo {cold_wall:.2f}s"
+
+        st = client.status(sock)
+        assert st["jobs_done"] == 2
+        assert st["engine"]["walk_tier"]["memo_hits"] >= 2  # warm job
+        assert st["cache"]["compile"].get("program_hit", 0) > 0
+        # HTTP dialect on the same socket.
+        import socket as socklib
+
+        s = socklib.socket(socklib.AF_UNIX)
+        s.connect(sock)
+        s.sendall(b"GET /status HTTP/1.0\r\n\r\n")
+        resp = b""
+        while True:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            resp += chunk
+        s.close()
+        assert resp.startswith(b"HTTP/1.0 200")
+        assert json.loads(resp.split(b"\r\n\r\n", 1)[1])["jobs_done"] == 2
+
+        assert client.shutdown(sock)["event"] == "shutting_down"
+        assert proc.wait(timeout=60) == 0
+        assert not os.path.exists(sock), "socket not cleaned up"
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
+def test_supervised_daemon_survives_sigkill_and_requeues(
+        tsv_paths, tmp_path):
+    """The acceptance drill: SIGKILL mid-train (injected, once) kills the
+    daemon; the supervisor relaunches it; the journal re-queues the
+    in-flight job; it completes against the restored warm disk caches
+    with outputs byte-identical to a solo run."""
+    from g2vec_tpu.serve import client
+
+    proc, sock, state, env = _spawn_daemon(
+        tmp_path, tsv_paths,
+        extra=("--supervise", "--supervise-backoff", "0.1",
+               "--fault-plan", "stage=train,kind=sigkill"))
+    try:
+        assert client.wait_ready(sock, 120), "daemon never became ready"
+        job = {**_job(tsv_paths, tmp_path, "k1"), "epoch": 10}
+        with pytest.raises(client.ServeConnectionLost) as ei:
+            client.submit_job(sock, job, timeout=300)
+        job_id = ei.value.job_id
+        assert job_id, "job died before acknowledgement"
+        rec = client.poll_result(state, job_id, deadline_s=240)
+        assert rec["status"] == "done"
+        outs = rec["variants"]["v"]["outputs"]
+        assert all(os.path.exists(p) for p in outs)
+        assert client.wait_ready(sock, 60), "relaunched daemon not serving"
+
+        # Correctness of the recovered outputs: byte-equal to solo.
+        solo = subprocess.run(
+            [sys.executable, "-m", "g2vec_tpu", job["expression_file"],
+             job["clinical_file"], job["network_file"],
+             os.path.join(str(tmp_path), "out", "ksolo"), "-p", "8",
+             "-r", "2", "-s", "16", "-e", "10", "-l", "0.05", "-n", "5",
+             "--compute-dtype", "float32", "--platform", "cpu",
+             "--walker-backend", "device"],
+            capture_output=True, text=True, env=env, timeout=300)
+        assert solo.returncode == 0, solo.stderr[-500:]
+        for p in outs:
+            suffix = p.rsplit("_", 1)[1]
+            twin = os.path.join(str(tmp_path), "out", f"ksolo_{suffix}")
+            with open(p, "rb") as a, open(twin, "rb") as b:
+                assert a.read() == b.read(), f"{p} differs from {twin}"
+
+        client.shutdown(sock)
+        assert proc.wait(timeout=60) == 0
+    finally:
+        if proc.poll() is None:
+            # The supervisor owns a child daemon; take the tree down.
+            try:
+                os.kill(proc.pid, signal.SIGTERM)
+            except OSError:
+                pass
+            proc.kill()
+            proc.wait()
+
+
+def test_bench_serve_ab_smoke():
+    """bench.py --_serve_ab at ultra-toy scale emits a serve_runs_per_hour
+    line whose on-the-spot byte-identity check passed."""
+    env = {**os.environ, "G2VEC_BENCH_SERVE_JOBS": "2",
+           "G2VEC_BENCH_SERVE_REPS": "1", "G2VEC_BENCH_SERVE_EPOCHS": "5",
+           "G2VEC_BENCH_SERVE_ARRIVAL": "0.2"}
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--_serve_ab"],
+        capture_output=True, text=True, timeout=420, env=env)
+    assert proc.returncode == 0, proc.stderr[-800:]
+    lines = [json.loads(ln) for ln in proc.stdout.splitlines()
+             if ln.strip().startswith("{")]
+    assert len(lines) == 1
+    line = lines[0]
+    assert line["metric"] == "serve_runs_per_hour"
+    assert line["value"] and line["value"] > 0
+    assert line["bit_identical"] is True
+    assert line["jobs"] == 2
+    assert line["p50_latency_s"] > 0 and line["p99_latency_s"] > 0
+    assert line["baseline_runs_per_hour"] > 0

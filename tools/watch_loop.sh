@@ -27,13 +27,15 @@ while [ "$arms" -lt "$MAX_ARMS" ] && [ "$(date +%s)" -lt "$DEADLINE" ]; do
     # (watchdogs, rank-scoped kills, degraded-mesh resume) on virtual
     # devices, the overlap/cache suite (scheduler drains cleanly on
     # stage failure — no deadlock, original exception propagates — plus
-    # the walk-cache verify matrix), and the batch-engine lane matrix
+    # the walk-cache verify matrix), the batch-engine lane matrix
     # (per-lane bitwise parity vs solo runs, manifest validation, walk
-    # share accounting). Non-fatal: a red matrix is reported, the chip
-    # battery still runs.
+    # share accounting), and the serve matrix (admission control, job
+    # joining, served-vs-solo byte parity, supervisor SIGKILL re-queue).
+    # Non-fatal: a red matrix is reported, the chip battery still runs.
     if ! JAX_PLATFORMS=cpu "$PY" -m pytest tests/test_resilience.py \
             tests/test_fleet.py tests/test_fleet_e2e.py \
             tests/test_overlap_cache.py tests/test_batch_engine.py \
+            tests/test_serve.py \
             -q -m "not slow" \
             -p no:cacheprovider >/tmp/fault_matrix_arm$arms.log 2>&1; then
         echo "[watch_loop] WARNING: fault/fleet matrix FAILED on arm $arms (log: /tmp/fault_matrix_arm$arms.log)"
