@@ -148,6 +148,20 @@ SERVE_AB_MEAN_ARRIVAL_S = float(
 SERVE_AB_SCALE = int(os.environ.get("G2VEC_BENCH_SERVE_SCALE", "1"))
 SERVE_AB_ARTIFACT = "BENCH_SERVE_AB.json"
 
+# Streaming-vs-full-batch trainer A/B (train/stream.py): min-of-N reps at
+# the bundled-scale synthetic, plus a scale-free big-graph axis
+# (data/synth.py) where the walk-path volume grows while the streaming
+# arm's host memory must NOT. Defaults are 1-core-safe; env-shrinkable
+# like every other net here.
+STREAM_AB_REPS = int(os.environ.get("G2VEC_BENCH_STREAM_REPS", "3"))
+STREAM_AB_EPOCHS = int(os.environ.get("G2VEC_BENCH_STREAM_EPOCHS", "30"))
+STREAM_AB_GENES = int(os.environ.get("G2VEC_BENCH_STREAM_GENES", "6000"))
+STREAM_AB_BIG_EPOCHS = int(os.environ.get("G2VEC_BENCH_STREAM_BIG_EPOCHS",
+                                          "4"))
+STREAM_AB_WALK_REPS = tuple(int(x) for x in os.environ.get(
+    "G2VEC_BENCH_STREAM_WALK_REPS", "4,12").split(","))
+STREAM_AB_ARTIFACT = "BENCH_STREAM_AB.json"
+
 # Peak bf16 matmul throughput per chip, for the MFU estimate.
 _PEAK_FLOPS = {"v4": 275e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12}
 # HBM bandwidth per chip (bytes/s): the roofline's other axis. This
@@ -876,6 +890,231 @@ def _batch_ab() -> None:
             json.dump({"line": line, "code_key": _current_code_key(repo),
                        "written_by": "bench.py --_batch_ab"}, f, indent=1)
         note(f"wrote {BATCH_AB_ARTIFACT}")
+
+
+#: Child wrapper for the stream A/B: run the CLI in-process and report the
+#: child's own peak RSS (RUSAGE_SELF ru_maxrss is per-process and exact —
+#: RUSAGE_CHILDREN in the parent is a monotone max over ALL children and
+#: cannot attribute a peak to one arm).
+_STREAM_RSS_WRAPPER = (
+    "import sys, resource\n"
+    "from g2vec_tpu.__main__ import main\n"
+    "rc = main(sys.argv[1:])\n"
+    "print('G2V_RSS_KB=%d'\n"
+    "      % resource.getrusage(resource.RUSAGE_SELF).ru_maxrss)\n"
+    "sys.exit(rc)\n")
+
+
+def _stream_child(args, env, timeout=1800) -> int:
+    """Run one pipeline child; returns its peak RSS in KB."""
+    proc = subprocess.run([sys.executable, "-c", _STREAM_RSS_WRAPPER] + args,
+                          capture_output=True, text=True, env=env,
+                          timeout=timeout)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"stream A/B child rc={proc.returncode}: "
+            f"{(proc.stderr or proc.stdout)[-400:]}")
+    for line in proc.stdout.splitlines():
+        if line.startswith("G2V_RSS_KB="):
+            return int(line.split("=", 1)[1])
+    raise RuntimeError("stream A/B child printed no RSS line")
+
+
+def _stream_last_events(mj_path: str) -> dict:
+    """Last event of each type from a metrics JSONL stream."""
+    out = {}
+    with open(mj_path) as f:
+        for line in f:
+            ev = json.loads(line)
+            out[ev.get("event")] = ev
+    return out
+
+
+def _stream_arm(tmpdir: str, tag: str, base_args, extra, env, reps,
+                note) -> dict:
+    """min-of-``reps`` wall for one (input, mode) arm; keeps the best
+    rep's metrics, RSS, and output files."""
+    best = None
+    for rep in range(reps):
+        out = os.path.join(tmpdir, f"{tag}-r{rep}")
+        os.makedirs(out, exist_ok=True)
+        mj = os.path.join(out, "metrics.jsonl")
+        args = list(base_args)
+        args[3] = os.path.join(out, "RES")
+        args += ["--metrics-jsonl", mj] + list(extra)
+        t0 = time.time()
+        rss_kb = _stream_child(args, env)
+        wall = time.time() - t0
+        note(f"stream A/B {tag} rep {rep}: {wall:.1f}s rss {rss_kb//1024}MB")
+        if best is None or wall < best["wall_s"]:
+            evs = _stream_last_events(mj)
+            best = {
+                "wall_s": round(wall, 2), "rss_kb": rss_kb,
+                "acc_val": (evs.get("train_done") or {}).get("acc_val"),
+                "stage_seconds": (evs.get("done") or {}).get(
+                    "stage_seconds", {}),
+                "stream": {k: v for k, v in (evs.get("stream") or {}).items()
+                           if k not in ("seq", "ts", "event")},
+                "result": os.path.join(out, "RES"),
+            }
+    return best
+
+
+def _biomarker_overlap(res_a: str, res_b: str) -> "float | None":
+    try:
+        def genes(path):
+            with open(path + "_biomarkers.txt") as f:
+                return {l.strip() for l in f.readlines()[1:] if l.strip()}
+        a, b = genes(res_a), genes(res_b)
+        return round(len(a & b) / max(len(a), 1), 3)
+    except OSError:
+        return None
+
+
+def _stream_ab_line(note) -> dict:
+    """Streaming-vs-full-batch trainer A/B — the streaming mode's headline.
+
+    Three claims, measured (fresh process per arm so peak RSS attributes
+    cleanly):
+
+    (a) **Overlap**: at bundled scale (the medium example-shaped
+        synthetic), the streaming arm's time-to-first-update is a small
+        fraction of the FULL arm's whole stage-3 wall — training starts
+        while sampling runs, instead of after it.
+    (b) **Bounded memory**: on the scale-free big graph (data/synth.py),
+        the walk-path volume grows ~3x across the STREAM_AB_WALK_REPS
+        axis; the full arm's peak RSS grows with it (it materializes and
+        densifies every path), the streaming arm's stays ~flat
+        (O(shard x ring depth) paths in flight).
+    (c) **No wall regression at bundled scale**: streaming end-to-end
+        wall within noise of full-batch (ratio reported).
+
+    Parity is reported beside the perf numbers (val-ACC delta + top-N
+    biomarker overlap): the contract is the statistical band
+    tests/test_stream.py pins, not bitwise equality.
+    """
+    import shutil
+    import tempfile
+
+    from g2vec_tpu.data.make_example import SCALES
+    from g2vec_tpu.data.synth import SynthGraphSpec, write_synth_graph
+    from g2vec_tpu.data.synthetic import write_synthetic_tsv
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH",
+                                                            "")}
+    reps = STREAM_AB_REPS
+    big_reps = int(os.environ.get("G2VEC_BENCH_STREAM_BIG_REPS", "1"))
+    line: dict = {"metric": "stream_time_to_first_update_ms", "unit": "ms"}
+    with tempfile.TemporaryDirectory() as td:
+        # ---- bundled scale: the medium example-shaped synthetic ----
+        paths = write_synthetic_tsv(SCALES["medium"],
+                                    os.path.join(td, "data"))
+        base = [paths["expression"], paths["clinical"], paths["network"],
+                "RES", "-p", "20", "-r", "10", "-s", "32",
+                "-e", str(STREAM_AB_EPOCHS), "-n", "20",
+                "--compute-dtype", "float32", "--platform", "cpu",
+                "--seed", "5"]
+        full = _stream_arm(td, "bundled-full", base, [], env, reps, note)
+        stream = _stream_arm(
+            td, "bundled-stream", base,
+            ["--train-mode", "streaming", "--shard-paths", "2048"],
+            env, reps, note)
+        ttfu_ms = stream["stream"].get("time_to_first_update_ms")
+        full_paths_wall = full["stage_seconds"].get("paths")
+        overlap_frac = (round(ttfu_ms / (full_paths_wall * 1e3), 3)
+                        if ttfu_ms and full_paths_wall else None)
+        line.update({
+            "value": ttfu_ms,
+            "full_stage3_wall_s": full_paths_wall,
+            "ttfu_frac_of_full_stage3": overlap_frac,
+            "overlap_ok": (overlap_frac is not None
+                           and overlap_frac < 0.5),
+            "bundled_full_wall_s": full["wall_s"],
+            "bundled_stream_wall_s": stream["wall_s"],
+            "bundled_wall_ratio": round(stream["wall_s"] / full["wall_s"],
+                                        3),
+            "bundled_full_rss_mb": full["rss_kb"] // 1024,
+            "bundled_stream_rss_mb": stream["rss_kb"] // 1024,
+            "bundled_runs_per_hour": {
+                "full": round(3600.0 / full["wall_s"], 1),
+                "streaming": round(3600.0 / stream["wall_s"], 1)},
+            "parity": {
+                "acc_val_full": full["acc_val"],
+                "acc_val_streaming": stream["acc_val"],
+                "acc_val_delta": (round(stream["acc_val"] - full["acc_val"],
+                                        4)
+                                  if None not in (stream["acc_val"],
+                                                  full["acc_val"])
+                                  else None),
+                "biomarker_overlap": _biomarker_overlap(full["result"],
+                                                        stream["result"]),
+            },
+            "bundled_stream_stats": stream["stream"],
+        })
+        # ---- big graph: path volume grows, streaming RSS must not ----
+        growth = {}
+        for walk_reps in STREAM_AB_WALK_REPS:
+            spec = SynthGraphSpec(n_genes=STREAM_AB_GENES, seed=3)
+            gdir = os.path.join(td, f"big{walk_reps}")
+            gp = write_synth_graph(spec, gdir)
+            gbase = [gp["expression"], gp["clinical"], gp["network"],
+                     "RES", "-p", "16", "-r", str(walk_reps), "-s", "32",
+                     "-e", str(STREAM_AB_BIG_EPOCHS), "-n", "20",
+                     "--compute-dtype", "float32", "--platform", "cpu",
+                     "--seed", "5"]
+            gfull = _stream_arm(td, f"big{walk_reps}-full", gbase, [],
+                                env, big_reps, note)
+            gstream = _stream_arm(
+                td, f"big{walk_reps}-stream", gbase,
+                ["--train-mode", "streaming", "--shard-paths", "2048"],
+                env, big_reps, note)
+            growth[f"walk_reps_{walk_reps}"] = {
+                "full_rss_mb": gfull["rss_kb"] // 1024,
+                "stream_rss_mb": gstream["rss_kb"] // 1024,
+                "full_wall_s": gfull["wall_s"],
+                "stream_wall_s": gstream["wall_s"],
+                "stream_ttfu_ms": gstream["stream"].get(
+                    "time_to_first_update_ms"),
+                "full_stage3_wall_s": gfull["stage_seconds"].get("paths"),
+                "stream_ring_peak_bytes": gstream["stream"].get(
+                    "ring_peak_bytes"),
+                "rows_sampled": gstream["stream"].get("rows_sampled"),
+            }
+        lo, hi = (f"walk_reps_{STREAM_AB_WALK_REPS[0]}",
+                  f"walk_reps_{STREAM_AB_WALK_REPS[-1]}")
+        line["big_graph"] = {
+            "genes": STREAM_AB_GENES, "epochs": STREAM_AB_BIG_EPOCHS,
+            **growth,
+            "full_rss_growth_mb": (growth[hi]["full_rss_mb"]
+                                   - growth[lo]["full_rss_mb"]),
+            "stream_rss_growth_mb": (growth[hi]["stream_rss_mb"]
+                                     - growth[lo]["stream_rss_mb"]),
+        }
+        shutil.rmtree(td, ignore_errors=True)
+    line["reps"] = reps
+    line["note"] = (
+        "fresh process per arm; RSS = child ru_maxrss. Streaming contract "
+        "is statistical (val-ACC band + biomarker overlap, pinned in "
+        "tests/test_stream.py); full-batch stays the bitwise-golden path")
+    return line
+
+
+def _stream_ab() -> None:
+    """Standalone mode: measure the streaming A/B and (with
+    G2VEC_BENCH_STREAM_WRITE=1) refresh the committed artifact."""
+    def note(msg):
+        print(f"# {msg}", file=sys.stderr, flush=True)
+
+    line = _stream_ab_line(note)
+    print(json.dumps(line), flush=True)
+    if os.environ.get("G2VEC_BENCH_STREAM_WRITE") == "1":
+        repo = os.path.dirname(os.path.abspath(__file__))
+        with open(os.path.join(repo, STREAM_AB_ARTIFACT), "w") as f:
+            json.dump({"line": line, "code_key": _current_code_key(repo),
+                       "written_by": "bench.py --_stream_ab"}, f, indent=1)
+        note(f"wrote {STREAM_AB_ARTIFACT}")
 
 
 def _serve_ab_line(note) -> dict:
@@ -2030,5 +2269,7 @@ if __name__ == "__main__":
         _batch_ab()
     elif "--_serve_ab" in sys.argv:
         _serve_ab()
+    elif "--_stream_ab" in sys.argv:
+        _stream_ab()
     else:
         main()

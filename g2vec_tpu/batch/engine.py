@@ -306,6 +306,8 @@ class ResidentEngine:
 
     def status(self) -> Dict:
         """The warm-state inventory (the serve /status currency)."""
+        from g2vec_tpu.train.stream import stream_stats
+
         return {
             "batches_executed": self.batches_executed,
             "lanes_executed": self.lanes_executed,
@@ -313,6 +315,10 @@ class ResidentEngine:
             "walk_tier": self.walk_tier.stats(),
             "walk_products_resident": len(self.walk_tier.memo),
             "warm_shapes": [dict(s) for s in self.warm_shapes],
+            # Streaming-job totals (shards emitted, ring high-water,
+            # prefetch wait, last time-to-first-update) — empty dict
+            # until the first --train-mode streaming job runs.
+            "stream": stream_stats(),
         }
 
     def _dataset_key(self, cfg: G2VecConfig) -> Tuple:
@@ -367,10 +373,77 @@ class ResidentEngine:
         self.close()
 
 
+def _execute_streaming(engine: ResidentEngine, cfg: G2VecConfig,
+                       variants: Optional[List[LaneVariant]], *,
+                       console: Callable[[str], None],
+                       metrics, lane_jobs: Optional[List[str]]
+                       ) -> BatchResult:
+    """Streaming-mode lanes: each variant runs the SOLO streaming
+    pipeline, sequentially.
+
+    The vmapped lane trainer wants every lane's full path matrix on
+    device at once — exactly the materialization ``--train-mode
+    streaming`` exists to avoid — so streaming jobs trade lane batching
+    for the mode's own overlap (sampling ∥ training) and its bounded
+    memory. This keeps streaming jobs first-class under the batch CLI
+    and the serve daemon (admission, journaling, metrics attribution all
+    unchanged); a tenant who wants lane-batched throughput on small
+    cohorts uses train_mode=full, one who wants a big graph streams.
+    """
+    from g2vec_tpu.pipeline import run as run_pipeline
+    from g2vec_tpu.train.stream import stream_stats
+    from g2vec_tpu.utils.metrics import MetricsWriter
+
+    cfg.validate()
+    if variants is None:
+        variants = plan_variants(cfg)
+    n_lanes = len(variants)
+    if lane_jobs is not None and len(lane_jobs) != n_lanes:
+        raise ValueError(f"lane_jobs has {len(lane_jobs)} entries for "
+                         f"{n_lanes} lane(s)")
+    own_metrics = None
+    if metrics is None:
+        own_metrics = metrics = MetricsWriter(cfg.metrics_jsonl)
+    t_start = time.time()
+    console(f">>> [batch] streaming mode: {n_lanes} lane(s), each the solo "
+            f"streaming pipeline (no lane batching — the path matrix "
+            f"never materializes)")
+    results: List = []
+    try:
+        for i, v in enumerate(variants):
+            lm = (metrics.bind_job(lane_jobs[i]).bind_lane(v.tag())
+                  if lane_jobs is not None else metrics.bind_lane(v.tag()))
+            lm.emit("lane_variant", **dataclasses.asdict(v))
+            res = run_pipeline(lane_config(cfg, v), console=console)
+            lm.emit("stream", **res.stream_stats)
+            lm.emit("done", outputs=res.output_files, acc_val=res.acc_val,
+                    n_paths=res.n_paths)
+            results.append(res)
+        wall = time.time() - t_start
+        rph = n_lanes / wall * 3600.0
+        metrics.emit("done", n_lanes=n_lanes, wall_seconds=round(wall, 3),
+                     runs_per_hour=round(rph, 2), train_mode="streaming",
+                     stream_totals=stream_stats())
+        engine.batches_executed += 1
+        engine.lanes_executed += n_lanes
+        return BatchResult(
+            lanes=results, variants=variants, wall_seconds=wall,
+            runs_per_hour=rph, walk_stats={},
+            buckets=[{"n_paths": r.n_paths, "lanes": 1,
+                      "mode": "stream-solo"} for r in results],
+            stage_seconds={})
+    finally:
+        if own_metrics is not None:
+            own_metrics.close()
+
+
 def _execute_lanes(engine: ResidentEngine, cfg: G2VecConfig,
                    variants: Optional[List[LaneVariant]], *,
                    console: Callable[[str], None],
                    metrics, lane_jobs: Optional[List[str]]) -> BatchResult:
+    if cfg.train_mode == "streaming":
+        return _execute_streaming(engine, cfg, variants, console=console,
+                                  metrics=metrics, lane_jobs=lane_jobs)
     import jax
 
     from g2vec_tpu.analysis import (biomarker_scores_lanes, freq_index,

@@ -108,6 +108,33 @@ class G2VecConfig:
                                      # amortizes per-iteration dispatch/cond
                                      # overhead, early stop still lands ON
                                      # the dip
+    train_mode: str = "full"         # "full": the reference's full-batch
+                                     # trainer (bitwise-golden contract).
+                                     # "streaming": walk shards stream from
+                                     # the sampler pool through a bounded
+                                     # host ring into double-buffered device
+                                     # prefetch; minibatch SGD starts before
+                                     # sampling finishes and peak host path
+                                     # memory is O(shard x depth), not
+                                     # O(total paths). Statistical contract
+                                     # (val-ACC parity band + biomarker
+                                     # overlap), NOT bitwise vs full
+                                     # (train/stream.py)
+    shard_paths: int = 0             # rows per streaming walk shard, both
+                                     # groups combined (0 = auto ~4096);
+                                     # also the minibatch size — shards are
+                                     # the matrix-multiply-shaped batches
+                                     # of arXiv:1611.06172
+    prefetch_depth: int = 2          # bounded host shard-ring depth; the
+                                     # producer blocks (backpressure) when
+                                     # this many shards wait unconsumed.
+                                     # Peak host path memory ~= shard x
+                                     # (depth + 2 in-flight)
+    stream_patience: int = 5         # streaming early stop: epochs without
+                                     # a strict val-ACC improvement before
+                                     # stopping (1 = the full-batch
+                                     # first-dip rule; minibatch epochs
+                                     # jitter, so the default widens it)
     donate_state: bool = True        # donate the (params, opt_state,
                                      # snapshot, history) carry to the chunk
                                      # program so Adam's fp32 read/write set
@@ -234,6 +261,39 @@ class G2VecConfig:
         if self.epoch_superstep < 1:
             raise ValueError(
                 f"epoch_superstep must be >= 1, got {self.epoch_superstep}")
+        if self.train_mode not in ("full", "streaming"):
+            raise ValueError(
+                f"train_mode must be full|streaming, got {self.train_mode}")
+        if self.shard_paths < 0:
+            raise ValueError(
+                f"shard_paths must be >= 0 (0 = auto), got {self.shard_paths}")
+        if 0 < self.shard_paths < 4:
+            raise ValueError(
+                f"shard_paths must be >= 4 (2 per group, and the per-shard "
+                f"split needs both sides non-empty), got {self.shard_paths}")
+        if self.prefetch_depth < 1:
+            raise ValueError(
+                f"prefetch_depth must be >= 1, got {self.prefetch_depth}")
+        if self.stream_patience < 1:
+            raise ValueError(
+                f"stream_patience must be >= 1, got {self.stream_patience}")
+        if self.train_mode == "streaming":
+            if self.walker_backend == "device":
+                raise ValueError(
+                    "--train-mode streaming needs the native sampler's "
+                    "shard emission (walker index ranges); "
+                    "--walker-backend device cannot stream")
+            for flag, name in ((self.distributed, "--distributed"),
+                               (self.fleet_size, "--fleet-size"),
+                               (self.mesh_shape, "--mesh"),
+                               (self.checkpoint_dir, "--checkpoint-dir"),
+                               (self.resume, "--resume")):
+                if flag:
+                    raise ValueError(
+                        f"--train-mode streaming does not compose with "
+                        f"{name} yet — the streaming trainer is a "
+                        f"single-device minibatch loop (ROADMAP item 2 "
+                        f"shards it)")
         if self.sampler_threads < 0:
             raise ValueError(
                 f"sampler_threads must be >= 0 (0 = all cores), "
@@ -326,7 +386,12 @@ SERVE_JOB_KEYS = (
     "subsample_seed", "compat_lgroup_tiebreak", "compute_dtype",
     "param_dtype", "walker_batch", "walker_hbm_budget", "walker_backend",
     "sampler_threads", "fused_eval", "epoch_superstep", "donate_state",
-    "use_native_io", "lanes")
+    "use_native_io", "lanes",
+    # Streaming trainer (train/stream.py): a tenant may pick the mode and
+    # its shard/ring geometry; the daemon still owns the device. Jobs with
+    # different train_mode never _join_key-match, so a streaming job
+    # cannot be folded into a full-batch bucket (serve/daemon.py).
+    "train_mode", "shard_paths", "prefetch_depth", "stream_patience")
 
 _SERVE_JOB_REQUIRED = ("expression_file", "clinical_file", "network_file",
                        "result_name")
@@ -457,6 +522,36 @@ def build_parser() -> argparse.ArgumentParser:
                              "pool (0 = all cores). Walk output is "
                              "bit-identical at any count — per-walker PRNG "
                              "streams are keyed by global walker index.")
+    parser.add_argument("--train-mode", type=str, default="full",
+                        choices=("full", "streaming"),
+                        help="full (default): the reference's full-batch "
+                             "trainer — the bitwise-golden path. "
+                             "streaming: fixed-size walk shards stream "
+                             "from the sampler pool through a bounded "
+                             "host ring into device prefetch buffers; "
+                             "minibatch-SGD training starts before "
+                             "sampling finishes and peak host path "
+                             "memory is O(shard x depth). Statistical "
+                             "contract: val-ACC parity band + biomarker "
+                             "overlap vs full-batch, not bitwise.")
+    parser.add_argument("--shard-paths", type=int, default=0, metavar="N",
+                        help="Rows per streaming walk shard / minibatch "
+                             "(both groups combined; 0 = auto ~4096). "
+                             "Same seed + same shard size => bitwise-"
+                             "identical streaming trajectories at any "
+                             "thread count or ring depth.")
+    parser.add_argument("--prefetch-depth", type=int, default=2,
+                        metavar="D",
+                        help="Bounded host shard-ring depth for "
+                             "--train-mode streaming (default 2); the "
+                             "sampler blocks when D shards wait "
+                             "unconsumed (backpressure).")
+    parser.add_argument("--stream-patience", type=int, default=5,
+                        metavar="K",
+                        help="Streaming early stop: stop after K epochs "
+                             "without a strict val-ACC improvement and "
+                             "return the best epoch's snapshot (default "
+                             "5; 1 = the full-batch first-dip rule).")
     parser.add_argument("--no-fused-eval", action="store_true",
                         help="Keep the val-split eval as its own per-epoch "
                              "program instead of riding the grad pass's "
@@ -619,6 +714,10 @@ def config_from_args(argv=None) -> G2VecConfig:
         walker_backend=args.walker_backend,
         sampler_threads=args.sampler_threads,
         fused_eval=not args.no_fused_eval,
+        train_mode=args.train_mode,
+        shard_paths=args.shard_paths,
+        prefetch_depth=args.prefetch_depth,
+        stream_patience=args.stream_patience,
         epoch_superstep=args.epoch_superstep,
         donate_state=not args.no_donate,
         kernel_autotune=args.kernel_autotune,

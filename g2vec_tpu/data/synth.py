@@ -1,0 +1,157 @@
+"""Seeded scale-free synthetic inputs at parameterized gene counts.
+
+The bundled-scale generator (data/synthetic.py) plants a few dozen
+module genes — enough to exercise correctness, far too small to
+exercise SCALE. This module builds reference-format inputs whose graph
+is a preferential-attachment (Barabási–Albert-style) network at any
+gene count, with expression engineered so each prognosis group's
+|PCC|-thresholded graph keeps a large, group-specific edge subset:
+
+- **Network**: every new node attaches to ``attach`` existing nodes
+  sampled proportionally to degree (the classic repeated-endpoint
+  trick), seeded from a small ring — one connected component, power-law
+  degree tails, the shape real interactomes approximate.
+- **Expression**: each gene is "active" in each group independently
+  with probability ``active_prob``; active genes load (with a random
+  sign) on that group's per-sample latent factor plus noise, so two
+  active genes correlate within the group at |PCC| ~ 1/(1+noise^2) and
+  an edge survives the threshold iff both endpoints are active there.
+  Genes active in exactly one group also get a mean shift in that
+  group, so differential-expression t-scores light up — the biomarker
+  scorer has real signal to rank.
+- Inactive/other genes see iid noise; their edges die at the
+  threshold. The two groups' graphs are therefore large, overlapping
+  but distinct subgraphs of one scale-free network — group-specific
+  walks exist at every scale.
+
+First brick of ROADMAP item 2 (million-node scale-out); the streaming
+trainer's bench (bench.py --_stream_ab) uses it as the
+beyond-bundled-scale input. Pure numpy, no jax.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class SynthGraphSpec:
+    n_genes: int = 20_000
+    n_good: int = 40
+    n_poor: int = 40
+    attach: int = 3              # edges per new node (mean degree ~2*attach)
+    active_prob: float = 0.7     # per-(gene, group) activity
+    noise: float = 0.3           # in-group residual std (corr ~ 1/(1+n^2))
+    shift: float = 1.0           # mean shift for single-group-active genes
+    seed: int = 0
+
+    @property
+    def n_samples(self) -> int:
+        return self.n_good + self.n_poor
+
+
+def make_scale_free_edges(n_nodes: int, attach: int,
+                          rng: np.random.Generator
+                          ) -> Tuple[np.ndarray, np.ndarray]:
+    """Preferential-attachment edge list (directed as written; the
+    pipeline's graph stage treats edges per its own convention).
+
+    Endpoints of every accepted edge are appended to a repeat buffer;
+    sampling uniformly from the buffer IS degree-proportional sampling.
+    Seeded ring over the first ``attach + 1`` nodes guarantees one
+    component.
+    """
+    if n_nodes < attach + 2:
+        raise ValueError(
+            f"need at least attach+2={attach + 2} nodes, got {n_nodes}")
+    m = attach
+    cap = 2 * m * n_nodes + 4 * (m + 1)
+    rep = np.empty(cap, dtype=np.int64)
+    src: list = []
+    dst: list = []
+    count = 0
+    for i in range(m + 1):
+        j = (i + 1) % (m + 1)
+        src.append(i)
+        dst.append(j)
+        rep[count:count + 2] = (i, j)
+        count += 2
+    for v in range(m + 1, n_nodes):
+        picks = np.unique(rep[rng.integers(0, count, size=m)])
+        for u in picks:
+            src.append(v)
+            dst.append(int(u))
+            rep[count:count + 2] = (v, int(u))
+            count += 2
+    return np.asarray(src, dtype=np.int64), np.asarray(dst, dtype=np.int64)
+
+
+def make_synth_graph(spec: SynthGraphSpec):
+    """(gene names, samples, labels, expr [S, G] f32, (src, dst) edges).
+
+    Deterministic in ``spec.seed`` — the CLI (tools/make_synth_graph.py)
+    and the stream bench regenerate identical inputs from the spec
+    alone.
+    """
+    rng = np.random.default_rng(spec.seed)
+    G, S = spec.n_genes, spec.n_samples
+    genes = np.array([f"SG{i:07d}" for i in range(G)])
+    samples = np.array([f"SAMP-{i:05d}" for i in range(S)])
+    labels = np.array([0] * spec.n_good + [1] * spec.n_poor, dtype=np.int32)
+
+    src, dst = make_scale_free_edges(G, spec.attach, rng)
+
+    act = rng.random((2, G)) < spec.active_prob        # per-group activity
+    sign = rng.choice(np.array([-1.0, 1.0]), size=(2, G)).astype(np.float32)
+    z = rng.standard_normal((2, S)).astype(np.float32)  # per-group factors
+
+    expr = rng.standard_normal((S, G)).astype(np.float32) * spec.noise
+    for gi in range(2):
+        rows = labels == gi
+        cols = act[gi]
+        # Active gene in its group: signed factor loading + the noise the
+        # background already holds; inactive genes keep iid noise scaled
+        # UP to unit-ish variance so their correlations stay ~0 but their
+        # variance does not advertise activity.
+        expr[np.ix_(rows, cols)] += sign[gi, cols] * z[gi, rows][:, None]
+        only = act[gi] & ~act[1 - gi]
+        expr[np.ix_(rows, only)] += spec.shift
+    inactive_everywhere = ~act[0] & ~act[1]
+    expr[:, inactive_everywhere] += (
+        rng.standard_normal((S, int(inactive_everywhere.sum())))
+        .astype(np.float32))
+    return genes, samples, labels, expr, (src, dst)
+
+
+def write_synth_graph(spec: SynthGraphSpec, out_dir: str,
+                      prefix: str = "big") -> Dict[str, str]:
+    """Write the dataset as reference-format TSVs (same layout as
+    data/synthetic.write_synthetic_tsv); returns the three paths plus
+    edge/gene counts for the caller's report."""
+    genes, samples, labels, expr, (src, dst) = make_synth_graph(spec)
+    os.makedirs(out_dir, exist_ok=True)
+    paths = {
+        "expression": os.path.join(out_dir, f"{prefix}_EXPRESSION.txt"),
+        "clinical": os.path.join(out_dir, f"{prefix}_CLINICAL.txt"),
+        "network": os.path.join(out_dir, f"{prefix}_NETWORK.txt"),
+        "n_genes": str(len(genes)), "n_edges": str(len(src)),
+    }
+    with open(paths["expression"], "w") as f:
+        f.write("PATIENT\t" + "\t".join(samples) + "\n")
+        # One formatted row per gene; %.4f keeps a 100k-gene file in the
+        # tens of MB instead of hundreds.
+        for j, g in enumerate(genes):
+            f.write(g + "\t" + "\t".join("%.4f" % v for v in expr[:, j])
+                    + "\n")
+    with open(paths["clinical"], "w") as f:
+        f.write("PATIENT_BARCODE\tLABEL\n")
+        for s, l in zip(samples, labels):
+            f.write(f"{s}\t{int(l)}\n")
+    with open(paths["network"], "w") as f:
+        f.write("src\tdest\n")
+        for a, b in zip(src, dst):
+            f.write(f"{genes[a]}\t{genes[b]}\n")
+    return paths

@@ -31,6 +31,7 @@ from __future__ import annotations
 import os
 import threading
 from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
 from typing import Optional, Set
 
 import numpy as np
@@ -113,7 +114,9 @@ def walk_packed_rows(src: np.ndarray, dst: np.ndarray, w: np.ndarray,
                      n_genes: int, *, len_path: int, reps: int, seed: int,
                      starts: Optional[np.ndarray] = None,
                      n_threads: int = 0, walker_lo: int = 0,
-                     walker_hi: Optional[int] = None) -> np.ndarray:
+                     walker_hi: Optional[int] = None,
+                     csr: Optional[tuple] = None,
+                     out: Optional[np.ndarray] = None) -> np.ndarray:
     """Native walks for the walker index range [walker_lo, walker_hi) of
     the flat (repetition x start) axis -> [n_local, ceil(G/8)] uint8
     packed multi-hot rows (NOT deduplicated).
@@ -129,13 +132,15 @@ def walk_packed_rows(src: np.ndarray, dst: np.ndarray, w: np.ndarray,
         starts = np.arange(n_genes, dtype=np.int32)
     starts = np.asarray(starts, dtype=np.int32)
     # The C++ side indexes visited[] and indptr[] with these without
-    # checks — bound them here, once, at the language boundary.
-    for name, arr in (("starts", starts), ("dst", dst)):
+    # checks — bound them here, once, at the language boundary. A
+    # precomputed ``csr`` skips the O(E) edge scans (the caller ran them
+    # when it built the CSR through this function once already).
+    check_arrays = (("starts", starts),) if csr is not None \
+        else (("starts", starts), ("dst", dst), ("src", src))
+    for name, arr in check_arrays:
         if arr.size and (arr.min() < 0 or arr.max() >= n_genes):
             raise ValueError(
                 f"{name} contains node ids outside [0, {n_genes})")
-    if src.size and (src.min() < 0 or src.max() >= n_genes):
-        raise ValueError(f"src contains node ids outside [0, {n_genes})")
     n_starts = starts.shape[0]
     total = n_starts * reps
     walker_hi = total if walker_hi is None else walker_hi
@@ -151,7 +156,11 @@ def walk_packed_rows(src: np.ndarray, dst: np.ndarray, w: np.ndarray,
     # each deterministic but not cross-identical.
     stream_ids = np.arange(walker_lo, walker_hi, dtype=np.uint64)
 
-    indptr, indices, weights = edges_to_csr(src, dst, w, n_genes)
+    # ``csr`` lets a per-shard caller (walk_shard) pay the O(E log E)
+    # edge sort once per group instead of once per shard; values are
+    # exactly edges_to_csr's, so the walks cannot tell the difference.
+    indptr, indices, weights = (csr if csr is not None
+                                else edges_to_csr(src, dst, w, n_genes))
     # The sampler emits np.packbits-layout multi-hot rows directly (bits
     # set inside the C++ walk loop): no [W, n_genes] dense expansion on
     # either side of the boundary — at bundled scale the old
@@ -164,9 +173,10 @@ def walk_packed_rows(src: np.ndarray, dst: np.ndarray, w: np.ndarray,
         # accounting has a single owner.
         return walk_paths_packed(indptr, indices, weights, n_genes,
                                  all_starts, stream_ids, len_path, seed,
-                                 n_threads=1)
+                                 n_threads=1, out=out)
     nbytes = (n_genes + 7) // 8
-    out = np.empty((n_local, nbytes), dtype=np.uint8)
+    if out is None:
+        out = np.empty((n_local, nbytes), dtype=np.uint8)
     # Contiguous ranges of at most RANGE_CHUNK walkers (but no more tasks
     # than needed for ``threads``-way parallelism x a small queue depth).
     chunk = max(RANGE_CHUNK, -(-n_local // (threads * 8)))
@@ -180,6 +190,125 @@ def walk_packed_rows(src: np.ndarray, dst: np.ndarray, w: np.ndarray,
             1, out[lo:hi]))
     for f in futures:
         f.result()      # propagate the first worker exception, if any
+    return out
+
+
+@dataclass(frozen=True)
+class ShardPlan:
+    """The deterministic shard decomposition of one run's walker axis.
+
+    The streaming trainer (train/stream.py) consumes the two groups'
+    walks as fixed-size SHARDS instead of one monolithic path set. A
+    shard is a pure function of (shard index, plan): shard ``s`` holds
+    the START-GENE range ``[s*k, (s+1)*k)`` with ALL its repetitions,
+    for BOTH groups (the flat walker axis is rep-major —
+    ``tile(starts, reps)`` — so one shard is ``reps`` strided slices of
+    each group's axis). Start-major sharding is load-bearing for the
+    per-shard common-path filter: every copy of a start gene's walks —
+    all reps, both groups — lands in ONE shard, so degenerate common
+    paths and cross-rep duplicates are caught locally with O(shard)
+    memory, where rep-major shards would scatter them (measured:
+    rep-major sharding leaks ~45% duplicate/common rows into training
+    and costs ~0.2 val-ACC on the bundled-scale synthetic).
+
+    Because per-walker PRNG streams are keyed by GLOBAL walker index
+    (module docstring), shard contents are bit-identical at any thread
+    count, any ring depth, and any emission/consumption interleaving —
+    the determinism contract tests/test_stream.py pins.
+    """
+
+    n_starts: int           # common genes (each group's start list)
+    reps: int
+    starts_per_shard: int   # k
+    len_path: int
+
+    @property
+    def n_walkers(self) -> int:
+        """Per group: the flat walker-axis length."""
+        return self.n_starts * self.reps
+
+    @property
+    def n_shards(self) -> int:
+        return -(-self.n_starts // self.starts_per_shard)
+
+    @property
+    def rows_per_shard(self) -> int:
+        """Nominal rows in a full shard (both groups, all reps)."""
+        return 2 * self.starts_per_shard * self.reps
+
+    def start_range(self, shard: int) -> tuple:
+        """[lo, hi) of the start-gene axis covered by ``shard``."""
+        lo = shard * self.starts_per_shard
+        return lo, min(lo + self.starts_per_shard, self.n_starts)
+
+    def group_rows(self, shard: int) -> int:
+        """Rows ``shard`` holds per group."""
+        lo, hi = self.start_range(shard)
+        return (hi - lo) * self.reps
+
+
+def plan_shards(n_genes: int, reps: int, shard_paths: int, *,
+                len_path: int) -> ShardPlan:
+    """Shard the walker axis into ~``shard_paths``-row shards
+    (``shard_paths`` counts BOTH groups' rows across all reps; 0 = auto).
+
+    Sizing targets matrix-multiply-shaped batches (arXiv:1611.06172's
+    minibatch recipe): big enough that the per-shard device dispatch
+    amortizes, small enough that a handful of in-flight shards bound host
+    memory even at million-node scale.
+    """
+    if shard_paths < 0:
+        raise ValueError(f"shard_paths must be >= 0, got {shard_paths}")
+    if shard_paths == 0:
+        shard_paths = _AUTO_SHARD_PATHS
+    starts_per_shard = max(1, min(shard_paths // (2 * reps), n_genes))
+    return ShardPlan(n_starts=n_genes, reps=reps,
+                     starts_per_shard=starts_per_shard, len_path=len_path)
+
+
+#: Auto --shard-paths: 4096 rows ~= the trainer's packing chunk and a few
+#: MB of packed bits even at 100k genes — device-dispatch amortization
+#: without meaningful host-memory cost.
+_AUTO_SHARD_PATHS = 4096
+
+
+def walk_shard(src: np.ndarray, dst: np.ndarray, w: np.ndarray,
+               n_genes: int, plan: ShardPlan, shard: int, *, seed: int,
+               n_threads: int = 0, csr: Optional[tuple] = None) -> np.ndarray:
+    """One group's rows for shard ``shard`` of ``plan`` ->
+    [group_rows, ceil(G/8)] uint8 packed multi-hot rows (NOT
+    deduplicated; rep-major within the shard — rep r's block holds
+    walkers ``[r*n_starts + lo, r*n_starts + hi)`` in walker order, so
+    every row's bytes are exactly the full-range call's for that global
+    walker index).
+
+    A re-invocable pure function of (plan, shard, seed): the spool
+    integrity layer re-walks a shard whose bytes failed verification,
+    and determinism guarantees the retry reproduces the original
+    emission exactly. The per-rep blocks fan out over the module's
+    sampler pool (disjoint output slices, same bit-identity argument as
+    walk_packed_rows' range fan-out).
+    """
+    lo, hi = plan.start_range(shard)
+    k = hi - lo
+    nbytes = (n_genes + 7) // 8
+    out = np.empty((k * plan.reps, nbytes), dtype=np.uint8)
+    threads = min(resolve_sampler_threads(n_threads), plan.reps)
+
+    def _block(r: int):
+        return walk_packed_rows(
+            src, dst, w, n_genes, len_path=plan.len_path, reps=plan.reps,
+            seed=seed, walker_lo=r * plan.n_starts + lo,
+            walker_hi=r * plan.n_starts + hi, n_threads=1, csr=csr,
+            out=out[r * k:(r + 1) * k])
+
+    if threads <= 1 or plan.reps <= 1:
+        for r in range(plan.reps):
+            _block(r)
+    else:
+        pool = _pool(threads)
+        for f in [pool.submit(_block, r) for r in range(plan.reps)]:
+            f.result()
     return out
 
 

@@ -72,6 +72,7 @@ class OverlapScheduler:
         self._order: list = []
         self._lock = threading.Lock()
         self._done_cv = threading.Condition()
+        self._closers: list = []
 
     # ---- submission -------------------------------------------------------
 
@@ -202,10 +203,43 @@ class OverlapScheduler:
                     pass
         return len(victims)
 
+    def add_closer(self, fn: Callable[[], None]) -> Callable[[], None]:
+        """Register an unblocker run at the START of :meth:`close`.
+
+        The sampler->trainer streaming edge (train/stream.py) is the one
+        task shape whose thread can legitimately BLOCK mid-run — a shard
+        producer parked on a full ring. The plain drain contract ("every
+        task finishes") only holds if something wakes it when the
+        consumer is gone, so the edge registers its ring's ``cancel``
+        here; close() then cannot deadlock on a producer whose consumer
+        died in a foreground stage. Closers run in registration order;
+        a closer's exception is swallowed (close is a ``finally`` path).
+        Returns a deregistration thunk — a finished edge removes its
+        closer so a resident engine's scheduler does not accumulate one
+        per batch for the process lifetime.
+        """
+        with self._lock:
+            self._closers.append(fn)
+
+        def remove() -> None:
+            with self._lock:
+                try:
+                    self._closers.remove(fn)
+                except ValueError:
+                    pass
+        return remove
+
     def close(self) -> None:
         """Drain without raising, then shut the executor down. Safe in a
         ``finally``: a pipeline failing in a foreground stage must not
         hang on background tasks at teardown."""
+        with self._lock:
+            closers = list(self._closers)
+        for fn in closers:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — teardown must proceed
+                pass
         self.drain(raise_errors=False)
         self._ex.shutdown(wait=True)
 

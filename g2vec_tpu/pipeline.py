@@ -59,6 +59,10 @@ class PipelineResult:
     walk_cache_hits: List[str] = dataclasses.field(default_factory=list)
                                  # groups whose stage-3 walks were served
                                  # from the artifact cache
+    stream_stats: Dict = dataclasses.field(default_factory=dict)
+                                 # --train-mode streaming attribution
+                                 # (train/stream.py StreamStats.as_dict();
+                                 # empty for full-batch runs)
 
 
 def _background_warm(fn, console):
@@ -307,194 +311,288 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
                     n_genes, cfg.sizeHiddenlayer, k=cfg.n_lgroups,
                     iters=cfg.kmeans_iters), console))
         walk_cache_hits: List[str] = []
-        fault_point("paths")
-        fleet.note_phase("paths")
-        with timer.stage("paths"):
-            path_sets: List = [None, None]
-            joins = []
-            for i, group in enumerate(["g", "p"]):
-                expr_group = data.expr[data.label == i]
-                # Sparse transitions: per-step walk cost O(W*D) instead of
-                # O(W*G), and no dense G^2 matrix in HBM (ops/graph.py).
-                s_k, d_k, w_k = thresholded_edges(expr_group, src, dst,
-                                                  threshold=cfg.pcc_threshold)
-                ckey = None
-                if walk_cache is not None:
-                    # Content-addressed: the exact thresholded edges + the
-                    # walk params + the sampler's PRNG-family tag. Any
-                    # input or config drift misses; a verified hit skips
-                    # this group's walks entirely (g2vec_tpu/cache.py).
-                    ckey = walk_cache_key(
-                        np.asarray(s_k), np.asarray(d_k), np.asarray(w_k),
-                        n_genes, len_path=cfg.lenPath,
-                        reps=cfg.numRepetition, seed=(cfg.seed << 1) | i,
-                        family=(NATIVE_FAMILY if walker_backend == "native"
-                                else DEVICE_FAMILY))
-                    cached = walk_cache.load(ckey)
-                    if cached is not None:
-                        path_sets[i] = cached
-                        walk_cache_hits.append(group)
-                        console(f"    [cache] group {group!r}: verified "
-                                f"walk artifact hit ({len(cached)} unique "
-                                f"paths) — walks skipped")
-                        metrics.emit("walk_cache", group=group,
-                                     outcome="hit", n_rows=len(cached))
+        if cfg.train_mode == "streaming":
+            # ---- streaming minibatch trainer: stages 3-4 merged ----
+            # (train/stream.py): the sampler pool emits walk shards into
+            # a bounded host ring while the jitted minibatch-SGD step
+            # consumes them — training starts the moment shard 0 lands,
+            # and host path memory peaks at O(shard x ring depth) instead
+            # of O(total paths). Statistical contract vs full-batch
+            # (val-ACC parity band + biomarker overlap, ARCHITECTURE.md
+            # §12); bitwise-deterministic WITHIN the mode at any thread
+            # count / ring depth.
+            if walker_backend != "native":
+                raise ValueError(
+                    "--train-mode streaming needs the native sampler "
+                    "(shard emission over walker-index ranges); this host "
+                    f"resolved walker_backend={walker_backend!r} — build "
+                    "the C++ toolchain or use --train-mode full")
+            from g2vec_tpu.train.stream import train_cbow_streaming
+
+            fault_point("paths")
+            fleet.note_phase("paths")
+            with timer.stage("paths"):
+                group_edges = []
+                for i in range(2):
+                    expr_group = data.expr[data.label == i]
+                    s_k, d_k, w_k = thresholded_edges(
+                        expr_group, src, dst, threshold=cfg.pcc_threshold)
+                    group_edges.append((np.asarray(s_k), np.asarray(d_k),
+                                        np.asarray(w_k)))
+            _stage_edge("paths")
+            console("    [stream] walk shards stream from the sampler "
+                    "pool; stage 4 overlaps stage 3")
+            console(">>> 4. Compute distributed representations using "
+                    "modified CBOW")
+            console("     Start training the modified CBOW with early "
+                    "stopping")
+            reporter = _EpochReporter(console, cfg.display_step)
+
+            def on_epoch(step, acc_val, acc_tr, secs):
+                reporter.on_epoch(step, acc_val, acc_tr, secs)
+                metrics.emit("epoch", step=step, acc_val=acc_val,
+                             acc_tr=acc_tr, secs=secs)
+
+            fault_point("train")
+            fleet.note_phase("train")
+            with timer.stage("train"):
+                sres = train_cbow_streaming(
+                    groups=group_edges, n_genes=n_genes, genes=data.gene,
+                    hidden=cfg.sizeHiddenlayer,
+                    learning_rate=cfg.learningRate, max_epochs=cfg.epoch,
+                    val_fraction=cfg.val_fraction,
+                    decision_threshold=cfg.decision_threshold,
+                    compute_dtype=cfg.compute_dtype,
+                    param_dtype=cfg.param_dtype,
+                    seed=(cfg.seed if cfg.train_seed is None
+                          else cfg.train_seed),
+                    walk_seed=cfg.seed, len_path=cfg.lenPath,
+                    reps=cfg.numRepetition, shard_paths=cfg.shard_paths,
+                    prefetch_depth=cfg.prefetch_depth,
+                    patience=cfg.stream_patience,
+                    sampler_threads=cfg.sampler_threads,
+                    overlap=overlap, on_epoch=on_epoch, console=console)
+            _stage_edge("train")
+            result = sres.train
+            gene_freq = sres.gene_freq
+            n_paths = sres.n_paths
+            console("    n_paths : %d\t(streamed, %d shard(s))"
+                    % (n_paths, sres.stats.n_shards))
+            console("    n_genes : %d\t(genes in good or poor random "
+                    "paths)" % len(gene_freq))
+            console("    [stream] first update %.0f ms in; sampling wall "
+                    "%.2f s; ring high-water %d/%d shard(s)"
+                    % (sres.stats.time_to_first_update_ms,
+                       sres.stats.sampling_wall_s,
+                       sres.stats.ring_occupancy_hw, cfg.prefetch_depth))
+            metrics.emit("paths", n_paths=n_paths,
+                         n_path_genes=len(gene_freq),
+                         walker_backend=walker_backend,
+                         sampler_threads=sampler_threads,
+                         walk_cache_hits=walk_cache_hits)
+            metrics.emit("stream", **sres.stats.as_dict())
+            timer.annotate("paths",
+                           sampling_wall_s=sres.stats.sampling_wall_s,
+                           walker_backend=walker_backend,
+                           sampler_threads=sampler_threads)
+            timer.annotate("train", train_mode="streaming",
+                           **sres.stats.as_dict())
+            if result.stopped_early:
+                reporter.on_stop(result.stop_epoch, result.acc_val,
+                                 result.acc_tr)
+            console("    Optimization Finish")
+            metrics.emit("train_done", stop_epoch=result.stop_epoch,
+                         acc_val=result.acc_val, acc_tr=result.acc_tr,
+                         stopped_early=result.stopped_early)
+        else:
+            fault_point("paths")
+            fleet.note_phase("paths")
+            with timer.stage("paths"):
+                path_sets: List = [None, None]
+                joins = []
+                for i, group in enumerate(["g", "p"]):
+                    expr_group = data.expr[data.label == i]
+                    # Sparse transitions: per-step walk cost O(W*D) instead of
+                    # O(W*G), and no dense G^2 matrix in HBM (ops/graph.py).
+                    s_k, d_k, w_k = thresholded_edges(expr_group, src, dst,
+                                                      threshold=cfg.pcc_threshold)
+                    ckey = None
+                    if walk_cache is not None:
+                        # Content-addressed: the exact thresholded edges + the
+                        # walk params + the sampler's PRNG-family tag. Any
+                        # input or config drift misses; a verified hit skips
+                        # this group's walks entirely (g2vec_tpu/cache.py).
+                        ckey = walk_cache_key(
+                            np.asarray(s_k), np.asarray(d_k), np.asarray(w_k),
+                            n_genes, len_path=cfg.lenPath,
+                            reps=cfg.numRepetition, seed=(cfg.seed << 1) | i,
+                            family=(NATIVE_FAMILY if walker_backend == "native"
+                                    else DEVICE_FAMILY))
+                        cached = walk_cache.load(ckey)
+                        if cached is not None:
+                            path_sets[i] = cached
+                            walk_cache_hits.append(group)
+                            console(f"    [cache] group {group!r}: verified "
+                                    f"walk artifact hit ({len(cached)} unique "
+                                    f"paths) — walks skipped")
+                            metrics.emit("walk_cache", group=group,
+                                         outcome="hit", n_rows=len(cached))
+                            continue
+                        metrics.emit("walk_cache", group=group, outcome="miss")
+                    if walker_backend == "native":
+                        # Threaded C++ CSR sampler (ops/host_walker.py): the
+                        # default host path (ops/backend.py has the measured
+                        # rationale). Same packed-row contract; its own
+                        # deterministic PRNG family (module docstring). In a
+                        # multi-process run each host walks its shard of the
+                        # walker axis and the packed rows are allgathered —
+                        # bit-identical to the single-host set.
+                        if cfg.distributed:
+                            # Collective; falls back to the plain single-host
+                            # call itself when process_count == 1.
+                            from g2vec_tpu.parallel.distributed import \
+                                sharded_native_path_set
+
+                            path_sets[i] = sharded_native_path_set(
+                                np.asarray(s_k), np.asarray(d_k),
+                                np.asarray(w_k), n_genes,
+                                len_path=cfg.lenPath, reps=cfg.numRepetition,
+                                seed=(cfg.seed << 1) | i,
+                                n_threads=cfg.sampler_threads)
+                            continue
+                        from g2vec_tpu.ops.host_walker import \
+                            generate_path_set_native
+
+                        def _walk(s=np.asarray(s_k), d=np.asarray(d_k),
+                                  w=np.asarray(w_k), i=i, group=group,
+                                  ckey=ckey):
+                            ps = generate_path_set_native(
+                                s, d, w, n_genes, len_path=cfg.lenPath,
+                                reps=cfg.numRepetition,
+                                seed=(cfg.seed << 1) | i,
+                                n_threads=cfg.sampler_threads)
+                            if walk_cache is not None and ckey:
+                                walk_cache.store(ckey, ps, n_genes,
+                                                 meta={"group": group})
+                            return ps
+
+                        if use_overlap:
+                            # Both groups' walks share the sampler pool; the
+                            # second group's ranges interleave with the
+                            # first's instead of waiting for its full join.
+                            overlap.submit(f"walks_{group}", _walk)
+                            joins.append((i, f"walks_{group}"))
+                        else:
+                            path_sets[i] = _walk()
                         continue
-                    metrics.emit("walk_cache", group=group, outcome="miss")
-                if walker_backend == "native":
-                    # Threaded C++ CSR sampler (ops/host_walker.py): the
-                    # default host path (ops/backend.py has the measured
-                    # rationale). Same packed-row contract; its own
-                    # deterministic PRNG family (module docstring). In a
-                    # multi-process run each host walks its shard of the
-                    # walker axis and the packed rows are allgathered —
-                    # bit-identical to the single-host set.
-                    if cfg.distributed:
-                        # Collective; falls back to the plain single-host
-                        # call itself when process_count == 1.
-                        from g2vec_tpu.parallel.distributed import \
-                            sharded_native_path_set
+                    table = neighbor_table(s_k, d_k, w_k, n_genes)
+                    path_sets[i] = generate_path_set(
+                        table, jax.random.fold_in(key, i), len_path=cfg.lenPath,
+                        reps=cfg.numRepetition, walker_batch=cfg.walker_batch,
+                        walker_hbm_budget=cfg.walker_hbm_budget,
+                        mesh_ctx=mesh_ctx)
+                    if walk_cache is not None and ckey:
+                        walk_cache.store(ckey, path_sets[i], n_genes,
+                                         meta={"group": group})
+                for i, name in joins:
+                    # Re-raises a walk task's exception here, inside the
+                    # stage — same failure surface as the sequential order.
+                    path_sets[i] = overlap.result(name)
+                # Paths stay bit-packed from the walker all the way into the
+                # trainer — the dense uint8 [n_paths, n_genes] matrix never
+                # materializes on the host (8x smaller at any scale).
+                paths, labels = integrate_path_sets(path_sets[0], path_sets[1],
+                                                    n_genes, packed=True)
+                if use_overlap and paths.shape[0] >= 2:
+                    # n_paths is known the moment integrate returns: warm the
+                    # trainer's chunk program in the background while the
+                    # foreground counts gene frequencies and train_cbow
+                    # bit-packs the split — train_cbow joins this via its
+                    # pre-compile hook, right where it wants the executable.
+                    from g2vec_tpu.train.trainer import warm_train_compile
 
-                        path_sets[i] = sharded_native_path_set(
-                            np.asarray(s_k), np.asarray(d_k),
-                            np.asarray(w_k), n_genes,
-                            len_path=cfg.lenPath, reps=cfg.numRepetition,
-                            seed=(cfg.seed << 1) | i,
-                            n_threads=cfg.sampler_threads)
-                        continue
-                    from g2vec_tpu.ops.host_walker import \
-                        generate_path_set_native
+                    n_paths_known = int(paths.shape[0])
+                    # The warm must predict the REAL chunk program — the
+                    # fused/superstep/donate trainer modes and the autotuner's
+                    # tile installs are all part of its cache key, so they ride
+                    # along here (a warm that swept the autotune shapes also
+                    # spares the foreground the measurement sweep).
+                    overlap.submit("warm_trainer", _background_warm(
+                        lambda: warm_train_compile(
+                            n_paths_known, n_genes, hidden=cfg.sizeHiddenlayer,
+                            learning_rate=cfg.learningRate,
+                            max_epochs=cfg.epoch,
+                            val_fraction=cfg.val_fraction,
+                            decision_threshold=cfg.decision_threshold,
+                            compute_dtype=cfg.compute_dtype,
+                            param_dtype=cfg.param_dtype, mesh_ctx=mesh_ctx,
+                            checkpoint_dir=cfg.checkpoint_dir,
+                            checkpoint_every=cfg.checkpoint_every,
+                            fused_eval=cfg.fused_eval,
+                            epoch_superstep=cfg.epoch_superstep,
+                            donate=cfg.donate_state,
+                            kernel_autotune=cfg.kernel_autotune,
+                            autotune_cache_path=autotune_path), console))
+                gene_freq = count_gene_freq(paths, labels, data.gene, packed=True)
+            _stage_edge("paths")
+            n_paths = paths.shape[0]
+            if n_paths < 2:
+                raise ValueError(
+                    "fewer than 2 distinct group-specific paths were generated — "
+                    "the |PCC| > %.2f graphs are too sparse for this dataset; try "
+                    "lowering --pcc-threshold or raising -r/--numRepetition"
+                    % cfg.pcc_threshold)
+            console("    n_paths : %d" % n_paths)
+            console("    n_genes : %d\t(genes in good or poor random paths)" % len(gene_freq))
+            metrics.emit("paths", n_paths=n_paths, n_path_genes=len(gene_freq),
+                         walker_backend=walker_backend,
+                         sampler_threads=sampler_threads,
+                         walk_cache_hits=walk_cache_hits)
+            timer.annotate("paths", walker_backend=walker_backend,
+                           sampler_threads=sampler_threads,
+                           walk_cache_hits=list(walk_cache_hits))
 
-                    def _walk(s=np.asarray(s_k), d=np.asarray(d_k),
-                              w=np.asarray(w_k), i=i, group=group,
-                              ckey=ckey):
-                        ps = generate_path_set_native(
-                            s, d, w, n_genes, len_path=cfg.lenPath,
-                            reps=cfg.numRepetition,
-                            seed=(cfg.seed << 1) | i,
-                            n_threads=cfg.sampler_threads)
-                        if walk_cache is not None and ckey:
-                            walk_cache.store(ckey, ps, n_genes,
-                                             meta={"group": group})
-                        return ps
+            console(">>> 4. Compute distributed representations using modified CBOW")
+            console("     Start training the modified CBOW with early stopping")
+            reporter = _EpochReporter(console, cfg.display_step)
 
-                    if use_overlap:
-                        # Both groups' walks share the sampler pool; the
-                        # second group's ranges interleave with the
-                        # first's instead of waiting for its full join.
-                        overlap.submit(f"walks_{group}", _walk)
-                        joins.append((i, f"walks_{group}"))
-                    else:
-                        path_sets[i] = _walk()
-                    continue
-                table = neighbor_table(s_k, d_k, w_k, n_genes)
-                path_sets[i] = generate_path_set(
-                    table, jax.random.fold_in(key, i), len_path=cfg.lenPath,
-                    reps=cfg.numRepetition, walker_batch=cfg.walker_batch,
-                    walker_hbm_budget=cfg.walker_hbm_budget,
-                    mesh_ctx=mesh_ctx)
-                if walk_cache is not None and ckey:
-                    walk_cache.store(ckey, path_sets[i], n_genes,
-                                     meta={"group": group})
-            for i, name in joins:
-                # Re-raises a walk task's exception here, inside the
-                # stage — same failure surface as the sequential order.
-                path_sets[i] = overlap.result(name)
-            # Paths stay bit-packed from the walker all the way into the
-            # trainer — the dense uint8 [n_paths, n_genes] matrix never
-            # materializes on the host (8x smaller at any scale).
-            paths, labels = integrate_path_sets(path_sets[0], path_sets[1],
-                                                n_genes, packed=True)
-            if use_overlap and paths.shape[0] >= 2:
-                # n_paths is known the moment integrate returns: warm the
-                # trainer's chunk program in the background while the
-                # foreground counts gene frequencies and train_cbow
-                # bit-packs the split — train_cbow joins this via its
-                # pre-compile hook, right where it wants the executable.
-                from g2vec_tpu.train.trainer import warm_train_compile
+            def on_epoch(step, acc_val, acc_tr, secs):
+                reporter.on_epoch(step, acc_val, acc_tr, secs)
+                metrics.emit("epoch", step=step, acc_val=acc_val, acc_tr=acc_tr, secs=secs)
 
-                n_paths_known = int(paths.shape[0])
-                # The warm must predict the REAL chunk program — the
-                # fused/superstep/donate trainer modes and the autotuner's
-                # tile installs are all part of its cache key, so they ride
-                # along here (a warm that swept the autotune shapes also
-                # spares the foreground the measurement sweep).
-                overlap.submit("warm_trainer", _background_warm(
-                    lambda: warm_train_compile(
-                        n_paths_known, n_genes, hidden=cfg.sizeHiddenlayer,
-                        learning_rate=cfg.learningRate,
-                        max_epochs=cfg.epoch,
-                        val_fraction=cfg.val_fraction,
-                        decision_threshold=cfg.decision_threshold,
-                        compute_dtype=cfg.compute_dtype,
-                        param_dtype=cfg.param_dtype, mesh_ctx=mesh_ctx,
-                        checkpoint_dir=cfg.checkpoint_dir,
-                        checkpoint_every=cfg.checkpoint_every,
-                        fused_eval=cfg.fused_eval,
-                        epoch_superstep=cfg.epoch_superstep,
-                        donate=cfg.donate_state,
-                        kernel_autotune=cfg.kernel_autotune,
-                        autotune_cache_path=autotune_path), console))
-            gene_freq = count_gene_freq(paths, labels, data.gene, packed=True)
-        _stage_edge("paths")
-        n_paths = paths.shape[0]
-        if n_paths < 2:
-            raise ValueError(
-                "fewer than 2 distinct group-specific paths were generated — "
-                "the |PCC| > %.2f graphs are too sparse for this dataset; try "
-                "lowering --pcc-threshold or raising -r/--numRepetition"
-                % cfg.pcc_threshold)
-        console("    n_paths : %d" % n_paths)
-        console("    n_genes : %d\t(genes in good or poor random paths)" % len(gene_freq))
-        metrics.emit("paths", n_paths=n_paths, n_path_genes=len(gene_freq),
-                     walker_backend=walker_backend,
-                     sampler_threads=sampler_threads,
-                     walk_cache_hits=walk_cache_hits)
-        timer.annotate("paths", walker_backend=walker_backend,
-                       sampler_threads=sampler_threads,
-                       walk_cache_hits=list(walk_cache_hits))
-
-        console(">>> 4. Compute distributed representations using modified CBOW")
-        console("     Start training the modified CBOW with early stopping")
-        reporter = _EpochReporter(console, cfg.display_step)
-
-        def on_epoch(step, acc_val, acc_tr, secs):
-            reporter.on_epoch(step, acc_val, acc_tr, secs)
-            metrics.emit("epoch", step=step, acc_val=acc_val, acc_tr=acc_tr, secs=secs)
-
-        fault_point("train")
-        fleet.note_phase("train")
-        with timer.stage("train"):
-            result = train_cbow(
-                paths, labels, packed_genes=n_genes,
-                hidden=cfg.sizeHiddenlayer, learning_rate=cfg.learningRate,
-                max_epochs=cfg.epoch, val_fraction=cfg.val_fraction,
-                decision_threshold=cfg.decision_threshold,
-                compute_dtype=cfg.compute_dtype, param_dtype=cfg.param_dtype,
-                seed=(cfg.seed if cfg.train_seed is None else cfg.train_seed),
-                mesh_ctx=mesh_ctx, on_epoch=on_epoch,
-                checkpoint_dir=cfg.checkpoint_dir, resume=cfg.resume,
-                checkpoint_every=cfg.checkpoint_every,
-                checkpoint_layout=cfg.checkpoint_layout,
-                fused_eval=cfg.fused_eval,
-                epoch_superstep=cfg.epoch_superstep,
-                donate=cfg.donate_state,
-                kernel_autotune=cfg.kernel_autotune,
-                autotune_cache_path=autotune_path,
-                # Joins the background chunk-program warm right before the
-                # trainer requests the executable (after the host-side
-                # packing it overlapped); None = compile in line.
-                pre_compile_hook=(
-                    (lambda: overlap.result("warm_trainer"))
-                    if use_overlap and overlap.has("warm_trainer")
-                    else None))
-        _stage_edge("train")
-        if result.stopped_early:
-            reporter.on_stop(result.stop_epoch, result.acc_val, result.acc_tr)
-        console("    Optimization Finish")
-        metrics.emit("train_done", stop_epoch=result.stop_epoch,
-                     acc_val=result.acc_val, acc_tr=result.acc_tr,
-                     stopped_early=result.stopped_early)
+            fault_point("train")
+            fleet.note_phase("train")
+            with timer.stage("train"):
+                result = train_cbow(
+                    paths, labels, packed_genes=n_genes,
+                    hidden=cfg.sizeHiddenlayer, learning_rate=cfg.learningRate,
+                    max_epochs=cfg.epoch, val_fraction=cfg.val_fraction,
+                    decision_threshold=cfg.decision_threshold,
+                    compute_dtype=cfg.compute_dtype, param_dtype=cfg.param_dtype,
+                    seed=(cfg.seed if cfg.train_seed is None else cfg.train_seed),
+                    mesh_ctx=mesh_ctx, on_epoch=on_epoch,
+                    checkpoint_dir=cfg.checkpoint_dir, resume=cfg.resume,
+                    checkpoint_every=cfg.checkpoint_every,
+                    checkpoint_layout=cfg.checkpoint_layout,
+                    fused_eval=cfg.fused_eval,
+                    epoch_superstep=cfg.epoch_superstep,
+                    donate=cfg.donate_state,
+                    kernel_autotune=cfg.kernel_autotune,
+                    autotune_cache_path=autotune_path,
+                    # Joins the background chunk-program warm right before the
+                    # trainer requests the executable (after the host-side
+                    # packing it overlapped); None = compile in line.
+                    pre_compile_hook=(
+                        (lambda: overlap.result("warm_trainer"))
+                        if use_overlap and overlap.has("warm_trainer")
+                        else None))
+            _stage_edge("train")
+            if result.stopped_early:
+                reporter.on_stop(result.stop_epoch, result.acc_val, result.acc_tr)
+            console("    Optimization Finish")
+            metrics.emit("train_done", stop_epoch=result.stop_epoch,
+                         acc_val=result.acc_val, acc_tr=result.acc_tr,
+                         stopped_early=result.stopped_early)
 
         console(">>> 5. Find L-groups")
         if use_overlap:
@@ -575,7 +673,9 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
             train_history=result.history, acc_val=result.acc_val,
             stage_seconds=timer.as_dict(), walker_backend=walker_backend,
             sampler_threads=sampler_threads, overlap_saved_s=overlap_saved,
-            walk_cache_hits=walk_cache_hits)
+            walk_cache_hits=walk_cache_hits,
+            stream_stats=(sres.stats.as_dict()
+                          if cfg.train_mode == "streaming" else {}))
     finally:
         if overlap is not None:
             # Drain, never raise: the exception in flight (if any) is the

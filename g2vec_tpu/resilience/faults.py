@@ -83,7 +83,18 @@ SEAMS = ("load", "preprocess", "paths", "train", "lgroups", "biomarkers",
          # Walk-artifact cache (g2vec_tpu/cache.py): fires right after a
          # store finalizes, so kind=corrupt models post-save bitrot that
          # only the manifest verification can catch.
-         "walk_cache")
+         "walk_cache",
+         # Streaming trainer (train/stream.py): ``shard_ring`` fires in
+         # the producer right after a shard spools and before it enters
+         # the ring (epoch = shard index; kind=corrupt gets the spool
+         # file, modelling a torn shard the replay verification must
+         # catch and re-walk); ``prefetch`` fires in the consumer as it
+         # requests the next shard (a wedged/dying prefetch stage). The
+         # ring's failure contract — producer faults surface at the
+         # consumer's next get, consumer death cancels the ring so a
+         # blocked producer unblocks — makes every kind here terminate
+         # instead of deadlocking the edge.
+         "shard_ring", "prefetch")
 
 
 class FaultPlanError(ValueError):

@@ -1,0 +1,693 @@
+"""Streaming minibatch trainer — sampling overlapped with training,
+bounded host memory, big graphs (ROADMAP item 3).
+
+Every other trainer mode (solo, lanes, serve) is full-batch: ALL walk
+paths for both groups materialize on host and pack onto device before
+epoch 0, which hard-caps graph size at host RAM, forces strict stage
+3 -> stage 4 sequencing, and makes a resident daemon's footprint
+proportional to the largest job it ever saw. This module is the
+shared-memory minibatch-SGD recipe of "Parallelizing Word2Vec in
+Multi-Core and Many-Core Architectures" (arXiv:1611.06172) applied to
+the walk pipeline:
+
+- The PR 3 multicore sampler pool emits fixed-size **walk shards**
+  (packed context/target blocks: each shard is the same walker-index
+  range of BOTH groups' axes, so labels mix evenly —
+  ops/host_walker.py ``ShardPlan``). Shard order is deterministic by
+  shard index; shard contents are bit-identical at any thread count.
+- A bounded host **ring** (:class:`ShardRing`, ``--prefetch-depth``)
+  carries shards from the producer (an overlap-scheduler task) to the
+  trainer. A full ring BLOCKS the producer — backpressure — so peak
+  host path memory is O(shard x depth), never O(total paths).
+- A **double-buffered device prefetch** stage uploads shard ``b+1``
+  while the jitted minibatch-SGD step consumes shard ``b`` (JAX's
+  async dispatch does the overlap; the feed just keeps one upload in
+  flight ahead of the step).
+- Epoch 0 consumes the ring — training starts the moment shard 0
+  lands, long before sampling finishes. Shards are spooled to disk
+  (sha256-manifested — :class:`ShardSpool`) as they pass, and epochs
+  1..N replay the spool; a replayed shard whose bytes fail
+  verification is re-walked once (determinism makes the retry exact)
+  and the run dies cleanly if even the re-walk mismatches.
+- The early stop evaluates the SAME metric as full-batch — held-out
+  val accuracy, first strict dip, previous epoch's snapshot returned —
+  at shard-epoch boundaries, on a bounded val buffer accumulated from
+  per-shard held-out rows during epoch 0.
+
+Contract vs full-batch: STATISTICAL, not bitwise. The stream trains on
+the raw walk rows (no global dedup, no common-path drop — both need
+the full set) with per-shard Adam steps, so trajectories differ; the
+pinned contract is a val-ACC parity band plus top-N biomarker overlap
+(tests/test_stream.py), while the full-batch path remains the
+bitwise-golden reference. WITHIN streaming mode the trajectory is
+bitwise-deterministic: same seed + same shard size reproduce it at any
+``--sampler-threads`` and any ring depth.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import shutil
+import tempfile
+import threading
+import time
+import warnings
+from collections import deque
+from typing import Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from g2vec_tpu.ops.host_walker import (ShardPlan, edges_to_csr, plan_shards,
+                                       walk_shard)
+from g2vec_tpu.resilience.faults import fault_point
+from g2vec_tpu.utils.integrity import sha256_file
+
+# ---------------------------------------------------------------------------
+# Process-wide stream accounting (the serve /status "how warm/busy is the
+# streaming path" currency, beside cache.cache_stats()).
+# ---------------------------------------------------------------------------
+
+_STATS_LOCK = threading.Lock()
+_TOTALS: Dict[str, float] = {}
+
+
+def _record_totals(**fields) -> None:
+    with _STATS_LOCK:
+        _TOTALS["runs"] = _TOTALS.get("runs", 0) + 1
+        for k, v in fields.items():
+            if k.startswith("last_"):
+                _TOTALS[k] = v
+            elif k.startswith("max_"):
+                _TOTALS[k] = max(_TOTALS.get(k, 0), v)
+            else:
+                _TOTALS[k] = _TOTALS.get(k, 0) + v
+
+
+def stream_stats() -> Dict[str, float]:
+    """Snapshot of every streaming run's counters since process start
+    (batch/engine.py surfaces it on the engine status -> serve /status)."""
+    with _STATS_LOCK:
+        return dict(_TOTALS)
+
+
+@dataclasses.dataclass
+class Shard:
+    """One in-flight walk shard: group-g rows then group-p rows, still in
+    the walker's np.packbits layout (8 genes/byte)."""
+
+    index: int
+    x: np.ndarray            # [rows, ceil(G/8)] uint8
+    y: np.ndarray            # [rows] int32 labels (0 good, 1 poor)
+
+    @property
+    def nbytes(self) -> int:
+        return self.x.nbytes + self.y.nbytes
+
+
+class ShardRing:
+    """Bounded producer->consumer shard queue with explicit failure edges.
+
+    The no-deadlock contract (tests/test_stream.py pins all four edges):
+
+    - full ring: ``put`` BLOCKS (backpressure — the sampler cannot run
+      ahead of the trainer by more than ``depth`` shards);
+    - producer failure: ``fail(exc)`` parks the exception; the consumer's
+      next ``get`` re-raises it (same surface as an overlap join);
+    - producer done: ``finish``; ``get`` returns None after the queue
+      drains;
+    - consumer death: ``cancel`` wakes a blocked producer, whose ``put``
+      returns False (the producer task then exits instead of wedging the
+      overlap drain — the scheduler runs ``cancel`` as a close-time
+      closer, parallel/overlap.py ``add_closer``).
+    """
+
+    def __init__(self, depth: int):
+        if depth < 1:
+            raise ValueError(f"ring depth must be >= 1, got {depth}")
+        self.depth = depth
+        self._items: deque = deque()
+        self._cv = threading.Condition()
+        self._error: Optional[BaseException] = None
+        self._finished = False
+        self._cancelled = False
+        # Accounting (read after the run; the lock covers writes).
+        self.occupancy_hw = 0        # max shards resident at once
+        self.peak_bytes = 0          # max bytes resident at once
+        self.shards_put = 0
+        self.wait_put_s = 0.0        # producer time blocked on a full ring
+        self.wait_get_s = 0.0        # consumer time blocked on an empty one
+
+    def put(self, shard: Shard) -> bool:
+        """Enqueue; blocks while full. False = ring cancelled (consumer
+        gone) — the producer should stop emitting."""
+        t0 = time.perf_counter()
+        with self._cv:
+            while len(self._items) >= self.depth and not self._cancelled:
+                self._cv.wait(timeout=0.1)
+            self.wait_put_s += time.perf_counter() - t0
+            if self._cancelled:
+                return False
+            self._items.append(shard)
+            self.shards_put += 1
+            self.occupancy_hw = max(self.occupancy_hw, len(self._items))
+            self.peak_bytes = max(self.peak_bytes,
+                                  sum(s.nbytes for s in self._items))
+            self._cv.notify_all()
+        return True
+
+    def get(self) -> Optional[Shard]:
+        """Dequeue the next shard (emission order); blocks while empty.
+        None = producer finished and queue drained; a producer failure
+        re-raises here."""
+        t0 = time.perf_counter()
+        with self._cv:
+            while True:
+                if self._error is not None:
+                    self.wait_get_s += time.perf_counter() - t0
+                    raise self._error
+                if self._items:
+                    self.wait_get_s += time.perf_counter() - t0
+                    shard = self._items.popleft()
+                    self._cv.notify_all()
+                    return shard
+                if self._finished or self._cancelled:
+                    self.wait_get_s += time.perf_counter() - t0
+                    return None
+                self._cv.wait(timeout=0.1)
+
+    def fail(self, exc: BaseException) -> None:
+        with self._cv:
+            self._error = exc
+            self._cv.notify_all()
+
+    def finish(self) -> None:
+        with self._cv:
+            self._finished = True
+            self._cv.notify_all()
+
+    def cancel(self) -> None:
+        """Consumer-side teardown: unblock and stop the producer. Idempotent
+        and safe after finish()."""
+        with self._cv:
+            self._cancelled = True
+            self._items.clear()
+            self._cv.notify_all()
+
+
+class SpoolIntegrityError(ValueError):
+    """A spooled shard failed sha256 verification and its deterministic
+    re-walk did not reproduce the recorded bytes — the inputs changed
+    under the run, a fatal condition (never retried)."""
+
+
+class ShardSpool:
+    """Disk spool for the epoch-0 shard stream, replayed by epochs 1..N.
+
+    One ``.npy`` pair per shard under a run-private temp dir, each with
+    its sha256 recorded AT EMISSION (utils/integrity.py — the same
+    trust-nothing stance as the walk cache and checkpoint manifests). A
+    replay whose bytes mismatch (torn write, bitrot, an injected
+    ``shard_ring`` corrupt fault) is re-walked ONCE through the
+    deterministic sampler — the retry must reproduce the recorded hash
+    exactly, else :class:`SpoolIntegrityError`. Host memory never holds
+    more than the shards in flight; the spool is why epochs > 0 cost
+    sequential file reads instead of a full re-sample.
+    """
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        self._sha: Dict[int, str] = {}
+        self.rewalks = 0
+
+    def x_path(self, index: int) -> str:
+        return os.path.join(self.directory, f"shard{index:06d}_x.npy")
+
+    def save(self, shard: Shard) -> str:
+        np.save(self.x_path(shard.index), shard.x)
+        self._sha[shard.index] = sha256_file(self.x_path(shard.index))
+        return self.x_path(shard.index)
+
+    def load(self, index: int,
+             rewalk: Callable[[int], np.ndarray]) -> np.ndarray:
+        """Shard ``index``'s verified x rows (labels are re-derived by the
+        caller — they are a pure function of the plan)."""
+        path = self.x_path(index)
+        want = self._sha[index]
+        if sha256_file(path) != want:
+            warnings.warn(
+                f"spooled shard {index} failed sha256 verification "
+                f"({path}) — re-walking it through the deterministic "
+                f"sampler", RuntimeWarning)
+            self.rewalks += 1
+            np.save(path, rewalk(index))
+            if sha256_file(path) != want:
+                raise SpoolIntegrityError(
+                    f"shard {index}: deterministic re-walk does not "
+                    f"reproduce the bytes recorded at emission — the walk "
+                    f"inputs changed under the run; aborting")
+        return np.load(path)
+
+
+@dataclasses.dataclass
+class StreamStats:
+    """One streaming run's attribution record (metrics `stream` event,
+    StageTimer extras, BENCH_STREAM_AB.json)."""
+
+    n_shards: int = 0
+    shards_emitted: int = 0
+    n_paths: int = 0                 # rows actually trained on (after the
+                                     # per-shard common-drop/dedup)
+    rows_sampled: int = 0            # raw walker rows emitted (2 x walkers)
+    shard_rows: int = 0
+    ring_depth: int = 0
+    ring_occupancy_hw: int = 0
+    ring_peak_bytes: int = 0
+    prefetch_wait_ms: float = 0.0
+    time_to_first_update_ms: float = 0.0
+    shards_at_first_update: int = 0
+    sampling_wall_s: float = 0.0
+    producer_blocked_s: float = 0.0
+    rewalks: int = 0
+    epochs: int = 0
+
+    def as_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class StreamTrainResult:
+    train: object                    # train.trainer.TrainResult
+    gene_freq: Dict[str, int]        # streaming count_gene_freq twin
+    n_paths: int
+    stats: StreamStats
+
+
+def _group_edges_csr(src: np.ndarray, dst: np.ndarray, n_genes: int):
+    """One-time bounds check per group (walk_shard skips the per-shard
+    O(E) scans when handed a prebuilt CSR)."""
+    for name, arr in (("src", src), ("dst", dst)):
+        if arr.size and (arr.min() < 0 or arr.max() >= n_genes):
+            raise ValueError(
+                f"{name} contains node ids outside [0, {n_genes})")
+
+
+def _shard_split(rows: int, seed: int, shard_index: int,
+                 val_fraction: float):
+    """The per-shard held-out split: the full-batch shuffled hold-out
+    (trainer._split_indices) applied per shard, seeded by (train seed,
+    shard index) so it is invariant to thread count and ring depth.
+    Every shard keeps at least one row on each side."""
+    rng = np.random.default_rng(np.random.SeedSequence((seed, shard_index)))
+    perm = rng.permutation(rows)
+    pivot = int(rows * (1.0 - val_fraction))
+    pivot = max(1, min(pivot, rows - 1))
+    return perm[:pivot], perm[pivot:]
+
+
+#: Bounded eval buffers: the val (and train-probe) sets accumulate
+#: per-shard held-out rows in shard order UP TO this many rows, so the
+#: epoch-boundary eval stays O(1) in graph size. 4096 rows matches the
+#: auto shard size; the full-batch trainer's val set at bundled scale is
+#: smaller than this, so at small scale the buffers are effectively
+#: uncapped.
+EVAL_ROWS_CAP = 4096
+
+
+def train_cbow_streaming(
+        *, groups, n_genes: int, genes, hidden: int, learning_rate: float,
+        max_epochs: int, val_fraction: float = 0.2,
+        decision_threshold: float = 0.5, compute_dtype: str = "bfloat16",
+        param_dtype: str = "float32", seed: int = 0, walk_seed: int = 0,
+        len_path: int, reps: int, shard_paths: int = 0,
+        prefetch_depth: int = 2, patience: int = 5, sampler_threads: int = 0,
+        overlap=None, use_pallas: Optional[bool] = None,
+        eval_rows_cap: int = EVAL_ROWS_CAP,
+        on_epoch: Optional[Callable[[int, float, float, float], None]] = None,
+        console: Callable[[str], None] = print,
+        ) -> StreamTrainResult:
+    """Stream walk shards from the sampler pool straight into minibatch
+    SGD; returns the trained embeddings plus the streaming twin of the
+    stage-3 byproducts (gene frequency votes, total path count).
+
+    ``groups`` is ``[(src_g, dst_g, w_g), (src_p, dst_p, w_p)]`` — the
+    two thresholded per-group edge lists (the same arrays the full-batch
+    stage 3 hands the walkers). ``overlap`` is the pipeline's
+    OverlapScheduler; the producer runs on it as a DAG task under the
+    existing drain contract (None spins a private thread). ``seed`` is
+    the trainer's split/init seed, ``walk_seed`` the stage-3 walk seed —
+    the same split the full-batch config makes.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from g2vec_tpu.models.cbow import init_params
+    from g2vec_tpu.ops import packed_matmul as pm
+    from g2vec_tpu.parallel.mesh import make_mesh_context, pad_to_multiple
+    from g2vec_tpu.train.trainer import (_DTYPES, _get_stream_fns,
+                                         _get_unpack_fn, _plan_layout,
+                                         TrainResult)
+    import optax
+
+    if len(groups) != 2:
+        raise ValueError(f"need exactly 2 groups, got {len(groups)}")
+    if compute_dtype not in _DTYPES or param_dtype not in _DTYPES:
+        raise ValueError(
+            f"dtypes must be one of {sorted(_DTYPES)}, got "
+            f"{compute_dtype!r}/{param_dtype!r}")
+
+    plan = plan_shards(n_genes, reps, shard_paths, len_path=len_path)
+    n_shards = plan.n_shards
+    total_rows = 2 * plan.n_walkers
+    stats = StreamStats(n_shards=n_shards, rows_sampled=total_rows,
+                        shard_rows=plan.rows_per_shard,
+                        ring_depth=prefetch_depth)
+
+    csr = []
+    for s, d, w in groups:
+        _group_edges_csr(np.asarray(s), np.asarray(d), n_genes)
+        csr.append(edges_to_csr(np.asarray(s), np.asarray(d),
+                                np.asarray(w), n_genes))
+
+    def _walk_group(gi: int, shard_index: int) -> np.ndarray:
+        s, d, w = groups[gi]
+        return walk_shard(np.asarray(s), np.asarray(d), np.asarray(w),
+                          n_genes, plan, shard_index,
+                          seed=(walk_seed << 1) | gi,
+                          n_threads=sampler_threads, csr=csr[gi])
+
+    def _walk_shard_rows(shard_index: int) -> np.ndarray:
+        return np.concatenate([_walk_group(0, shard_index),
+                               _walk_group(1, shard_index)], axis=0)
+
+    def _shard_labels(shard_index: int) -> np.ndarray:
+        n = plan.group_rows(shard_index)
+        return np.concatenate([np.zeros(n, np.int32),
+                               np.ones(n, np.int32)])
+
+    ring = ShardRing(prefetch_depth)
+    spool_dir = tempfile.mkdtemp(prefix="g2v-stream-")
+    spool = ShardSpool(spool_dir)
+    producer_wall = [0.0]
+
+    def _produce():
+        t0 = time.perf_counter()
+        try:
+            for si in range(n_shards):
+                shard = Shard(si, _walk_shard_rows(si), _shard_labels(si))
+                path = spool.save(shard)
+                # The in-flight-shard seam: kind=corrupt tears the SPOOLED
+                # bytes (epoch 0 trains on the good in-memory copy; the
+                # replay verification catches it), crash/stall/fatal
+                # surface at the consumer's next get via ring.fail.
+                fault_point("shard_ring", epoch=si, path=path)
+                if not ring.put(shard):
+                    return          # consumer gone; exit quietly
+            ring.finish()
+        except BaseException as e:  # noqa: BLE001 — consumer re-raises
+            ring.fail(e)
+        finally:
+            producer_wall[0] = time.perf_counter() - t0
+
+    remove_closer = None
+    if overlap is not None:
+        remove_closer = overlap.add_closer(ring.cancel)
+        overlap.submit("stream_shards", _produce)
+        producer_thread = None
+    else:
+        producer_thread = threading.Thread(target=_produce,
+                                           name="g2v-stream-producer",
+                                           daemon=True)
+        producer_thread.start()
+
+    # ---- device layout: the full-batch derivation, per shard ----
+    ctx = make_mesh_context(None)
+    cdtype = _DTYPES[compute_dtype]
+    pdtype = _DTYPES[param_dtype]
+    rows_nom = plan.rows_per_shard
+    tr_nom = max(1, min(int(rows_nom * (1.0 - val_fraction)), rows_nom - 1))
+    layout = _plan_layout(tr_nom, n_genes, hidden, compute_dtype, ctx,
+                          use_pallas)
+    n_genes_pad = layout.n_genes_pad
+    tr_pad = pad_to_multiple(tr_nom, layout.row_multiple)
+    unpack_fn = None if layout.use_pallas else _get_unpack_fn(ctx, cdtype)
+    update_fn, eval_fn = _get_stream_fns(
+        learning_rate, cdtype, decision_threshold,
+        packed=layout.use_pallas, interpret=layout.interpret)
+
+    def _pack_rows(rows_packed: np.ndarray, n_pad: int) -> np.ndarray:
+        """Walker packbits rows -> the device layout, row-padded to n_pad
+        (the full-batch _pack_split's per-chunk logic, one shard at a
+        time)."""
+        out = np.zeros((n_pad, n_genes_pad // 8), dtype=np.uint8)
+        n = rows_packed.shape[0]
+        if not layout.use_pallas and rows_packed.shape[1] == n_genes_pad // 8:
+            out[:n] = rows_packed
+            return out
+        dense = np.unpackbits(rows_packed, axis=1)[:, :n_genes] != 0
+        xb = np.zeros((n, n_genes_pad), dtype=bool)
+        xb[:, :n_genes] = dense
+        out[:n] = (pm.pack_blockwise(xb) if layout.use_pallas
+                   else np.packbits(xb, axis=1))
+        return out
+
+    def _put_x(packed_np: np.ndarray):
+        if layout.use_pallas:
+            return jnp.asarray(packed_np)
+        return unpack_fn(jnp.asarray(packed_np))
+
+    def _upload(x_np, y_np, n_pad):
+        n = x_np.shape[0]
+        y = np.zeros((n_pad, 1), np.float32)
+        y[:n, 0] = y_np
+        w = np.zeros((n_pad, 1), np.float32)
+        w[:n] = 1.0
+        return (_put_x(_pack_rows(x_np, n_pad)), jnp.asarray(y),
+                jnp.asarray(w))
+
+    # ---- params + optimizer (the full-batch init at this layout) ----
+    params = init_params(jax.random.key(seed), n_genes, hidden,
+                         param_dtype=pdtype, pad_to=n_genes_pad)
+    tx = optax.adam(learning_rate, b1=0.9, b2=0.999, eps=1e-8)
+    opt_state = tx.init(params)
+
+    def _filter_rows(x: np.ndarray, y: np.ndarray) -> np.ndarray:
+        """integrate_path_sets at shard granularity: drop rows whose path
+        bytes appear in BOTH groups' blocks of this shard, and keep one
+        copy per group of within-shard duplicates.
+
+        Shard ``s`` covers the SAME walker-index range of both groups'
+        axes, and walker i starts at the same gene in both — so the
+        degenerate common paths (dead-end starts whose walks visit the
+        identical gene set under both graphs) align inside one shard and
+        are dropped HERE, with O(shard) memory, exactly where the
+        full-batch common-path drop needed O(total). Cross-shard common
+        paths survive as label noise; that residue is what the
+        statistical (not bitwise) parity contract absorbs. Returns the
+        kept row indices, in order.
+        """
+        row_bytes = [r.tobytes() for r in x]
+        g_set = {b for b, l in zip(row_bytes, y) if l == 0}
+        common = g_set & {b for b, l in zip(row_bytes, y) if l == 1}
+        seen = (set(), set())
+        keep = []
+        for i, (b, l) in enumerate(zip(row_bytes, y)):
+            if b in common or b in seen[l]:
+                continue
+            seen[l].add(b)
+            keep.append(i)
+        return np.asarray(keep, dtype=np.int64)
+
+    # ---- epoch-0 byproducts, accumulated in shard order ----
+    good_counts = np.zeros(n_genes, np.int64)
+    poor_counts = np.zeros(n_genes, np.int64)
+    val_x: List[np.ndarray] = []
+    val_y: List[np.ndarray] = []
+    probe_x: List[np.ndarray] = []
+    probe_y: List[np.ndarray] = []
+    eval_buffers = [0, 0]            # collected (val, probe) row counts
+    kept_rows = [0]                  # rows surviving the per-shard filter
+
+    def _accumulate(x: np.ndarray, y: np.ndarray, tr_idx, vl_idx) -> None:
+        dense = np.unpackbits(x, axis=1)[:, :n_genes]
+        good_counts[:] += dense[y == 0].sum(axis=0, dtype=np.int64)
+        poor_counts[:] += dense[y == 1].sum(axis=0, dtype=np.int64)
+        if eval_buffers[0] < eval_rows_cap and len(vl_idx):
+            take = vl_idx[:eval_rows_cap - eval_buffers[0]]
+            val_x.append(x[take])
+            val_y.append(y[take])
+            eval_buffers[0] += len(take)
+        if eval_buffers[1] < eval_rows_cap and len(tr_idx):
+            take = tr_idx[:eval_rows_cap - eval_buffers[1]]
+            probe_x.append(x[take])
+            probe_y.append(y[take])
+            eval_buffers[1] += len(take)
+
+    def _epoch0_iter() -> Iterator[Shard]:
+        for expect in range(n_shards):
+            fault_point("prefetch", epoch=expect)
+            shard = ring.get()
+            if shard is None:
+                raise RuntimeError(
+                    f"shard ring closed after {expect}/{n_shards} shards — "
+                    f"producer exited early without failing")
+            if shard.index != expect:
+                raise RuntimeError(
+                    f"shard order violated: got {shard.index}, expected "
+                    f"{expect}")
+            yield shard
+
+    def _replay_iter() -> Iterator[Shard]:
+        for si in range(n_shards):
+            fault_point("prefetch", epoch=si)
+            yield Shard(si, spool.load(si, _walk_shard_rows),
+                        _shard_labels(si))
+
+    def _device_feed(shards: Iterator[Shard], epoch0: bool):
+        """The double buffer: shard b+1's H2D upload (and on-device
+        unpack) is dispatched before shard b is yielded to the SGD step,
+        so the upload hides under the step's device time."""
+        pending = None
+        for shard in shards:
+            keep = _filter_rows(shard.x, shard.y)
+            if not len(keep):
+                continue             # every row was group-common noise
+            fx, fy = shard.x[keep], shard.y[keep]
+            tr_idx, vl_idx = _shard_split(fx.shape[0], seed, shard.index,
+                                          val_fraction)
+            if epoch0:
+                kept_rows[0] += len(keep)
+                _accumulate(fx, fy, tr_idx, vl_idx)
+            nxt = _upload(fx[tr_idx], fy[tr_idx], tr_pad)
+            if pending is not None:
+                yield pending
+            pending = nxt
+        if pending is not None:
+            yield pending
+
+    # ---- the epoch loop ----
+    # Early stop: the SAME metric as full-batch (held-out val accuracy,
+    # snapshot-at-the-best returned), evaluated at shard-epoch
+    # boundaries — but with PATIENCE instead of the first-strict-dip
+    # rule. Per-shard SGD makes the epoch-boundary val accuracy jitter
+    # in a way the full-batch trajectory never does (one noisy epoch 1
+    # would end the run at random-init accuracy); ``patience``
+    # consecutive epochs without a strict improvement over the best is
+    # the minibatch-honest reading of "first decrease". patience=1
+    # recovers the full-batch rule exactly.
+    if patience < 1:
+        raise ValueError(f"patience must be >= 1, got {patience}")
+    history: List[dict] = []
+    best_val, best_tr = -1.0, -1.0
+    best_epoch = 0
+    since_best = 0
+    snapshot = jax.tree.map(jnp.copy, params)
+    stopped_early = False
+    stop_epoch = max_epochs - 1
+    val_dev = probe_dev = None
+    t_phase0 = time.perf_counter()
+    first_update_ms = None
+
+    try:
+        epoch = 0
+        while epoch < max_epochs and not stopped_early:
+            t_epoch = time.perf_counter()
+            losses = []
+            feed = _device_feed(
+                _epoch0_iter() if epoch == 0 else _replay_iter(),
+                epoch0=(epoch == 0))
+            for x_dev, y_dev, w_dev in feed:
+                params, opt_state, loss = update_fn(params, opt_state,
+                                                    x_dev, y_dev, w_dev)
+                if first_update_ms is None:
+                    jax.block_until_ready(loss)
+                    first_update_ms = (time.perf_counter() - t_phase0) * 1e3
+                    stats.time_to_first_update_ms = round(first_update_ms, 2)
+                    stats.shards_at_first_update = ring.shards_put
+                losses.append(loss)
+            if epoch == 0:
+                if eval_buffers[0] == 0:
+                    raise ValueError(
+                        "streaming val buffer is empty — shards contributed "
+                        "no held-out rows (raise --shard-paths or "
+                        "val_fraction)")
+                val_dev = _upload(np.concatenate(val_x),
+                                  np.concatenate(val_y),
+                                  pad_to_multiple(eval_buffers[0],
+                                                  layout.row_multiple))
+                probe_dev = _upload(np.concatenate(probe_x),
+                                    np.concatenate(probe_y),
+                                    pad_to_multiple(eval_buffers[1],
+                                                    layout.row_multiple))
+                val_x.clear(), val_y.clear()
+                probe_x.clear(), probe_y.clear()
+            acc_val = float(eval_fn(params, *val_dev))
+            acc_tr = float(eval_fn(params, *probe_dev))
+            loss_mean = float(np.mean([float(l) for l in losses]))
+            secs = time.perf_counter() - t_epoch
+            history.append({"epoch": epoch, "acc_val": acc_val,
+                            "acc_tr": acc_tr, "loss": loss_mean,
+                            "secs": secs})
+            if on_epoch is not None:
+                on_epoch(epoch, acc_val, acc_tr, secs)
+            fault_point("train", epoch=epoch)
+            if acc_val > best_val:
+                snapshot = jax.tree.map(jnp.copy, params)
+                best_val, best_tr = acc_val, acc_tr
+                best_epoch = epoch
+                since_best = 0
+            else:
+                since_best += 1
+                if since_best >= patience:
+                    # Post-best epochs' updates are discarded: the
+                    # best-epoch snapshot is the result (the full-batch
+                    # dip convention, patience-widened).
+                    stopped_early = True
+                    stop_epoch = best_epoch
+            epoch += 1
+        stats.epochs = len(history)
+    finally:
+        ring.cancel()
+        if remove_closer is not None:
+            remove_closer()
+        if producer_thread is not None:
+            producer_thread.join(timeout=30)
+        elif overlap is not None and overlap.has("stream_shards"):
+            try:
+                overlap.result("stream_shards")
+            except BaseException:  # noqa: BLE001 — best-effort join; the
+                pass               # real error already surfaced at get()
+        shutil.rmtree(spool_dir, ignore_errors=True)
+
+    stats.n_paths = kept_rows[0]
+    stats.shards_emitted = ring.shards_put
+    stats.ring_occupancy_hw = ring.occupancy_hw
+    stats.ring_peak_bytes = ring.peak_bytes
+    stats.prefetch_wait_ms = round(ring.wait_get_s * 1e3, 2)
+    stats.producer_blocked_s = round(ring.wait_put_s, 3)
+    stats.sampling_wall_s = round(producer_wall[0], 3)
+    stats.rewalks = spool.rewalks
+    _record_totals(shards_emitted=stats.shards_emitted,
+                   rewalks=stats.rewalks,
+                   max_ring_occupancy_hw=stats.ring_occupancy_hw,
+                   max_ring_peak_bytes=stats.ring_peak_bytes,
+                   prefetch_wait_ms=stats.prefetch_wait_ms,
+                   last_time_to_first_update_ms=(
+                       stats.time_to_first_update_ms),
+                   epochs=stats.epochs)
+
+    gene_freq: Dict[str, int] = {}
+    for i, g in enumerate(genes):
+        fg, fp = int(good_counts[i]), int(poor_counts[i])
+        if fg == 0 and fp == 0:
+            continue
+        gene_freq[g] = 0 if fg > fp else (1 if fg < fp else 2)
+
+    w_ih = np.asarray(snapshot.w_ih.astype(jnp.float32)[:n_genes])
+    train = TrainResult(
+        w_ih=w_ih, stop_epoch=(best_epoch if stopped_early
+                               else stop_epoch),
+        stopped_early=stopped_early,
+        acc_val=best_val, acc_tr=best_tr, history=history,
+        params=snapshot)
+    return StreamTrainResult(train=train, gene_freq=gene_freq,
+                             n_paths=kept_rows[0], stats=stats)

@@ -628,6 +628,77 @@ def _get_chunk_fn(learning_rate: float, compute_dtype, decision_threshold: float
     return _lru_get(_CHUNK_FN_CACHE, key, _CHUNK_FN_CACHE_MAX, make)
 
 
+def _make_stream_fns(learning_rate: float, compute_dtype,
+                     decision_threshold: float, packed: bool,
+                     interpret: bool):
+    """The streaming trainer's two device programs (train/stream.py).
+
+    ``update`` is ONE minibatch-SGD step on one walk shard — the
+    matrix-multiply-shaped batch of arXiv:1611.06172: grad of the masked
+    BCE over the shard's train rows, one Adam step. The loss is the
+    masked MEAN over real rows (padding rows carry weight 0), so the
+    update magnitude is invariant to shard padding and to the last
+    partial shard's size — the per-batch weighting stays honest in the
+    corrected-CBOW sense (arXiv:2012.15332): every context contributes
+    equally to its batch's update regardless of batch geometry.
+    ``evaluate`` is the shared accuracy forward for the held-out val /
+    train-probe buffers at shard-epoch boundaries.
+
+    Single-device by contract (config.py forbids streaming + --mesh);
+    the ``packed`` path runs the same fused bit-packed Pallas kernel as
+    the full-batch chunk program — shards stay bit-packed in HBM.
+    ``update`` donates (params, opt_state) so Adam's state updates in
+    place across the thousands of shard steps a big graph produces.
+    """
+    logit_threshold = float(np.log(decision_threshold
+                                   / (1.0 - decision_threshold)))
+
+    if packed:
+        def logits_fn(params, x):
+            h = pm.packed_matmul(x, params.w_ih.astype(compute_dtype),
+                                 interpret)
+            return output_logits(h, params.w_ho, compute_dtype)
+    else:
+        def logits_fn(params, x):
+            return forward(params, x, compute_dtype)
+
+    def loss_fn(params, x, y, w):
+        return masked_bce_loss(logits_fn(params, x), y, w)
+
+    tx = optax.adam(learning_rate, b1=_ADAM_B1, b2=_ADAM_B2, eps=_ADAM_EPS)
+
+    def update(params, opt_state, x, y, w):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y, w)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    def evaluate(params, x, y, w):
+        return accuracy_from_logits(logits_fn(params, x), y, w,
+                                    logit_threshold)
+
+    return (jax.jit(update, donate_argnums=(0, 1)), jax.jit(evaluate))
+
+
+_STREAM_FN_CACHE: "OrderedDict" = OrderedDict()
+_STREAM_FN_CACHE_MAX = 8
+
+
+def _get_stream_fns(learning_rate: float, compute_dtype,
+                    decision_threshold: float, packed: bool = False,
+                    interpret: bool = False):
+    """LRU-cached (update, evaluate) pair — same reuse contract as
+    :func:`_get_chunk_fn` (jit caches live on the function objects, so
+    repeat streaming runs at one config must share them)."""
+    key = (learning_rate, jnp.dtype(compute_dtype).name, decision_threshold,
+           packed, interpret, pm.tuned_token() if packed else 0)
+
+    def make():
+        return _make_stream_fns(learning_rate, compute_dtype,
+                                decision_threshold, packed, interpret)
+
+    return _lru_get(_STREAM_FN_CACHE, key, _STREAM_FN_CACHE_MAX, make)
+
+
 def _get_unpack_fn(ctx: MeshContext, compute_dtype):
     """[rows, n_bytes] uint8 -> [rows, n_bytes*8] compute-dtype multi-hot.
 

@@ -1,0 +1,458 @@
+"""Streaming minibatch trainer suite (train/stream.py) — tier-1.
+
+Five contracts, each pinned here:
+
+1. **Ring**: bounded backpressure, slow-producer waits, producer-failure
+   propagation, consumer-cancel unblocking — the four no-deadlock edges.
+2. **Determinism**: shard contents are bit-identical to the full-range
+   walker call, and the whole streaming trajectory (histories AND output
+   bytes) is invariant to ``--sampler-threads`` and ring depth.
+3. **Statistical parity vs full-batch**: val-ACC within the pinned band
+   and top-N biomarker overlap above the pinned floor on the bundled-
+   scale synthetic (the full-batch path stays the bitwise-golden
+   reference; streaming's contract is this band).
+4. **Bounded memory + overlap**: at a synthetic scale whose total path
+   volume is many times the ring bound, peak in-flight path bytes stay
+   at O(shard x depth) and training starts while sampling runs
+   (backpressure caps the shards emitted before the first update).
+5. **Fault seams**: ``shard_ring``/``prefetch`` faults terminate cleanly
+   (stall/crash -> the injected error, never a wedged ring); a corrupted
+   spool shard is detected at replay, deterministically re-walked, and
+   the run's outputs are byte-identical to the unfaulted run's.
+"""
+import os
+import shutil
+import threading
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.stream
+
+HAVE_CXX = shutil.which("g++") is not None
+needs_native = pytest.mark.skipif(not HAVE_CXX, reason="no C++ toolchain")
+
+
+# ---------------------------------------------------------------------------
+# 1. ShardRing unit tests (no jax, no native code)
+# ---------------------------------------------------------------------------
+
+def _shard(i, rows=4, nb=8):
+    from g2vec_tpu.train.stream import Shard
+
+    return Shard(i, np.full((rows, nb), i % 251, np.uint8),
+                 np.zeros(rows, np.int32))
+
+
+def test_ring_backpressure_bounds_producer():
+    from g2vec_tpu.train.stream import ShardRing
+
+    ring = ShardRing(2)
+
+    def produce():
+        for i in range(7):
+            assert ring.put(_shard(i))
+        ring.finish()
+
+    t = threading.Thread(target=produce)
+    t.start()
+    time.sleep(0.3)                 # let the producer hit the full ring
+    got = []
+    while True:
+        s = ring.get()
+        if s is None:
+            break
+        got.append(s.index)
+        time.sleep(0.02)            # slow consumer
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert got == list(range(7))            # emission order preserved
+    assert ring.occupancy_hw <= 2           # never more than depth resident
+    assert ring.peak_bytes <= 2 * _shard(0).nbytes
+    assert ring.wait_put_s > 0.1            # the producer really blocked
+
+
+def test_ring_slow_producer_consumer_waits():
+    from g2vec_tpu.train.stream import ShardRing
+
+    ring = ShardRing(4)
+
+    def produce():
+        for i in range(3):
+            time.sleep(0.05)
+            ring.put(_shard(i))
+        ring.finish()
+
+    t = threading.Thread(target=produce)
+    t.start()
+    got = [ring.get().index for _ in range(3)]
+    assert ring.get() is None               # drained + finished
+    t.join(timeout=5)
+    assert got == [0, 1, 2]
+    assert ring.wait_get_s > 0.05           # the consumer really waited
+
+
+def test_ring_producer_failure_raises_at_get():
+    from g2vec_tpu.train.stream import ShardRing
+
+    ring = ShardRing(2)
+    boom = RuntimeError("sampler died")
+    ring.fail(boom)
+    with pytest.raises(RuntimeError, match="sampler died"):
+        ring.get()
+    # Idempotent: every later get re-raises too (no deadlock, no None).
+    with pytest.raises(RuntimeError):
+        ring.get()
+
+
+def test_ring_cancel_unblocks_blocked_producer():
+    from g2vec_tpu.train.stream import ShardRing
+
+    ring = ShardRing(1)
+    assert ring.put(_shard(0))
+    outcome = {}
+
+    def produce():
+        outcome["second_put"] = ring.put(_shard(1))   # blocks: ring full
+
+    t = threading.Thread(target=produce)
+    t.start()
+    time.sleep(0.2)
+    assert t.is_alive()                     # genuinely parked on the ring
+    ring.cancel()
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert outcome["second_put"] is False   # told to stop, not wedged
+
+
+# ---------------------------------------------------------------------------
+# 2. Shard plan + walker-range determinism
+# ---------------------------------------------------------------------------
+
+def test_shard_plan_partitions_start_axis():
+    from g2vec_tpu.ops.host_walker import plan_shards
+
+    plan = plan_shards(101, 3, 24, len_path=10)     # 24/(2*3) = 4 starts
+    assert plan.starts_per_shard == 4
+    covered = []
+    total_rows = 0
+    for s in range(plan.n_shards):
+        lo, hi = plan.start_range(s)
+        covered.extend(range(lo, hi))
+        total_rows += 2 * plan.group_rows(s)
+    assert covered == list(range(101))              # exact partition
+    assert total_rows == 2 * plan.n_walkers         # both groups, all reps
+    assert plan.rows_per_shard == 2 * 4 * 3
+
+
+def test_shard_plan_auto_and_validation():
+    from g2vec_tpu.ops.host_walker import plan_shards
+
+    auto = plan_shards(100_000, 10, 0, len_path=80)
+    assert auto.starts_per_shard * 2 * 10 <= 4096
+    with pytest.raises(ValueError):
+        plan_shards(100, 10, -1, len_path=80)
+
+
+@needs_native
+def test_walk_shard_bitwise_matches_full_range(small_dataset):
+    """Every shard's rows are byte-for-byte the full-range call's rows for
+    the same global walker indices — the determinism the spool re-walk
+    and the thread/depth invariance both rest on."""
+    from g2vec_tpu.ops.host_walker import (edges_to_csr, plan_shards,
+                                           walk_packed_rows, walk_shard)
+
+    rng = np.random.default_rng(0)
+    G = 37
+    src = rng.integers(0, G, 120).astype(np.int64)
+    dst = rng.integers(0, G, 120).astype(np.int64)
+    w = rng.random(120).astype(np.float32) + 0.1
+    reps = 3
+    full = walk_packed_rows(src, dst, w, G, len_path=9, reps=reps, seed=5)
+    plan = plan_shards(G, reps, 10, len_path=9)
+    csr = edges_to_csr(src, dst, w, G)
+    for s in range(plan.n_shards):
+        lo, hi = plan.start_range(s)
+        expect = np.concatenate(
+            [full[r * G + lo:r * G + hi] for r in range(reps)])
+        got = walk_shard(src, dst, w, G, plan, s, seed=5, csr=csr,
+                         n_threads=2)
+        np.testing.assert_array_equal(got, expect)
+
+
+# ---------------------------------------------------------------------------
+# Pipeline-level fixtures
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def stream_tsv(tmp_path_factory):
+    from g2vec_tpu.data.synthetic import SyntheticSpec, write_synthetic_tsv
+
+    spec = SyntheticSpec(
+        n_good=30, n_poor=26, module_size=16, shared_module_size=6,
+        n_background=24, n_expr_only=4, n_net_only=4, module_chords=3,
+        background_edges=40, noise=0.25, shift=1.4, seed=7)
+    return write_synthetic_tsv(
+        spec, str(tmp_path_factory.mktemp("stream_data")))
+
+
+def _cfg(paths, out, **over):
+    from g2vec_tpu.config import G2VecConfig
+
+    base = dict(
+        expression_file=paths["expression"], clinical_file=paths["clinical"],
+        network_file=paths["network"], result_name=out,
+        lenPath=20, numRepetition=4, sizeHiddenlayer=32, epoch=40,
+        numBiomarker=10, seed=11, compute_dtype="float32",
+        walker_backend="native", train_mode="streaming", shard_paths=64)
+    base.update(over)
+    return G2VecConfig(**base)
+
+
+def _run(cfg):
+    from g2vec_tpu.pipeline import run
+
+    return run(cfg, console=lambda s: None)
+
+
+def _read_outputs(res):
+    return [open(p, "rb").read() for p in res.output_files]
+
+
+# ---------------------------------------------------------------------------
+# 3. Determinism across threads and ring depth; 4. parity band
+# ---------------------------------------------------------------------------
+
+@needs_native
+def test_streaming_invariant_to_threads_and_depth(stream_tsv, tmp_path):
+    ref = _run(_cfg(stream_tsv, str(tmp_path / "a"),
+                    sampler_threads=1, prefetch_depth=1, epoch=8))
+    ref_bytes = _read_outputs(ref)
+    for tag, threads, depth in (("b", 3, 1), ("c", 1, 4), ("d", 2, 3)):
+        res = _run(_cfg(stream_tsv, str(tmp_path / tag),
+                        sampler_threads=threads, prefetch_depth=depth,
+                        epoch=8))
+        assert _read_outputs(res) == ref_bytes, (tag, threads, depth)
+
+        def strip(hist):
+            return [{k: v for k, v in h.items() if k != "secs"}
+                    for h in hist]
+
+        assert strip(res.train_history) == strip(ref.train_history), (tag,)
+
+
+@needs_native
+def test_streaming_parity_band_vs_full_batch(stream_tsv, tmp_path):
+    """The statistical contract: same config, streaming vs full-batch —
+    val-ACC within the pinned band, top-N biomarker overlap above the
+    pinned floor. (Both numbers measured with margin: at this seed the
+    modes land within ~0.12 ACC and >= 0.85 overlap.)"""
+    full = _run(_cfg(stream_tsv, str(tmp_path / "full"),
+                     train_mode="full"))
+    stream = _run(_cfg(stream_tsv, str(tmp_path / "stream"),
+                       stream_patience=8))
+    assert abs(stream.acc_val - full.acc_val) <= 0.20
+    a, b = set(full.biomarkers), set(stream.biomarkers)
+    assert len(a & b) / max(len(a), 1) >= 0.6
+    # The streamed per-shard filter approximates the global integrate:
+    # kept rows within ~15% of the full-batch path count at this scale.
+    assert abs(stream.n_paths - full.n_paths) / full.n_paths <= 0.3
+
+
+# ---------------------------------------------------------------------------
+# 4. Bounded memory + sampling/training overlap
+# ---------------------------------------------------------------------------
+
+@needs_native
+def test_streaming_memory_bounded_and_overlapped(tmp_path):
+    """At a scale where the full-batch path matrix would be many times
+    the ring bound, the in-flight path bytes stay O(shard x depth) and
+    backpressure caps how far sampling runs ahead of training."""
+    from g2vec_tpu.data.synth import SynthGraphSpec, write_synth_graph
+
+    spec = SynthGraphSpec(n_genes=1500, attach=2, n_good=10, n_poor=10,
+                          seed=3)
+    paths = write_synth_graph(spec, str(tmp_path / "big"))
+    depth = 2
+    cfg = _cfg(paths, str(tmp_path / "res"), lenPath=12, numRepetition=4,
+               shard_paths=128, prefetch_depth=depth, epoch=2,
+               stream_patience=2, sizeHiddenlayer=16)
+    res = _run(cfg)
+    st = res.stream_stats
+    nb = (res.n_genes + 7) // 8
+    shard_bytes = st["shard_rows"] * (nb + 4)       # x rows + int32 labels
+    total_path_bytes = st["rows_sampled"] * nb      # full-batch would hold
+    assert st["n_shards"] >= 40                     # genuinely many shards
+    assert st["ring_occupancy_hw"] <= depth
+    assert st["ring_peak_bytes"] <= depth * shard_bytes
+    # The bound is real: materializing every sampled row (what full-batch
+    # does before epoch 0) would need >10x the ring's peak.
+    assert total_path_bytes > 10 * st["ring_peak_bytes"]
+    # Overlap: backpressure means at most (device double-buffer + ring
+    # depth + 1) shards existed when the first update retired — training
+    # began while the other ~90% of sampling still ran.
+    assert st["shards_at_first_update"] <= depth + 4
+    assert st["shards_at_first_update"] < st["n_shards"] // 2
+    assert st["time_to_first_update_ms"] / 1e3 < st["sampling_wall_s"]
+
+
+# ---------------------------------------------------------------------------
+# 5. Fault seams: stall/crash terminate cleanly; corrupt -> re-walk
+# ---------------------------------------------------------------------------
+
+@needs_native
+def test_shard_ring_stall_fault_fails_clean(stream_tsv, tmp_path):
+    from g2vec_tpu.resilience.faults import InjectedFault, _reset_for_tests
+
+    _reset_for_tests()
+    cfg = _cfg(stream_tsv, str(tmp_path / "r"), epoch=6,
+               fault_plan="stage=shard_ring,kind=stall,seconds=0.05")
+    t0 = time.time()
+    with pytest.raises(InjectedFault):
+        _run(cfg)
+    assert time.time() - t0 < 60        # died promptly, no wedged ring
+    _reset_for_tests()
+
+
+@needs_native
+def test_prefetch_crash_fault_fails_clean(stream_tsv, tmp_path):
+    from g2vec_tpu.resilience.faults import InjectedFault, _reset_for_tests
+
+    _reset_for_tests()
+    cfg = _cfg(stream_tsv, str(tmp_path / "r"), epoch=6,
+               fault_plan="stage=prefetch,kind=crash,epoch=2")
+    with pytest.raises(InjectedFault):
+        _run(cfg)
+    _reset_for_tests()
+
+
+@needs_native
+def test_spool_corrupt_rewalks_and_matches_unfaulted(stream_tsv, tmp_path):
+    """kind=corrupt tears a spooled shard AFTER emission: epoch 0 trains
+    on the good in-memory copy, the epoch-1 replay catches the sha256
+    mismatch, re-walks the shard (deterministic => identical bytes), and
+    the run completes with outputs byte-identical to the unfaulted run."""
+    from g2vec_tpu.resilience.faults import _reset_for_tests
+
+    _reset_for_tests()
+    clean = _run(_cfg(stream_tsv, str(tmp_path / "clean"), epoch=6,
+                      shard_paths=32, stream_patience=6))
+    assert clean.stream_stats["rewalks"] == 0
+    _reset_for_tests()
+    with pytest.warns(RuntimeWarning, match="re-walking"):
+        faulted = _run(_cfg(
+            stream_tsv, str(tmp_path / "faulted"), epoch=6,
+            shard_paths=32, stream_patience=6,
+            fault_plan="stage=shard_ring,kind=corrupt,epoch=1"))
+    assert faulted.stream_stats["rewalks"] == 1
+    assert _read_outputs(faulted) == _read_outputs(clean)
+    _reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# Config plumbing + synth generator + engine integration
+# ---------------------------------------------------------------------------
+
+def test_streaming_config_validation(stream_tsv):
+    from g2vec_tpu.config import SERVE_JOB_KEYS, G2VecConfig
+
+    def cfg(**over):
+        c = _cfg(stream_tsv, "x", **over)
+        c.validate()
+        return c
+
+    cfg()                                            # baseline valid
+    with pytest.raises(ValueError, match="streaming"):
+        cfg(mesh_shape=(2, 1))
+    with pytest.raises(ValueError, match="streaming"):
+        cfg(checkpoint_dir="/tmp/ck")
+    with pytest.raises(ValueError, match="cannot stream"):
+        cfg(walker_backend="device")
+    with pytest.raises(ValueError, match="shard_paths"):
+        cfg(shard_paths=2)
+    with pytest.raises(ValueError, match="prefetch_depth"):
+        cfg(prefetch_depth=0)
+    with pytest.raises(ValueError, match="stream_patience"):
+        cfg(stream_patience=0)
+    with pytest.raises(ValueError, match="train_mode"):
+        G2VecConfig(train_mode="sideways").validate()
+    for key in ("train_mode", "shard_paths", "prefetch_depth",
+                "stream_patience"):
+        assert key in SERVE_JOB_KEYS                 # serve jobs may stream
+
+
+def test_synth_graph_deterministic_and_loadable(tmp_path):
+    from g2vec_tpu.data.synth import (SynthGraphSpec, make_scale_free_edges,
+                                      make_synth_graph, write_synth_graph)
+    from g2vec_tpu.io.readers import (load_clinical, load_expression,
+                                      load_network)
+
+    spec = SynthGraphSpec(n_genes=200, n_good=6, n_poor=6, seed=9)
+    g1 = make_synth_graph(spec)
+    g2 = make_synth_graph(spec)
+    np.testing.assert_array_equal(g1[3], g2[3])      # expr deterministic
+    np.testing.assert_array_equal(g1[4][0], g2[4][0])
+    src, dst = make_scale_free_edges(200, 3, np.random.default_rng(0))
+    assert src.min() >= 0 and dst.max() < 200
+    deg = np.bincount(np.concatenate([src, dst]), minlength=200)
+    assert deg.min() >= 1                            # one component seeded
+    assert deg.max() >= 5 * max(np.median(deg), 1)   # heavy-tailed hubs
+
+    paths = write_synth_graph(spec, str(tmp_path), prefix="t")
+    data = load_expression(paths["expression"], use_native=False)
+    clin = load_clinical(paths["clinical"])
+    net = load_network(paths["network"])
+    assert data.expr.shape == (12, 200)
+    assert len(clin) == 12
+    assert len(net.edges) == int(paths["n_edges"])
+
+
+def test_make_synth_graph_cli_smoke(tmp_path):
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "make_synth_graph.py"),
+         "--genes", "60", "--good", "4", "--poor", "4",
+         "--out", str(tmp_path), "--prefix", "cli"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-400:]
+    assert os.path.exists(tmp_path / "cli_EXPRESSION.txt")
+    proc2 = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "make_synth_graph.py"),
+         "--genes", "10", "--attach", "20", "--out", str(tmp_path)],
+        capture_output=True, text=True, timeout=60)
+    assert proc2.returncode == 2                     # loud validation
+
+
+@needs_native
+def test_engine_streaming_lanes_and_status(stream_tsv, tmp_path):
+    """Streaming jobs are first-class under the batch engine (and so
+    under serve): lanes run the solo streaming pipeline, metrics carry
+    per-lane stream events, and the engine status surfaces the stream
+    totals the daemon's /status republishes."""
+    import json
+
+    from g2vec_tpu.batch.engine import ResidentEngine, plan_variants
+
+    mj = str(tmp_path / "m.jsonl")
+    cfg = _cfg(stream_tsv, str(tmp_path / "m"), epoch=6, batch_seeds=2,
+               shard_paths=32, metrics_jsonl=mj)
+    with ResidentEngine() as engine:
+        br = engine.execute(cfg, plan_variants(cfg),
+                            console=lambda s: None)
+        status = engine.status()
+    assert len(br.lanes) == 2
+    assert all(b["mode"] == "stream-solo" for b in br.buckets)
+    for r in br.lanes:
+        for p in r.output_files:
+            assert os.path.exists(p)
+    events = [json.loads(l) for l in open(mj)]
+    stream_events = [e for e in events if e["event"] == "stream"]
+    assert len(stream_events) == 2
+    assert all("lane" in e and e["shards_emitted"] > 0
+               for e in stream_events)
+    assert status["stream"]["runs"] >= 2             # /status currency
+    assert status["stream"]["shards_emitted"] > 0
