@@ -162,6 +162,17 @@ STREAM_AB_WALK_REPS = tuple(int(x) for x in os.environ.get(
     "G2VEC_BENCH_STREAM_WALK_REPS", "4,12").split(","))
 STREAM_AB_ARTIFACT = "BENCH_STREAM_AB.json"
 
+# Chaos soak (tools/chaos_soak.py): a seeded fault storm against the
+# serve daemon — SIGKILLs, SIGTERM drains, armed fault plans at the
+# durable seams, client cancels and tight deadlines — whose acceptance
+# is exactly-once accounting: every acknowledged job reaches exactly one
+# well-defined terminal state, zero lost/duplicated, sampled completed
+# outputs byte-identical to solo uninterrupted runs. Env-shrinkable.
+CHAOS_JOBS = int(os.environ.get("G2VEC_BENCH_CHAOS_JOBS", "50"))
+CHAOS_SEED = int(os.environ.get("G2VEC_BENCH_CHAOS_SEED", "0"))
+CHAOS_BUDGET = float(os.environ.get("G2VEC_BENCH_CHAOS_BUDGET", "900"))
+CHAOS_ARTIFACT = "BENCH_CHAOS_SOAK.json"
+
 # Peak bf16 matmul throughput per chip, for the MFU estimate.
 _PEAK_FLOPS = {"v4": 275e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12}
 # HBM bandwidth per chip (bytes/s): the roofline's other axis. This
@@ -1337,6 +1348,78 @@ def _serve_ab() -> None:
         note(f"wrote {SERVE_AB_ARTIFACT}")
 
 
+def _chaos_soak_line(note) -> dict:
+    """Run tools/chaos_soak.py as a subprocess (no jax in THIS process)
+    and distill its summary into one metric line. The soak's own exit
+    code IS the acceptance: 0 iff every acknowledged job landed in
+    exactly one terminal state with zero lost/duplicated and sampled
+    byte parity intact."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "G2V_CHAOS_JOBS": str(CHAOS_JOBS),
+           "G2V_CHAOS_SEED": str(CHAOS_SEED),
+           "G2V_CHAOS_BUDGET": str(CHAOS_BUDGET)}
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "chaos_soak.py")],
+        capture_output=True, text=True, env=env,
+        timeout=CHAOS_BUDGET + 120)
+    for ln in (proc.stderr or "").splitlines():
+        if ln.startswith("# "):
+            note(f"chaos {ln[2:]}")
+    try:
+        summary = json.loads(proc.stdout)
+    except ValueError:
+        raise RuntimeError(
+            f"chaos soak emitted no summary (rc={proc.returncode}): "
+            f"{(proc.stderr or proc.stdout)[-400:]}")
+    accepted = summary.get("accepted", 0) or 1
+    accounted = accepted - len(summary.get("lost", ()))
+    return {
+        "metric": "chaos_soak_accounted_fraction",
+        "value": round(accounted / accepted, 4), "unit": "fraction",
+        "ok": bool(summary.get("ok")) and proc.returncode == 0,
+        "jobs": summary.get("jobs"), "accepted": accepted,
+        "terminal_by_status": summary.get("terminal_by_status"),
+        "lost": len(summary.get("lost", ())),
+        "duplicated": len(summary.get("duplicated", ())),
+        "kills": summary.get("kills"), "drains": summary.get("drains"),
+        "drain_exit_codes": summary.get("drain_exit_codes"),
+        "fault_injections": summary.get("fault_injections"),
+        "cancels_sent": summary.get("cancels_sent"),
+        "recover_p50_s": summary.get("recover_p50_s"),
+        "recover_p99_s": summary.get("recover_p99_s"),
+        "byte_checked": summary.get("byte_checked"),
+        "byte_identical": summary.get("byte_identical"),
+        "seed": summary.get("seed"),
+        "wall_s": round(time.time() - t0, 1),
+        "note": "seeded fault storm vs serve daemon (SIGKILL / SIGTERM "
+                "drain / armed fault plans at stream_ckpt, train, drain "
+                "seams / cancels / deadlines); acceptance = exactly-once "
+                "terminal accounting + sampled byte parity vs solo "
+                "uninterrupted twins",
+    }
+
+
+def _chaos_soak() -> None:
+    """Standalone mode: run the chaos soak and (with
+    G2VEC_BENCH_CHAOS_WRITE=1) refresh the committed artifact."""
+    def note(msg):
+        print(f"# {msg}", file=sys.stderr, flush=True)
+
+    line = _chaos_soak_line(note)
+    print(json.dumps(line), flush=True)
+    if os.environ.get("G2VEC_BENCH_CHAOS_WRITE") == "1":
+        repo = os.path.dirname(os.path.abspath(__file__))
+        with open(os.path.join(repo, CHAOS_ARTIFACT), "w") as f:
+            json.dump({"line": line, "code_key": _current_code_key(repo),
+                       "written_by": "bench.py --_chaos_soak"}, f,
+                      indent=1)
+        note(f"wrote {CHAOS_ARTIFACT}")
+    if not line["ok"]:
+        sys.exit(1)
+
+
 def _run_measure_child(budget: int, child_env: dict,
                        first_metric_cutoff: int,
                        cmd: "list | None" = None) -> tuple:
@@ -2271,5 +2354,7 @@ if __name__ == "__main__":
         _serve_ab()
     elif "--_stream_ab" in sys.argv:
         _stream_ab()
+    elif "--_chaos_soak" in sys.argv:
+        _chaos_soak()
     else:
         main()

@@ -294,15 +294,24 @@ class ResidentEngine:
                 variants: Optional[List[LaneVariant]] = None, *,
                 console: Callable[[str], None] = print,
                 metrics=None,
-                lane_jobs: Optional[List[str]] = None) -> BatchResult:
+                lane_jobs: Optional[List[str]] = None,
+                check: Optional[Callable[[], None]] = None,
+                lifecycle=None) -> BatchResult:
         """Run ``variants`` (default: plan from ``cfg``) as one batch on
         this engine's warm state. ``metrics`` may be a caller-owned
         MetricsWriter/BoundMetrics view (the daemon's lifetime stream);
         None builds one from ``cfg.metrics_jsonl`` for this call.
         ``lane_jobs`` stamps lane i's events with ``job_id`` so joined
-        jobs stay attributable (utils/metrics.py ``bind_job``)."""
+        jobs stay attributable (utils/metrics.py ``bind_job``).
+
+        ``check`` is the cooperative-interruption hook threaded into the
+        trainers (resilience/lifecycle.py); ``lifecycle(job_id, state,
+        info)`` observes per-job durable transitions ("checkpointed",
+        "resumed") — job_id comes from ``lane_jobs`` (lane tag when
+        absent)."""
         return _execute_lanes(self, cfg, variants, console=console,
-                              metrics=metrics, lane_jobs=lane_jobs)
+                              metrics=metrics, lane_jobs=lane_jobs,
+                              check=check, lifecycle=lifecycle)
 
     def status(self) -> Dict:
         """The warm-state inventory (the serve /status currency)."""
@@ -376,8 +385,9 @@ class ResidentEngine:
 def _execute_streaming(engine: ResidentEngine, cfg: G2VecConfig,
                        variants: Optional[List[LaneVariant]], *,
                        console: Callable[[str], None],
-                       metrics, lane_jobs: Optional[List[str]]
-                       ) -> BatchResult:
+                       metrics, lane_jobs: Optional[List[str]],
+                       check: Optional[Callable[[], None]] = None,
+                       lifecycle=None) -> BatchResult:
     """Streaming-mode lanes: each variant runs the SOLO streaming
     pipeline, sequentially.
 
@@ -405,6 +415,9 @@ def _execute_streaming(engine: ResidentEngine, cfg: G2VecConfig,
     if metrics is None:
         own_metrics = metrics = MetricsWriter(cfg.metrics_jsonl)
     t_start = time.time()
+    parent = os.path.dirname(cfg.result_name)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
     console(f">>> [batch] streaming mode: {n_lanes} lane(s), each the solo "
             f"streaming pipeline (no lane batching — the path matrix "
             f"never materializes)")
@@ -414,7 +427,23 @@ def _execute_streaming(engine: ResidentEngine, cfg: G2VecConfig,
             lm = (metrics.bind_job(lane_jobs[i]).bind_lane(v.tag())
                   if lane_jobs is not None else metrics.bind_lane(v.tag()))
             lm.emit("lane_variant", **dataclasses.asdict(v))
-            res = run_pipeline(lane_config(cfg, v), console=console)
+            lane_cfg = lane_config(cfg, v)
+            if cfg.checkpoint_dir:
+                # Per-lane cursor directory: the variant name is stable
+                # across restarts (the daemon names lanes
+                # "<job_id>.<variant>"), so a relaunched job resumes its
+                # own cursor and never reads a sibling's.
+                lane_cfg = dataclasses.replace(
+                    lane_cfg,
+                    checkpoint_dir=os.path.join(cfg.checkpoint_dir, v.name),
+                    resume=cfg.resume)
+            jid = lane_jobs[i] if lane_jobs is not None else v.tag()
+            lane_lifecycle = (
+                (lambda state, info, _jid=jid:
+                 lifecycle(_jid, state, info))
+                if lifecycle is not None else None)
+            res = run_pipeline(lane_cfg, console=console, check=check,
+                               lifecycle=lane_lifecycle)
             lm.emit("stream", **res.stream_stats)
             lm.emit("done", outputs=res.output_files, acc_val=res.acc_val,
                     n_paths=res.n_paths)
@@ -440,10 +469,13 @@ def _execute_streaming(engine: ResidentEngine, cfg: G2VecConfig,
 def _execute_lanes(engine: ResidentEngine, cfg: G2VecConfig,
                    variants: Optional[List[LaneVariant]], *,
                    console: Callable[[str], None],
-                   metrics, lane_jobs: Optional[List[str]]) -> BatchResult:
+                   metrics, lane_jobs: Optional[List[str]],
+                   check: Optional[Callable[[], None]] = None,
+                   lifecycle=None) -> BatchResult:
     if cfg.train_mode == "streaming":
         return _execute_streaming(engine, cfg, variants, console=console,
-                                  metrics=metrics, lane_jobs=lane_jobs)
+                                  metrics=metrics, lane_jobs=lane_jobs,
+                                  check=check, lifecycle=lifecycle)
     import jax
 
     from g2vec_tpu.analysis import (biomarker_scores_lanes, freq_index,
@@ -709,7 +741,8 @@ def _execute_lanes(engine: ResidentEngine, cfg: G2VecConfig,
                         fused_eval=cfg.fused_eval,
                         epoch_superstep=cfg.epoch_superstep,
                         donate=cfg.donate_state,
-                        pre_compile_hook=join_warm)
+                        pre_compile_hook=join_warm,
+                        check=check)
                     lane_results[li] = res
                     if res.params is not None:
                         lane_emb[li] = res.params.w_ih.astype(
@@ -738,7 +771,8 @@ def _execute_lanes(engine: ResidentEngine, cfg: G2VecConfig,
                         fused_eval=cfg.fused_eval,
                         epoch_superstep=cfg.epoch_superstep,
                         donate=cfg.donate_state,
-                        pre_compile_hook=join_warm)
+                        pre_compile_hook=join_warm,
+                        check=check)
                     for b, li in enumerate(lis):
                         lane_results[li] = results[b]
                         lane_emb[li] = emb_stack[b]

@@ -285,15 +285,21 @@ class G2VecConfig:
                     "--walker-backend device cannot stream")
             for flag, name in ((self.distributed, "--distributed"),
                                (self.fleet_size, "--fleet-size"),
-                               (self.mesh_shape, "--mesh"),
-                               (self.checkpoint_dir, "--checkpoint-dir"),
-                               (self.resume, "--resume")):
+                               (self.mesh_shape, "--mesh")):
                 if flag:
                     raise ValueError(
                         f"--train-mode streaming does not compose with "
                         f"{name} yet — the streaming trainer is a "
                         f"single-device minibatch loop (ROADMAP item 2 "
                         f"shards it)")
+            if self.resume and not self.checkpoint_dir:
+                raise ValueError(
+                    "--resume with --train-mode streaming needs "
+                    "--checkpoint-dir: the streaming cursor lives there")
+            if self.checkpoint_dir and self.checkpoint_layout != "single":
+                raise ValueError(
+                    "--train-mode streaming checkpoints use the single-file "
+                    "layout only (--checkpoint-layout single)")
         if self.sampler_threads < 0:
             raise ValueError(
                 f"sampler_threads must be >= 0 (0 = all cores), "
@@ -391,7 +397,11 @@ SERVE_JOB_KEYS = (
     # its shard/ring geometry; the daemon still owns the device. Jobs with
     # different train_mode never _join_key-match, so a streaming job
     # cannot be folded into a full-batch bucket (serve/daemon.py).
-    "train_mode", "shard_paths", "prefetch_depth", "stream_patience")
+    "train_mode", "shard_paths", "prefetch_depth", "stream_patience",
+    # Streaming checkpoint cadence (shards between cursor writes). The
+    # daemon owns WHERE checkpoints go (its state dir); a job may only
+    # tune how often its own cursor is cut.
+    "checkpoint_every")
 
 _SERVE_JOB_REQUIRED = ("expression_file", "clinical_file", "network_file",
                        "result_name")

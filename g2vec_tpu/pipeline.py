@@ -105,8 +105,18 @@ class _EpochReporter:
                      % (stop_epoch, acc_val, acc_tr, self.block_secs))
 
 
-def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineResult:
-    """Execute the full pipeline; returns all artifacts plus run stats."""
+def run(cfg: G2VecConfig, console: Callable[[str], None] = print,
+        check: Optional[Callable[[], None]] = None,
+        lifecycle: Optional[Callable[[str, dict], None]] = None,
+        ) -> PipelineResult:
+    """Execute the full pipeline; returns all artifacts plus run stats.
+
+    ``check`` is the cooperative-interruption hook threaded into the
+    trainers' epoch/shard loops (resilience/lifecycle.py — the serve
+    daemon raises cancel/deadline/drain through it); ``lifecycle(state,
+    info)`` observes the durable-job transitions ("checkpointed",
+    "resumed") the streaming trainer emits.
+    """
     # Deferred imports: jax must not be pulled in before the CLI has had the
     # chance to set platform env vars (see __main__.py).
     import jax
@@ -371,7 +381,11 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
                     prefetch_depth=cfg.prefetch_depth,
                     patience=cfg.stream_patience,
                     sampler_threads=cfg.sampler_threads,
-                    overlap=overlap, on_epoch=on_epoch, console=console)
+                    overlap=overlap,
+                    checkpoint_dir=cfg.checkpoint_dir, resume=cfg.resume,
+                    checkpoint_every=cfg.checkpoint_every,
+                    check=check, lifecycle=lifecycle,
+                    on_epoch=on_epoch, console=console)
             _stage_edge("train")
             result = sres.train
             gene_freq = sres.gene_freq
@@ -579,6 +593,7 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print) -> PipelineRes
                     donate=cfg.donate_state,
                     kernel_autotune=cfg.kernel_autotune,
                     autotune_cache_path=autotune_path,
+                    check=check,
                     # Joins the background chunk-program warm right before the
                     # trainer requests the executable (after the host-side
                     # packing it overlapped); None = compile in line.

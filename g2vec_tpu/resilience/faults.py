@@ -94,7 +94,15 @@ SEAMS = ("load", "preprocess", "paths", "train", "lgroups", "biomarkers",
          # consumer's next get, consumer death cancels the ring so a
          # blocked producer unblocks — makes every kind here terminate
          # instead of deadlocking the edge.
-         "shard_ring", "prefetch")
+         "shard_ring", "prefetch",
+         # Durable-job seams (train/stream.py + serve/daemon.py):
+         # ``stream_ckpt`` fires right after a streaming cursor checkpoint
+         # finalizes (epoch = training epoch; a sigkill here is the
+         # worst-case mid-epoch death the resume drill pins);
+         # ``drain`` fires as the daemon begins a graceful drain, before
+         # it checkpoints in-flight jobs (a crash there models a drain
+         # that never completed — the journal must still re-queue).
+         "stream_ckpt", "drain")
 
 
 class FaultPlanError(ValueError):

@@ -1,0 +1,50 @@
+"""Cooperative job interruption — the exception vocabulary of the
+durable-job layer (serve/daemon.py + batch/engine.py + both trainers).
+
+The daemon threads a zero-argument ``check`` callable through
+``ResidentEngine.execute`` into the trainers' epoch/shard loops. The
+trainers call it at every consistent boundary (full-batch: top of each
+chunk; streaming: every shard step, where the cursor checkpoint is also
+cut). When the daemon wants a job stopped — client cancel, deadline
+passed, SIGTERM drain — ``check`` raises one of these, the trainer
+unwinds (the streaming trainer checkpoints its cursor first on a drain),
+and the daemon maps the exception back to a per-job terminal or
+re-queued state. Cooperative beats preemptive here: the boundaries are
+exactly where device state is host-consistent, so an interrupted job is
+always either resumable or cleanly terminal, never torn.
+"""
+from __future__ import annotations
+
+
+class JobInterrupted(RuntimeError):
+    """Base of all cooperative interruptions. ``job_id`` is the serve job
+    the interruption targets (None for whole-process reasons like drain —
+    every job in the batch is affected)."""
+
+    reason = "interrupted"
+
+    def __init__(self, job_id=None, detail: str = ""):
+        self.job_id = job_id
+        msg = f"job {job_id}: {self.reason}" if job_id else self.reason
+        super().__init__(f"{msg} ({detail})" if detail else msg)
+
+
+class JobCancelled(JobInterrupted):
+    """A client asked for this specific job to stop. Terminal."""
+
+    reason = "cancelled"
+
+
+class JobDeadlineExceeded(JobInterrupted):
+    """The job's ``deadline_s`` elapsed before it finished. Terminal."""
+
+    reason = "deadline_exceeded"
+
+
+class DrainRequested(JobInterrupted):
+    """The daemon is draining (SIGTERM). NOT terminal for the job: the
+    streaming trainer checkpoints its cursor before re-raising, the
+    daemon leaves the job journaled, and the next daemon run resumes
+    it from the checkpoint."""
+
+    reason = "drain"

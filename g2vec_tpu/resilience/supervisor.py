@@ -66,6 +66,13 @@ _FATAL_NAME = re.compile(
 
 def classify_exception(exc: BaseException) -> str:
     """Return ``"retryable"`` or ``"fatal"`` for an in-process failure."""
+    from g2vec_tpu.resilience.lifecycle import JobInterrupted
+
+    if isinstance(exc, JobInterrupted):
+        # A cooperative interruption is an ANSWER, not a failure — it must
+        # never enter a retry loop (the daemon handles it before
+        # classification; this guard is for any other supervisor).
+        return "fatal"
     if isinstance(exc, _RETRYABLE_TYPES):
         return "retryable"
     if isinstance(exc, InjectedFatal):

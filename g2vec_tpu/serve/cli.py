@@ -8,13 +8,18 @@ Daemon::
 
 Client (same flag, a client op instead of --state-dir)::
 
-    g2vec serve --socket /tmp/g2vec.sock --submit job.json [--tenant me]
+    g2vec serve --socket /tmp/g2vec.sock --submit job.json [--tenant me] \\
+        [--priority interactive|batch] [--deadline-s SECS]
     g2vec serve --socket /tmp/g2vec.sock --status | --ping | --shutdown
+    g2vec serve --socket /tmp/g2vec.sock --cancel JOB_ID | --drain
 
 ``--submit`` streams the job's JSONL events to stdout and exits 0 on
-``job_done``, 4 on ``rejected``, 5 on ``job_failed``, 6 when the daemon
-connection is lost mid-job (the job is journaled — poll
-``<state-dir>/results/<job_id>.json`` or resubmit --status later).
+``job_done``, 4 on ``rejected``, 5 on ``job_failed`` (or any other
+terminal state), 6 when the daemon connection is lost mid-job (the job
+is journaled — poll ``<state-dir>/results/<job_id>.json`` or resubmit
+--status later). ``--drain`` asks the daemon to stop admitting,
+checkpoint in-flight streaming jobs, and exit 0 with everything
+unfinished journaled for the next start.
 """
 from __future__ import annotations
 
@@ -76,6 +81,16 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "events to stdout ('-' reads stdin).")
     p.add_argument("--tenant", type=str, default="default",
                    help="Tenant name for --submit (fair-share unit).")
+    p.add_argument("--priority", type=str, default=None,
+                   choices=("interactive", "batch"),
+                   help="Priority class for --submit: interactive pops "
+                        "before batch; aging keeps batch from starving "
+                        "(default batch).")
+    p.add_argument("--deadline-s", type=float, default=None, metavar="S",
+                   help="Wall-clock budget for --submit, measured from "
+                        "submission (survives daemon restarts); an "
+                        "overdue job terminates deadline_exceeded at the "
+                        "next shard/chunk boundary.")
     p.add_argument("--status", action="store_true",
                    help="Client mode: print the daemon status JSON.")
     p.add_argument("--ping", action="store_true",
@@ -83,6 +98,13 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--shutdown", action="store_true",
                    help="Client mode: stop the daemon after its current "
                         "batch; queued jobs stay journaled.")
+    p.add_argument("--cancel", type=str, default=None, metavar="JOB_ID",
+                   help="Client mode: cancel a queued (immediate) or "
+                        "running (next boundary) job.")
+    p.add_argument("--drain", action="store_true",
+                   help="Client mode: graceful drain — stop admitting, "
+                        "checkpoint in-flight streaming jobs, journal "
+                        "everything unfinished, exit 0.")
     return p
 
 
@@ -90,7 +112,8 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
     args = build_serve_parser().parse_args(argv)
     from g2vec_tpu.serve import client
 
-    if args.status or args.ping or args.shutdown or args.submit:
+    if args.status or args.ping or args.shutdown or args.submit \
+            or args.cancel or args.drain:
         try:
             if args.status:
                 print(json.dumps(client.status(args.socket), indent=1))
@@ -101,12 +124,21 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
             if args.shutdown:
                 print(json.dumps(client.shutdown(args.socket)))
                 return 0
+            if args.cancel:
+                ev = client.cancel(args.socket, args.cancel)
+                print(json.dumps(ev))
+                return 0 if ev.get("event") != "error" else 4
+            if args.drain:
+                print(json.dumps(client.drain(args.socket)))
+                return 0
             src = sys.stdin if args.submit == "-" else open(args.submit)
             with src:
                 job = json.load(src)
             try:
                 events = client.submit_job(args.socket, job,
-                                           tenant=args.tenant)
+                                           tenant=args.tenant,
+                                           priority=args.priority,
+                                           deadline_s=args.deadline_s)
             except client.ServeConnectionLost as e:
                 print(json.dumps({"event": "connection_lost",
                                   "job_id": e.job_id, "error": str(e)}))

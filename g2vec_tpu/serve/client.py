@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import socket
 import time
 from typing import Iterator, List, Optional
@@ -20,6 +21,15 @@ from g2vec_tpu.serve import protocol
 
 class ServeConnectionLost(RuntimeError):
     """The daemon's stream closed before the job's terminal event."""
+
+    def __init__(self, msg: str, job_id: Optional[str] = None):
+        super().__init__(msg)
+        self.job_id = job_id
+
+
+class ServeTimeout(TimeoutError):
+    """A client-side wait expired. Always names the job it was waiting
+    for — a bare ``socket.timeout`` tells an operator nothing."""
 
     def __init__(self, msg: str, job_id: Optional[str] = None):
         super().__init__(msg)
@@ -45,27 +55,42 @@ def request(socket_path: str, payload: dict,
         s.close()
 
 
-_TERMINAL = ("job_done", "job_failed")
+#: Terminal stream events (``job_drained`` is terminal for THIS stream —
+#: the job itself pauses, stays journaled, and resumes after restart).
+_TERMINAL = ("job_done", "job_failed", "job_cancelled",
+             "job_deadline_exceeded", "job_drained")
 
 
 def submit_job(socket_path: str, job: dict, tenant: str = "default",
-               timeout: Optional[float] = None) -> List[dict]:
+               timeout: Optional[float] = None,
+               priority: Optional[str] = None,
+               deadline_s: Optional[float] = None) -> List[dict]:
     """Submit ``job`` and stream its events to completion. Returns every
-    event received ([..., job_done|job_failed] on success/failure, or
+    event received ([..., terminal event] on success/failure, or
     [rejected] on admission refusal). Raises :class:`ServeConnectionLost`
     if the stream dies first (daemon killed mid-job — poll_result picks
-    the job back up after the supervisor relaunch)."""
+    the job back up after the supervisor relaunch) and
+    :class:`ServeTimeout` when a socket read outlives ``timeout``."""
     events: List[dict] = []
     job_id = None
-    for ev in request(socket_path,
-                      {"op": "submit", "tenant": tenant, "job": job},
-                      timeout=timeout):
-        events.append(ev)
-        kind = ev.get("event")
-        if kind == "accepted":
-            job_id = ev.get("job_id")
-        if kind == "rejected" or kind in _TERMINAL:
-            return events
+    payload = {"op": "submit", "tenant": tenant, "job": job}
+    if priority is not None:
+        payload["priority"] = priority
+    if deadline_s is not None:
+        payload["deadline_s"] = deadline_s
+    try:
+        for ev in request(socket_path, payload, timeout=timeout):
+            events.append(ev)
+            kind = ev.get("event")
+            if kind == "accepted":
+                job_id = ev.get("job_id")
+            if kind == "rejected" or kind in _TERMINAL:
+                return events
+    except socket.timeout:
+        raise ServeTimeout(
+            f"no event from the daemon within {timeout}s while waiting "
+            f"on job {job_id or '<unacknowledged>'}",
+            job_id=job_id) from None
     raise ServeConnectionLost(
         f"daemon stream closed before job "
         f"{job_id or '<unacknowledged>'} finished", job_id=job_id)
@@ -87,6 +112,77 @@ def ping(socket_path: str, timeout: Optional[float] = 5.0) -> dict:
 
 def shutdown(socket_path: str, timeout: Optional[float] = 10.0) -> dict:
     return _one(socket_path, "shutdown", timeout)
+
+
+def cancel(socket_path: str, job_id: str,
+           timeout: Optional[float] = 10.0) -> dict:
+    """Cancel a queued (immediate) or running (cooperative, next
+    shard/chunk boundary) job."""
+    for ev in request(socket_path, {"op": "cancel", "job_id": job_id},
+                      timeout=timeout):
+        return ev
+    raise ServeConnectionLost("no response to 'cancel'", job_id=job_id)
+
+
+def drain(socket_path: str, timeout: Optional[float] = 10.0) -> dict:
+    """Ask the daemon to drain gracefully: admission closes, in-flight
+    streaming jobs checkpoint, everything unfinished stays journaled."""
+    return _one(socket_path, "drain", timeout)
+
+
+def submit_and_wait(socket_path: str, job: dict, tenant: str = "default",
+                    state_dir: Optional[str] = None,
+                    timeout: Optional[float] = None,
+                    poll_deadline_s: float = 300.0,
+                    priority: Optional[str] = None,
+                    deadline_s: Optional[float] = None,
+                    retries: int = 3, backoff: float = 0.25,
+                    jitter: float = 0.25,
+                    rng: Optional[random.Random] = None) -> dict:
+    """Submit a job and return its terminal record, surviving daemon
+    restarts.
+
+    Transport-level failures retry with exponential backoff plus jitter
+    (``backoff * 2**attempt + U[0, jitter)`` seconds — the jitter keeps a
+    fleet of clients from re-dialing a relaunching daemon in lockstep).
+    Two distinct recovery paths:
+
+    - connect refused / reset BEFORE acceptance → resubmit (nothing was
+      journaled, so nothing is duplicated);
+    - stream lost AFTER acceptance (:class:`ServeConnectionLost` with a
+      job_id) → the job is journaled; fall through to :func:`poll_result`
+      for the record the relaunched daemon writes. Never resubmit here —
+      that WOULD duplicate the job.
+
+    Raises :class:`ServeTimeout` naming the job when all retries or the
+    result poll expire."""
+    rng = rng if rng is not None else random.Random()
+    last: Optional[BaseException] = None
+    for attempt in range(retries + 1):
+        try:
+            events = submit_job(socket_path, job, tenant=tenant,
+                                timeout=timeout, priority=priority,
+                                deadline_s=deadline_s)
+            return events[-1]
+        except ServeConnectionLost as e:
+            if e.job_id is not None:
+                if state_dir is None:
+                    raise ServeTimeout(
+                        f"stream to job {e.job_id} lost and no state_dir "
+                        f"to poll its durable record from",
+                        job_id=e.job_id) from e
+                return poll_result(state_dir, e.job_id,
+                                   deadline_s=poll_deadline_s)
+            last = e          # unacknowledged — safe to resubmit
+        except ServeTimeout:
+            raise
+        except (ConnectionError, FileNotFoundError, OSError) as e:
+            last = e
+        if attempt < retries:
+            time.sleep(backoff * (2 ** attempt) + rng.uniform(0.0, jitter))
+    raise ServeTimeout(
+        f"submit failed after {retries + 1} attempt(s): "
+        f"{type(last).__name__}: {last}") from last
 
 
 def wait_ready(socket_path: str, deadline_s: float = 60.0,
@@ -119,5 +215,5 @@ def poll_result(state_dir: str, job_id: str, deadline_s: float = 300.0,
             except (OSError, ValueError):
                 pass        # mid-write; atomic rename makes this brief
         time.sleep(interval)
-    raise TimeoutError(f"no result record for job {job_id} within "
-                       f"{deadline_s:.0f}s ({path})")
+    raise ServeTimeout(f"no result record for job {job_id} within "
+                       f"{deadline_s:.0f}s ({path})", job_id=job_id)

@@ -32,7 +32,18 @@ This daemon is that shape for g2vec:
   relaunch (the ``--supervise`` watchdog, resilience/supervisor.py
   ``supervise_serve``) re-queues every journaled job; the persistent
   ``--cache-dir`` tiers restore the compile and walk caches, so the
-  re-run is warm-start, not cold.
+  re-run is warm-start, not cold. Streaming jobs additionally resume
+  from their (epoch, shard) cursor under ``<state-dir>/ckpt/`` — a
+  relaunch re-enters training mid-epoch instead of re-running it.
+- **Job lifecycle** (PR 9): per-job ``priority`` (interactive/batch with
+  aging so batch never starves), ``deadline_s`` (measured from original
+  submission, survives restarts), client ``cancel`` (cooperative — the
+  trainers' check hook raises at the next shard/chunk boundary), and
+  graceful drain (SIGTERM or the ``drain`` op: admission closes,
+  in-flight streaming jobs checkpoint, everything unfinished stays
+  journaled, the process exits 0). Every job walks a monotone
+  ``queued → started → (checkpointed|resumed)* → terminal`` state
+  machine, emitted as ``job_state`` metrics and counted on /status.
 
 Outputs are BYTE-IDENTICAL to the same config run solo (float32, same
 backend): jobs execute through the engine's lane machinery, whose parity
@@ -42,6 +53,7 @@ spool files to each job's requested ``result_name``.
 from __future__ import annotations
 
 import dataclasses
+import glob
 import os
 import queue
 import shutil
@@ -49,18 +61,25 @@ import socket
 import threading
 import time
 import uuid
-from collections import OrderedDict, deque
+from collections import Counter, OrderedDict, deque
 from typing import Callable, Dict, List, Optional, Tuple
 
 from g2vec_tpu.batch.engine import (LaneVariant, ManifestError,
                                     ResidentEngine, _variant_from_dict,
                                     seed_sweep_variants)
 from g2vec_tpu.config import G2VecConfig, config_from_job
+from g2vec_tpu.resilience.lifecycle import (DrainRequested, JobCancelled,
+                                            JobDeadlineExceeded,
+                                            JobInterrupted)
 from g2vec_tpu.serve import protocol
 from g2vec_tpu.utils.integrity import write_json_atomic
 from g2vec_tpu.utils.metrics import MetricsWriter
 
 _TENANT_MAX = 64
+#: Job priority classes: ``interactive`` pops before ``batch``; aging
+#: (ServeOptions.aging_s) promotes a long-waiting batch job so a steady
+#: interactive stream can never starve it.
+PRIORITIES = ("interactive", "batch")
 #: Lanes one job may submit; a bigger sweep should be several jobs (the
 #: scheduler joins them anyway) so admission stays per-tenant fair.
 MAX_JOB_LANES = 64
@@ -95,6 +114,7 @@ class ServeOptions:
     queue_depth: int = 16        # max jobs queued (not yet executing)
     max_join: int = 4            # max jobs merged into one engine batch
     job_retries: int = 1         # in-process retries for retryable failures
+    aging_s: float = 30.0        # batch job older than this outranks interactive
     cache_dir: Optional[str] = None
     metrics_jsonl: Optional[str] = None
     fault_plan: Optional[str] = None
@@ -113,22 +133,42 @@ class ServeJob:
     join_key: Tuple = ()
     attempts: int = 0
     subscriber: Optional["queue.Queue"] = None
+    priority: str = "batch"
+    #: Wall-clock budget measured from ``submitted_at`` (the ORIGINAL
+    #: submission, surviving journal recovery — a deadline is a promise
+    #: to the client, not to whichever daemon incarnation runs the job).
+    deadline_s: Optional[float] = None
+    queued_at: float = 0.0       # set at each (re)queue; drives aging
+    cancel_ev: threading.Event = dataclasses.field(
+        default_factory=threading.Event)
+
+    def deadline_expired(self, now: Optional[float] = None) -> bool:
+        if self.deadline_s is None:
+            return False
+        return (time.time() if now is None else now) \
+            > self.submitted_at + self.deadline_s
 
 
 class _FairQueue:
-    """Bounded multi-tenant FIFO with round-robin pop.
+    """Bounded multi-tenant, two-priority FIFO with round-robin pop.
 
-    Per-tenant deques; ``pop`` serves the first tenant with work and
-    rotates it to the back, so a tenant submitting N jobs waits behind
-    every other tenant once per own job, not zero times.
+    Per-tenant deques inside two priority tiers. ``pop`` order is
+    strict-priority with aging: an aged batch job (queued longer than
+    ``aging_s``) first, then any interactive job, then any batch job —
+    so interactive jobs cut the line but can never starve batch work.
+    Within a tier the first tenant with work is served and rotated to
+    the back, so a tenant submitting N jobs waits behind every other
+    tenant once per own job, not zero times.
     ``take_compatible`` pulls additional queued jobs with a matching join
-    key (any tenant, FIFO within each) for batch joining — those jobs
-    would only have waited longer by staying queued.
+    key (any tenant or priority, FIFO within each) for batch joining —
+    those jobs would only have waited longer by staying queued.
     """
 
-    def __init__(self, depth: int):
+    def __init__(self, depth: int, aging_s: float = 30.0):
         self._depth = depth
-        self._tenants: "OrderedDict[str, deque]" = OrderedDict()
+        self._aging_s = aging_s
+        self._tiers: Dict[str, "OrderedDict[str, deque]"] = {
+            p: OrderedDict() for p in PRIORITIES}
         self._n = 0
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
@@ -137,42 +177,73 @@ class _FairQueue:
         with self._lock:
             return self._n
 
+    def depths(self) -> Dict[str, int]:
+        with self._lock:
+            return {p: sum(len(dq) for dq in tier.values())
+                    for p, tier in self._tiers.items()}
+
     def push(self, job: ServeJob) -> None:
         with self._lock:
             if self._n >= self._depth:
                 raise QueueFull(
                     f"job queue is full ({self._n}/{self._depth})")
-            self._tenants.setdefault(job.tenant, deque()).append(job)
+            job.queued_at = time.time()
+            tier = self._tiers[job.priority]
+            tier.setdefault(job.tenant, deque()).append(job)
             self._n += 1
             self._not_empty.notify()
+
+    def _pop_tier(self, tier: "OrderedDict[str, deque]",
+                  min_age: float = 0.0) -> Optional[ServeJob]:
+        now = time.time()
+        for name, dq in list(tier.items()):
+            if dq and (not min_age or now - dq[0].queued_at >= min_age):
+                tier.move_to_end(name)
+                self._n -= 1
+                return dq.popleft()
+        return None
 
     def pop(self, timeout: Optional[float] = None) -> Optional[ServeJob]:
         with self._not_empty:
             if not self._n:
                 self._not_empty.wait(timeout)
-            for name, dq in list(self._tenants.items()):
-                if dq:
-                    self._tenants.move_to_end(name)
-                    self._n -= 1
-                    return dq.popleft()
-            return None
+            job = self._pop_tier(self._tiers["batch"],
+                                 min_age=self._aging_s)     # aged first
+            if job is None:
+                job = self._pop_tier(self._tiers["interactive"])
+            if job is None:
+                job = self._pop_tier(self._tiers["batch"])
+            return job
 
     def take_compatible(self, key: Tuple, limit: int) -> List[ServeJob]:
         out: List[ServeJob] = []
         if limit <= 0:
             return out
         with self._lock:
-            for name, dq in list(self._tenants.items()):
-                keep: deque = deque()
-                while dq:
-                    j = dq.popleft()
-                    if len(out) < limit and j.join_key == key:
-                        out.append(j)
-                    else:
-                        keep.append(j)
-                self._tenants[name] = keep
+            for tier in self._tiers.values():
+                for name, dq in list(tier.items()):
+                    keep: deque = deque()
+                    while dq:
+                        j = dq.popleft()
+                        if len(out) < limit and j.join_key == key:
+                            out.append(j)
+                        else:
+                            keep.append(j)
+                    tier[name] = keep
             self._n -= len(out)
         return out
+
+    def remove(self, job_id: str) -> Optional[ServeJob]:
+        """Pull a specific queued job (the queued-cancel path)."""
+        with self._lock:
+            for tier in self._tiers.values():
+                for name, dq in tier.items():
+                    for j in dq:
+                        if j.job_id == job_id:
+                            dq.remove(j)
+                            self._n -= 1
+                            return j
+        return None
 
 
 class ServeDaemon:
@@ -199,13 +270,16 @@ class ServeDaemon:
         self._spool_dir = os.path.join(opts.state_dir, "spool")
         for d in (self._jobs_dir, self._results_dir, self._spool_dir):
             os.makedirs(d, exist_ok=True)
+        self._ckpt_dir = os.path.join(opts.state_dir, "ckpt")
         self.metrics = MetricsWriter(opts.metrics_jsonl, append=True)
         self.engine = ResidentEngine(cache_dir=opts.cache_dir)
-        self._queue = _FairQueue(opts.queue_depth)
+        self._queue = _FairQueue(opts.queue_depth, aging_s=opts.aging_s)
         self._defaults = G2VecConfig()
         self._running: Dict[str, ServeJob] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
+        self._draining = False
+        self._state_counts: "Counter[str]" = Counter()
         self._t0 = time.time()
         self._serial = 0
         self._batches = 0
@@ -237,6 +311,17 @@ class ServeDaemon:
                 or len(tenant) > _TENANT_MAX:
             raise ValueError(f"'tenant' must be a 1-{_TENANT_MAX} char "
                              f"string, got {tenant!r}")
+        priority = payload.get("priority", "batch")
+        if priority not in PRIORITIES:
+            raise ValueError(f"'priority' must be one of {PRIORITIES}, "
+                             f"got {priority!r}")
+        deadline_s = payload.get("deadline_s")
+        if deadline_s is not None:
+            if not isinstance(deadline_s, (int, float)) \
+                    or isinstance(deadline_s, bool) or deadline_s <= 0:
+                raise ValueError(f"'deadline_s' must be a positive number, "
+                                 f"got {deadline_s!r}")
+            deadline_s = float(deadline_s)
         jobd = payload.get("job")
         if not isinstance(jobd, dict):
             raise ValueError("submit needs a 'job' object")
@@ -274,7 +359,8 @@ class ServeDaemon:
         job = ServeJob(job_id=job_id or self._new_job_id(), tenant=tenant,
                        cfg=cfg, variants=variants, raw=payload,
                        submitted_at=(time.time() if submitted_at is None
-                                     else submitted_at))
+                                     else submitted_at),
+                       priority=priority, deadline_s=deadline_s)
         job.join_key = _join_key(cfg)
         return job
 
@@ -289,8 +375,10 @@ class ServeDaemon:
                               detail=str(e)[:300])
             return {"event": "rejected", "error": "bad_job",
                     "detail": str(e)[:500]}
-        if self._stop.is_set():
-            return {"event": "rejected", "error": "shutting_down",
+        if self._stop.is_set() or self._draining:
+            return {"event": "rejected",
+                    "error": ("draining" if self._draining
+                              else "shutting_down"),
                     "job_id": job.job_id}
         job.subscriber = subscriber
         try:
@@ -304,11 +392,14 @@ class ServeDaemon:
                     "queue_depth": self.opts.queue_depth,
                     "job_id": job.job_id}
         self._journal(job)
+        self._job_state(job.job_id, "queued", tenant=job.tenant,
+                        priority=job.priority)
         self.metrics.bind_job(job.job_id).emit(
             "job_accepted", tenant=job.tenant, n_lanes=len(job.variants),
-            queued=self._queue.depth())
+            priority=job.priority, queued=self._queue.depth())
         return {"event": "accepted", "job_id": job.job_id,
                 "tenant": job.tenant, "n_lanes": len(job.variants),
+                "priority": job.priority,
                 "state_dir": self.opts.state_dir}
 
     # ---- journal / crash recovery ----------------------------------------
@@ -344,6 +435,22 @@ class ServeDaemon:
                 os.unlink(os.path.join(self._jobs_dir, fn))
         for rec in sorted(recs, key=lambda r: r.get("submitted_at", 0.0)):
             job_id = rec.get("job_id", "?")
+            if os.path.exists(os.path.join(self._results_dir,
+                                           f"{job_id}.json")):
+                # Exactly-once: the previous daemon died in the window
+                # between writing the durable result and unlinking the
+                # journal entry. The job finished — re-running it would
+                # duplicate work (and terminal events).
+                try:
+                    os.unlink(os.path.join(self._jobs_dir,
+                                           f"{job_id}.json"))
+                except OSError:
+                    pass
+                self.metrics.bind_job(job_id).emit(
+                    "job_recovered_complete")
+                self.console(f"[serve] journal entry {job_id} already has "
+                             f"a result record; dropping (exactly-once)")
+                continue
             self._serial += 1          # keep new ids monotonic-ish
             try:
                 job = self._plan_job(rec["payload"], job_id=job_id,
@@ -359,10 +466,88 @@ class ServeDaemon:
                     f"requeue failed: {type(e).__name__}: {e}",
                     classified="fatal")
                 continue
+            self._job_state(job_id, "queued", tenant=job.tenant,
+                            priority=job.priority, recovered=True)
             self.metrics.bind_job(job_id).emit("job_requeued",
                                                tenant=job.tenant)
             self.console(f"[serve] re-queued journaled job {job_id} "
                          f"(tenant {job.tenant!r})")
+
+    # ---- job lifecycle ----------------------------------------------------
+
+    def _job_state(self, job_id: str, state: str, **info) -> None:
+        """One edge of the per-job state machine
+        (queued → started → (checkpointed|resumed)* → terminal, where
+        terminal ∈ {done, failed, cancelled, deadline_exceeded}; ``drained``
+        marks a checkpoint-and-requeue pause, not an end state). Every edge
+        lands in the metrics JSONL and the ``/status`` per-state counters."""
+        self._state_counts[state] += 1
+        self.metrics.bind_job(job_id).emit("job_state", state=state, **info)
+
+    def _cleanup_ckpt(self, job_id: str) -> None:
+        """Drop a terminal job's streaming cursor directories (one per
+        lane, named ``<job_id>.<variant>``) — a finished job must never
+        leave a cursor a future same-id job could resume from."""
+        for d in glob.glob(os.path.join(self._ckpt_dir, f"{job_id}.*")):
+            shutil.rmtree(d, ignore_errors=True)
+
+    def _finish_terminal(self, job: ServeJob, status: str,
+                         detail: str) -> None:
+        """Record a cancelled / deadline_exceeded terminal state: result
+        record, journal removal, cursor cleanup, subscriber notice."""
+        record = {"event": f"job_{status}", "job_id": job.job_id,
+                  "tenant": job.tenant, "status": status, "detail": detail,
+                  "submitted_at": job.submitted_at,
+                  "finished_at": time.time()}
+        write_json_atomic(
+            os.path.join(self._results_dir, f"{job.job_id}.json"), record)
+        self._unjournal(job)
+        self._cleanup_ckpt(job.job_id)
+        self.jobs_failed += 1
+        self._job_state(job.job_id, status, detail=detail)
+        self._notify(job, record)
+        self._notify(job, None)
+
+    def cancel_job(self, job_id: str) -> dict:
+        """Client-initiated cancel. A queued job dies immediately; a
+        running job gets its cancel flag set and the trainers' check hook
+        raises JobCancelled at the next shard/chunk boundary."""
+        queued = self._queue.remove(job_id)
+        if queued is not None:
+            self._finish_terminal(queued, "cancelled",
+                                  "cancelled while queued")
+            return {"event": "cancelled", "job_id": job_id,
+                    "where": "queued"}
+        with self._lock:
+            running = self._running.get(job_id)
+        if running is not None:
+            running.cancel_ev.set()
+            self.metrics.bind_job(job_id).emit("job_cancel_requested")
+            return {"event": "cancelling", "job_id": job_id,
+                    "where": "running",
+                    "note": "cooperative — takes effect at the next "
+                            "shard/chunk boundary"}
+        return {"event": "error", "error": f"unknown job {job_id!r} "
+                                           f"(not queued, not running)"}
+
+    def _begin_drain(self, source: str) -> None:
+        """Graceful drain: stop admitting, let the in-flight batch hit its
+        next boundary (where DrainRequested checkpoints streaming jobs and
+        leaves everything journaled), then exit 0. Idempotent."""
+        if self._draining:
+            return
+        self._draining = True
+        self.metrics.emit("drain_begin", source=source,
+                          queued=self._queue.depth(),
+                          running=len(self._running))
+        self.console(f"[serve] draining ({source}): admission closed, "
+                     f"in-flight jobs checkpoint at the next boundary")
+        from g2vec_tpu.resilience.faults import fault_point
+
+        try:
+            fault_point("drain")
+        finally:
+            self._stop.set()
 
     # ---- scheduling / execution ------------------------------------------
 
@@ -375,7 +560,22 @@ class ServeDaemon:
             return 0
         batch = [job] + self._queue.take_compatible(
             job.join_key, self.opts.max_join - 1)
-        return self._run_jobs(batch)
+        # Pre-execution lifecycle filter: a job cancelled or past its
+        # deadline while queued terminates here, without costing a lane.
+        live: List[ServeJob] = []
+        for j in batch:
+            if j.cancel_ev.is_set():
+                self._finish_terminal(j, "cancelled",
+                                      "cancelled while queued")
+            elif j.deadline_expired():
+                self._finish_terminal(
+                    j, "deadline_exceeded",
+                    f"deadline_s={j.deadline_s} elapsed while queued")
+            else:
+                live.append(j)
+        if not live:
+            return 0
+        return self._run_jobs(live)
 
     def _notify(self, job: ServeJob, event: Optional[dict]) -> None:
         q = job.subscriber
@@ -400,19 +600,52 @@ class ServeDaemon:
         exec_cfg = dataclasses.replace(
             batch[0].cfg, result_name=os.path.join(spool, "out"),
             metrics_jsonl=None, manifest=None, batch_seeds=0)
+        if exec_cfg.train_mode == "streaming":
+            # Durable streaming: every lane checkpoints its cursor under
+            # <state-dir>/ckpt/<job_id>.<variant> and resumes from it on a
+            # journal re-queue (the lane names are restart-stable).
+            exec_cfg = dataclasses.replace(
+                exec_cfg, checkpoint_dir=self._ckpt_dir, resume=True)
         self.metrics.emit("batch_start", batch=bid,
                           jobs=[j.job_id for j in batch],
                           n_lanes=len(merged))
         for j in batch:
+            self._job_state(j.job_id, "started", batch=bid,
+                            attempt=j.attempts)
             self._notify(j, {"event": "started", "job_id": j.job_id,
                              "batch": bid, "joined_jobs": len(batch),
                              "n_lanes": len(j.variants)})
+
+        def check() -> None:
+            """Cooperative-interruption hook (resilience/lifecycle.py):
+            the trainers call this at shard/chunk boundaries, the only
+            points where stopping leaves a consistent, checkpointable
+            state."""
+            if self._draining:
+                raise DrainRequested(detail="daemon drain")
+            now = time.time()
+            for j in batch:
+                if j.cancel_ev.is_set():
+                    raise JobCancelled(j.job_id)
+                if j.deadline_expired(now):
+                    raise JobDeadlineExceeded(
+                        j.job_id, detail=f"deadline_s={j.deadline_s}")
+
+        def lifecycle(job_id: str, state: str, info: dict) -> None:
+            self._job_state(job_id, state,
+                            **{k: info[k] for k in ("epoch", "shard", "done")
+                               if k in info})
+
         t0 = time.time()
         try:
             res = self.engine.execute(exec_cfg, merged,
                                       console=self.console,
                                       metrics=self.metrics,
-                                      lane_jobs=lane_jobs)
+                                      lane_jobs=lane_jobs,
+                                      check=check, lifecycle=lifecycle)
+        except JobInterrupted as e:
+            self._handle_interrupt(batch, e, bid, spool)
+            return 0
         except BaseException as e:  # noqa: BLE001 — classified below
             if isinstance(e, (KeyboardInterrupt, SystemExit)):
                 raise
@@ -452,7 +685,9 @@ class ServeDaemon:
             write_json_atomic(
                 os.path.join(self._results_dir, f"{j.job_id}.json"), record)
             self._unjournal(j)
+            self._cleanup_ckpt(j.job_id)
             self.jobs_done += 1
+            self._job_state(j.job_id, "done", batch=bid)
             self.metrics.bind_job(j.job_id).emit(
                 "job_done", tenant=j.tenant, batch=bid,
                 joined_jobs=len(batch),
@@ -466,6 +701,47 @@ class ServeDaemon:
                      f"{len(merged)} lane(s) in {wall:.2f}s "
                      f"({res.runs_per_hour:.0f} runs/hour)")
         return len(batch)
+
+    def _handle_interrupt(self, batch: List[ServeJob], exc: JobInterrupted,
+                          bid: int, spool: str) -> None:
+        """A cooperative interruption surfaced from the trainers.
+
+        - DrainRequested: every job in the batch stays journaled (streaming
+          lanes just checkpointed their cursors); the restart re-queues and
+          resumes them. No terminal record is written — the job is paused,
+          not over.
+        - JobCancelled / JobDeadlineExceeded: the culprit job (named by
+          ``exc.job_id``) terminates; innocent batch-mates re-queue WITHOUT
+          an attempt charge — they did nothing wrong, the batch did.
+        """
+        shutil.rmtree(spool, ignore_errors=True)
+        if isinstance(exc, DrainRequested):
+            for j in batch:
+                self._job_state(j.job_id, "drained", batch=bid)
+                self._notify(j, {"event": "job_drained",
+                                 "job_id": j.job_id,
+                                 "note": "daemon draining; job stays "
+                                         "journaled and resumes on the "
+                                         "next start"})
+                self._notify(j, None)
+            self.console(f"[serve] batch {bid} drained "
+                         f"({len(batch)} job(s) checkpointed + journaled)")
+        else:
+            for j in batch:
+                if j.job_id == exc.job_id:
+                    self._finish_terminal(j, exc.reason, str(exc))
+                    continue
+                try:
+                    self._queue.push(j)
+                    self._job_state(j.job_id, "queued",
+                                    requeued_after=exc.reason)
+                except QueueFull:
+                    self._finish_failed(
+                        j, f"requeue after batch-mate "
+                           f"{exc.reason} found the queue full", "fatal")
+        with self._lock:
+            for j in batch:
+                self._running.pop(j.job_id, None)
 
     def _route_outputs(self, job: ServeJob, v: LaneVariant, lane) -> List[str]:
         """Move a lane's spool files to the job's requested result_name —
@@ -491,6 +767,7 @@ class ServeDaemon:
                 self._finish_failed(job, f"{err} (retry queue full)",
                                     classified)
                 return
+            self._job_state(job.job_id, "queued", retry=job.attempts)
             self.metrics.bind_job(job.job_id).emit(
                 "job_retry", attempt=job.attempts, error=err)
             self._notify(job, {"event": "job_retry", "job_id": job.job_id,
@@ -508,7 +785,9 @@ class ServeDaemon:
         write_json_atomic(
             os.path.join(self._results_dir, f"{job.job_id}.json"), record)
         self._unjournal(job)
+        self._cleanup_ckpt(job.job_id)
         self.jobs_failed += 1
+        self._job_state(job.job_id, "failed", classified=classified)
         self.metrics.bind_job(job.job_id).emit("job_failed", error=err,
                                                classified=classified)
         self._notify(job, record)
@@ -527,6 +806,9 @@ class ServeDaemon:
                 "socket": self.opts.socket_path,
                 "state_dir": self.opts.state_dir,
                 "queued": self._queue.depth(), "running": running,
+                "queued_by_priority": self._queue.depths(),
+                "draining": self._draining,
+                "job_states": dict(self._state_counts),
                 "queue_depth_limit": self.opts.queue_depth,
                 "max_join": self.opts.max_join,
                 "jobs_done": self.jobs_done,
@@ -572,6 +854,23 @@ class ServeDaemon:
             elif op == "ping":
                 protocol.write_event(f, {"event": "pong",
                                          "pid": os.getpid()})
+            elif op == "cancel":
+                job_id = req.get("job_id")
+                if not isinstance(job_id, str) or not job_id:
+                    protocol.write_event(
+                        f, {"event": "error",
+                            "error": "cancel needs a 'job_id' string"})
+                else:
+                    protocol.write_event(f, self.cancel_job(job_id))
+            elif op == "drain":
+                protocol.write_event(
+                    f, {"event": "draining",
+                        "queued": self._queue.depth(),
+                        "running": len(self._running),
+                        "note": "in-flight jobs checkpoint + stay "
+                                "journaled; restart resumes them"})
+                threading.Thread(target=self._begin_drain,
+                                 args=("client",), daemon=True).start()
             elif op == "shutdown":
                 protocol.write_event(
                     f, {"event": "shutting_down",
@@ -625,9 +924,14 @@ class ServeDaemon:
         sched = threading.Thread(target=_sched, name="g2v-serve-sched",
                                  daemon=True)
         sched.start()
+        def _on_sigterm(*_):
+            # Signal context: just flip the flags and let the scheduler /
+            # accept loops do the actual drain work on their own threads.
+            threading.Thread(target=self._begin_drain, args=("sigterm",),
+                             daemon=True).start()
+
         try:
-            signal.signal(signal.SIGTERM,
-                          lambda *_: self._stop.set())
+            signal.signal(signal.SIGTERM, _on_sigterm)
         except ValueError:
             pass      # not the main thread (tests) — SIGTERM unhandled
         if os.path.exists(self.opts.socket_path):

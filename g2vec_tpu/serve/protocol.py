@@ -3,10 +3,11 @@
 One request object per connection, newline-terminated; the daemon answers
 with a stream of newline-delimited JSON events and closes the stream after
 the terminal event (``accepted``/``rejected`` + per-job progress ending in
-``job_done``/``job_failed`` for submits; a single event for
-``status``/``ping``/``shutdown``). Line-delimited JSON keeps both sides
-trivially incremental — the daemon can stream a job's events as they
-happen and a shell client is one ``nc -U`` away.
+``job_done``/``job_failed``/``job_cancelled``/``job_deadline_exceeded``/
+``job_drained`` for submits; a single event for
+``status``/``ping``/``shutdown``/``cancel``/``drain``). Line-delimited
+JSON keeps both sides trivially incremental — the daemon can stream a
+job's events as they happen and a shell client is one ``nc -U`` away.
 
 The same socket also answers plain HTTP ``GET /status`` (detected from the
 request's first bytes), so ``curl --unix-socket <sock> http://g2vec/status``
@@ -14,8 +15,11 @@ works without a client library.
 
 Requests::
 
-    {"op": "submit", "tenant": "alice", "job": {...}}   # see daemon.py
+    {"op": "submit", "tenant": "alice", "job": {...},    # see daemon.py
+     "priority": "interactive", "deadline_s": 120}       # both optional
     {"op": "status"} | {"op": "ping"} | {"op": "shutdown"}
+    {"op": "cancel", "job_id": "j0001-..."}              # cooperative
+    {"op": "drain"}     # stop admitting, checkpoint, journal, exit 0
 """
 from __future__ import annotations
 

@@ -162,6 +162,132 @@ def save_state(directory: str, params: Any, opt_state: Any, snapshot: Any,
     return path
 
 
+STREAM_NAME = "stream_state.npz"
+
+
+def save_stream_state(directory: str, arrays: dict, cursor: dict,
+                      fingerprint: Optional[dict] = None) -> str:
+    """Atomically write the streaming trainer's durable state.
+
+    ``arrays`` is a flat name -> host ndarray dict (params/opt/snapshot
+    leaves plus the host-side byproducts the full-batch format has no slot
+    for); ``cursor`` is the JSON-serializable (epoch, shard, spool) record
+    that makes mid-epoch resume possible — it rides in the MANIFEST, next
+    to the integrity data, because the cursor is only meaningful when the
+    state it points into verifies. Same machinery as :func:`save_state`:
+    tmp + rename, keep-previous ``.prev`` twin, per-leaf sha256 + whole
+    file sha256, fingerprint drift check on load. Single-process by
+    construction (the streaming trainer is a single-device loop).
+
+    The ``stream_ckpt`` fault seam fires after the manifest commits — a
+    sigkill there models the worst case the resume drill pins: death with
+    a fully durable checkpoint whose progress must not be repeated.
+    """
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, STREAM_NAME)
+    epoch = int(cursor.get("epoch", 0))
+    fault_point("checkpoint_write", path=path, epoch=epoch)
+    tmp = path + ".tmp"
+    np.savez(tmp, **arrays)
+    written = tmp + ".npz" if os.path.exists(tmp + ".npz") else tmp
+    manifest = {
+        "schema": SCHEMA_VERSION, "layout": "stream",
+        "file_sha256": _sha256_file(written),
+        "leaves": [{"name": k, "sha256": _sha256_array(np.asarray(v)),
+                    "shape": list(np.shape(v)),
+                    "dtype": str(np.asarray(v).dtype)}
+                   for k, v in arrays.items()],
+        "cursor": cursor,
+        "fingerprint": fingerprint,
+        "written_unix": int(time.time()),
+    }
+    if os.path.exists(path):
+        os.replace(path, path + PREV_SUFFIX)
+        if os.path.exists(path + MANIFEST_SUFFIX):
+            os.replace(path + MANIFEST_SUFFIX,
+                       path + PREV_SUFFIX + MANIFEST_SUFFIX)
+    os.replace(written, path)
+    _write_json_atomic(path + MANIFEST_SUFFIX, manifest)
+    fault_point("checkpoint_finalize", path=path, epoch=epoch)
+    fault_point("stream_ckpt", path=path, epoch=epoch)
+    return path
+
+
+def _stream_dtype(name: str) -> np.dtype:
+    """Manifest dtype string -> dtype, including the ml_dtypes family
+    (np.savez stores bfloat16 as raw void bytes; the manifest remembers
+    what they mean)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def load_stream_state(directory: str,
+                      fingerprint: Optional[dict] = None
+                      ) -> Optional[Tuple[dict, dict]]:
+    """Restore ``(arrays, cursor)`` written by :func:`save_stream_state`.
+
+    Verification order mirrors :func:`_read_single`: whole-file sha first,
+    then per-leaf sha, then the fingerprint drift check — and a latest
+    checkpoint that fails any of them falls back to the ``.prev`` twin
+    with a warning (at most one checkpoint interval is repeated). Returns
+    None when no stream checkpoint exists; raises when every kept
+    generation is corrupt.
+    """
+    path = os.path.join(directory, STREAM_NAME)
+    failures = []
+    for cand in (path, path + PREV_SUFFIX):
+        if not os.path.exists(cand):
+            continue
+        reason = _verify_single(cand)
+        man = _load_manifest(cand) if reason is None else None
+        if reason is None and (man is None or "cursor" not in man):
+            reason = "missing or cursor-less manifest"
+        if reason is None:
+            try:
+                with np.load(cand) as data:
+                    arrays = {k: data[k] for k in data.files}
+            except Exception as e:  # noqa: BLE001 — corrupt zip
+                reason = f"unreadable ({type(e).__name__}: {e})"
+        if reason is None:
+            records = {r["name"]: r for r in man.get("leaves", [])}
+            if set(records) != set(arrays):
+                reason = (f"manifest names {sorted(records)} but checkpoint "
+                          f"holds {sorted(arrays)}")
+            else:
+                for k, arr in arrays.items():
+                    if records[k].get("sha256") and \
+                            _sha256_array(arr) != records[k]["sha256"]:
+                        reason = f"{k} sha256 mismatch"
+                        break
+        if reason is None:
+            _check_fingerprint(cand, man, fingerprint)
+            for k, arr in arrays.items():
+                want = _stream_dtype(records[k]["dtype"])
+                if arr.dtype.kind == "V" and arr.dtype != want:
+                    arrays[k] = arr.view(want)
+            if cand != path:
+                warnings.warn(
+                    f"resuming from the previous checkpoint {cand} (the "
+                    "latest failed verification) — at most one checkpoint "
+                    "interval of progress is repeated", RuntimeWarning)
+            return arrays, man["cursor"]
+        failures.append(f"{os.path.basename(cand)}: {reason}")
+        warnings.warn(
+            f"checkpoint {cand} failed integrity verification ({reason}); "
+            "falling back to the previous checkpoint", RuntimeWarning)
+    if failures:
+        raise ValueError(
+            f"no intact stream checkpoint under {directory} — "
+            + "; ".join(failures)
+            + " — every kept generation is corrupt; restart without "
+              "--resume to retrain from scratch")
+    return None
+
+
 def _leaf_dict(tree: Any, meta: Optional[np.ndarray] = None) -> dict:
     """Index-keyed flat dict — names custom pytree nodes (NamedTuples,
     optax states) out of the storage format entirely."""

@@ -42,9 +42,27 @@ pinned contract is a val-ACC parity band plus top-N biomarker overlap
 bitwise-golden reference. WITHIN streaming mode the trajectory is
 bitwise-deterministic: same seed + same shard size reproduce it at any
 ``--sampler-threads`` and any ring depth.
+
+Durability (PR 9): with ``checkpoint_dir`` set the trainer carries an
+(epoch, shard) CURSOR through the sha256-manifest machinery
+(train/checkpoint.py ``save_stream_state``): every ``checkpoint_every``
+shard updates — and at every epoch boundary — the full device state
+(params/Adam/snapshot), the epoch-0 byproducts (gene counts, bounded
+eval buffers, kept-row count), the history, and the partial-epoch loss
+list all land atomically next to a cursor naming the NEXT shard to
+train. The spool becomes durable (``<checkpoint_dir>/spool``) and the
+cursor records each spooled shard's sha256, so ``resume=True`` restarts
+mid-epoch: epochs > 0 replay the verified spool from the cursor shard;
+a mid-epoch-0 resume restarts the deterministic producer AT the cursor
+shard. Because the in-stream trajectory is bitwise-deterministic and
+every checkpoint cuts at a shard boundary (where device state is
+host-consistent), a resumed run's final outputs are byte-identical to
+an uninterrupted one — the contract tests/test_stream.py and the serve
+SIGKILL drill pin.
 """
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import os
 import shutil
@@ -60,6 +78,7 @@ import numpy as np
 from g2vec_tpu.ops.host_walker import (ShardPlan, edges_to_csr, plan_shards,
                                        walk_shard)
 from g2vec_tpu.resilience.faults import fault_point
+from g2vec_tpu.resilience.lifecycle import DrainRequested
 from g2vec_tpu.utils.integrity import sha256_file
 
 # ---------------------------------------------------------------------------
@@ -200,6 +219,43 @@ class SpoolIntegrityError(ValueError):
     under the run, a fatal condition (never retried)."""
 
 
+class SpoolWriteError(RuntimeError):
+    """A shard failed to SPOOL — ENOSPC, EIO, or a short write under the
+    spool directory. Structured (shard index, path, errno) so the failure
+    names the disk problem instead of surfacing as a bare OSError from an
+    anonymous worker thread; a RuntimeError so the serve classifier calls
+    it retryable (space may free) while the job still fails cleanly and
+    the daemon stays up."""
+
+    def __init__(self, index: int, path: str, detail: str,
+                 errno: Optional[int] = None):
+        self.index, self.path, self.errno = index, path, errno
+        super().__init__(
+            f"failed to spool shard {index} to {path}: {detail} — "
+            f"the streaming job cannot replay epochs without its spool")
+
+
+def _spool_write(index: int, path: str, arr: np.ndarray) -> None:
+    """np.save with the failure modes named: OSError (ENOSPC et al.)
+    and the silent short write a full-but-not-failing filesystem can
+    leave behind both raise :class:`SpoolWriteError`."""
+    try:
+        np.save(path, arr)
+        size = os.path.getsize(path)
+    except OSError as e:
+        with contextlib.suppress(OSError):
+            os.unlink(path)
+        raise SpoolWriteError(index, path,
+                              f"{type(e).__name__}: {e}",
+                              errno=getattr(e, "errno", None)) from e
+    if size < arr.nbytes:        # .npy = header + raw bytes, so >= nbytes
+        with contextlib.suppress(OSError):
+            os.unlink(path)
+        raise SpoolWriteError(
+            index, path,
+            f"short write ({size} bytes on disk < {arr.nbytes} data bytes)")
+
+
 class ShardSpool:
     """Disk spool for the epoch-0 shard stream, replayed by epochs 1..N.
 
@@ -223,7 +279,7 @@ class ShardSpool:
         return os.path.join(self.directory, f"shard{index:06d}_x.npy")
 
     def save(self, shard: Shard) -> str:
-        np.save(self.x_path(shard.index), shard.x)
+        _spool_write(shard.index, self.x_path(shard.index), shard.x)
         self._sha[shard.index] = sha256_file(self.x_path(shard.index))
         return self.x_path(shard.index)
 
@@ -239,7 +295,7 @@ class ShardSpool:
                 f"({path}) — re-walking it through the deterministic "
                 f"sampler", RuntimeWarning)
             self.rewalks += 1
-            np.save(path, rewalk(index))
+            _spool_write(index, path, rewalk(index))
             if sha256_file(path) != want:
                 raise SpoolIntegrityError(
                     f"shard {index}: deterministic re-walk does not "
@@ -269,6 +325,9 @@ class StreamStats:
     producer_blocked_s: float = 0.0
     rewalks: int = 0
     epochs: int = 0
+    checkpoints: int = 0             # cursor checkpoints written this run
+    checkpoint_wall_s: float = 0.0   # wall spent inside save_stream_state
+    resumed: int = 0                 # 1 = this run restored a cursor
 
     def as_dict(self) -> Dict:
         return dataclasses.asdict(self)
@@ -322,6 +381,10 @@ def train_cbow_streaming(
         prefetch_depth: int = 2, patience: int = 5, sampler_threads: int = 0,
         overlap=None, use_pallas: Optional[bool] = None,
         eval_rows_cap: int = EVAL_ROWS_CAP,
+        checkpoint_dir: Optional[str] = None, resume: bool = False,
+        checkpoint_every: int = 25,
+        check: Optional[Callable[[], None]] = None,
+        lifecycle: Optional[Callable[[str, dict], None]] = None,
         on_epoch: Optional[Callable[[int, float, float, float], None]] = None,
         console: Callable[[str], None] = print,
         ) -> StreamTrainResult:
@@ -336,6 +399,15 @@ def train_cbow_streaming(
     existing drain contract (None spins a private thread). ``seed`` is
     the trainer's split/init seed, ``walk_seed`` the stage-3 walk seed —
     the same split the full-batch config makes.
+
+    Durability: ``checkpoint_dir`` enables the (epoch, shard) cursor
+    checkpoint every ``checkpoint_every`` shard updates and at every
+    epoch boundary; ``resume=True`` restores the newest verified cursor
+    and continues bitwise-identically (module docstring). ``check`` is
+    the cooperative-interruption hook (resilience/lifecycle.py), called
+    at every shard boundary — a :class:`DrainRequested` raised there
+    checkpoints the current consistent state before propagating.
+    ``lifecycle(state, info)`` observes "resumed"/"checkpointed".
     """
     import jax
     import jax.numpy as jnp
@@ -343,6 +415,10 @@ def train_cbow_streaming(
     from g2vec_tpu.models.cbow import init_params
     from g2vec_tpu.ops import packed_matmul as pm
     from g2vec_tpu.parallel.mesh import make_mesh_context, pad_to_multiple
+    from g2vec_tpu.train.checkpoint import (RUN_COMPLETED, RUN_EARLY_STOPPED,
+                                            RUN_IN_PROGRESS,
+                                            load_stream_state,
+                                            save_stream_state)
     from g2vec_tpu.train.trainer import (_DTYPES, _get_stream_fns,
                                          _get_unpack_fn, _plan_layout,
                                          TrainResult)
@@ -354,6 +430,9 @@ def train_cbow_streaming(
         raise ValueError(
             f"dtypes must be one of {sorted(_DTYPES)}, got "
             f"{compute_dtype!r}/{param_dtype!r}")
+    if checkpoint_every < 1:
+        raise ValueError(
+            f"checkpoint_every must be >= 1, got {checkpoint_every}")
 
     plan = plan_shards(n_genes, reps, shard_paths, len_path=len_path)
     n_shards = plan.n_shards
@@ -385,14 +464,52 @@ def train_cbow_streaming(
                                np.ones(n, np.int32)])
 
     ring = ShardRing(prefetch_depth)
-    spool_dir = tempfile.mkdtemp(prefix="g2v-stream-")
+    if checkpoint_dir:
+        # Durable spool: replay epochs AND resumes read it, so it lives
+        # with the cursor checkpoint and survives the process. Removed
+        # only by whoever owns checkpoint_dir (the serve daemon cleans it
+        # with the job's terminal state).
+        spool_dir = os.path.join(os.path.abspath(checkpoint_dir), "spool")
+        os.makedirs(spool_dir, exist_ok=True)
+        spool_is_tmp = False
+    else:
+        spool_dir = tempfile.mkdtemp(prefix="g2v-stream-")
+        spool_is_tmp = True
     spool = ShardSpool(spool_dir)
+
+    fingerprint = {
+        "hidden": hidden, "learning_rate": learning_rate,
+        "compute_dtype": compute_dtype, "param_dtype": param_dtype,
+        "seed": seed, "walk_seed": walk_seed,
+        "val_fraction": val_fraction,
+        "decision_threshold": decision_threshold,
+        "n_genes": n_genes, "len_path": len_path, "reps": reps,
+        "n_shards": n_shards, "rows_per_shard": plan.rows_per_shard,
+        "patience": patience, "eval_rows_cap": eval_rows_cap,
+        "max_epochs": max_epochs,
+    }
+
+    # ---- resume: restore the newest verified cursor BEFORE the producer
+    # starts — it decides where (and whether) sampling restarts ----
+    resume_arrays = resume_cursor = None
+    if checkpoint_dir and resume:
+        loaded = load_stream_state(checkpoint_dir, fingerprint=fingerprint)
+        if loaded is not None:
+            resume_arrays, resume_cursor = loaded
+            spool._sha = {int(k): v for k, v in
+                          resume_cursor.get("spool_sha", {}).items()}
+            stats.resumed = 1
+    start_epoch = int(resume_cursor["epoch"]) if resume_cursor else 0
+    start_shard = int(resume_cursor["shard"]) if resume_cursor else 0
+    resume_done = (int(resume_cursor.get("done", RUN_IN_PROGRESS))
+                   if resume_cursor else RUN_IN_PROGRESS)
+
     producer_wall = [0.0]
 
     def _produce():
         t0 = time.perf_counter()
         try:
-            for si in range(n_shards):
+            for si in range(start_shard, n_shards):
                 shard = Shard(si, _walk_shard_rows(si), _shard_labels(si))
                 path = spool.save(shard)
                 # The in-flight-shard seam: kind=corrupt tears the SPOOLED
@@ -408,16 +525,20 @@ def train_cbow_streaming(
         finally:
             producer_wall[0] = time.perf_counter() - t0
 
+    # The producer (re)samples ONLY the epoch-0 tail: a resume at epoch
+    # >= 1 (or at a terminal cursor) replays the durable spool instead.
+    need_producer = (resume_done == RUN_IN_PROGRESS and start_epoch == 0)
     remove_closer = None
-    if overlap is not None:
-        remove_closer = overlap.add_closer(ring.cancel)
-        overlap.submit("stream_shards", _produce)
-        producer_thread = None
-    else:
-        producer_thread = threading.Thread(target=_produce,
-                                           name="g2v-stream-producer",
-                                           daemon=True)
-        producer_thread.start()
+    producer_thread = None
+    if need_producer:
+        if overlap is not None:
+            remove_closer = overlap.add_closer(ring.cancel)
+            overlap.submit("stream_shards", _produce)
+        else:
+            producer_thread = threading.Thread(target=_produce,
+                                               name="g2v-stream-producer",
+                                               daemon=True)
+            producer_thread.start()
 
     # ---- device layout: the full-batch derivation, per shard ----
     ctx = make_mesh_context(None)
@@ -469,6 +590,17 @@ def train_cbow_streaming(
                          param_dtype=pdtype, pad_to=n_genes_pad)
     tx = optax.adam(learning_rate, b1=0.9, b2=0.999, eps=1e-8)
     opt_state = tx.init(params)
+    snapshot = jax.tree.map(jnp.copy, params)
+    # The checkpoint treedef: (params, opt_state, snapshot) flattened in
+    # deterministic order — the train/checkpoint.py convention, with the
+    # fresh init as the shape/dtype template.
+    _, _state_treedef = jax.tree_util.tree_flatten(
+        (params, opt_state, snapshot))
+    if resume_arrays is not None:
+        n_leaves = sum(1 for k in resume_arrays if k.startswith("leaf_"))
+        params, opt_state, snapshot = jax.tree_util.tree_unflatten(
+            _state_treedef, [jnp.asarray(resume_arrays[f"leaf_{i}"])
+                             for i in range(n_leaves)])
 
     def _filter_rows(x: np.ndarray, y: np.ndarray) -> np.ndarray:
         """integrate_path_sets at shard granularity: drop rows whose path
@@ -507,6 +639,34 @@ def train_cbow_streaming(
     eval_buffers = [0, 0]            # collected (val, probe) row counts
     kept_rows = [0]                  # rows surviving the per-shard filter
 
+    # ---- early-stop bookkeeping (restored wholesale on resume) ----
+    history: List[dict] = []
+    best_val, best_tr = -1.0, -1.0
+    best_epoch = 0
+    since_best = 0
+    stopped_early = False
+    stop_epoch = max_epochs - 1
+    losses0: List[float] = []        # restored partial-epoch loss prefix
+    x_width = (n_genes + 7) // 8
+    if resume_arrays is not None:
+        good_counts[:] = resume_arrays["good_counts"]
+        poor_counts[:] = resume_arrays["poor_counts"]
+        val_x.append(resume_arrays["val_x"])
+        val_y.append(resume_arrays["val_y"])
+        probe_x.append(resume_arrays["probe_x"])
+        probe_y.append(resume_arrays["probe_y"])
+        sc = resume_arrays["scalars"]
+        best_val, best_tr = float(sc[0]), float(sc[1])
+        best_epoch, since_best = int(sc[2]), int(sc[3])
+        kept_rows[0] = int(sc[4])
+        eval_buffers[0], eval_buffers[1] = int(sc[5]), int(sc[6])
+        stopped_early, stop_epoch = bool(sc[7]), int(sc[8])
+        history = [{"epoch": int(r[0]), "acc_val": float(r[1]),
+                    "acc_tr": float(r[2]), "loss": float(r[3]),
+                    "secs": float(r[4])}
+                   for r in resume_arrays["history"].reshape(-1, 5)]
+        losses0 = [float(x) for x in resume_arrays["losses"]]
+
     def _accumulate(x: np.ndarray, y: np.ndarray, tr_idx, vl_idx) -> None:
         dense = np.unpackbits(x, axis=1)[:, :n_genes]
         good_counts[:] += dense[y == 0].sum(axis=0, dtype=np.int64)
@@ -522,8 +682,8 @@ def train_cbow_streaming(
             probe_y.append(y[take])
             eval_buffers[1] += len(take)
 
-    def _epoch0_iter() -> Iterator[Shard]:
-        for expect in range(n_shards):
+    def _epoch0_iter(start: int = 0) -> Iterator[Shard]:
+        for expect in range(start, n_shards):
             fault_point("prefetch", epoch=expect)
             shard = ring.get()
             if shard is None:
@@ -536,8 +696,8 @@ def train_cbow_streaming(
                     f"{expect}")
             yield shard
 
-    def _replay_iter() -> Iterator[Shard]:
-        for si in range(n_shards):
+    def _replay_iter(start: int = 0) -> Iterator[Shard]:
+        for si in range(start, n_shards):
             fault_point("prefetch", epoch=si)
             yield Shard(si, spool.load(si, _walk_shard_rows),
                         _shard_labels(si))
@@ -545,7 +705,18 @@ def train_cbow_streaming(
     def _device_feed(shards: Iterator[Shard], epoch0: bool):
         """The double buffer: shard b+1's H2D upload (and on-device
         unpack) is dispatched before shard b is yielded to the SGD step,
-        so the upload hides under the step's device time."""
+        so the upload hides under the step's device time.
+
+        Yields ``(shard_index, accumulate_cb, (x, y, w))``. The epoch-0
+        byproduct accumulation is DEFERRED to the yield (the consumer
+        runs ``accumulate_cb`` right before the SGD step): the double
+        buffer reads shard b+1 before shard b trains, and an eager
+        accumulate there would make a checkpoint cut after shard b's
+        update carry shard b+1's byproducts — a cursor the resume could
+        never reproduce. Deferral keeps the H2D prefetch (the upload is
+        still dispatched early) while the host-visible state advances in
+        strict shard order.
+        """
         pending = None
         for shard in shards:
             keep = _filter_rows(shard.x, shard.y)
@@ -554,10 +725,13 @@ def train_cbow_streaming(
             fx, fy = shard.x[keep], shard.y[keep]
             tr_idx, vl_idx = _shard_split(fx.shape[0], seed, shard.index,
                                           val_fraction)
+            acc_cb = None
             if epoch0:
-                kept_rows[0] += len(keep)
-                _accumulate(fx, fy, tr_idx, vl_idx)
-            nxt = _upload(fx[tr_idx], fy[tr_idx], tr_pad)
+                def acc_cb(fx=fx, fy=fy, tr=tr_idx, vl=vl_idx, k=len(keep)):
+                    kept_rows[0] += k
+                    _accumulate(fx, fy, tr, vl)
+            nxt = (shard.index, acc_cb,
+                   _upload(fx[tr_idx], fy[tr_idx], tr_pad))
             if pending is not None:
                 yield pending
             pending = nxt
@@ -576,26 +750,145 @@ def train_cbow_streaming(
     # recovers the full-batch rule exactly.
     if patience < 1:
         raise ValueError(f"patience must be >= 1, got {patience}")
-    history: List[dict] = []
-    best_val, best_tr = -1.0, -1.0
-    best_epoch = 0
-    since_best = 0
-    snapshot = jax.tree.map(jnp.copy, params)
-    stopped_early = False
-    stop_epoch = max_epochs - 1
     val_dev = probe_dev = None
     t_phase0 = time.perf_counter()
     first_update_ms = None
+    ckpt_count = [0]
+    ckpt_wall = [0.0]
+
+    def _host_eval_cat():
+        """The eval buffers as single host array pairs (empty-safe) — the
+        exact bytes a resume needs to rebuild the epoch-boundary eval."""
+        if val_x:
+            vx, vy = np.concatenate(val_x), np.concatenate(val_y)
+        else:
+            vx, vy = (np.zeros((0, x_width), np.uint8),
+                      np.zeros((0,), np.int32))
+        if probe_x:
+            px, py = np.concatenate(probe_x), np.concatenate(probe_y)
+        else:
+            px, py = (np.zeros((0, x_width), np.uint8),
+                      np.zeros((0,), np.int32))
+        return vx, vy, px, py
+
+    def _save_ckpt(cur_epoch: int, next_shard: int, cur_losses,
+                   done: int = RUN_IN_PROGRESS) -> None:
+        """Cut the cursor at the current consistent boundary: everything
+        the loop owns, keyed to the NEXT shard to train."""
+        if not checkpoint_dir:
+            return
+        t0 = time.perf_counter()
+        leaves, _ = jax.tree_util.tree_flatten(
+            (params, opt_state, snapshot))
+        arrays = {f"leaf_{i}": np.asarray(jax.device_get(leaf))
+                  for i, leaf in enumerate(leaves)}
+        vx, vy, px, py = _host_eval_cat()
+        arrays.update(
+            good_counts=good_counts, poor_counts=poor_counts,
+            val_x=vx, val_y=vy, probe_x=px, probe_y=py,
+            history=np.array(
+                [[h["epoch"], h["acc_val"], h["acc_tr"], h["loss"],
+                  h["secs"]] for h in history],
+                np.float64).reshape(len(history), 5),
+            losses=np.asarray([float(l) for l in cur_losses], np.float64),
+            scalars=np.array(
+                [best_val, best_tr, best_epoch, since_best, kept_rows[0],
+                 eval_buffers[0], eval_buffers[1], float(stopped_early),
+                 float(stop_epoch)], np.float64))
+        cursor = {"epoch": int(cur_epoch), "shard": int(next_shard),
+                  "done": int(done), "n_shards": int(n_shards),
+                  "spool_sha": {str(k): v
+                                for k, v in dict(spool._sha).items()}}
+        path = save_stream_state(checkpoint_dir, arrays, cursor,
+                                 fingerprint=fingerprint)
+        ckpt_count[0] += 1
+        ckpt_wall[0] += time.perf_counter() - t0
+        if lifecycle is not None:
+            lifecycle("checkpointed",
+                      {"epoch": int(cur_epoch), "shard": int(next_shard),
+                       "done": int(done), "path": path})
+
+    def _checked(cur_epoch: int, next_shard: int, cur_losses) -> None:
+        """Run the cooperative-interruption hook at a consistent
+        boundary. A drain cuts a checkpoint at exactly this cursor before
+        propagating (the next run resumes here); cancel/deadline
+        propagate bare — they are terminal, there is nothing to keep."""
+        if check is None:
+            return
+        try:
+            check()
+        except DrainRequested:
+            _save_ckpt(cur_epoch, next_shard, cur_losses)
+            raise
+
+    def _build_result() -> StreamTrainResult:
+        stats.n_paths = kept_rows[0]
+        stats.epochs = len(history)
+        stats.checkpoints = ckpt_count[0]
+        stats.checkpoint_wall_s = round(ckpt_wall[0], 3)
+        gene_freq: Dict[str, int] = {}
+        for i, g in enumerate(genes):
+            fg, fp = int(good_counts[i]), int(poor_counts[i])
+            if fg == 0 and fp == 0:
+                continue
+            gene_freq[g] = 0 if fg > fp else (1 if fg < fp else 2)
+        w_ih = np.asarray(snapshot.w_ih.astype(jnp.float32)[:n_genes])
+        train = TrainResult(
+            w_ih=w_ih,
+            stop_epoch=(best_epoch if stopped_early else stop_epoch),
+            stopped_early=stopped_early,
+            acc_val=best_val, acc_tr=best_tr, history=history,
+            params=snapshot)
+        return StreamTrainResult(train=train, gene_freq=gene_freq,
+                                 n_paths=kept_rows[0], stats=stats)
+
+    if resume_done != RUN_IN_PROGRESS:
+        # The previous run FINISHED; the process died between its final
+        # checkpoint and whatever consumed the result (the serve result
+        # record, the output writer). Rebuild the result from state alone
+        # — no producer, no training, byte-identical outputs.
+        if lifecycle is not None:
+            lifecycle("resumed", {"epoch": start_epoch,
+                                  "shard": start_shard,
+                                  "done": resume_done})
+        _record_totals(epochs=0)
+        return _build_result()
+
+    if resume_cursor is not None:
+        if lifecycle is not None:
+            lifecycle("resumed", {"epoch": start_epoch,
+                                  "shard": start_shard,
+                                  "done": resume_done})
+        console(f"[stream] resuming from cursor epoch {start_epoch} "
+                f"shard {start_shard}/{n_shards}")
+        if start_epoch > 0:
+            # Epoch 0 finished, so the eval buffers are final: rebuild
+            # the device copies the epoch-boundary eval reads (bitwise
+            # the arrays the original epoch-0 pass uploaded).
+            val_dev = _upload(val_x[0], val_y[0],
+                              pad_to_multiple(eval_buffers[0],
+                                              layout.row_multiple))
+            probe_dev = _upload(probe_x[0], probe_y[0],
+                                pad_to_multiple(eval_buffers[1],
+                                                layout.row_multiple))
 
     try:
-        epoch = 0
+        epoch = start_epoch
+        since_ckpt = 0
         while epoch < max_epochs and not stopped_early:
             t_epoch = time.perf_counter()
-            losses = []
+            resumed_here = (resume_cursor is not None
+                            and epoch == start_epoch)
+            offset = start_shard if resumed_here else 0
+            losses = list(losses0) if resumed_here else []
+            _checked(epoch, offset, losses)
             feed = _device_feed(
-                _epoch0_iter() if epoch == 0 else _replay_iter(),
+                _epoch0_iter(offset) if epoch == 0 else _replay_iter(offset),
                 epoch0=(epoch == 0))
-            for x_dev, y_dev, w_dev in feed:
+            for si, acc_cb, (x_dev, y_dev, w_dev) in feed:
+                _checked(epoch, si, losses)
+                if acc_cb is not None:
+                    acc_cb()
                 params, opt_state, loss = update_fn(params, opt_state,
                                                     x_dev, y_dev, w_dev)
                 if first_update_ms is None:
@@ -604,22 +897,31 @@ def train_cbow_streaming(
                     stats.time_to_first_update_ms = round(first_update_ms, 2)
                     stats.shards_at_first_update = ring.shards_put
                 losses.append(loss)
+                since_ckpt += 1
+                if checkpoint_dir and since_ckpt >= checkpoint_every \
+                        and si + 1 < n_shards:
+                    _save_ckpt(epoch, si + 1, losses)
+                    since_ckpt = 0
             if epoch == 0:
                 if eval_buffers[0] == 0:
                     raise ValueError(
                         "streaming val buffer is empty — shards contributed "
                         "no held-out rows (raise --shard-paths or "
                         "val_fraction)")
-                val_dev = _upload(np.concatenate(val_x),
-                                  np.concatenate(val_y),
+                # Collapse the eval buffers to ONE host array pair each:
+                # the device copies feed the epoch-boundary eval; the
+                # host cats stay behind for the cursor checkpoints (a
+                # resume at epoch >= 1 re-uploads these exact bytes).
+                val_x[:], val_y[:] = ([np.concatenate(val_x)],
+                                      [np.concatenate(val_y)])
+                probe_x[:], probe_y[:] = ([np.concatenate(probe_x)],
+                                          [np.concatenate(probe_y)])
+                val_dev = _upload(val_x[0], val_y[0],
                                   pad_to_multiple(eval_buffers[0],
                                                   layout.row_multiple))
-                probe_dev = _upload(np.concatenate(probe_x),
-                                    np.concatenate(probe_y),
+                probe_dev = _upload(probe_x[0], probe_y[0],
                                     pad_to_multiple(eval_buffers[1],
                                                     layout.row_multiple))
-                val_x.clear(), val_y.clear()
-                probe_x.clear(), probe_y.clear()
             acc_val = float(eval_fn(params, *val_dev))
             acc_tr = float(eval_fn(params, *probe_dev))
             loss_mean = float(np.mean([float(l) for l in losses]))
@@ -644,7 +946,18 @@ def train_cbow_streaming(
                     stopped_early = True
                     stop_epoch = best_epoch
             epoch += 1
+            if checkpoint_dir and not stopped_early and epoch < max_epochs:
+                # Epoch-boundary cut: the cheapest resume point (no
+                # partial-epoch losses, cursor shard 0).
+                _save_ckpt(epoch, 0, [])
+                since_ckpt = 0
         stats.epochs = len(history)
+        # Terminal cut: the done code makes a post-completion relaunch
+        # (death between here and the result consumer) rebuild the result
+        # from state instead of retraining.
+        _save_ckpt(epoch, 0, [],
+                   done=(RUN_EARLY_STOPPED if stopped_early
+                         else RUN_COMPLETED))
     finally:
         ring.cancel()
         if remove_closer is not None:
@@ -656,9 +969,12 @@ def train_cbow_streaming(
                 overlap.result("stream_shards")
             except BaseException:  # noqa: BLE001 — best-effort join; the
                 pass               # real error already surfaced at get()
-        shutil.rmtree(spool_dir, ignore_errors=True)
+        if spool_is_tmp:
+            # A durable spool (checkpoint_dir) outlives the process — the
+            # replay/resume contract needs it; its owner removes it with
+            # the checkpoint directory.
+            shutil.rmtree(spool_dir, ignore_errors=True)
 
-    stats.n_paths = kept_rows[0]
     stats.shards_emitted = ring.shards_put
     stats.ring_occupancy_hw = ring.occupancy_hw
     stats.ring_peak_bytes = ring.peak_bytes
@@ -673,21 +989,8 @@ def train_cbow_streaming(
                    prefetch_wait_ms=stats.prefetch_wait_ms,
                    last_time_to_first_update_ms=(
                        stats.time_to_first_update_ms),
-                   epochs=stats.epochs)
+                   epochs=stats.epochs,
+                   checkpoints=ckpt_count[0],
+                   resumes=stats.resumed)
 
-    gene_freq: Dict[str, int] = {}
-    for i, g in enumerate(genes):
-        fg, fp = int(good_counts[i]), int(poor_counts[i])
-        if fg == 0 and fp == 0:
-            continue
-        gene_freq[g] = 0 if fg > fp else (1 if fg < fp else 2)
-
-    w_ih = np.asarray(snapshot.w_ih.astype(jnp.float32)[:n_genes])
-    train = TrainResult(
-        w_ih=w_ih, stop_epoch=(best_epoch if stopped_early
-                               else stop_epoch),
-        stopped_early=stopped_early,
-        acc_val=best_val, acc_tr=best_tr, history=history,
-        params=snapshot)
-    return StreamTrainResult(train=train, gene_freq=gene_freq,
-                             n_paths=kept_rows[0], stats=stats)
+    return _build_result()

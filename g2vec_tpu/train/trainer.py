@@ -888,6 +888,7 @@ def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
                fused_eval: bool = True, epoch_superstep: int = 1,
                donate: bool = True, kernel_autotune: bool = False,
                autotune_cache_path: Optional[str] = None,
+               check: Optional[Callable[[], None]] = None,
                ) -> TrainResult:
     """Train the modified CBOW; returns the embedding table and history.
 
@@ -1126,6 +1127,11 @@ def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
     t0 = time.time()
     step = step_start = start_epoch
     while step < max_epochs and not stopped_early:
+        # Cooperative interruption (resilience/lifecycle.py): the chunk
+        # boundary is where device state is host-consistent, so a serve
+        # cancel/deadline/drain raised here never tears a run.
+        if check is not None:
+            check()
         limit = min(chunk, max_epochs - step)
         (params, opt_state, snapshot, bv_d, bt_d, count_d, dip_d, hist_dev
          ) = chunk_fn(params, opt_state, snapshot, hist_dev, before_val,
@@ -1198,6 +1204,7 @@ def train_cbow_lanes(lanes, *, packed_genes: Optional[int] = None,
                      fused_eval: bool = True, epoch_superstep: int = 1,
                      donate: bool = True,
                      pre_compile_hook: Optional[Callable[[], None]] = None,
+                     check: Optional[Callable[[], None]] = None,
                      ):
     """Train B same-shape CBOW lanes as ONE batched device program.
 
@@ -1337,6 +1344,10 @@ def train_cbow_lanes(lanes, *, packed_genes: Optional[int] = None,
     histories: List[List[dict]] = [[] for _ in range(B)]
     t0 = time.time()
     while alive.any():
+        # Cooperative interruption at the batched chunk boundary — the
+        # same seam the solo trainer checks (resilience/lifecycle.py).
+        if check is not None:
+            check()
         limits = np.where(alive,
                           np.minimum(chunk, max_epochs - step),
                           0).astype(np.int32)

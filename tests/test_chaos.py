@@ -1,0 +1,63 @@
+"""Chaos soak harness (tools/chaos_soak.py): seeded fault storm vs the
+serve daemon with exactly-once terminal accounting.
+
+The storm itself is slow (daemon relaunches, real SIGKILLs) so the soak
+e2e is opt-in via ``-m chaos`` (also marked slow — tier-1 stays fast);
+the parser/accounting units run everywhere.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.chaos
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SOAK = os.path.join(REPO, "tools", "chaos_soak.py")
+
+
+def test_parser_env_fallbacks(monkeypatch):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import chaos_soak
+    finally:
+        sys.path.pop(0)
+    monkeypatch.setenv("G2V_CHAOS_JOBS", "7")
+    monkeypatch.setenv("G2V_CHAOS_SEED", "3")
+    opts = chaos_soak.build_parser().parse_args([])
+    assert (opts.jobs, opts.seed) == (7, 3)
+    # Explicit flags beat the env.
+    opts = chaos_soak.build_parser().parse_args(["--jobs", "2"])
+    assert opts.jobs == 2
+    assert opts.budget_s > 0 and opts.mean_arrival > 0
+
+
+@pytest.mark.slow
+def test_chaos_soak_small_storm_accounts_every_job(tmp_path):
+    """A shrunk storm (jobs, ops, budget from env) must still satisfy
+    the full acceptance: exit 0, every acknowledged job in exactly one
+    terminal state, zero lost/duplicated, drains exit 0, sampled byte
+    parity intact."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "G2V_CHAOS_JOBS": "6", "G2V_CHAOS_OPS": "3",
+           "G2V_CHAOS_EVERY": "4", "G2V_CHAOS_VERIFY": "2",
+           "G2V_CHAOS_BUDGET": "300"}
+    out = os.path.join(str(tmp_path), "summary.json")
+    proc = subprocess.run(
+        [sys.executable, SOAK, "--seed", "1", "--json", out],
+        capture_output=True, text=True, env=env, timeout=420)
+    assert proc.returncode == 0, (proc.stdout + proc.stderr)[-1200:]
+    with open(out) as f:
+        summary = json.load(f)
+    assert summary["ok"] is True
+    assert summary["accepted"] == 6
+    assert summary["lost"] == [] and summary["duplicated"] == []
+    assert summary["unsubmitted"] == 0
+    assert summary["journal_leftover"] == []
+    assert sum(summary["terminal_by_status"].values()) == 6
+    assert set(summary["terminal_by_status"]) <= {
+        "done", "cancelled", "deadline_exceeded"}
+    assert all(rc == 0 for rc in summary["drain_exit_codes"])
+    assert summary["byte_identical"] == summary["byte_checked"]

@@ -438,3 +438,363 @@ def test_bench_serve_ab_smoke():
     assert line["jobs"] == 2
     assert line["p50_latency_s"] > 0 and line["p99_latency_s"] > 0
     assert line["baseline_runs_per_hour"] > 0
+
+
+# ---------------------------------------------------------------------------
+# PR 9: job lifecycle — priority/aging, deadlines, cancel, drain, resume
+# ---------------------------------------------------------------------------
+
+import shutil as _shutil
+import threading
+
+needs_native = pytest.mark.skipif(_shutil.which("g++") is None,
+                                  reason="no C++ toolchain")
+
+
+def _stream_job(tsv_paths, tmp_path, name, **overrides):
+    return _job(tsv_paths, tmp_path, name,
+                train_mode="streaming", walker_backend="native",
+                shard_paths=16, **overrides)
+
+
+def test_priority_classes_and_aging_pop_order():
+    from g2vec_tpu.serve.daemon import ServeJob, _FairQueue
+
+    def mk(i, p):
+        return ServeJob(job_id=f"j{i}", tenant="t", cfg=None, variants=[],
+                        raw={}, submitted_at=0.0, priority=p)
+
+    q = _FairQueue(depth=8, aging_s=0.2)
+    for i, p in enumerate(("batch", "interactive", "batch", "interactive")):
+        q.push(mk(i, p))
+    assert q.depths() == {"interactive": 2, "batch": 2}
+    assert q.pop(timeout=0).job_id == "j1"      # interactive cuts the line
+    time.sleep(0.25)                            # j0/j2 age past the bound
+    q.push(mk(4, "interactive"))
+    assert q.pop(timeout=0).job_id == "j0"      # aged batch outranks
+    assert q.pop(timeout=0).job_id == "j2"      # still aged
+    assert q.pop(timeout=0).job_id == "j3"      # back to strict priority
+    assert q.pop(timeout=0).job_id == "j4"
+    assert q.remove("zz") is None
+    q.push(mk(5, "batch"))
+    assert q.remove("j5").job_id == "j5"        # targeted pull (cancel)
+    assert q.depth() == 0
+
+
+def test_submit_validation_rejects_bad_priority_and_deadline(
+        tsv_paths, tmp_path):
+    d = _daemon(tmp_path)
+    try:
+        for payload, needle in [
+            ({"priority": "urgent",
+              "job": _job(tsv_paths, tmp_path, "x")}, "priority"),
+            ({"deadline_s": -1,
+              "job": _job(tsv_paths, tmp_path, "x")}, "deadline_s"),
+            ({"deadline_s": True,
+              "job": _job(tsv_paths, tmp_path, "x")}, "deadline_s"),
+        ]:
+            rej = d.admit(payload)
+            assert rej["event"] == "rejected" and rej["error"] == "bad_job"
+            assert needle in rej["detail"], (needle, rej["detail"])
+    finally:
+        d.close()
+
+
+def test_job_lifecycle_state_machine_pinned(tsv_paths, tmp_path):
+    """Satellite pin: a completed job's job_state stream is exactly
+    queued -> started -> (checkpointed|resumed)* -> done, and /status
+    republishes the per-state counters."""
+    import re
+
+    mj = os.path.join(str(tmp_path), "lc.jsonl")
+    d = _daemon(tmp_path, metrics_jsonl=mj)
+    try:
+        ok = d.admit({"priority": "interactive",
+                      "job": _job(tsv_paths, tmp_path, "lc1")})
+        assert ok["event"] == "accepted" and ok["priority"] == "interactive"
+        assert d.step() == 1
+        st = d.status()
+        assert st["draining"] is False
+        assert st["job_states"]["queued"] == 1
+        assert st["job_states"]["started"] == 1
+        assert st["job_states"]["done"] == 1
+        assert st["queued_by_priority"] == {"interactive": 0, "batch": 0}
+        with open(mj) as f:
+            events = [json.loads(line) for line in f]
+        states = [e["state"] for e in events
+                  if e["event"] == "job_state"
+                  and e.get("job_id") == ok["job_id"]]
+        assert re.fullmatch(r"queued started ((checkpointed|resumed) )*done",
+                            " ".join(states)), states
+    finally:
+        d.close()
+
+
+@needs_native
+def test_streaming_serve_job_checkpoints_and_cleans_cursor(
+        tsv_paths, tmp_path):
+    """A streaming job under the daemon checkpoints its cursor beneath
+    <state-dir>/ckpt/<job_id>.<variant> while running and removes it at
+    the terminal state (a finished job must never leave a cursor)."""
+    mj = os.path.join(str(tmp_path), "sc.jsonl")
+    d = _daemon(tmp_path, metrics_jsonl=mj)
+    try:
+        ok = d.admit({"job": _stream_job(tsv_paths, tmp_path, "sj",
+                                         epoch=6, checkpoint_every=1)})
+        assert ok["event"] == "accepted"
+        assert d.step() == 1
+        rec = _result(d, ok["job_id"])
+        assert rec["status"] == "done"
+        with open(mj) as f:
+            events = [json.loads(line) for line in f]
+        states = [e["state"] for e in events
+                  if e["event"] == "job_state"
+                  and e.get("job_id") == ok["job_id"]]
+        assert "checkpointed" in states
+        assert states[0] == "queued" and states[-1] == "done"
+        ckpt_root = os.path.join(d.opts.state_dir, "ckpt")
+        leftovers = [p for p in (os.listdir(ckpt_root)
+                                 if os.path.isdir(ckpt_root) else [])
+                     if p.startswith(ok["job_id"])]
+        assert leftovers == [], leftovers
+    finally:
+        d.close()
+
+
+def test_cancel_queued_job_is_immediate(tsv_paths, tmp_path):
+    d = _daemon(tmp_path)
+    try:
+        ok = d.admit({"job": _job(tsv_paths, tmp_path, "cq")})
+        resp = d.cancel_job(ok["job_id"])
+        assert resp["event"] == "cancelled" and resp["where"] == "queued"
+        rec = _result(d, ok["job_id"])
+        assert rec["status"] == "cancelled"
+        assert d._queue.depth() == 0
+        assert os.listdir(os.path.join(d.opts.state_dir, "jobs")) == []
+        assert d.cancel_job("nope")["event"] == "error"
+    finally:
+        d.close()
+
+
+def test_cancel_running_job_is_cooperative(tsv_paths, tmp_path):
+    """Cancel lands while the batch executes; the trainers' check hook
+    raises JobCancelled at the next boundary; the record is terminal
+    ``cancelled`` and the daemon keeps serving."""
+    d = _daemon(tmp_path)
+    try:
+        # Cold first batch: seconds of walk + compile run before the first
+        # trainer boundary, so a cancel set as soon as the job is running
+        # is guaranteed to precede the first check() call.
+        ok = d.admit({"job": _job(tsv_paths, tmp_path, "cr")})
+        got = {}
+
+        def _cancel():
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                with d._lock:
+                    running = ok["job_id"] in d._running
+                if running:
+                    got["resp"] = d.cancel_job(ok["job_id"])
+                    return
+                time.sleep(0.02)
+
+        t = threading.Thread(target=_cancel)
+        t.start()
+        done = d.step()
+        t.join(timeout=30)
+        assert got["resp"]["event"] == "cancelling", got
+        assert done == 0
+        rec = _result(d, ok["job_id"])
+        assert rec["status"] == "cancelled"
+        # The daemon is still alive and serving.
+        ok2 = d.admit({"job": _job(tsv_paths, tmp_path, "cr2", epoch=6)})
+        assert d.step() == 1
+        assert _result(d, ok2["job_id"])["status"] == "done"
+    finally:
+        d.close()
+
+
+def test_deadline_exceeded_while_queued(tsv_paths, tmp_path):
+    d = _daemon(tmp_path)
+    try:
+        ok = d.admit({"deadline_s": 0.15,
+                      "job": _job(tsv_paths, tmp_path, "dq")})
+        time.sleep(0.3)
+        assert d.step(timeout=0.1) == 0          # expired before execution
+        rec = _result(d, ok["job_id"])
+        assert rec["status"] == "deadline_exceeded"
+        st = d.status()
+        assert st["job_states"]["deadline_exceeded"] == 1
+    finally:
+        d.close()
+
+
+def test_client_retry_backoff_and_structured_timeouts(tmp_path):
+    """Satellite: submit_and_wait retries connect failures with backoff +
+    jitter and every timeout path raises ServeTimeout naming the job."""
+    import random
+
+    from g2vec_tpu.serve import client
+
+    missing = os.path.join(str(tmp_path), "nope.sock")
+    t0 = time.time()
+    with pytest.raises(client.ServeTimeout, match="4 attempt"):
+        client.submit_and_wait(missing, {"x": 1}, retries=3,
+                               backoff=0.01, jitter=0.01,
+                               rng=random.Random(7))
+    assert time.time() - t0 < 5                  # bounded, no hang
+    with pytest.raises(client.ServeTimeout, match="job jX") as ei:
+        client.poll_result(str(tmp_path), "jX", deadline_s=0.2,
+                           interval=0.05)
+    assert ei.value.job_id == "jX"
+    assert isinstance(ei.value, TimeoutError)    # still catchable as stdlib
+
+
+@needs_native
+def test_graceful_drain_sigterm_checkpoints_and_resumes(tsv_paths, tmp_path):
+    """Acceptance drill: SIGTERM with an in-flight streaming job and a
+    queued full-batch job -> daemon exits 0 within the drain deadline,
+    the streaming cursor is on disk, both jobs stay journaled; a restart
+    re-queues both and completes them (streaming resumed, zero re-walks)."""
+    from g2vec_tpu.serve import client
+
+    mj = os.path.join(str(tmp_path), "drain.jsonl")
+    proc, sock, state, env = _spawn_daemon(
+        tmp_path, tsv_paths, extra=("--metrics-jsonl", mj))
+    holder = {}
+
+    def _submit(key, job):
+        try:
+            holder[key] = client.submit_job(sock, job, timeout=600)
+        except client.ServeConnectionLost as e:
+            holder[key + "_lost"] = e.job_id
+
+    try:
+        assert client.wait_ready(sock, 120), "daemon never became ready"
+        job_a = _stream_job(tsv_paths, tmp_path, "drainA", epoch=60,
+                            stream_patience=60, checkpoint_every=1)
+        job_b = {**_job(tsv_paths, tmp_path, "drainB"), "epoch": 6}
+        ta = threading.Thread(target=_submit, args=("a", job_a))
+        ta.start()
+        deadline = time.time() + 180
+        st = {"running": []}
+        while time.time() < deadline and not st["running"]:
+            try:
+                st = client.status(sock)
+            except OSError:
+                pass
+            time.sleep(0.1)
+        assert st["running"], "streaming job never started"
+        tb = threading.Thread(target=_submit, args=("b", job_b))
+        tb.start()
+        deadline = time.time() + 60
+        while time.time() < deadline and st["queued"] == 0:
+            st = client.status(sock)
+            time.sleep(0.05)
+        assert st["queued"] == 1, "full-batch job never queued"
+
+        os.kill(proc.pid, signal.SIGTERM)
+        assert proc.wait(timeout=180) == 0        # graceful exit code
+        ta.join(timeout=30)
+        tb.join(timeout=30)
+        a_id = (holder["a"][0]["job_id"] if "a" in holder
+                else holder["a_lost"])
+        b_id = (holder["b"][0]["job_id"] if "b" in holder
+                else holder["b_lost"])
+        assert a_id and b_id
+        journaled = set(os.listdir(os.path.join(state, "jobs")))
+        assert journaled == {f"{a_id}.json", f"{b_id}.json"}, journaled
+
+        # Restart on the same state dir: journal re-queues, streaming
+        # resumes from its cursor, both jobs reach done.
+        proc2, sock, state, env = _spawn_daemon(
+            tmp_path, tsv_paths, extra=("--metrics-jsonl", mj))
+        try:
+            rec_a = client.poll_result(state, a_id, deadline_s=420)
+            rec_b = client.poll_result(state, b_id, deadline_s=420)
+            assert rec_a["status"] == "done" and rec_b["status"] == "done"
+            with open(mj) as f:
+                events = [json.loads(line) for line in f]
+            a_states = [e["state"] for e in events
+                        if e.get("event") == "job_state"
+                        and e.get("job_id") == a_id]
+            assert "drained" in a_states and "resumed" in a_states
+            assert a_states[-1] == "done"
+            streams = [e for e in events if e.get("event") == "stream"
+                       and e.get("job_id") == a_id]
+            assert streams and streams[-1]["resumed"] == 1
+            assert streams[-1]["rewalks"] == 0     # no re-walk after resume
+            client.shutdown(sock)
+            assert proc2.wait(timeout=120) == 0
+        finally:
+            if proc2.poll() is None:
+                proc2.kill()
+                proc2.wait()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
+@needs_native
+def test_streaming_serve_sigkill_resumes_mid_epoch_byte_identical(
+        tsv_paths, tmp_path):
+    """THE acceptance drill: a streaming serve job SIGKILLed at the
+    stream_ckpt seam (mid-epoch, right after a cursor checkpoint
+    finalizes) -> supervisor relaunches -> journal re-queues -> the job
+    resumes from the cursor and completes with outputs byte-identical to
+    the same config run solo, uninterrupted."""
+    from g2vec_tpu.serve import client
+
+    mj = os.path.join(str(tmp_path), "kk.jsonl")
+    proc, sock, state, env = _spawn_daemon(
+        tmp_path, tsv_paths,
+        extra=("--supervise", "--supervise-backoff", "0.1",
+               "--fault-plan", "stage=stream_ckpt,kind=sigkill,epoch=1",
+               "--metrics-jsonl", mj))
+    try:
+        assert client.wait_ready(sock, 120), "daemon never became ready"
+        job = _stream_job(tsv_paths, tmp_path, "kk", epoch=12,
+                          checkpoint_every=1)
+        with pytest.raises(client.ServeConnectionLost) as ei:
+            client.submit_job(sock, job, timeout=600)
+        job_id = ei.value.job_id
+        assert job_id, "job died before acknowledgement"
+        rec = client.poll_result(state, job_id, deadline_s=420)
+        assert rec["status"] == "done"
+        outs = rec["variants"]["v"]["outputs"]
+        assert outs and all(os.path.exists(p) for p in outs)
+
+        with open(mj) as f:
+            events = [json.loads(line) for line in f]
+        states = [e["state"] for e in events
+                  if e.get("event") == "job_state"
+                  and e.get("job_id") == job_id]
+        assert "checkpointed" in states        # cursor written pre-kill
+        assert "resumed" in states             # picked up after relaunch
+        assert states[-1] == "done"
+
+        # Byte parity: the resumed served outputs == the solo twin's.
+        from g2vec_tpu.batch.engine import _variant_from_dict, lane_config
+        from g2vec_tpu.config import config_from_job
+        from g2vec_tpu.pipeline import run as solo_run
+
+        cfg = config_from_job(
+            {**job, "result_name": os.path.join(str(tmp_path), "out",
+                                                "kksolo")})
+        v = _variant_from_dict(0, {"name": "v"}, cfg)
+        sr = solo_run(lane_config(cfg, v), console=lambda s: None)
+        for fa, fb in zip(sorted(outs), sorted(sr.output_files)):
+            with open(fa, "rb") as x, open(fb, "rb") as y:
+                assert x.read() == y.read(), f"{fa} differs from {fb}"
+
+        client.shutdown(sock)
+        assert proc.wait(timeout=120) == 0
+    finally:
+        if proc.poll() is None:
+            try:
+                os.kill(proc.pid, signal.SIGTERM)
+            except OSError:
+                pass
+            proc.kill()
+            proc.wait()

@@ -363,10 +363,14 @@ def test_streaming_config_validation(stream_tsv):
         return c
 
     cfg()                                            # baseline valid
+    cfg(checkpoint_dir="/tmp/ck")                    # durable cursor (PR 9)
+    cfg(checkpoint_dir="/tmp/ck", resume=True)
     with pytest.raises(ValueError, match="streaming"):
         cfg(mesh_shape=(2, 1))
-    with pytest.raises(ValueError, match="streaming"):
-        cfg(checkpoint_dir="/tmp/ck")
+    with pytest.raises(ValueError, match="checkpoint-dir"):
+        cfg(resume=True)                             # cursor needs a home
+    with pytest.raises(ValueError, match="single"):
+        cfg(checkpoint_dir="/tmp/ck", checkpoint_layout="sharded")
     with pytest.raises(ValueError, match="cannot stream"):
         cfg(walker_backend="device")
     with pytest.raises(ValueError, match="shard_paths"):
@@ -456,3 +460,102 @@ def test_engine_streaming_lanes_and_status(stream_tsv, tmp_path):
                for e in stream_events)
     assert status["stream"]["runs"] >= 2             # /status currency
     assert status["stream"]["shards_emitted"] > 0
+
+
+# ---------------------------------------------------------------------------
+# 6. Durable checkpoint/resume (PR 9): mid-epoch cursor, byte-identical
+# ---------------------------------------------------------------------------
+
+def test_spool_write_error_is_structured(tmp_path, monkeypatch):
+    """ENOSPC / short-write during shard spooling surfaces as
+    SpoolWriteError naming the shard and path — a clean job failure, not
+    a half-written spool file silently poisoning the epoch-1 replay."""
+    import errno
+
+    from g2vec_tpu.train import stream as st
+
+    arr = np.arange(65536, dtype=np.uint32).reshape(1024, 64)
+    dest = str(tmp_path / "shard_000.npy")
+
+    def boom(path, a):
+        raise OSError(errno.ENOSPC, "No space left on device")
+
+    monkeypatch.setattr(st.np, "save", boom)
+    with pytest.raises(st.SpoolWriteError, match="shard 3") as ei:
+        st._spool_write(3, dest, arr)
+    assert ei.value.errno == errno.ENOSPC
+    assert not os.path.exists(dest)          # no poisoned partial file
+    monkeypatch.undo()
+
+    real_save = np.save
+
+    def short(path, a):
+        real_save(path, a[: len(a) // 2])    # silent truncation
+
+    monkeypatch.setattr(st.np, "save", short)
+    with pytest.raises(st.SpoolWriteError, match="short write"):
+        st._spool_write(0, dest, arr)
+
+
+@needs_native
+def test_stream_checkpoint_resume_byte_identical(stream_tsv, tmp_path):
+    """The tentpole drill, in-process: a streaming run dies at the
+    stream_ckpt seam mid-run; a --resume run picks the cursor up from
+    the durable spool and finishes with outputs BYTE-IDENTICAL to an
+    uninterrupted run — and a second --resume is a completed-run no-op
+    that rewrites the same bytes."""
+    from g2vec_tpu.resilience.faults import InjectedFault, _reset_for_tests
+
+    _reset_for_tests()
+    clean = _run(_cfg(stream_tsv, str(tmp_path / "clean"), epoch=6,
+                      shard_paths=32, stream_patience=6))
+    clean_bytes = _read_outputs(clean)
+
+    ck = str(tmp_path / "ck")
+    cfg_kw = dict(epoch=6, shard_paths=32, stream_patience=6,
+                  checkpoint_dir=ck, checkpoint_every=1)
+    with pytest.raises(InjectedFault):
+        _run(_cfg(stream_tsv, str(tmp_path / "dur"),
+                  fault_plan="stage=stream_ckpt,kind=crash,epoch=1",
+                  **cfg_kw))
+    _reset_for_tests()
+    assert os.path.exists(os.path.join(ck, "stream_state.npz"))
+
+    resumed = _run(_cfg(stream_tsv, str(tmp_path / "dur"),
+                        resume=True, **cfg_kw))
+    assert resumed.stream_stats["resumed"] == 1
+    assert resumed.stream_stats["checkpoints"] > 0
+    assert _read_outputs(resumed) == clean_bytes
+
+    again = _run(_cfg(stream_tsv, str(tmp_path / "dur"),
+                      resume=True, **cfg_kw))
+    assert again.stream_stats["resumed"] == 1        # done short-circuit:
+    assert again.stream_stats["shards_emitted"] == 0  # no training, no walks
+    assert again.stream_stats["checkpoints"] == 0
+    assert _read_outputs(again) == clean_bytes
+    _reset_for_tests()
+
+
+@needs_native
+def test_stream_resume_from_every_epoch_boundary(stream_tsv, tmp_path):
+    """Whichever epoch the death lands in, resume converges to the same
+    bytes (the cursor is (epoch, shard), not just epoch)."""
+    from g2vec_tpu.resilience.faults import InjectedFault, _reset_for_tests
+
+    _reset_for_tests()
+    clean = _run(_cfg(stream_tsv, str(tmp_path / "clean"), epoch=5,
+                      shard_paths=32, stream_patience=6))
+    clean_bytes = _read_outputs(clean)
+    for ep in (0, 2):
+        ck = str(tmp_path / f"ck{ep}")
+        out = str(tmp_path / f"dur{ep}")
+        kw = dict(epoch=5, shard_paths=32, stream_patience=6,
+                  checkpoint_dir=ck, checkpoint_every=2)
+        with pytest.raises(InjectedFault):
+            _run(_cfg(stream_tsv, out,
+                      fault_plan=f"stage=stream_ckpt,kind=crash,epoch={ep}",
+                      **kw))
+        _reset_for_tests()
+        resumed = _run(_cfg(stream_tsv, out, resume=True, **kw))
+        assert _read_outputs(resumed) == clean_bytes, ep
+    _reset_for_tests()

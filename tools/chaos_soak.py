@@ -1,0 +1,525 @@
+#!/usr/bin/env python3
+"""Chaos soak: a seeded fault storm against the serve daemon, with
+exactly-once accounting.
+
+The harness is the supervisor: it launches ``g2vec serve`` as a child
+(UNsupervised, so drain exit codes are observable), drives a seeded
+Poisson schedule of job arrivals (a mix of full-batch and streaming
+jobs, tenants, priorities, some with tight deadlines), and injects a
+seeded rotation of faults while the jobs run:
+
+- ``sigkill``  — SIGKILL the daemon mid-whatever; relaunch immediately.
+- ``drain``    — SIGTERM; the daemon must exit 0 with in-flight
+  streaming jobs checkpointed and everything unfinished journaled.
+- ``fault:*``  — drain, then relaunch with a ``--fault-plan`` armed at a
+  durable seam (``stream_ckpt``/``train`` sigkill, ``drain`` crash) and
+  a fresh ``G2VEC_FAULT_STATE`` file so each injection fires once.
+- ``cancel``   — client-cancel a random not-yet-terminal job.
+
+After the storm a clean daemon quiesces the backlog. The soak PASSES
+iff every acknowledged job reaches exactly one well-defined terminal
+state (done / cancelled / deadline_exceeded — ``failed`` counts but is
+reported separately), zero jobs are lost (acknowledged but never
+recorded) or duplicated (more than one terminal job_state event in the
+daemon-lifetime metrics JSONL), the journal is empty, and a sample of
+completed jobs is byte-identical to solo uninterrupted runs of the same
+configs.
+
+Scale knobs are flags with G2V_CHAOS_* env fallbacks so CI can shrink
+the soak (``G2V_CHAOS_JOBS=6 python tools/chaos_soak.py``). The
+committed artifact (BENCH_CHAOS_SOAK.json) is written by
+``bench.py --_chaos_soak``, which wraps this module.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+TERMINAL_STATES = ("done", "failed", "cancelled", "deadline_exceeded")
+
+
+def _env_int(name: str, default: int) -> int:
+    return int(os.environ.get(name, str(default)))
+
+
+def _env_float(name: str, default: float) -> float:
+    return float(os.environ.get(name, str(default)))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="chaos_soak",
+        description="Seeded fault storm against g2vec serve with "
+                    "exactly-once job accounting.")
+    p.add_argument("--jobs", type=int,
+                   default=_env_int("G2V_CHAOS_JOBS", 50))
+    p.add_argument("--seed", type=int,
+                   default=_env_int("G2V_CHAOS_SEED", 0))
+    p.add_argument("--epochs", type=int,
+                   default=_env_int("G2V_CHAOS_EPOCHS", 8),
+                   help="Base epoch count per job (jittered per job).")
+    p.add_argument("--mean-arrival", type=float,
+                   default=_env_float("G2V_CHAOS_ARRIVAL", 0.4),
+                   help="Mean exponential interarrival seconds.")
+    p.add_argument("--chaos-ops", type=int,
+                   default=_env_int("G2V_CHAOS_OPS", 0),
+                   help="Fault injections over the soak (0 = jobs//8, "
+                        "min 3).")
+    p.add_argument("--chaos-every", type=float,
+                   default=_env_float("G2V_CHAOS_EVERY", 7.0),
+                   help="Mean seconds between fault injections.")
+    p.add_argument("--stream-frac", type=float,
+                   default=_env_float("G2V_CHAOS_STREAM_FRAC", 0.4),
+                   help="Fraction of streaming jobs (needs g++; 0 if "
+                        "no native toolchain).")
+    p.add_argument("--verify", type=int,
+                   default=_env_int("G2V_CHAOS_VERIFY", 4),
+                   help="Completed jobs to byte-compare against solo "
+                        "uninterrupted twins.")
+    p.add_argument("--budget-s", type=float,
+                   default=_env_float("G2V_CHAOS_BUDGET", 900.0),
+                   help="Hard wall-clock budget for the whole soak.")
+    p.add_argument("--workdir", type=str, default=None,
+                   help="Working directory (default: a fresh tempdir, "
+                        "removed unless --keep).")
+    p.add_argument("--keep", action="store_true",
+                   help="Keep the workdir (logs, metrics, outputs).")
+    p.add_argument("--json", type=str, default=None, metavar="PATH",
+                   help="Also write the summary JSON here.")
+    return p
+
+
+class Soak:
+    def __init__(self, opts, workdir: str):
+        self.opts = opts
+        self.wd = workdir
+        self.rng = random.Random(opts.seed)
+        self.sock = os.path.join(workdir, "chaos.sock")
+        self.state = os.path.join(workdir, "state")
+        self.metrics_path = os.path.join(workdir, "metrics.jsonl")
+        self.log_path = os.path.join(workdir, "daemon.log")
+        self.proc: Optional[subprocess.Popen] = None
+        self.env = {**os.environ, "JAX_PLATFORMS": "cpu",
+                    "PYTHONPATH": REPO + os.pathsep
+                    + os.environ.get("PYTHONPATH", "")}
+        self.lock = threading.Lock()
+        self.acks: Dict[str, dict] = {}      # job_id -> {"k", "job"}
+        self.rejected: List[int] = []
+        self.unsubmitted: List[int] = []
+        self.recoveries: List[float] = []
+        self.kills = 0
+        self.drains = 0
+        self.drain_rcs: List[int] = []
+        self.fault_injections: List[str] = []
+        self.cancels_sent = 0
+        self.notes: List[str] = []
+        self._fault_serial = 0
+        self.t0 = time.time()
+
+    def note(self, msg: str) -> None:
+        line = f"[{time.time() - self.t0:7.1f}s] {msg}"
+        self.notes.append(line)
+        print(f"# {line}", file=sys.stderr, flush=True)
+
+    # ---- daemon lifecycle ------------------------------------------------
+
+    def launch(self, fault_plan: Optional[str] = None) -> None:
+        from g2vec_tpu.serve import client
+
+        env = dict(self.env)
+        if fault_plan:
+            self._fault_serial += 1
+            env["G2VEC_FAULT_STATE"] = os.path.join(
+                self.wd, f"fault-state-{self._fault_serial}.json")
+        argv = [sys.executable, "-m", "g2vec_tpu", "serve",
+                "--socket", self.sock, "--state-dir", self.state,
+                "--platform", "cpu",
+                "--cache-dir", os.path.join(self.wd, "cache"),
+                "--queue-depth", "64", "--max-join", "6",
+                "--metrics-jsonl", self.metrics_path]
+        if fault_plan:
+            argv += ["--fault-plan", fault_plan]
+        log = open(self.log_path, "a")
+        self.proc = subprocess.Popen(argv, env=env, stdout=log,
+                                     stderr=subprocess.STDOUT)
+        log.close()
+        if not client.wait_ready(self.sock, 120):
+            raise RuntimeError(
+                f"daemon never became ready (log: {self.log_path})")
+
+    def relaunch_after_death(self, why: str) -> None:
+        t_down = time.time()
+        self.launch()
+        self.recoveries.append(time.time() - t_down)
+        self.note(f"relaunched after {why} "
+                  f"(ready in {self.recoveries[-1]:.1f}s)")
+
+    # ---- job construction ------------------------------------------------
+
+    def make_job(self, k: int, paths: dict, native_ok: bool) -> dict:
+        rng = random.Random((self.opts.seed << 16) ^ k)
+        job = dict(
+            expression_file=paths["expression"],
+            clinical_file=paths["clinical"],
+            network_file=paths["network"],
+            result_name=os.path.join(self.wd, "out", f"job{k}"),
+            lenPath=8, numRepetition=2, sizeHiddenlayer=16,
+            epoch=self.opts.epochs + rng.choice((0, 2, 4)),
+            learningRate=0.05, numBiomarker=5, compute_dtype="float32",
+            seed=0, train_seed=k, kmeans_seed=k)
+        if native_ok and rng.random() < self.opts.stream_frac:
+            job.update(train_mode="streaming", walker_backend="native",
+                       shard_paths=16, checkpoint_every=1)
+        else:
+            job["walker_backend"] = "device"
+        return job
+
+    def submit_one(self, k: int, job: dict) -> None:
+        """Submit until acknowledged (or rejected); backoff with jitter
+        across daemon deaths. Terminal accounting happens from durable
+        records, not from this stream."""
+        from g2vec_tpu.serve import client
+
+        rng = random.Random((self.opts.seed << 20) ^ k)
+        priority = "interactive" if rng.random() < 0.3 else "batch"
+        deadline_s = (round(rng.uniform(2.0, 8.0), 2)
+                      if rng.random() < 0.15 else None)
+        for attempt in range(12):
+            try:
+                evs = client.submit_job(
+                    self.sock, job, tenant=f"t{k % 3}", timeout=600,
+                    priority=priority, deadline_s=deadline_s)
+                if evs and evs[-1].get("event") == "rejected":
+                    with self.lock:
+                        self.rejected.append(k)
+                    return
+                jid = evs[0].get("job_id") if evs else None
+                if jid:
+                    with self.lock:
+                        self.acks[jid] = {"k": k, "job": job,
+                                          "deadline_s": deadline_s}
+                    return
+                break
+            except client.ServeConnectionLost as e:
+                if e.job_id:     # acknowledged; journaled; never resubmit
+                    with self.lock:
+                        self.acks[e.job_id] = {"k": k, "job": job,
+                                               "deadline_s": deadline_s}
+                    return
+            except (client.ServeTimeout, OSError):
+                pass
+            time.sleep(min(5.0, 0.2 * (2 ** attempt))
+                       + rng.uniform(0.0, 0.25))
+        with self.lock:
+            self.unsubmitted.append(k)
+
+    # ---- chaos ops -------------------------------------------------------
+
+    def op_sigkill(self) -> None:
+        self.kills += 1
+        self.note(f"chaos: SIGKILL daemon (kill #{self.kills})")
+        try:
+            os.kill(self.proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        self.proc.wait()
+        self.relaunch_after_death("SIGKILL")
+
+    def op_drain(self, relaunch_plan: Optional[str] = None) -> None:
+        self.drains += 1
+        self.note(f"chaos: SIGTERM drain (drain #{self.drains}"
+                  + (f", relaunch armed: {relaunch_plan}"
+                     if relaunch_plan else "") + ")")
+        try:
+            os.kill(self.proc.pid, signal.SIGTERM)
+        except OSError:
+            pass
+        try:
+            rc = self.proc.wait(timeout=300)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            rc = -9
+        self.drain_rcs.append(rc)
+        t_down = time.time()
+        self.launch(fault_plan=relaunch_plan)
+        self.recoveries.append(time.time() - t_down)
+        if relaunch_plan:
+            self.fault_injections.append(relaunch_plan)
+
+    def op_cancel(self) -> None:
+        from g2vec_tpu.serve import client
+
+        with self.lock:
+            pending = [jid for jid in self.acks
+                       if not os.path.exists(os.path.join(
+                           self.state, "results", f"{jid}.json"))]
+        if not pending:
+            return
+        jid = self.rng.choice(pending)
+        self.cancels_sent += 1
+        self.note(f"chaos: cancel {jid}")
+        try:
+            client.cancel(self.sock, jid)
+        except (OSError, client.ServeConnectionLost):
+            pass
+
+    def run_chaos_op(self, op: str) -> None:
+        if op == "sigkill":
+            self.op_sigkill()
+        elif op == "drain":
+            self.op_drain()
+        elif op == "fault_stream_ckpt":
+            self.op_drain("stage=stream_ckpt,kind=sigkill")
+        elif op == "fault_train":
+            self.op_drain("stage=train,kind=sigkill")
+        elif op == "fault_drain_seam":
+            # Arm a crash INSIDE _begin_drain, then drain: the drain
+            # thread dies at the seam but admission is already closed
+            # and the stop flag still falls — the exit must stay clean.
+            self.op_drain("stage=drain,kind=crash")
+            self.op_drain()
+        elif op == "cancel":
+            self.op_cancel()
+
+    # ---- accounting ------------------------------------------------------
+
+    def results(self) -> Dict[str, dict]:
+        out = {}
+        rdir = os.path.join(self.state, "results")
+        if not os.path.isdir(rdir):
+            return out
+        for fn in os.listdir(rdir):
+            if fn.endswith(".json"):
+                try:
+                    with open(os.path.join(rdir, fn)) as f:
+                        out[fn[:-5]] = json.load(f)
+                except (OSError, ValueError):
+                    pass
+        return out
+
+    def journal_ids(self) -> List[str]:
+        jdir = os.path.join(self.state, "jobs")
+        if not os.path.isdir(jdir):
+            return []
+        return [fn[:-5] for fn in os.listdir(jdir)
+                if fn.endswith(".json")]
+
+    def terminal_event_counts(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        try:
+            with open(self.metrics_path) as f:
+                for line in f:
+                    try:
+                        ev = json.loads(line)
+                    except ValueError:
+                        continue
+                    if ev.get("event") == "job_state" \
+                            and ev.get("state") in TERMINAL_STATES:
+                        jid = ev.get("job_id")
+                        counts[jid] = counts.get(jid, 0) + 1
+        except OSError:
+            pass
+        return counts
+
+
+def _percentile(vals: List[float], q: float) -> float:
+    if not vals:
+        return 0.0
+    s = sorted(vals)
+    return round(s[min(len(s) - 1, int(round(q * (len(s) - 1))))], 3)
+
+
+def run_soak(opts, workdir: str) -> dict:
+    from g2vec_tpu.data.synthetic import SyntheticSpec, write_synthetic_tsv
+    from g2vec_tpu.serve import client
+
+    soak = Soak(opts, workdir)
+    native_ok = bool(shutil.which("g++")) and opts.stream_frac > 0
+    spec = SyntheticSpec(n_good=24, n_poor=20, module_size=12,
+                         n_background=24, n_expr_only=4, n_net_only=4,
+                         module_chords=2, background_edges=40, seed=7)
+    paths = write_synthetic_tsv(spec, os.path.join(workdir, "data"))
+    os.makedirs(os.path.join(workdir, "out"), exist_ok=True)
+
+    n = opts.jobs
+    n_ops = opts.chaos_ops or max(3, n // 8)
+    rng = soak.rng
+    arrivals, t = [], 0.0
+    for _ in range(n):
+        arrivals.append(t)
+        t += rng.expovariate(1.0 / opts.mean_arrival)
+    op_pool = ["sigkill", "drain", "cancel", "fault_train"]
+    if native_ok:
+        op_pool += ["fault_stream_ckpt", "fault_drain_seam"]
+    ops = [op_pool[i % len(op_pool)] for i in range(n_ops)]
+    rng.shuffle(ops)
+
+    soak.note(f"soak: {n} jobs (stream_frac="
+              f"{opts.stream_frac if native_ok else 0}), "
+              f"{n_ops} chaos ops {ops}, seed {opts.seed}")
+    soak.launch()
+
+    threads: List[threading.Thread] = []
+
+    def arrival_loop():
+        t0 = time.time()
+        jobs = [soak.make_job(k, paths, native_ok) for k in range(n)]
+        for k in range(n):
+            now = time.time() - t0
+            if now < arrivals[k]:
+                time.sleep(arrivals[k] - now)
+            th = threading.Thread(target=soak.submit_one,
+                                  args=(k, jobs[k]), daemon=True)
+            th.start()
+            threads.append(th)
+
+    arr = threading.Thread(target=arrival_loop, daemon=True)
+    arr.start()
+
+    deadline = soak.t0 + opts.budget_s
+    next_chaos = time.time() + rng.uniform(1.0, opts.chaos_every)
+    budget_blown = False
+    while True:
+        if time.time() > deadline:
+            budget_blown = True
+            soak.note("BUDGET BLOWN — abandoning the storm")
+            break
+        if soak.proc.poll() is not None:
+            # Died on its own: an armed fault plan fired.
+            soak.relaunch_after_death(
+                f"self-death rc={soak.proc.returncode}")
+        if ops and time.time() >= next_chaos:
+            soak.run_chaos_op(ops.pop(0))
+            next_chaos = time.time() + rng.uniform(
+                0.5 * opts.chaos_every, 1.5 * opts.chaos_every)
+        if not ops and not arr.is_alive() \
+                and all(not th.is_alive() for th in threads):
+            with soak.lock:
+                acked = set(soak.acks)
+            if acked and acked <= set(soak.results()) \
+                    and not soak.journal_ids():
+                break
+        time.sleep(0.25)
+
+    # Quiesce: a clean daemon finishes whatever the storm left behind.
+    arr.join(timeout=60)
+    for th in threads:
+        th.join(timeout=120)
+    while not budget_blown and time.time() < deadline:
+        if soak.proc.poll() is not None:
+            soak.relaunch_after_death(
+                f"self-death rc={soak.proc.returncode}")
+        with soak.lock:
+            acked = set(soak.acks)
+        if acked <= set(soak.results()) and not soak.journal_ids():
+            break
+        time.sleep(0.5)
+    try:
+        client.shutdown(soak.sock)
+        soak.proc.wait(timeout=120)
+    except (OSError, client.ServeConnectionLost,
+            subprocess.TimeoutExpired):
+        soak.proc.kill()
+        soak.proc.wait()
+
+    # ---- accounting ------------------------------------------------------
+    results = soak.results()
+    with soak.lock:
+        acks = dict(soak.acks)
+    lost = sorted(jid for jid in acks if jid not in results)
+    term_counts = soak.terminal_event_counts()
+    duplicated = sorted(jid for jid, c in term_counts.items() if c > 1)
+    by_status: Dict[str, int] = {}
+    for jid in acks:
+        st = results.get(jid, {}).get("status", "LOST")
+        by_status[st] = by_status.get(st, 0) + 1
+
+    # ---- byte parity on a sample of completed jobs -----------------------
+    done_ids = [jid for jid in acks
+                if results.get(jid, {}).get("status") == "done"]
+    sample = sorted(done_ids)[:max(0, opts.verify)]
+    byte_checked, byte_identical, mismatches = 0, 0, []
+    if sample:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from g2vec_tpu.batch.engine import _variant_from_dict, lane_config
+        from g2vec_tpu.config import config_from_job
+        from g2vec_tpu.pipeline import run as solo_run
+
+        for jid in sample:
+            k = acks[jid]["k"]
+            job = acks[jid]["job"]
+            cfg = config_from_job(
+                {**job, "result_name": os.path.join(workdir, "out",
+                                                    f"solo{k}")})
+            v = _variant_from_dict(0, {"name": "v"}, cfg)
+            sres = solo_run(lane_config(cfg, v), console=lambda s: None)
+            outs = results[jid]["variants"]["v"]["outputs"]
+            byte_checked += 1
+            same = True
+            for fa, fb in zip(sorted(outs), sorted(sres.output_files)):
+                with open(fa, "rb") as a, open(fb, "rb") as b:
+                    if a.read() != b.read():
+                        same = False
+                        mismatches.append(f"{jid}: {fa} != {fb}")
+            byte_identical += int(same)
+            soak.note(f"parity {jid} (job{k}): "
+                      f"{'identical' if same else 'MISMATCH'}")
+
+    ok = (not budget_blown and not lost and not duplicated
+          and not soak.unsubmitted and not soak.journal_ids()
+          and by_status.get("failed", 0) == 0
+          and byte_identical == byte_checked
+          and all(rc == 0 for rc in soak.drain_rcs))
+    return {
+        "ok": ok, "seed": opts.seed, "jobs": n,
+        "accepted": len(acks), "rejected": len(soak.rejected),
+        "unsubmitted": len(soak.unsubmitted),
+        "terminal_by_status": by_status,
+        "lost": lost, "duplicated": duplicated,
+        "journal_leftover": soak.journal_ids(),
+        "kills": soak.kills, "drains": soak.drains,
+        "drain_exit_codes": soak.drain_rcs,
+        "fault_injections": soak.fault_injections,
+        "cancels_sent": soak.cancels_sent,
+        "recover_p50_s": _percentile(soak.recoveries, 0.5),
+        "recover_p99_s": _percentile(soak.recoveries, 0.99),
+        "recoveries": len(soak.recoveries),
+        "byte_checked": byte_checked, "byte_identical": byte_identical,
+        "mismatches": mismatches,
+        "budget_blown": budget_blown,
+        "wall_s": round(time.time() - soak.t0, 1),
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    opts = build_parser().parse_args(argv)
+    workdir = opts.workdir or tempfile.mkdtemp(prefix="g2vec-chaos-")
+    os.makedirs(workdir, exist_ok=True)
+    try:
+        summary = run_soak(opts, workdir)
+    finally:
+        if not opts.keep and not opts.workdir:
+            shutil.rmtree(workdir, ignore_errors=True)
+    print(json.dumps(summary, indent=1), flush=True)
+    if opts.json:
+        with open(opts.json, "w") as f:
+            json.dump(summary, f, indent=1)
+    return 0 if summary["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -45,6 +45,20 @@ while [ "$arms" -lt "$MAX_ARMS" ] && [ "$(date +%s)" -lt "$DEADLINE" ]; do
     else
         echo "[watch_loop] fault/fleet matrix green (arm $arms)"
     fi
+    # Chaos soak (every 3rd arm): the randomized fault storm against the
+    # serve daemon — SIGKILL / drain / armed seams / cancels under
+    # Poisson arrivals — shrunk to stay inside an arm's budget. The
+    # acceptance is exactly-once accounting, so any red here is a real
+    # durability regression. Non-fatal like the matrix above.
+    if [ $((arms % 3)) -eq 1 ]; then
+        if ! JAX_PLATFORMS=cpu G2V_CHAOS_JOBS=10 G2V_CHAOS_BUDGET=420 \
+                "$PY" -m pytest tests/test_chaos.py -q -m chaos \
+                -p no:cacheprovider >/tmp/chaos_arm$arms.log 2>&1; then
+            echo "[watch_loop] WARNING: chaos soak FAILED on arm $arms (log: /tmp/chaos_arm$arms.log)"
+        else
+            echo "[watch_loop] chaos soak green (arm $arms)"
+        fi
+    fi
     left_h=$("$PY" -c "import sys,time;print(max(0.1,(float(sys.argv[1])-time.time())/3600))" "$DEADLINE")
     WATCHER_MAX_HOURS="$left_h" "$PY" tools/chip_watcher.py
     if "$PY" tools/chip_watcher.py --check-complete; then
