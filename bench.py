@@ -173,6 +173,21 @@ CHAOS_SEED = int(os.environ.get("G2VEC_BENCH_CHAOS_SEED", "0"))
 CHAOS_BUDGET = float(os.environ.get("G2VEC_BENCH_CHAOS_BUDGET", "900"))
 CHAOS_ARTIFACT = "BENCH_CHAOS_SOAK.json"
 
+# Million-node shard-scale sweep (parallel/shard.py + train/shard.py):
+# "genes:ranks" cells, run as real multi-process fleets of
+# tests/shard_worker.py over the KV transport. The diagonal (constant
+# genes/ranks) is the claim: per-rank peak RSS stays ~flat while the
+# graph grows with the rank count. Env-shrinkable for smoke tests.
+SHARD_SCALE_GRID = os.environ.get(
+    "G2VEC_BENCH_SHARD_GRID",
+    "262144:1,262144:2,524288:2,524288:4,1048576:4,1048576:1")
+SHARD_SCALE_HIDDEN = int(os.environ.get("G2VEC_BENCH_SHARD_HIDDEN", "128"))
+SHARD_SCALE_STARTS = int(os.environ.get("G2VEC_BENCH_SHARD_STARTS", "2048"))
+SHARD_SCALE_CELL_TIMEOUT = int(os.environ.get(
+    "G2VEC_BENCH_SHARD_CELL_TIMEOUT", "2400"))
+SHARD_SCALE_RSS_FLAT = 1.3     # diagonal max/min per-rank peak RSS bound
+SHARD_SCALE_ARTIFACT = "BENCH_SHARD_SCALE.json"
+
 # Peak bf16 matmul throughput per chip, for the MFU estimate.
 _PEAK_FLOPS = {"v4": 275e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12}
 # HBM bandwidth per chip (bytes/s): the roofline's other axis. This
@@ -1420,6 +1435,236 @@ def _chaos_soak() -> None:
         sys.exit(1)
 
 
+def _shard_scale_line(note) -> dict:
+    """Million-node shard-scale sweep — ROADMAP item 2's headline.
+
+    For each ``genes:ranks`` cell of ``SHARD_SCALE_GRID``: stream the
+    scale-free synthetic to disk (data/synth.write_synth_graph_streamed,
+    never materializing the graph), then run a REAL ``ranks``-process
+    fleet of tests/shard_worker.py — sharded walk sampling over the
+    chunked KV transport, the split [G/R, H] trainer, partitioned
+    k-means/t-scores — and record every rank's own peak RSS (ru_maxrss).
+
+    1-rank cells route through the EXACT unsharded code paths (the
+    byte-identity contract), so they double as the measured unsharded
+    anchors — what one host actually pays at that scale, process
+    overhead and transients included, not just the analytic table
+    bytes.
+
+    Three claims measured on the spot:
+
+    (a) **Flat diagonal**: across MULTI-RANK cells with equal
+        genes/ranks the per-rank peak RSS must stay within
+        ``SHARD_SCALE_RSS_FLAT`` — a graph R x larger at R x ranks
+        costs each rank ~the same memory.
+    (b) **Fit vs the unsharded run**: at the largest scale, every
+        sharded rank's peak RSS sits below the MEASURED single-host
+        unsharded run's peak at the same scale (and is compared to the
+        analytic unsharded trainer-state bytes, 4 x [G, H] f32, for
+        reference).
+    (c) **1-rank byte identity**: at the smallest scale, the sharded
+        single-rank cell's output files are byte-identical to a plain
+        unsharded streaming run (the tests/test_shard.py contract,
+        re-verified at bench scale).
+
+    No jax in THIS process — every measurement runs in worker children.
+    """
+    import shutil
+    import socket
+    import tempfile
+
+    from g2vec_tpu.data.synth import (SynthGraphSpec,
+                                      write_synth_graph_streamed)
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    worker = os.path.join(repo, "tests", "shard_worker.py")
+    grid = [(int(g), int(r)) for g, r in
+            (cell.split(":") for cell in SHARD_SCALE_GRID.split(","))]
+    hidden = SHARD_SCALE_HIDDEN
+
+    def rank_env(port: int, process_id: int, n_ranks: int) -> dict:
+        drop = ("PALLAS_AXON", "AXON_", "TPU_", "JAX_", "XLA_", "LIBTPU",
+                "PJRT_")
+        env = {k: v for k, v in os.environ.items()
+               if not k.startswith(drop)}
+        parts = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                 if p and "axon" not in p.lower()]
+        env["PYTHONPATH"] = os.pathsep.join([repo] + parts)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["G2VEC_COORDINATOR"] = f"127.0.0.1:{port}"
+        env["G2VEC_PROCESS_ID"] = str(process_id)
+        env["G2VEC_NUM_PROCESSES"] = str(n_ranks)
+        return env
+
+    def launch(td: str, cfg: dict, n_ranks: int) -> list:
+        cfg_path = os.path.join(td, f"cfg{n_ranks}.json")
+        with open(cfg_path, "w") as f:
+            json.dump(cfg, f)
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        procs = [subprocess.Popen(
+            [sys.executable, worker, cfg_path],
+            env=rank_env(port, i, n_ranks), cwd=repo,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+            for i in range(n_ranks)]
+        parsed = []
+        try:
+            for i, p in enumerate(procs):
+                stdout, stderr = p.communicate(
+                    timeout=SHARD_SCALE_CELL_TIMEOUT)
+                if p.returncode != 0:
+                    raise RuntimeError(
+                        f"shard-scale rank {i}/{n_ranks} rc="
+                        f"{p.returncode}: {stderr[-400:]}")
+                parsed.append(json.loads(
+                    stdout.strip().splitlines()[-1]))
+        finally:
+            for q in procs:             # a dead sibling must not wedge
+                if q.poll() is None:
+                    q.kill()
+        return parsed
+
+    def cell_cfg(paths: dict, out: str, n_ranks: int) -> dict:
+        cfg = dict(
+            expression_file=paths["expression"],
+            clinical_file=paths["clinical"],
+            network_file=paths["network"], result_name=out,
+            lenPath=12, numRepetition=2, sizeHiddenlayer=hidden,
+            epoch=2, numBiomarker=10, seed=11, compute_dtype="float32",
+            walker_backend="native", train_mode="streaming",
+            stream_patience=2, shard_paths=256,
+            walk_starts=SHARD_SCALE_STARTS, stream_eval_rows=512,
+            graph_shards=max(n_ranks, 1), embed_shards=max(n_ranks, 1))
+        if n_ranks > 1:
+            cfg.update(distributed=True,
+                       fleet_watchdog_deadline=float(
+                           SHARD_SCALE_CELL_TIMEOUT))
+        return cfg
+
+    def read_outputs(result_name: str) -> dict:
+        out = {}
+        for suffix in ("_biomarkers.txt", "_lgroups.txt", "_vectors.txt"):
+            with open(result_name + suffix, "rb") as f:
+                out[suffix] = f.read()
+        return out
+
+    cells = []
+    byte_identical = None
+    with tempfile.TemporaryDirectory() as td:
+        data = {}
+        for n_genes in sorted({g for g, _ in grid}):
+            t0 = time.time()
+            spec = SynthGraphSpec(n_genes=n_genes, n_good=8, n_poor=8,
+                                  seed=5)
+            data[n_genes] = write_synth_graph_streamed(
+                spec, os.path.join(td, f"g{n_genes}"))
+            note(f"shard-scale data: {n_genes} genes, "
+                 f"{data[n_genes]['n_edges']} edges streamed to disk in "
+                 f"{time.time() - t0:.1f}s")
+        for n_genes, n_ranks in grid:
+            out = os.path.join(td, f"c{n_genes}x{n_ranks}", "RES")
+            os.makedirs(os.path.dirname(out), exist_ok=True)
+            t0 = time.time()
+            parsed = launch(td, cell_cfg(data[n_genes], out, n_ranks),
+                            n_ranks)
+            wall = time.time() - t0
+            rss_mb = [p["rss_kb"] // 1024 for p in parsed]
+            cells.append({
+                "n_genes": n_genes, "n_ranks": n_ranks,
+                "wall_s": round(wall, 1),
+                "per_rank_peak_rss_mb": rss_mb,
+                "max_rank_rss_mb": max(rss_mb),
+                "acc_val": round(parsed[0]["acc_val"], 4),
+                "n_paths": parsed[0]["n_paths"]})
+            note(f"shard-scale cell {n_genes}x{n_ranks}: {wall:.1f}s, "
+                 f"per-rank peak RSS {rss_mb} MB, "
+                 f"acc {parsed[0]['acc_val']:.3f}, "
+                 f"{parsed[0]['n_paths']} paths")
+            if n_ranks == 1 and byte_identical is None:
+                # (c): plain unsharded twin at the same scale.
+                ref = os.path.join(td, f"ref{n_genes}", "RES")
+                os.makedirs(os.path.dirname(ref), exist_ok=True)
+                cfg = cell_cfg(data[n_genes], ref, 1)
+                cfg.update(graph_shards=0, embed_shards=0)
+                launch(td, cfg, 1)
+                byte_identical = read_outputs(out) == read_outputs(ref)
+                note(f"shard-scale 1-rank byte identity at {n_genes} "
+                     f"genes: {byte_identical}")
+        shutil.rmtree(td, ignore_errors=True)
+
+    # (a) the diagonal: equal genes-per-rank MULTI-RANK cells must cost
+    # ~equal per-rank RSS (1-rank cells are the unsharded anchors and
+    # have a structurally different profile — full-width buffers).
+    sharded = [c for c in cells if c["n_ranks"] > 1]
+    anchors = {c["n_genes"]: c for c in cells if c["n_ranks"] == 1}
+    diagonals = {}
+    for c in sharded:
+        diagonals.setdefault(c["n_genes"] // c["n_ranks"], []).append(c)
+    diag_detail = []
+    flat_ratio = 1.0
+    for key in sorted(diagonals):
+        group = sorted(diagonals[key], key=lambda c: c["n_genes"])
+        if len(group) < 2:
+            continue
+        rss = [c["max_rank_rss_mb"] for c in group]
+        ratio = round(max(rss) / max(min(rss), 1), 3)
+        flat_ratio = max(flat_ratio, ratio)
+        diag_detail.append({
+            "genes_per_rank": key,
+            "cells": [f"{c['n_genes']}x{c['n_ranks']}" for c in group],
+            "max_rank_rss_mb": rss, "ratio": ratio})
+    # (b) the largest sharded cell vs the MEASURED unsharded run at the
+    # same scale (plus the analytic trainer-state bytes for reference).
+    big = max(sharded, key=lambda c: c["n_genes"])
+    anchor = anchors.get(big["n_genes"])
+    unsharded_run_mb = anchor["max_rank_rss_mb"] if anchor else None
+    unsharded_state_mb = 4 * big["n_genes"] * hidden * 4 // (1024 * 1024)
+    return {
+        "metric": "shard_scale_per_rank_peak_rss_mb",
+        "value": big["max_rank_rss_mb"], "unit": "MB",
+        "vs_baseline": (round(unsharded_run_mb
+                              / max(big["max_rank_rss_mb"], 1), 2)
+                        if unsharded_run_mb else None),
+        "unsharded_run_rss_mb": unsharded_run_mb,
+        "fits_under_unsharded_run":
+            (big["max_rank_rss_mb"] < unsharded_run_mb
+             if unsharded_run_mb else None),
+        "unsharded_trainer_state_mb": unsharded_state_mb,
+        "hidden": hidden, "walk_starts": SHARD_SCALE_STARTS,
+        "cells": cells,
+        "diagonals": diag_detail,
+        "diagonal_rss_flat_ratio": flat_ratio,
+        "diagonal_flat_ok": flat_ratio <= SHARD_SCALE_RSS_FLAT,
+        "single_rank_byte_identical": byte_identical,
+        "note": "real multi-process fleets over the chunked KV transport "
+                "(sharded walks + split [G/R, H] trainer + partitioned "
+                "k-means/t-scores); vs_baseline = the MEASURED unsharded "
+                "single-host run's peak RSS at the largest scale over the "
+                "largest sharded cell's per-rank peak",
+    }
+
+
+def _shard_scale() -> None:
+    """Standalone mode: measure the shard-scale sweep and (with
+    G2VEC_BENCH_SHARD_WRITE=1) refresh the committed artifact."""
+    def note(msg):
+        print(f"# {msg}", file=sys.stderr, flush=True)
+
+    line = _shard_scale_line(note)
+    print(json.dumps(line), flush=True)
+    if os.environ.get("G2VEC_BENCH_SHARD_WRITE") == "1":
+        repo = os.path.dirname(os.path.abspath(__file__))
+        with open(os.path.join(repo, SHARD_SCALE_ARTIFACT), "w") as f:
+            json.dump({"line": line, "code_key": _current_code_key(repo),
+                       "written_by": "bench.py --_shard_scale"}, f,
+                      indent=1)
+        note(f"wrote {SHARD_SCALE_ARTIFACT}")
+    if not (line["fits_under_unsharded_run"] and line["diagonal_flat_ok"]
+            and line["single_rank_byte_identical"] is not False):
+        sys.exit(1)
+
+
 def _run_measure_child(budget: int, child_env: dict,
                        first_metric_cutoff: int,
                        cmd: "list | None" = None) -> tuple:
@@ -2356,5 +2601,7 @@ if __name__ == "__main__":
         _stream_ab()
     elif "--_chaos_soak" in sys.argv:
         _chaos_soak()
+    elif "--_shard_scale" in sys.argv:
+        _shard_scale()
     else:
         main()

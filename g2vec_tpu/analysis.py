@@ -278,6 +278,70 @@ def select_biomarkers(embeddings: np.ndarray, expr: np.ndarray,
                           num_biomarker)
 
 
+def find_lgroups_sharded(emb_local, freq_idx_local: np.ndarray, sctx, *,
+                         key, k: int = 3, compat_tiebreak: bool = False,
+                         n_init: int = 10, iters: int = 50) -> jax.Array:
+    """:func:`find_lgroups_device` over a gene-range-sharded embedding
+    (ROADMAP item 2): ``emb_local`` is this rank's ``[g_local, H]`` slice,
+    ``freq_idx_local`` the matching slice of the [G] vote vector, and
+    ``sctx`` a parallel/shard.ShardContext. Returns the LOCAL [g_local]
+    L-group assignment (the writer boundary concatenates rank slices).
+
+    Per Lloyd iteration only per-cluster sufficient statistics cross
+    ranks (ops/kmeans.kmeans_sharded); the good/poor vote reduces one
+    [3, k] tally stack, then runs the identical host arithmetic — the
+    vote, tie-breaks and the compat quirk included, so the decision is
+    replicated bit-for-bit on every rank. Single-rank callers must use
+    :func:`find_lgroups_device` instead (pipeline.py routes them there —
+    the byte-identity contract)."""
+    from g2vec_tpu.ops.kmeans import kmeans_sharded
+
+    if k < 3:
+        raise ValueError(f"find_lgroups needs k >= 3 (good/poor/other), got {k}")
+    km_idx, _, _ = kmeans_sharded(
+        emb_local, k, key, allreduce=sctx.allreduce,
+        gather=sctx.gather_concat, n_init=n_init, iters=iters)
+    counts, good, poor = _vote_counts(km_idx, jnp.asarray(freq_idx_local), k)
+    tallies = sctx.allreduce("lg_vote", np.stack(
+        [np.asarray(counts), np.asarray(good), np.asarray(poor)]))
+    good_cluster, poor_cluster = _pick_clusters(
+        tallies[0], tallies[1], tallies[2], k, compat_tiebreak)
+    return _renumber(km_idx, good_cluster, poor_cluster)
+
+
+def biomarker_scores_sharded(emb_local, expr_good_local, expr_poor_local,
+                             lgroup_local, sctx,
+                             score_mix: float = 0.5) -> jax.Array:
+    """:func:`biomarker_scores_device` over the rank's gene-range slice:
+    a LOCAL [2, g_local] score stack. d-scores are row-local and t-scores
+    column-local, so both are exact on slices; the only global quantities
+    are each group's masked extrema — reduced as two scalars per score
+    kind (min/max are order-independent, so the reduced values are
+    BITWISE the unsharded reduction's) and fed to the rescale half of
+    masked_minmax (ops/stats.masked_rescale mirrors it term for term).
+    Masked positions of the concatenated rank slices therefore carry
+    exactly the [2, G] values the unsharded call produces — sharded
+    stage 6 is numerically exact, unlike the statistically-contracted
+    trainer. ``expr_*_local`` are the expression matrices' local gene
+    COLUMNS ([samples, g_local])."""
+    from g2vec_tpu.ops.stats import masked_extrema, masked_rescale
+
+    d_local = dscores(emb_local)
+    t_local = tscores(expr_good_local, expr_poor_local)
+    rows = []
+    for group in (0, 1):
+        mask = lgroup_local == group
+        parts = []
+        for name, s in (("d", d_local), ("t", t_local)):
+            lo, hi = masked_extrema(s, mask)
+            ext = np.array([float(lo), -float(hi)])
+            ext = sctx.allreduce(f"bm_ext/{group}/{name}", ext, op="min")
+            parts.append(masked_rescale(s, jnp.float32(ext[0]),
+                                        jnp.float32(-ext[1])))
+        rows.append(score_mix * parts[0] + (1.0 - score_mix) * parts[1])
+    return jnp.stack(rows)
+
+
 def warm_lgroups_compile(n_genes: int, hidden: int, *, k: int = 3,
                          iters: int = 50, n_init: int = 10,
                          lanes: int = 0) -> bool:

@@ -135,6 +135,26 @@ class G2VecConfig:
                                      # stopping (1 = the full-batch
                                      # first-dip rule; minibatch epochs
                                      # jitter, so the default widens it)
+    graph_shards: int = 0            # million-node scale-out (parallel/
+                                     # shard.py): cut the streaming shard
+                                     # sequence into this many start-gene
+                                     # partitions; each is SAMPLED by one
+                                     # rank and exchanged to the rest over
+                                     # the chunked KV transport (0 = every
+                                     # rank samples everything)
+    embed_shards: int = 0            # split the [G, H] embedding by a
+                                     # byte-aligned gene range per rank
+                                     # (must equal the process count); the
+                                     # per-rank cap that fits graphs whose
+                                     # full table exceeds one host. 0 = off
+    walk_starts: int = 0             # cap the number of start genes per
+                                     # group (evenly spaced subset; 0 =
+                                     # every gene, the reference walk
+                                     # volume — infeasible at 1M nodes)
+    stream_eval_rows: int = 0        # streaming val/probe buffer row cap
+                                     # (0 = the 4096 default; each row is
+                                     # ceil(G/8) bytes, so big-G runs may
+                                     # need it smaller)
     donate_state: bool = True        # donate the (params, opt_state,
                                      # snapshot, history) carry to the chunk
                                      # program so Adam's fp32 read/write set
@@ -283,15 +303,23 @@ class G2VecConfig:
                     "--train-mode streaming needs the native sampler's "
                     "shard emission (walker index ranges); "
                     "--walker-backend device cannot stream")
-            for flag, name in ((self.distributed, "--distributed"),
-                               (self.fleet_size, "--fleet-size"),
-                               (self.mesh_shape, "--mesh")):
+            sharded = bool(self.graph_shards or self.embed_shards)
+            # The sharded mode (ROADMAP item 2) IS streaming x
+            # distributed: --graph-shards/--embed-shards open that gate.
+            # fleet/mesh stay closed — the sharded trainer coordinates
+            # over the KV transport, not a device mesh.
+            gates = [(self.fleet_size, "--fleet-size"),
+                     (self.mesh_shape, "--mesh")]
+            if not sharded:
+                gates.insert(0, (self.distributed, "--distributed"))
+            for flag, name in gates:
                 if flag:
                     raise ValueError(
                         f"--train-mode streaming does not compose with "
                         f"{name} yet — the streaming trainer is a "
-                        f"single-device minibatch loop (ROADMAP item 2 "
-                        f"shards it)")
+                        f"single-device minibatch loop per rank "
+                        f"(--graph-shards/--embed-shards is the "
+                        f"multi-process form)")
             if self.resume and not self.checkpoint_dir:
                 raise ValueError(
                     "--resume with --train-mode streaming needs "
@@ -300,6 +328,37 @@ class G2VecConfig:
                 raise ValueError(
                     "--train-mode streaming checkpoints use the single-file "
                     "layout only (--checkpoint-layout single)")
+        for field in ("graph_shards", "embed_shards", "walk_starts",
+                      "stream_eval_rows"):
+            if getattr(self, field) < 0:
+                raise ValueError(
+                    f"{field} must be >= 0 (0 = off/default), "
+                    f"got {getattr(self, field)}")
+        if self.graph_shards or self.embed_shards:
+            if self.train_mode != "streaming":
+                raise ValueError(
+                    "--graph-shards/--embed-shards shard the STREAMING "
+                    "trainer; add --train-mode streaming")
+            if self.checkpoint_dir or self.resume:
+                raise ValueError(
+                    "sharded streaming does not compose with "
+                    "--checkpoint-dir/--resume yet — the cursor would have "
+                    "to be a consistent distributed snapshot")
+            if self.manifest or self.batch_seeds:
+                raise ValueError(
+                    "sharded streaming does not compose with the batch "
+                    "engine (--manifest/--seeds)")
+            if self.supervise:
+                raise ValueError(
+                    "sharded streaming does not compose with --supervise "
+                    "yet — a retried rank cannot rejoin the fleet's "
+                    "collectives mid-run")
+            if self.embed_shards and self.num_processes \
+                    and self.embed_shards != self.num_processes:
+                raise ValueError(
+                    f"--embed-shards ({self.embed_shards}) must equal "
+                    f"--num-processes ({self.num_processes}): the gene "
+                    f"range is split 1:1 across ranks")
         if self.sampler_threads < 0:
             raise ValueError(
                 f"sampler_threads must be >= 0 (0 = all cores), "
@@ -397,6 +456,9 @@ SERVE_JOB_KEYS = (
     # its shard/ring geometry; the daemon still owns the device. Jobs with
     # different train_mode never _join_key-match, so a streaming job
     # cannot be folded into a full-batch bucket (serve/daemon.py).
+    # graph_shards/embed_shards/walk_starts/stream_eval_rows are
+    # deliberately ABSENT: the sharded mode spans processes — fleet
+    # topology is daemon infrastructure, not a per-job knob.
     "train_mode", "shard_paths", "prefetch_depth", "stream_patience",
     # Streaming checkpoint cadence (shards between cursor writes). The
     # daemon owns WHERE checkpoints go (its state dir); a job may only
@@ -562,6 +624,33 @@ def build_parser() -> argparse.ArgumentParser:
                              "without a strict val-ACC improvement and "
                              "return the best epoch's snapshot (default "
                              "5; 1 = the full-batch first-dip rule).")
+    parser.add_argument("--graph-shards", type=int, default=0, metavar="N",
+                        help="Scale-out: partition walk sampling into N "
+                             "start-gene ranges; each walk shard is sampled "
+                             "once by its owner rank and published to peers "
+                             "over the chunked KV transport (0 = off, every "
+                             "rank samples everything). Requires "
+                             "--train-mode streaming; multi-rank runs also "
+                             "need --distributed.")
+    parser.add_argument("--embed-shards", type=int, default=0, metavar="R",
+                        help="Scale-out: shard the [G, H] embedding table "
+                             "across R ranks by byte-aligned gene range; "
+                             "the hidden activation is allreduced once per "
+                             "step and stages 5-6 run on the local slice "
+                             "(0 = off). R must equal the process count; "
+                             "single-rank sharded runs are byte-identical "
+                             "to the unsharded path.")
+    parser.add_argument("--walk-starts", type=int, default=0, metavar="W",
+                        help="Cap the walk volume to W evenly spaced start "
+                             "genes instead of all G (0 = all genes, the "
+                             "previous behavior exactly). Million-node "
+                             "graphs need this: full walk volume scales "
+                             "with G x reps x len.")
+    parser.add_argument("--stream-eval-rows", type=int, default=0,
+                        metavar="M",
+                        help="Rows kept for the streaming val split "
+                             "(0 = auto cap). Bounds eval memory on "
+                             "million-node runs.")
     parser.add_argument("--no-fused-eval", action="store_true",
                         help="Keep the val-split eval as its own per-epoch "
                              "program instead of riding the grad pass's "
@@ -728,6 +817,10 @@ def config_from_args(argv=None) -> G2VecConfig:
         shard_paths=args.shard_paths,
         prefetch_depth=args.prefetch_depth,
         stream_patience=args.stream_patience,
+        graph_shards=args.graph_shards,
+        embed_shards=args.embed_shards,
+        walk_starts=args.walk_starts,
+        stream_eval_rows=args.stream_eval_rows,
         epoch_superstep=args.epoch_superstep,
         donate_state=not args.no_donate,
         kernel_autotune=args.kernel_autotune,

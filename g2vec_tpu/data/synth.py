@@ -53,16 +53,20 @@ class SynthGraphSpec:
         return self.n_good + self.n_poor
 
 
-def make_scale_free_edges(n_nodes: int, attach: int,
-                          rng: np.random.Generator
-                          ) -> Tuple[np.ndarray, np.ndarray]:
-    """Preferential-attachment edge list (directed as written; the
-    pipeline's graph stage treats edges per its own convention).
+def iter_scale_free_edges(n_nodes: int, attach: int,
+                          rng: np.random.Generator,
+                          chunk_edges: int = 1 << 20):
+    """Preferential-attachment edge stream: yields ``(src, dst)`` int64
+    chunks of at most ``chunk_edges`` edges each.
 
     Endpoints of every accepted edge are appended to a repeat buffer;
     sampling uniformly from the buffer IS degree-proportional sampling.
     Seeded ring over the first ``attach + 1`` nodes guarantees one
-    component.
+    component. Peak memory is the repeat buffer
+    (``2 * attach * n_nodes`` int64 — ~48 MB at a million nodes) plus
+    one chunk, never the full edge list; concatenating the chunks
+    reproduces :func:`make_scale_free_edges` exactly (same rng call
+    order).
     """
     if n_nodes < attach + 2:
         raise ValueError(
@@ -70,23 +74,40 @@ def make_scale_free_edges(n_nodes: int, attach: int,
     m = attach
     cap = 2 * m * n_nodes + 4 * (m + 1)
     rep = np.empty(cap, dtype=np.int64)
-    src: list = []
-    dst: list = []
+    buf_src = np.empty(chunk_edges, dtype=np.int64)
+    buf_dst = np.empty(chunk_edges, dtype=np.int64)
+    fill = 0
     count = 0
     for i in range(m + 1):
         j = (i + 1) % (m + 1)
-        src.append(i)
-        dst.append(j)
+        buf_src[fill] = i
+        buf_dst[fill] = j
+        fill += 1
         rep[count:count + 2] = (i, j)
         count += 2
     for v in range(m + 1, n_nodes):
         picks = np.unique(rep[rng.integers(0, count, size=m)])
         for u in picks:
-            src.append(v)
-            dst.append(int(u))
+            if fill == chunk_edges:
+                yield buf_src.copy(), buf_dst.copy()
+                fill = 0
+            buf_src[fill] = v
+            buf_dst[fill] = u
+            fill += 1
             rep[count:count + 2] = (v, int(u))
             count += 2
-    return np.asarray(src, dtype=np.int64), np.asarray(dst, dtype=np.int64)
+    if fill:
+        yield buf_src[:fill].copy(), buf_dst[:fill].copy()
+
+
+def make_scale_free_edges(n_nodes: int, attach: int,
+                          rng: np.random.Generator
+                          ) -> Tuple[np.ndarray, np.ndarray]:
+    """Materialized :func:`iter_scale_free_edges` (directed as written;
+    the pipeline's graph stage treats edges per its own convention)."""
+    chunks = list(iter_scale_free_edges(n_nodes, attach, rng))
+    return (np.concatenate([c[0] for c in chunks]),
+            np.concatenate([c[1] for c in chunks]))
 
 
 def make_synth_graph(spec: SynthGraphSpec):
@@ -154,4 +175,93 @@ def write_synth_graph(spec: SynthGraphSpec, out_dir: str,
         f.write("src\tdest\n")
         for a, b in zip(src, dst):
             f.write(f"{genes[a]}\t{genes[b]}\n")
+    return paths
+
+
+_EXPR_BLOCK = 16384   # fixed gene block => bytes independent of chunking
+
+
+def _streamed_expr_block(spec: SynthGraphSpec, labels: np.ndarray,
+                         z: np.ndarray, block: int, lo: int, hi: int
+                         ) -> np.ndarray:
+    """One ``[S, hi-lo]`` expression block of the STREAMED dataset.
+
+    Per-gene randomness comes from a child stream keyed on the block
+    index over the fixed ``_EXPR_BLOCK`` grid, so any writer chunking
+    produces the same values; the per-sample group factors ``z`` are
+    global (shared across blocks) so in-group gene-gene correlation —
+    the property the PCC threshold keys on — survives the split.
+    """
+    rng = np.random.default_rng([spec.seed, 2, block])
+    gb = hi - lo
+    S = spec.n_samples
+    act = rng.random((2, gb)) < spec.active_prob
+    sign = rng.choice(np.array([-1.0, 1.0]), size=(2, gb)).astype(np.float32)
+    expr = rng.standard_normal((S, gb)).astype(np.float32) * spec.noise
+    for gi in range(2):
+        rows = labels == gi
+        cols = act[gi]
+        expr[np.ix_(rows, cols)] += sign[gi, cols] * z[gi, rows][:, None]
+        only = act[gi] & ~act[1 - gi]
+        expr[np.ix_(rows, only)] += spec.shift
+    inactive = ~act[0] & ~act[1]
+    expr[:, inactive] += (
+        rng.standard_normal((S, int(inactive.sum()))).astype(np.float32))
+    return expr
+
+
+def write_synth_graph_streamed(spec: SynthGraphSpec, out_dir: str,
+                               prefix: str = "big",
+                               edge_chunk: int = 1 << 20) -> Dict[str, str]:
+    """:func:`write_synth_graph` at million-node scale: every stage
+    streams to disk in bounded chunks — the edge list never
+    materializes (``iter_scale_free_edges``) and expression is
+    generated per fixed ``_EXPR_BLOCK``-gene block from per-block child
+    seeds, so peak memory is O(block), not O(S x G) + O(edges).
+
+    Deterministic in ``spec`` and in ``edge_chunk``-independent bytes;
+    NOT byte-identical to :func:`write_synth_graph` (different rng
+    stream layout) — same distribution, same formats, same loaders.
+    """
+    G, S = spec.n_genes, spec.n_samples
+    if G < spec.attach + 2:
+        raise ValueError(
+            f"need at least attach+2={spec.attach + 2} genes, got {G}")
+    labels = np.array([0] * spec.n_good + [1] * spec.n_poor, dtype=np.int32)
+    samples = [f"SAMP-{i:05d}" for i in range(S)]
+    os.makedirs(out_dir, exist_ok=True)
+    paths = {
+        "expression": os.path.join(out_dir, f"{prefix}_EXPRESSION.txt"),
+        "clinical": os.path.join(out_dir, f"{prefix}_CLINICAL.txt"),
+        "network": os.path.join(out_dir, f"{prefix}_NETWORK.txt"),
+        "n_genes": str(G),
+    }
+    with open(paths["clinical"], "w") as f:
+        f.write("PATIENT_BARCODE\tLABEL\n")
+        for s, l in zip(samples, labels):
+            f.write(f"{s}\t{int(l)}\n")
+
+    z = (np.random.default_rng([spec.seed, 1])
+         .standard_normal((2, S)).astype(np.float32))
+    row_fmt = "\t%.4f" * S
+    with open(paths["expression"], "w") as f:
+        f.write("PATIENT\t" + "\t".join(samples) + "\n")
+        for lo in range(0, G, _EXPR_BLOCK):
+            hi = min(lo + _EXPR_BLOCK, G)
+            expr = _streamed_expr_block(spec, labels, z,
+                                        lo // _EXPR_BLOCK, lo, hi)
+            f.write("".join(
+                "SG%07d%s\n" % (lo + j, row_fmt % tuple(expr[:, j]))
+                for j in range(hi - lo)))
+
+    n_edges = 0
+    edge_rng = np.random.default_rng([spec.seed, 0])
+    with open(paths["network"], "w") as f:
+        f.write("src\tdest\n")
+        for src, dst in iter_scale_free_edges(G, spec.attach, edge_rng,
+                                              chunk_edges=edge_chunk):
+            f.write("".join("SG%07d\tSG%07d\n" % (a, b)
+                            for a, b in zip(src, dst)))
+            n_edges += len(src)
+    paths["n_edges"] = str(n_edges)
     return paths

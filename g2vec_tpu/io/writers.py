@@ -37,6 +37,65 @@ def write_lgroups(result_name: str, lgroup_idx: np.ndarray,
     return path
 
 
+def write_vectors_sharded(result_name: str, vectors_local: np.ndarray,
+                          genes: Sequence[str], sctx) -> str:
+    """:func:`write_vectors` for a gene-range-sharded embedding
+    (ROADMAP item 2): every rank publishes its ``[g_local, H]`` slice
+    over the explicit-key chunked transport; rank 0 streams the slices
+    into the file IN RANK ORDER — rank order IS gene order (contiguous
+    ranges), and the writer holds one slice at a time, never the [G, H]
+    table the sharding exists to avoid. The row format is
+    :func:`write_vectors`'s own, byte for byte.
+
+    COLLECTIVE over the shard context's ranks: every rank must call
+    (non-writers publish and return). ``genes`` is the FULL gene list
+    (every rank has it); the path returns on every rank.
+    """
+    import io as _io
+
+    from g2vec_tpu.parallel import hostcomm
+
+    spec = sctx.spec
+    path = result_name + "_vectors.txt"
+    vectors_local = np.asarray(vectors_local, dtype=np.float32)
+    if spec.n_ranks == 1:
+        return write_vectors(result_name, vectors_local, genes)
+    lo, hi = spec.gene_range()
+    if vectors_local.shape[0] != hi - lo:
+        raise ValueError(
+            f"write_vectors_sharded: rank {spec.rank} has "
+            f"{vectors_local.shape[0]} rows for gene range [{lo}, {hi})")
+    buf = _io.BytesIO()
+    np.save(buf, vectors_local, allow_pickle=False)
+    hostcomm.put_bytes_chunked(f"g2vec/xc/vectors/{spec.rank}",
+                               buf.getvalue())
+    if spec.rank != 0:
+        return path
+    with open(path, "w") as fout:
+        fout.write("GeneSymbol")
+        for i in range(vectors_local.shape[1]):
+            fout.write("\tV%d" % i)
+        fout.write("\n")
+        for r in range(spec.n_ranks):
+            if r == 0:
+                part = vectors_local
+            else:
+                part = np.load(_io.BytesIO(hostcomm.get_bytes_chunked(
+                    f"g2vec/xc/vectors/{r}", deadline=sctx.deadline,
+                    owner=r)), allow_pickle=False)
+            rlo, rhi = spec.gene_range(r)
+            if part.shape[0] != rhi - rlo:
+                raise ValueError(
+                    f"write_vectors_sharded: rank {r} published "
+                    f"{part.shape[0]} rows for gene range [{rlo}, {rhi})")
+            for gene, vector in zip(genes[rlo:rhi], part):
+                fout.write(gene)
+                for val in vector:
+                    fout.write("\t%.6f" % val)
+                fout.write("\n")
+    return path
+
+
 def write_vectors(result_name: str, vectors: np.ndarray,
                   genes: Sequence[str]) -> str:
     path = result_name + "_vectors.txt"

@@ -274,7 +274,8 @@ _AUTO_SHARD_PATHS = 4096
 
 def walk_shard(src: np.ndarray, dst: np.ndarray, w: np.ndarray,
                n_genes: int, plan: ShardPlan, shard: int, *, seed: int,
-               n_threads: int = 0, csr: Optional[tuple] = None) -> np.ndarray:
+               n_threads: int = 0, csr: Optional[tuple] = None,
+               starts: Optional[np.ndarray] = None) -> np.ndarray:
     """One group's rows for shard ``shard`` of ``plan`` ->
     [group_rows, ceil(G/8)] uint8 packed multi-hot rows (NOT
     deduplicated; rep-major within the shard — rep r's block holds
@@ -288,7 +289,18 @@ def walk_shard(src: np.ndarray, dst: np.ndarray, w: np.ndarray,
     emission exactly. The per-rep blocks fan out over the module's
     sampler pool (disjoint output slices, same bit-identity argument as
     walk_packed_rows' range fan-out).
+
+    ``starts`` restricts the start-gene list to an explicit subset (the
+    ``--walk-starts`` volume budget at million-node scale,
+    parallel/shard.subset_starts); the plan's ``n_starts`` must then be
+    ``len(starts)`` — walker/stream identities are indices into the
+    subset, so shard contents stay deterministic in (plan, shard, seed,
+    starts) regardless of rank ownership or thread count.
     """
+    if starts is not None and len(starts) != plan.n_starts:
+        raise ValueError(
+            f"plan.n_starts ({plan.n_starts}) must match len(starts) "
+            f"({len(starts)})")
     lo, hi = plan.start_range(shard)
     k = hi - lo
     nbytes = (n_genes + 7) // 8
@@ -298,7 +310,7 @@ def walk_shard(src: np.ndarray, dst: np.ndarray, w: np.ndarray,
     def _block(r: int):
         return walk_packed_rows(
             src, dst, w, n_genes, len_path=plan.len_path, reps=plan.reps,
-            seed=seed, walker_lo=r * plan.n_starts + lo,
+            seed=seed, starts=starts, walker_lo=r * plan.n_starts + lo,
             walker_hi=r * plan.n_starts + hi, n_threads=1, csr=csr,
             out=out[r * k:(r + 1) * k])
 

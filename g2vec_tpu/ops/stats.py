@@ -89,3 +89,37 @@ def masked_minmax(scores: jax.Array, mask: jax.Array,
 def dscores(embeddings: jax.Array) -> jax.Array:
     """Row-wise L2 norm of embedding rows (ref: G2Vec.py:96)."""
     return jnp.sqrt(jnp.sum(embeddings * embeddings, axis=1))
+
+
+# ---------------------------------------------------------------------------
+# Split masked min-max (ROADMAP item 2 — gene-range-sharded stage 6)
+# ---------------------------------------------------------------------------
+# masked_minmax factored into its two halves so a rank holding only a
+# [G/ranks] slice can compute LOCAL masked extrema, allreduce the two
+# scalars (min/max are order-independent, so the reduced values are
+# bitwise the global call's), and apply the identical rescale expression
+# locally. masked_minmax itself is golden-pinned and stays untouched;
+# these mirror its arithmetic term for term.
+
+@jax.jit
+def masked_extrema(scores: jax.Array, mask: jax.Array):
+    """(min, max) over the masked subset — +inf/-inf when the local mask
+    is empty, the identities the cross-rank min/max reduction needs."""
+    return (jnp.min(jnp.where(mask, scores, jnp.inf)),
+            jnp.max(jnp.where(mask, scores, -jnp.inf)))
+
+
+@jax.jit
+def masked_rescale(scores: jax.Array, old_min: jax.Array,
+                   old_max: jax.Array, new_min: float = 0.0,
+                   new_max: float = 1.0) -> jax.Array:
+    """:func:`masked_minmax`'s rescale half with the extrema supplied by
+    the caller. ``masked_rescale(s, *masked_extrema(s, m))`` is bitwise
+    ``masked_minmax(s, m)`` (same expression, same guard); with globally
+    reduced extrema the masked positions of every rank's slice carry
+    exactly the values the unsharded call would produce."""
+    span = old_max - old_min
+    safe = jnp.where(span > 0.0, span, 1.0)
+    return jnp.where(span > 0.0,
+                     (new_max - new_min) / safe * (scores - old_min) + new_min,
+                     jnp.full_like(scores, new_min))

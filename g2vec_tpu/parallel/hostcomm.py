@@ -193,3 +193,111 @@ def broadcast_bytes(name: str, payload: Optional[bytes], *,
 def barrier(name: str, *, deadline: Optional[float] = None) -> None:
     """All ranks rendezvous; stragglers are named on deadline expiry."""
     allgather_bytes(f"barrier/{name}", b"", deadline=deadline)
+
+
+# ---------------------------------------------------------------------------
+# Chunked explicit-key exchange — the walk-shard / gradient transport
+# ---------------------------------------------------------------------------
+#
+# Two differences from the sequence-numbered collectives above, both forced
+# by the sharded trainer (train/stream.py with a ShardContext):
+#
+# 1. **Explicit keys, no _seq.** The walk-shard exchange runs on the
+#    producer thread while the trainer thread allreduces activations on the
+#    main thread. Two threads drawing from one process-local sequence
+#    counter interleave nondeterministically, so the "same program order"
+#    contract of allgather_bytes cannot hold across threads. These helpers
+#    instead take a caller-supplied key that is already globally unique and
+#    deterministic (e.g. ``shard/{epoch}/{index}``) — blocking gets simply
+#    wait for that key, so cross-thread interleaving is harmless.
+# 2. **Chunking.** Walk-shard payloads at million-gene scale are multi-MB
+#    (rows x ceil(G/8) bytes). The KV string values are solid to multi-MB
+#    but not unbounded, and the ``*_bytes`` entry points that would lift the
+#    limit segfault in the pinned jaxlib (see the framing note above _encode
+#    — that workaround stays pinned here). Payloads are therefore split into
+#    raw chunks of at most KV_CHUNK_BYTES before the base64 framing.
+
+#: Raw payload bytes per KV value chunk. base64 expands 4/3, so the stored
+#: string stays ~2.7MB — comfortably inside the observed multi-MB envelope.
+KV_CHUNK_BYTES = 2 * 1024 * 1024
+
+
+def put_bytes_chunked(key: str, payload: bytes, *, client=None,
+                      chunk_bytes: int = KV_CHUNK_BYTES) -> int:
+    """Publish ``payload`` under ``key`` as framed chunks; returns the
+    chunk count. NOT collective — pure publish under an explicit key."""
+    if chunk_bytes <= 0:
+        raise ValueError(f"chunk_bytes must be positive, got {chunk_bytes}")
+    client = client if client is not None else kv_client()
+    if client is None:
+        raise RuntimeError(
+            f"put_bytes_chunked({key!r}) needs the coordination service; "
+            "was jax.distributed.initialize() skipped?")
+    n = max(1, -(-len(payload) // chunk_bytes))
+    for i in range(n):
+        chunk = payload[i * chunk_bytes:(i + 1) * chunk_bytes]
+        client.key_value_set(f"{key}/c{i}", _encode(chunk))
+    # Count published LAST: a reader that sees the count knows every chunk
+    # key is already present (the service orders sets from one client).
+    client.key_value_set(f"{key}/n", str(n))
+    return n
+
+
+def get_bytes_chunked(key: str, *, deadline: Optional[float] = None,
+                      client=None, owner: Optional[int] = None) -> bytes:
+    """Blocking read of a chunked payload published by
+    :func:`put_bytes_chunked`. On deadline expiry raises PeerTimeoutError
+    naming ``owner`` (when given) as the rank that never published."""
+    from g2vec_tpu.resilience import fleet
+
+    client = client if client is not None else kv_client()
+    if client is None:
+        raise RuntimeError(
+            f"get_bytes_chunked({key!r}) needs the coordination service; "
+            "was jax.distributed.initialize() skipped?")
+    budget = deadline if deadline else DEFAULT_DEADLINE_S
+    t_end = time.monotonic() + budget
+    try:
+        left_ms = max(1, int((t_end - time.monotonic()) * 1000))
+        n = int(client.blocking_key_value_get(f"{key}/n", left_ms))
+        parts = []
+        for i in range(n):
+            left_ms = max(1, int((t_end - time.monotonic()) * 1000))
+            parts.append(_decode(client.blocking_key_value_get(
+                f"{key}/c{i}", left_ms)))
+    except Exception as e:  # noqa: BLE001 — classify, don't swallow
+        if not _is_deadline_error(e):
+            raise
+        who = [] if owner is None else [owner]
+        raise fleet.PeerTimeoutError(
+            f"chunked get {key!r} exceeded its {budget:.1f}s deadline; "
+            f"missing rank(s): {who}{fleet.describe_ranks(who)}",
+            collective=key, suspects=tuple(who)) from e
+    return b"".join(parts)
+
+
+def exchange_bytes(key: str, payload: Optional[bytes], owner: int, *,
+                   deadline: Optional[float] = None,
+                   chunk_bytes: int = KV_CHUNK_BYTES) -> bytes:
+    """Rank ``owner`` publishes ``payload`` under the explicit ``key``;
+    every rank returns it. Single-process: a passthrough.
+
+    The walk-shard transport: unlike :func:`broadcast_bytes` this is safe to
+    call concurrently from multiple threads because the key carries all the
+    coordination state (callers must make keys unique and agree on the
+    owner — in the sharded trainer both derive from the shard index).
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        if payload is None:
+            raise ValueError(f"exchange {key!r}: owner payload is None")
+        return payload
+    if jax.process_index() == owner:
+        if payload is None:
+            raise ValueError(f"exchange {key!r}: owner payload is None")
+        put_bytes_chunked(f"g2vec/xc/{key}", payload,
+                          chunk_bytes=chunk_bytes)
+        return payload
+    return get_bytes_chunked(f"g2vec/xc/{key}", deadline=deadline,
+                             owner=owner)

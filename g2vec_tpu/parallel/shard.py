@@ -1,0 +1,258 @@
+"""Gene-range and walk-partition sharding for million-node graphs.
+
+ROADMAP item 2: every subsystem before this module assumes the graph's
+CSR, the walk volume, and the ``[G, H]`` embedding table fit one host.
+This module owns the *partitioning arithmetic and host collectives* that
+break that assumption; train/stream.py, ops/kmeans.py, analysis.py and
+pipeline.py consume it. Two independent axes, two flags:
+
+- ``--graph-shards N`` partitions the streaming walk-shard *sequence*
+  into N contiguous partitions; partition ``p`` is SAMPLED only by rank
+  ``p % n_ranks`` (on the PR 3 host pool) and its packed rows are
+  exchanged to the other ranks over the chunked KV transport
+  (parallel/hostcomm.exchange_bytes) — a remote rank is just another
+  shard producer feeding the PR 7 ring. Every rank still *spools* every
+  shard locally, so epoch replay and rewalk-on-corrupt stay local.
+- ``--embed-shards R`` splits the ``[G, H]`` embedding table by a
+  byte-aligned gene range per rank (R must equal the process count), so
+  a rank densifies and trains only ``[G/R, H]`` — the per-rank memory
+  cap that makes 100-1000x larger graphs fit. The softmax head ``w_ho``
+  stays replicated by determinism (every rank sees identical reduced
+  activations and applies the identical update). K-means, t-scores and
+  the min-max rescale then run over the local slice, reducing only
+  per-cluster statistics and masked extrema; full-width vectors are
+  gathered rank-by-rank at the writer boundary alone.
+
+Why byte-aligned ranges: walk rows travel and spool as np.packbits
+rows (8 genes/byte, MSB first). A rank whose gene range starts on a
+multiple of 8 can slice its columns *in packed form* —
+``rows[:, lo // 8 : (hi + 7) // 8]`` — and unpack only its own slice on
+device; the full-width multi-hot never materializes on any single rank.
+
+CPU fleets cannot compile cross-process XLA ("Multiprocess computations
+aren't implemented on the CPU backend"), so the "psum" of the sharded
+trainer is realized as a deterministic host allreduce over the KV-store
+allgather (rank-order summation — every rank reduces in the same order,
+so replicated state stays bit-identical across ranks). On backends with
+real cross-process XLA the same module works unchanged; swapping the
+transport for jit-time psums is a pure optimization left signposted.
+
+Parity contract (tests/test_shard.py): at ``n_ranks == 1`` the sharded
+mode routes through EXACTLY the unsharded code paths (the local gene
+range is the full range, the walk exchange is a passthrough) and is
+byte-identical to a run without the flags. At ``n_ranks > 1`` the
+reduction order of the hidden activations differs from the one-matmul
+unsharded program, so the contract is the PR 7 statistical one (val-ACC
+band + biomarker overlap), NOT bitwise.
+"""
+from __future__ import annotations
+
+import dataclasses
+import io
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardSpec:
+    """The pure partitioning arithmetic — unit-testable without jax.
+
+    ``embed_shards > 0`` activates gene-range splitting (and must then
+    equal ``n_ranks``); ``graph_shards > 0`` activates walk-partition
+    ownership. Either axis alone is a valid mode: graph-only shards the
+    sampling work while the model stays replicated; embed-only shards
+    the model while every rank samples everything.
+    """
+
+    rank: int
+    n_ranks: int
+    n_genes: int
+    graph_shards: int = 0
+    embed_shards: int = 0
+
+    def __post_init__(self):
+        if not (0 <= self.rank < max(1, self.n_ranks)):
+            raise ValueError(f"rank {self.rank} outside n_ranks {self.n_ranks}")
+        if self.embed_shards and self.embed_shards != self.n_ranks:
+            raise ValueError(
+                f"embed_shards ({self.embed_shards}) must equal the rank "
+                f"count ({self.n_ranks}): the gene range is split 1:1 "
+                f"across ranks")
+        if self.embed_shards > 1 and self.n_genes < 8 * self.embed_shards:
+            raise ValueError(
+                f"embed sharding needs >= 8 genes (one packed byte) per "
+                f"rank; {self.n_genes} genes across {self.embed_shards} "
+                f"ranks is too few")
+
+    # ---- embedding (gene-range) axis ----------------------------------
+    @property
+    def n_bytes(self) -> int:
+        """Packed row width: ceil(G / 8)."""
+        return (self.n_genes + 7) // 8
+
+    def byte_range(self, rank: Optional[int] = None) -> Tuple[int, int]:
+        """Rank's contiguous slice of the packed byte columns."""
+        r = self.rank if rank is None else rank
+        if not self.embed_shards or self.n_ranks == 1:
+            return (0, self.n_bytes)
+        nb, R = self.n_bytes, self.n_ranks
+        return (r * nb // R, (r + 1) * nb // R)
+
+    def gene_range(self, rank: Optional[int] = None) -> Tuple[int, int]:
+        """Rank's gene range [lo, hi) — lo is a multiple of 8 by
+        construction; hi is clipped to G on the last rank."""
+        blo, bhi = self.byte_range(rank)
+        return (blo * 8, min(bhi * 8, self.n_genes))
+
+    @property
+    def lo(self) -> int:
+        return self.gene_range()[0]
+
+    @property
+    def hi(self) -> int:
+        return self.gene_range()[1]
+
+    @property
+    def g_local(self) -> int:
+        return self.hi - self.lo
+
+    @property
+    def embed_split(self) -> bool:
+        """True when the trainer/stats must take the split-program path.
+        Single-rank "sharded" mode stays on the plain programs — the
+        byte-identity contract."""
+        return bool(self.embed_shards) and self.n_ranks > 1
+
+    def slice_packed(self, rows: np.ndarray) -> np.ndarray:
+        """Local packed byte columns of full-width rows [N, ceil(G/8)]."""
+        blo, bhi = self.byte_range()
+        return np.ascontiguousarray(rows[:, blo:bhi])
+
+    # ---- walk-partition axis ------------------------------------------
+    def shard_owner(self, si: int, n_shards: int) -> int:
+        """The rank that samples streaming shard ``si`` of ``n_shards``.
+
+        The shard sequence is cut into ``graph_shards`` contiguous
+        partitions (a partition is a start-gene range — shard indices
+        ARE start-major); partition ``p`` belongs to rank ``p % R``.
+        With graph sharding off every rank owns everything itself.
+        """
+        if not self.graph_shards or self.n_ranks == 1:
+            return self.rank
+        if not (0 <= si < n_shards):
+            raise ValueError(f"shard {si} outside [0, {n_shards})")
+        p = si * self.graph_shards // n_shards
+        return p % self.n_ranks
+
+
+class ShardContext:
+    """ShardSpec + the host collectives the sharded stages ride.
+
+    All reductions here are MAIN-THREAD collectives in program order on
+    every rank (the hostcomm sequence-number contract). The walk-shard
+    exchange — which runs on the PRODUCER thread — must NOT come through
+    here; it uses the explicit-key ``hostcomm.exchange_bytes`` transport
+    directly (see the thread-safety note in parallel/hostcomm.py).
+    """
+
+    def __init__(self, spec: ShardSpec, *, deadline: Optional[float] = None):
+        self.spec = spec
+        self.deadline = deadline
+
+    @property
+    def single(self) -> bool:
+        return self.spec.n_ranks == 1
+
+    def allreduce(self, name: str, arr: np.ndarray, op: str = "sum"
+                  ) -> np.ndarray:
+        """Deterministic allreduce of a same-shape host array.
+
+        Rank-order reduction: every rank applies the identical
+        left-to-right fold over the allgathered stack, so replicated
+        downstream state (the softmax head, k-means centers, early-stop
+        decisions) stays bit-identical across ranks.
+        """
+        arr = np.asarray(arr)
+        if self.single:
+            return arr
+        from g2vec_tpu.parallel import hostcomm
+
+        stack = hostcomm.allgather_array(name, arr, deadline=self.deadline)
+        fold = {"sum": np.add, "min": np.minimum, "max": np.maximum}[op]
+        acc = stack[0]
+        for p in range(1, stack.shape[0]):
+            acc = fold(acc, stack[p])
+        return acc
+
+    def gather_concat(self, name: str, arr: np.ndarray, axis: int = 0
+                      ) -> np.ndarray:
+        """Concatenate per-rank arrays (unequal shapes along ``axis``
+        allowed) in rank order, on every rank. The writer-boundary
+        gather for scores/labels — small [G]-shaped vectors, never the
+        [G, H] table (vectors stream rank-by-rank instead;
+        pipeline._write_vectors_sharded)."""
+        arr = np.ascontiguousarray(arr)
+        if self.single:
+            return arr
+        from g2vec_tpu.parallel import hostcomm
+
+        buf = io.BytesIO()
+        np.save(buf, arr, allow_pickle=False)
+        parts = hostcomm.allgather_bytes(name, buf.getvalue(),
+                                         deadline=self.deadline)
+        return np.concatenate(
+            [np.load(io.BytesIO(p), allow_pickle=False) for p in parts],
+            axis=axis)
+
+    def broadcast_array(self, name: str, arr: Optional[np.ndarray]
+                        ) -> np.ndarray:
+        """Rank 0's array on every rank (k-means seeding, center state)."""
+        if self.single:
+            if arr is None:
+                raise ValueError(f"broadcast {name!r}: rank-0 array is None")
+            return np.asarray(arr)
+        from g2vec_tpu.parallel import hostcomm
+
+        payload = None
+        if arr is not None:
+            buf = io.BytesIO()
+            np.save(buf, np.ascontiguousarray(arr), allow_pickle=False)
+            payload = buf.getvalue()
+        raw = hostcomm.broadcast_bytes(name, payload, deadline=self.deadline)
+        return np.load(io.BytesIO(raw), allow_pickle=False)
+
+
+def make_shard_context(graph_shards: int, embed_shards: int, n_genes: int,
+                       *, deadline: Optional[float] = None
+                       ) -> Optional[ShardContext]:
+    """The pipeline's entry point: None when both axes are off, else a
+    context bound to this process's rank. Validates the embed split
+    against the ACTUAL process count (config.py can only check flags
+    against flags)."""
+    if not graph_shards and not embed_shards:
+        return None
+    import jax
+
+    spec = ShardSpec(rank=jax.process_index(), n_ranks=jax.process_count(),
+                     n_genes=n_genes, graph_shards=graph_shards,
+                     embed_shards=embed_shards)
+    return ShardContext(spec, deadline=deadline)
+
+
+def subset_starts(n_genes: int, walk_starts: int) -> Optional[np.ndarray]:
+    """Evenly spaced start-gene subset for ``--walk-starts W`` (0/full =
+    None — the every-gene-starts reference semantics, byte-identical to
+    runs without the flag).
+
+    At million-node scale the reference's walk volume (every gene starts
+    ``reps`` times, both groups) is ~2 G x reps packed rows — hundreds of
+    GB before training sees a byte. Capping STARTS (not walk length)
+    keeps every sampled path a faithful reference walk while making
+    total volume a budget; evenly spaced over the sorted gene order so
+    coverage stays uniform across the id space.
+    """
+    if walk_starts <= 0 or walk_starts >= n_genes:
+        return None
+    idx = (np.arange(walk_starts, dtype=np.int64) * n_genes) // walk_starts
+    return np.unique(idx).astype(np.int32)

@@ -181,11 +181,13 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print,
         # cross-process shards collectively).
         from g2vec_tpu.parallel.distributed import is_coordinator
 
-        if jax.process_count() > 1 and not cfg.mesh_shape:
+        if jax.process_count() > 1 and not cfg.mesh_shape \
+                and not (cfg.graph_shards or cfg.embed_shards):
             raise ValueError(
                 f"--distributed with {jax.process_count()} processes needs "
-                "--mesh (e.g. --mesh 8x1); without it every process would "
-                "redundantly train the full model on one local device")
+                "--mesh (e.g. --mesh 8x1) or --graph-shards/--embed-shards; "
+                "without either every process would redundantly train the "
+                "full model on one local device")
         if not is_coordinator():
             console = lambda s: None  # noqa: E731
             cfg = dataclasses.replace(cfg, metrics_jsonl=None,
@@ -321,6 +323,7 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print,
                     n_genes, cfg.sizeHiddenlayer, k=cfg.n_lgroups,
                     iters=cfg.kmeans_iters), console))
         walk_cache_hits: List[str] = []
+        shard_ctx = None
         if cfg.train_mode == "streaming":
             # ---- streaming minibatch trainer: stages 3-4 merged ----
             # (train/stream.py): the sampler pool emits walk shards into
@@ -337,7 +340,24 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print,
                     "(shard emission over walker-index ranges); this host "
                     f"resolved walker_backend={walker_backend!r} — build "
                     "the C++ toolchain or use --train-mode full")
-            from g2vec_tpu.train.stream import train_cbow_streaming
+            from g2vec_tpu.parallel.shard import make_shard_context
+            from g2vec_tpu.train.stream import (EVAL_ROWS_CAP,
+                                                train_cbow_streaming)
+
+            # Million-node scale-out (ROADMAP item 2): the shard context
+            # binds this process's rank to the partitioning arithmetic;
+            # None when both --graph-shards/--embed-shards are off, and a
+            # single-rank context routes every consumer through the plain
+            # unsharded programs (byte-identity).
+            shard_ctx = make_shard_context(
+                cfg.graph_shards, cfg.embed_shards, n_genes,
+                deadline=(cfg.fleet_watchdog_deadline or None))
+            if shard_ctx is not None:
+                console(f"    [shard] rank {shard_ctx.spec.rank}/"
+                        f"{shard_ctx.spec.n_ranks}: graph_shards="
+                        f"{cfg.graph_shards} embed_shards="
+                        f"{cfg.embed_shards} gene range "
+                        f"[{shard_ctx.spec.lo}, {shard_ctx.spec.hi})")
 
             fault_point("paths")
             fleet.note_phase("paths")
@@ -385,7 +405,9 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print,
                     checkpoint_dir=cfg.checkpoint_dir, resume=cfg.resume,
                     checkpoint_every=cfg.checkpoint_every,
                     check=check, lifecycle=lifecycle,
-                    on_epoch=on_epoch, console=console)
+                    on_epoch=on_epoch, console=console,
+                    shard_ctx=shard_ctx, walk_starts=cfg.walk_starts,
+                    eval_rows_cap=(cfg.stream_eval_rows or EVAL_ROWS_CAP))
             _stage_edge("train")
             result = sres.train
             gene_freq = sres.gene_freq
@@ -627,30 +649,77 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print,
 
         import jax.numpy as jnp
 
-        if result.params is not None and not cfg.distributed:
-            emb = result.params.w_ih.astype(jnp.float32)[:n_genes]
-        else:
-            emb = result.w_ih
-        with timer.stage("lgroups"):
-            lgroup_dev = find_lgroups_device(
-                emb, freq_index(data.gene, gene_freq),
-                key=jax.random.key(cfg.kmeans_seed), k=cfg.n_lgroups,
-                compat_tiebreak=cfg.compat_lgroup_tiebreak, iters=cfg.kmeans_iters)
-        _stage_edge("lgroups")
+        embed_sharded = shard_ctx is not None and shard_ctx.spec.embed_split
+        if embed_sharded:
+            # Gene-range-sharded stages 5-6 (ROADMAP item 2): every
+            # array below is this rank's [g_local] slice; only
+            # per-cluster statistics and masked extrema cross ranks, and
+            # the full [G]-shaped score/label vectors exist only at the
+            # writer-boundary gathers. The [G, H] table never does.
+            spec = shard_ctx.spec
+            from g2vec_tpu.analysis import (biomarker_scores_sharded,
+                                            find_lgroups_sharded,
+                                            top_biomarkers)
 
-        console(">>> 6. Select biomarkers with gene scores")
-        fault_point("biomarkers")
-        fleet.note_phase("biomarkers")
-        with timer.stage("biomarkers"):
-            biomarkers, _ = select_biomarkers(
-                emb, data.expr, data.label, data.gene, lgroup_dev,
-                cfg.numBiomarker, score_mix=cfg.score_mix)
-            lgroup_idx = np.asarray(lgroup_dev)   # writer-boundary copy
-        _stage_edge("biomarkers")
+            if result.params is not None:
+                emb = result.params.w_ih.astype(jnp.float32)[:spec.g_local]
+            else:
+                emb = result.w_ih
+            with timer.stage("lgroups"):
+                lgroup_dev = find_lgroups_sharded(
+                    emb, freq_index(data.gene, gene_freq)[spec.lo:spec.hi],
+                    shard_ctx, key=jax.random.key(cfg.kmeans_seed),
+                    k=cfg.n_lgroups,
+                    compat_tiebreak=cfg.compat_lgroup_tiebreak,
+                    iters=cfg.kmeans_iters)
+            _stage_edge("lgroups")
+
+            console(">>> 6. Select biomarkers with gene scores")
+            fault_point("biomarkers")
+            fleet.note_phase("biomarkers")
+            with timer.stage("biomarkers"):
+                labels_np = np.asarray(data.label)
+                expr_local = data.expr[:, spec.lo:spec.hi]
+                scores2_local = np.asarray(biomarker_scores_sharded(
+                    emb, expr_local[labels_np == 0],
+                    expr_local[labels_np == 1], lgroup_dev, shard_ctx,
+                    score_mix=cfg.score_mix))
+                # Writer-boundary gathers: [2, G] scores + [G] L-groups
+                # (small vectors — the selection itself is the solo
+                # host logic on every rank, so the result is replicated).
+                scores2 = shard_ctx.gather_concat("bm_scores",
+                                                  scores2_local, axis=1)
+                lgroup_idx = shard_ctx.gather_concat(
+                    "lgroups", np.asarray(lgroup_dev), axis=0)
+                biomarkers, _ = top_biomarkers(scores2, lgroup_idx,
+                                               data.gene, cfg.numBiomarker)
+            _stage_edge("biomarkers")
+        else:
+            if result.params is not None and not cfg.distributed:
+                emb = result.params.w_ih.astype(jnp.float32)[:n_genes]
+            else:
+                emb = result.w_ih
+            with timer.stage("lgroups"):
+                lgroup_dev = find_lgroups_device(
+                    emb, freq_index(data.gene, gene_freq),
+                    key=jax.random.key(cfg.kmeans_seed), k=cfg.n_lgroups,
+                    compat_tiebreak=cfg.compat_lgroup_tiebreak, iters=cfg.kmeans_iters)
+            _stage_edge("lgroups")
+
+            console(">>> 6. Select biomarkers with gene scores")
+            fault_point("biomarkers")
+            fleet.note_phase("biomarkers")
+            with timer.stage("biomarkers"):
+                biomarkers, _ = select_biomarkers(
+                    emb, data.expr, data.label, data.gene, lgroup_dev,
+                    cfg.numBiomarker, score_mix=cfg.score_mix)
+                lgroup_idx = np.asarray(lgroup_dev)   # writer-boundary copy
+            _stage_edge("biomarkers")
 
         console(">>> 7. Save results")
         write_outputs = True
-        if cfg.distributed:
+        if cfg.distributed or (shard_ctx is not None
+                               and not shard_ctx.single):
             from g2vec_tpu.parallel.distributed import is_coordinator
 
             write_outputs = is_coordinator()
@@ -658,7 +727,22 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print,
         fleet.note_phase("save")
         with timer.stage("save"):
             outputs = []
-            if write_outputs:
+            if embed_sharded:
+                # The vectors write is COLLECTIVE (rank-by-rank slice
+                # publish — io/writers.py); biomarkers/lgroups are
+                # replicated and written by the coordinator alone.
+                from g2vec_tpu.io.writers import write_vectors_sharded
+
+                vec_path = write_vectors_sharded(
+                    cfg.result_name, result.w_ih, data.gene, shard_ctx)
+                if write_outputs:
+                    outputs = [
+                        write_biomarkers(cfg.result_name, biomarkers),
+                        write_lgroups(cfg.result_name, lgroup_idx,
+                                      data.gene),
+                        vec_path,
+                    ]
+            elif write_outputs:
                 outputs = [
                     write_biomarkers(cfg.result_name, biomarkers),
                     write_lgroups(cfg.result_name, lgroup_idx, data.gene),

@@ -102,7 +102,18 @@ SEAMS = ("load", "preprocess", "paths", "train", "lgroups", "biomarkers",
          # ``drain`` fires as the daemon begins a graceful drain, before
          # it checkpoints in-flight jobs (a crash there models a drain
          # that never completed — the journal must still re-queue).
-         "stream_ckpt", "drain")
+         "stream_ckpt", "drain",
+         # Sharded scale-out seams (train/stream.py with a ShardContext):
+         # ``shard_exchange`` fires on the OWNING rank right before it
+         # publishes a walk shard to its peers (epoch = shard index) — a
+         # sigkill there leaves the shard's KV keys absent forever, so the
+         # peers' chunked get times out with a PeerTimeoutError naming the
+         # dead rank (the fleet-watchdog drill). ``embed_allreduce`` fires
+         # in the trainer right before a rank contributes its partial
+         # hidden activations to the per-step allreduce (epoch = global
+         # step) — the same named-rank attribution for a death inside the
+         # model-parallel reduction.
+         "shard_exchange", "embed_allreduce")
 
 
 class FaultPlanError(ValueError):

@@ -1,0 +1,143 @@
+"""Gene-range-sharded CBOW step programs (ROADMAP item 2).
+
+The unsharded streaming step (trainer._make_stream_fns) is ONE jitted
+program: forward both matmuls, grad, Adam. That program needs the full
+``[G, H]`` embedding on one device — the exact memory cap this module
+removes. Here the step is split at the only point where cross-rank data
+flows: the hidden activations. Each rank holds the byte-aligned gene
+range ``[lo, hi)`` of ``W_ih`` (parallel/shard.ShardSpec) plus a
+REPLICATED head ``w_ho``, and one minibatch step is:
+
+1. :func:`partial_hidden` (local jit): unpack the rank's packed byte
+   columns of the shard and contract them with the local ``W_ih`` slice
+   — ``h_part = X_local @ W_ih_local`` in f32.
+2. Host allreduce-sum of ``h_part`` across ranks (the "psum"; on CPU
+   fleets it rides the KV transport — parallel/shard.ShardContext).
+   Rank-order summation makes the reduced ``h`` bit-identical on every
+   rank.
+3. :func:`head_grads` (local jit, replicated math): loss + gradients of
+   the masked BCE w.r.t. ``(w_ho, h)``. Identical inputs on every rank
+   produce identical ``dw_ho``/``dh`` — which is what keeps ``w_ho``
+   replicated with NO second collective.
+4. :func:`embed_grad` (local jit): ``dW_local = X_local^T @ dh`` — each
+   rank computes exactly its own slice's gradient; nothing to reduce.
+5. A local Adam step over ``(W_ih_local, w_ho)`` (the caller owns the
+   optax state; train/stream.py jits the apply).
+
+Dtype discipline mirrors models/cbow.py verbatim: inputs cast to the
+compute dtype, every contraction accumulates f32 via
+``preferred_element_type``, the decision threshold is applied in logit
+space. One step costs ONE collective of ``[rows, H]`` f32 — independent
+of G, the property that makes the per-rank footprint ``O(G/R * H)``.
+
+The single-rank sharded path never reaches this module (train/stream.py
+routes R == 1 through the plain programs — the byte-identity contract);
+at R > 1 the reduction order of ``h`` differs from the one-matmul
+program, so parity vs unsharded is the PR 7 statistical contract.
+"""
+from __future__ import annotations
+
+from math import sqrt
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from g2vec_tpu.models.cbow import (CBOWParams, accuracy_from_logits,
+                                   masked_bce_loss, output_logits)
+
+
+class SplitStepFns(NamedTuple):
+    partial_hidden: object   # (w_ih_local, x_packed) -> [rows, H] f32
+    head_grads: object       # (w_ho, h, y, w) -> (loss, dw_ho, dh)
+    embed_grad: object       # (x_packed, dh) -> [g_pad_local, H] f32
+    head_eval: object        # (w_ho, h, y, w) -> accuracy f32
+
+
+def _unpack_bits(packed: jax.Array, compute_dtype) -> jax.Array:
+    """[rows, nb] uint8 -> [rows, nb*8] compute-dtype multi-hot — the
+    trainer's device-side unpack (np.packbits order, MSB first) over a
+    rank's byte-column slice. Bits past the last real gene are zero in
+    the data, so the trailing pad columns contract against (and
+    gradient into) the zero pad rows of the local table — dead weight,
+    the init_params pad-row argument applied to a range slice."""
+    shifts = jnp.arange(7, -1, -1, dtype=jnp.uint8)
+    bits = (packed[:, :, None] >> shifts) & jnp.uint8(1)
+    return bits.reshape(packed.shape[0], -1).astype(compute_dtype)
+
+
+def make_split_fns(compute_dtype, decision_threshold: float) -> SplitStepFns:
+    """The four jitted halves of one sharded step (module docstring).
+    Built per run (train/stream.py holds them for the run's lifetime, so
+    the jit caches live exactly as long as they are useful)."""
+    logit_threshold = float(np.log(decision_threshold
+                                   / (1.0 - decision_threshold)))
+
+    def partial_hidden(w_ih_local, x_packed):
+        x = _unpack_bits(x_packed, compute_dtype)
+        return jax.lax.dot_general(
+            x, w_ih_local.astype(compute_dtype),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    def head_loss(w_ho, h, y, w):
+        return masked_bce_loss(output_logits(h, w_ho, compute_dtype), y, w)
+
+    def head_grads(w_ho, h, y, w):
+        loss, (dw_ho, dh) = jax.value_and_grad(
+            head_loss, argnums=(0, 1))(w_ho, h, y, w)
+        return loss, dw_ho, dh
+
+    def embed_grad(x_packed, dh):
+        x = _unpack_bits(x_packed, compute_dtype)
+        # dW_local = X_local^T @ dh, contracted over the row axis — the
+        # same cast-to-compute/accumulate-f32 recipe as the forward.
+        return jax.lax.dot_general(
+            x, dh.astype(compute_dtype),
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    def head_eval(w_ho, h, y, w):
+        return accuracy_from_logits(output_logits(h, w_ho, compute_dtype),
+                                    y, w, logit_threshold)
+
+    return SplitStepFns(partial_hidden=jax.jit(partial_hidden),
+                        head_grads=jax.jit(head_grads),
+                        embed_grad=jax.jit(embed_grad),
+                        head_eval=jax.jit(head_eval))
+
+
+def init_split_params(key, n_genes: int, hidden: int, spec,
+                      param_dtype=jnp.float32) -> CBOWParams:
+    """The rank-local twin of models/cbow.init_params: ``w_ih`` holds
+    this rank's gene range of THE SAME [G, H] truncated-normal draw the
+    unsharded init makes for this seed, padded with zero rows to the
+    byte-aligned local width; ``w_ho`` is drawn from k2 identically on
+    every rank (replicated by construction, kept replicated by the
+    deterministic reduction — module docstring).
+
+    Slice-of-the-same-draw matters: jax.random counts over the
+    flattened full shape, so per-rank keys would start every rank in an
+    UNRELATED embedding space — the sharded run would then converge to
+    embeddings uncorrelated with the unsharded run's and the biomarker-
+    overlap half of the parity contract would be vacuous. Each rank
+    therefore materializes the full [G, H] init ONCE, slices its range
+    and drops the rest — a transient (512 MB at 1M x 128), init-only,
+    and the price of keeping sharded-vs-unsharded a perturbation (the
+    reduced-h summation order) instead of a different model.
+    """
+    k1, k2 = jax.random.split(key)
+    std = 1.0 / sqrt(hidden)
+    blo, bhi = spec.byte_range()
+    g_pad_local = (bhi - blo) * 8
+    full = jax.random.truncated_normal(k1, -2.0, 2.0, (n_genes, hidden))
+    w_ih = full[spec.lo:spec.hi] * std
+    del full
+    if g_pad_local > spec.g_local:
+        w_ih = jnp.concatenate(
+            [w_ih, jnp.zeros((g_pad_local - spec.g_local, hidden),
+                             w_ih.dtype)], axis=0)
+    w_ho = jax.random.truncated_normal(k2, -2.0, 2.0, (hidden, 1)) * std
+    return CBOWParams(w_ih=w_ih.astype(param_dtype),
+                      w_ho=w_ho.astype(param_dtype))

@@ -64,6 +64,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import io
 import os
 import shutil
 import tempfile
@@ -77,6 +78,7 @@ import numpy as np
 
 from g2vec_tpu.ops.host_walker import (ShardPlan, edges_to_csr, plan_shards,
                                        walk_shard)
+from g2vec_tpu.parallel.shard import subset_starts
 from g2vec_tpu.resilience.faults import fault_point
 from g2vec_tpu.resilience.lifecycle import DrainRequested
 from g2vec_tpu.utils.integrity import sha256_file
@@ -211,6 +213,16 @@ class ShardRing:
             self._cancelled = True
             self._items.clear()
             self._cv.notify_all()
+
+    @property
+    def cancelled(self) -> bool:
+        """True once the consumer is gone. Producers blocked OUTSIDE the
+        ring (the sharded walk exchange waits on a remote rank's publish,
+        not on ``put``) poll this between bounded waits so a trainer that
+        stopped early — or died — doesn't leave them wedged on a
+        multi-day transport deadline."""
+        with self._cv:
+            return self._cancelled
 
 
 class SpoolIntegrityError(ValueError):
@@ -387,6 +399,7 @@ def train_cbow_streaming(
         lifecycle: Optional[Callable[[str, dict], None]] = None,
         on_epoch: Optional[Callable[[int, float, float, float], None]] = None,
         console: Callable[[str], None] = print,
+        shard_ctx=None, walk_starts: int = 0,
         ) -> StreamTrainResult:
     """Stream walk shards from the sampler pool straight into minibatch
     SGD; returns the trained embeddings plus the streaming twin of the
@@ -408,17 +421,37 @@ def train_cbow_streaming(
     at every shard boundary — a :class:`DrainRequested` raised there
     checkpoints the current consistent state before propagating.
     ``lifecycle(state, info)`` observes "resumed"/"checkpointed".
+
+    Scale-out (ROADMAP item 2): ``shard_ctx`` (parallel/shard.py) turns
+    on one or both sharding axes. Graph sharding makes the producer an
+    EXCHANGE: the rank owning shard ``si`` samples it and publishes the
+    packed rows over the chunked KV transport; the others receive
+    instead of sampling — then every rank spools and trains on every
+    shard, so the in-ring trajectory is bit-identical to the unsharded
+    stream (rewalk-on-corrupt stays local: the CSR is replicated and the
+    walker is rank-independent deterministic). Embed sharding swaps the
+    one-program SGD step for the split step (train/shard.py): each rank
+    uploads only its byte-aligned column slice of every shard, holds
+    ``[G/R, H]`` of the embedding, and one host allreduce of the hidden
+    activations per step keeps the replicated head in lockstep. At one
+    rank both axes route through EXACTLY the unsharded code below —
+    byte-identity, pinned by tests/test_shard.py. ``walk_starts`` caps
+    the number of start genes (parallel/shard.subset_starts; 0 = every
+    gene, the reference semantics). Sharded runs do not compose with
+    checkpoint/resume yet — the cursor would have to be a distributed
+    snapshot.
     """
     import jax
     import jax.numpy as jnp
 
-    from g2vec_tpu.models.cbow import init_params
+    from g2vec_tpu.models.cbow import CBOWParams, init_params
     from g2vec_tpu.ops import packed_matmul as pm
     from g2vec_tpu.parallel.mesh import make_mesh_context, pad_to_multiple
     from g2vec_tpu.train.checkpoint import (RUN_COMPLETED, RUN_EARLY_STOPPED,
                                             RUN_IN_PROGRESS,
                                             load_stream_state,
                                             save_stream_state)
+    from g2vec_tpu.train.shard import init_split_params, make_split_fns
     from g2vec_tpu.train.trainer import (_DTYPES, _get_stream_fns,
                                          _get_unpack_fn, _plan_layout,
                                          TrainResult)
@@ -434,7 +467,23 @@ def train_cbow_streaming(
         raise ValueError(
             f"checkpoint_every must be >= 1, got {checkpoint_every}")
 
-    plan = plan_shards(n_genes, reps, shard_paths, len_path=len_path)
+    # ---- sharding axes (docstring; parallel/shard.py) ----
+    spec = shard_ctx.spec if shard_ctx is not None else None
+    graph_multi = bool(spec and spec.graph_shards and spec.n_ranks > 1)
+    embed_multi = bool(spec and spec.embed_split)
+    if (graph_multi or embed_multi) and (checkpoint_dir or resume):
+        raise ValueError(
+            "sharded streaming (--graph-shards/--embed-shards at >1 "
+            "process) does not compose with checkpoint/resume yet — the "
+            "cursor would have to be a consistent distributed snapshot")
+    if spec is not None and spec.n_genes != n_genes:
+        raise ValueError(
+            f"shard context was built for {spec.n_genes} genes, trainer "
+            f"got {n_genes}")
+
+    starts = subset_starts(n_genes, walk_starts)
+    n_starts = n_genes if starts is None else len(starts)
+    plan = plan_shards(n_starts, reps, shard_paths, len_path=len_path)
     n_shards = plan.n_shards
     total_rows = 2 * plan.n_walkers
     stats = StreamStats(n_shards=n_shards, rows_sampled=total_rows,
@@ -452,7 +501,8 @@ def train_cbow_streaming(
         return walk_shard(np.asarray(s), np.asarray(d), np.asarray(w),
                           n_genes, plan, shard_index,
                           seed=(walk_seed << 1) | gi,
-                          n_threads=sampler_threads, csr=csr[gi])
+                          n_threads=sampler_threads, csr=csr[gi],
+                          starts=starts)
 
     def _walk_shard_rows(shard_index: int) -> np.ndarray:
         return np.concatenate([_walk_group(0, shard_index),
@@ -486,7 +536,7 @@ def train_cbow_streaming(
         "n_genes": n_genes, "len_path": len_path, "reps": reps,
         "n_shards": n_shards, "rows_per_shard": plan.rows_per_shard,
         "patience": patience, "eval_rows_cap": eval_rows_cap,
-        "max_epochs": max_epochs,
+        "max_epochs": max_epochs, "walk_starts": walk_starts,
     }
 
     # ---- resume: restore the newest verified cursor BEFORE the producer
@@ -506,11 +556,57 @@ def train_cbow_streaming(
 
     producer_wall = [0.0]
 
+    def _exchange_rows(si: int, owner: int) -> Optional[np.ndarray]:
+        """The graph-sharded producer's shard ``si``: the owner samples
+        and publishes (explicit-key chunked transport — this runs on the
+        PRODUCER thread, so the seq-numbered collectives are off limits;
+        parallel/hostcomm.py thread-safety note); the rest receive. The
+        receive polls in short slices, checking ``ring.cancelled``
+        between them, so a rank whose trainer already stopped returns
+        None instead of waiting out the transport deadline on a publish
+        that may never come."""
+        from g2vec_tpu.parallel import hostcomm
+        from g2vec_tpu.resilience.fleet import PeerTimeoutError
+
+        if owner == spec.rank:
+            rows = _walk_shard_rows(si)
+            # The dead-owner seam: sigkill here (before the publish)
+            # leaves the peers' chunked get waiting; their deadline
+            # expiry names this rank (tests/test_shard.py drill).
+            fault_point("shard_exchange", epoch=si)
+            buf = io.BytesIO()
+            np.save(buf, rows, allow_pickle=False)
+            hostcomm.exchange_bytes(f"walk/{si}", buf.getvalue(), owner)
+            return rows
+        budget = (shard_ctx.deadline if shard_ctx.deadline
+                  else hostcomm.DEFAULT_DEADLINE_S)
+        t_end = time.monotonic() + budget
+        while True:
+            left = t_end - time.monotonic()
+            if left <= 0:
+                # Re-raise the transport's own naming of the dead owner.
+                return np.load(io.BytesIO(hostcomm.exchange_bytes(
+                    f"walk/{si}", None, owner, deadline=1e-3)),
+                    allow_pickle=False)
+            try:
+                raw = hostcomm.exchange_bytes(f"walk/{si}", None, owner,
+                                              deadline=min(2.0, left))
+                return np.load(io.BytesIO(raw), allow_pickle=False)
+            except PeerTimeoutError:
+                if ring.cancelled:
+                    return None
+
     def _produce():
         t0 = time.perf_counter()
         try:
             for si in range(start_shard, n_shards):
-                shard = Shard(si, _walk_shard_rows(si), _shard_labels(si))
+                if graph_multi:
+                    rows = _exchange_rows(si, spec.shard_owner(si, n_shards))
+                    if rows is None:
+                        return      # consumer gone while waiting
+                else:
+                    rows = _walk_shard_rows(si)
+                shard = Shard(si, rows, _shard_labels(si))
                 path = spool.save(shard)
                 # The in-flight-shard seam: kind=corrupt tears the SPOOLED
                 # bytes (epoch 0 trains on the good in-memory copy; the
@@ -541,26 +637,44 @@ def train_cbow_streaming(
             producer_thread.start()
 
     # ---- device layout: the full-batch derivation, per shard ----
-    ctx = make_mesh_context(None)
     cdtype = _DTYPES[compute_dtype]
     pdtype = _DTYPES[param_dtype]
     rows_nom = plan.rows_per_shard
     tr_nom = max(1, min(int(rows_nom * (1.0 - val_fraction)), rows_nom - 1))
-    layout = _plan_layout(tr_nom, n_genes, hidden, compute_dtype, ctx,
-                          use_pallas)
-    n_genes_pad = layout.n_genes_pad
-    tr_pad = pad_to_multiple(tr_nom, layout.row_multiple)
-    unpack_fn = None if layout.use_pallas else _get_unpack_fn(ctx, cdtype)
-    update_fn, eval_fn = _get_stream_fns(
-        learning_rate, cdtype, decision_threshold,
-        packed=layout.use_pallas, interpret=layout.interpret)
+    if embed_multi:
+        # The split step (train/shard.py) unpacks the rank's byte
+        # columns inside its own jits — no mesh layout, no pallas, no
+        # full-width [G] device padding; the per-rank device arrays are
+        # [rows, nb_local] and [g_local_pad, H], never [G, ...].
+        layout = None
+        row_multiple = 8
+        blo, bhi = spec.byte_range()
+        nb_local = bhi - blo
+        split_fns = make_split_fns(cdtype, decision_threshold)
+        update_fn = eval_fn = None       # rebound to the split step below
+    else:
+        ctx = make_mesh_context(None)
+        layout = _plan_layout(tr_nom, n_genes, hidden, compute_dtype, ctx,
+                              use_pallas)
+        row_multiple = layout.row_multiple
+        n_genes_pad = layout.n_genes_pad
+        unpack_fn = None if layout.use_pallas else _get_unpack_fn(ctx, cdtype)
+        update_fn, eval_fn = _get_stream_fns(
+            learning_rate, cdtype, decision_threshold,
+            packed=layout.use_pallas, interpret=layout.interpret)
+    tr_pad = pad_to_multiple(tr_nom, row_multiple)
 
     def _pack_rows(rows_packed: np.ndarray, n_pad: int) -> np.ndarray:
         """Walker packbits rows -> the device layout, row-padded to n_pad
         (the full-batch _pack_split's per-chunk logic, one shard at a
-        time)."""
-        out = np.zeros((n_pad, n_genes_pad // 8), dtype=np.uint8)
+        time). Embed-sharded: the rank's byte-column slice, nothing
+        wider."""
         n = rows_packed.shape[0]
+        if embed_multi:
+            out = np.zeros((n_pad, nb_local), dtype=np.uint8)
+            out[:n] = rows_packed[:, blo:bhi]
+            return out
+        out = np.zeros((n_pad, n_genes_pad // 8), dtype=np.uint8)
         if not layout.use_pallas and rows_packed.shape[1] == n_genes_pad // 8:
             out[:n] = rows_packed
             return out
@@ -572,7 +686,7 @@ def train_cbow_streaming(
         return out
 
     def _put_x(packed_np: np.ndarray):
-        if layout.use_pallas:
+        if embed_multi or layout.use_pallas:
             return jnp.asarray(packed_np)
         return unpack_fn(jnp.asarray(packed_np))
 
@@ -586,11 +700,54 @@ def train_cbow_streaming(
                 jnp.asarray(w))
 
     # ---- params + optimizer (the full-batch init at this layout) ----
-    params = init_params(jax.random.key(seed), n_genes, hidden,
-                         param_dtype=pdtype, pad_to=n_genes_pad)
+    if embed_multi:
+        params = init_split_params(jax.random.key(seed), n_genes, hidden,
+                                   spec, param_dtype=pdtype)
+    else:
+        params = init_params(jax.random.key(seed), n_genes, hidden,
+                             param_dtype=pdtype, pad_to=n_genes_pad)
     tx = optax.adam(learning_rate, b1=0.9, b2=0.999, eps=1e-8)
     opt_state = tx.init(params)
     snapshot = jax.tree.map(jnp.copy, params)
+
+    if embed_multi:
+        # The sharded step: local partial activations, ONE host
+        # allreduce, replicated head math, local embedding gradient
+        # (train/shard.py module docstring). Rebinding update_fn/eval_fn
+        # keeps every downstream line of the epoch loop untouched.
+        step_count = [0]
+
+        def _apply_fn(params, opt_state, grads):
+            updates, new_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), new_state
+
+        _split_apply = jax.jit(_apply_fn, donate_argnums=(0, 1))
+
+        def _reduced_hidden(name, params, x_dev):
+            h_part = np.asarray(split_fns.partial_hidden(params.w_ih, x_dev))
+            return jnp.asarray(shard_ctx.allreduce(name, h_part))
+
+        def _split_update(params, opt_state, x_dev, y_dev, w_dev):
+            # The mid-step seam: a rank killed here leaves the others'
+            # allgather waiting; the collective watchdog names it
+            # (tests/test_shard.py drill).
+            fault_point("embed_allreduce", epoch=step_count[0])
+            step_count[0] += 1
+            h = _reduced_hidden("h_step", params, x_dev)
+            loss, dw_ho, dh = split_fns.head_grads(params.w_ho, h,
+                                                   y_dev, w_dev)
+            grads = CBOWParams(
+                w_ih=split_fns.embed_grad(x_dev, dh).astype(
+                    params.w_ih.dtype),
+                w_ho=dw_ho.astype(params.w_ho.dtype))
+            params, opt_state = _split_apply(params, opt_state, grads)
+            return params, opt_state, loss
+
+        def _split_eval(params, x_dev, y_dev, w_dev):
+            h = _reduced_hidden("h_eval", params, x_dev)
+            return split_fns.head_eval(params.w_ho, h, y_dev, w_dev)
+
+        update_fn, eval_fn = _split_update, _split_eval
     # The checkpoint treedef: (params, opt_state, snapshot) flattened in
     # deterministic order — the train/checkpoint.py convention, with the
     # fresh init as the shape/dtype template.
@@ -668,9 +825,17 @@ def train_cbow_streaming(
         losses0 = [float(x) for x in resume_arrays["losses"]]
 
     def _accumulate(x: np.ndarray, y: np.ndarray, tr_idx, vl_idx) -> None:
-        dense = np.unpackbits(x, axis=1)[:, :n_genes]
-        good_counts[:] += dense[y == 0].sum(axis=0, dtype=np.int64)
-        poor_counts[:] += dense[y == 1].sum(axis=0, dtype=np.int64)
+        # Row-chunked unpack: the [rows, G] dense transient is capped at
+        # ~32 MB regardless of G (at 1M genes a whole 4096-row shard
+        # would be 4 GB dense). int64 sums are chunking-order-
+        # independent, so the counts are bitwise those of the one-shot
+        # unpack at any G.
+        rows_chunk = max(1, (32 << 20) // max(1, n_genes))
+        for i in range(0, x.shape[0], rows_chunk):
+            dense = np.unpackbits(x[i:i + rows_chunk], axis=1)[:, :n_genes]
+            yc = y[i:i + rows_chunk]
+            good_counts[:] += dense[yc == 0].sum(axis=0, dtype=np.int64)
+            poor_counts[:] += dense[yc == 1].sum(axis=0, dtype=np.int64)
         if eval_buffers[0] < eval_rows_cap and len(vl_idx):
             take = vl_idx[:eval_rows_cap - eval_buffers[0]]
             val_x.append(x[take])
@@ -832,7 +997,12 @@ def train_cbow_streaming(
             if fg == 0 and fp == 0:
                 continue
             gene_freq[g] = 0 if fg > fp else (1 if fg < fp else 2)
-        w_ih = np.asarray(snapshot.w_ih.astype(jnp.float32)[:n_genes])
+        # Embed-sharded: the result carries THIS RANK's real gene rows
+        # only ([g_local, H]); stages 5/6 run sharded on it and the
+        # writer gathers rank-by-rank (pipeline.py). Unsharded: the full
+        # table minus layout padding, as ever.
+        w_ih = np.asarray(snapshot.w_ih.astype(jnp.float32)
+                          [:(spec.g_local if embed_multi else n_genes)])
         train = TrainResult(
             w_ih=w_ih,
             stop_epoch=(best_epoch if stopped_early else stop_epoch),
@@ -867,10 +1037,10 @@ def train_cbow_streaming(
             # the arrays the original epoch-0 pass uploaded).
             val_dev = _upload(val_x[0], val_y[0],
                               pad_to_multiple(eval_buffers[0],
-                                              layout.row_multiple))
+                                              row_multiple))
             probe_dev = _upload(probe_x[0], probe_y[0],
                                 pad_to_multiple(eval_buffers[1],
-                                                layout.row_multiple))
+                                                row_multiple))
 
     try:
         epoch = start_epoch
@@ -918,10 +1088,10 @@ def train_cbow_streaming(
                                           [np.concatenate(probe_y)])
                 val_dev = _upload(val_x[0], val_y[0],
                                   pad_to_multiple(eval_buffers[0],
-                                                  layout.row_multiple))
+                                                  row_multiple))
                 probe_dev = _upload(probe_x[0], probe_y[0],
                                     pad_to_multiple(eval_buffers[1],
-                                                    layout.row_multiple))
+                                                    row_multiple))
             acc_val = float(eval_fn(params, *val_dev))
             acc_tr = float(eval_fn(params, *probe_dev))
             loss_mean = float(np.mean([float(l) for l in losses]))

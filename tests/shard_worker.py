@@ -1,0 +1,41 @@
+"""Worker for multi-process SHARDED streaming runs (not pytest-collected).
+
+Launched R times by tests/test_shard.py and bench.py ``--_shard_scale``
+with G2VEC_COORDINATOR / G2VEC_PROCESS_ID / G2VEC_NUM_PROCESSES in the
+env — the same plumbing a real fleet launch uses. argv[1] is a JSON file
+of G2VecConfig field overrides (the input paths, --graph-shards /
+--embed-shards, the streaming knobs); the worker runs the full pipeline
+and prints ONE JSON line: val-ACC, biomarkers, output files, path count,
+and the process's peak RSS (ru_maxrss KB) — the number the scale-out
+exists to bound.
+"""
+import json
+import os
+import resource
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    with open(sys.argv[1]) as f:
+        overrides = json.load(f)
+
+    from g2vec_tpu.config import G2VecConfig
+    from g2vec_tpu.pipeline import run
+
+    cfg = G2VecConfig(**overrides)
+    res = run(cfg, console=lambda s: None)
+    print(json.dumps({
+        "process": int(os.environ.get("G2VEC_PROCESS_ID", "0")),
+        "acc_val": float(res.acc_val),
+        "biomarkers": list(res.biomarkers),
+        "n_paths": int(res.n_paths),
+        "n_genes": int(res.n_genes),
+        "output_files": list(res.output_files),
+        "rss_kb": int(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,450 @@
+"""Sharded million-node scale-out suite (parallel/shard.py, train/shard.py,
+sharded streaming stages 3-7) — tier-1.
+
+Contracts pinned here:
+
+1. **Partitioning**: byte-aligned gene ranges tile ``[0, G)`` exactly,
+   every shard has exactly one owner, ``subset_starts`` is even and
+   chunk-exact.
+2. **Chunked KV transport**: ``put/get_bytes_chunked`` round-trips at the
+   chunk-size boundaries against a fake client (the segfaulting
+   ``*_bytes`` KV entry points are documented in hostcomm.py — the
+   string-value + base64 framing workaround stays pinned).
+3. **Single-rank sharded == unsharded, BYTE-identical** — the
+   refactor-safety contract: ``--graph-shards 1 --embed-shards 1`` at one
+   process routes through the exact unsharded code paths.
+4. **Multi-rank statistical parity** (the PR 7 contract: val-ACC band +
+   biomarker overlap vs the unsharded run) on a TRUE 2-process fleet.
+5. **Fault drills**: a rank sigkilled at the ``shard_exchange`` /
+   ``embed_allreduce`` seams is NAMED by the survivor's
+   PeerTimeoutError instead of wedging the fleet.
+6. **Bounded per-rank RSS**: every sharded rank peaks well below the
+   MEASURED unsharded run at the same scale (slow — the committed
+   BENCH_SHARD_SCALE.json carries the full scaling curve).
+"""
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.shard
+
+HAVE_CXX = shutil.which("g++") is not None
+needs_native = pytest.mark.skipif(not HAVE_CXX, reason="no C++ toolchain")
+
+_WORKER = os.path.join(os.path.dirname(__file__), "shard_worker.py")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# 1. ShardSpec partitioning (no jax, no processes)
+# ---------------------------------------------------------------------------
+
+def test_shard_spec_byte_ranges_tile_exactly():
+    from g2vec_tpu.parallel.shard import ShardSpec
+
+    for n_genes, n_ranks in ((64, 2), (100, 3), (1000, 4), (9999, 7),
+                             (1 << 20, 4)):
+        nb = (n_genes + 7) // 8
+        covered = 0
+        prev_hi = 0
+        for r in range(n_ranks):
+            spec = ShardSpec(rank=r, n_ranks=n_ranks, n_genes=n_genes,
+                             embed_shards=n_ranks)
+            blo, bhi = spec.byte_range()
+            assert blo == prev_hi          # contiguous, no gaps/overlap
+            prev_hi = bhi
+            lo, hi = spec.gene_range()
+            assert lo == blo * 8 and hi == min(bhi * 8, n_genes)
+            assert spec.g_local == hi - lo
+            covered += spec.g_local
+        assert prev_hi == nb
+        assert covered == n_genes          # gene ranges tile [0, G)
+
+
+def test_shard_spec_slice_and_single_rank_passthrough():
+    from g2vec_tpu.parallel.shard import ShardSpec
+
+    rows = np.arange(3 * 13, dtype=np.uint8).reshape(3, 13)
+    spec = ShardSpec(rank=1, n_ranks=2, n_genes=100, embed_shards=2)
+    blo, bhi = spec.byte_range()
+    np.testing.assert_array_equal(spec.slice_packed(rows),
+                                  rows[:, blo:bhi])
+    # Sharding off / one rank: the full range, always.
+    off = ShardSpec(rank=0, n_ranks=1, n_genes=100, graph_shards=1,
+                    embed_shards=1)
+    assert off.byte_range() == (0, 13)
+    assert off.gene_range() == (0, 100)
+    assert not off.embed_split              # 1 rank => unsharded code paths
+
+
+def test_shard_owner_covers_every_shard_once():
+    from g2vec_tpu.parallel.shard import ShardSpec
+
+    n_shards = 23
+    for n_ranks, graph_shards in ((2, 2), (3, 5), (4, 4)):
+        specs = [ShardSpec(rank=r, n_ranks=n_ranks, n_genes=512,
+                           graph_shards=graph_shards)
+                 for r in range(n_ranks)]
+        for si in range(n_shards):
+            owners = {s.shard_owner(si, n_shards) for s in specs}
+            assert len(owners) == 1         # every rank agrees
+            assert 0 <= owners.pop() < n_ranks
+        owned = [sum(1 for si in range(n_shards)
+                     if specs[r].shard_owner(si, n_shards) == r)
+                 for r in range(n_ranks)]
+        assert all(c > 0 for c in owned)    # work for every rank
+
+
+def test_subset_starts_even_and_exact():
+    from g2vec_tpu.parallel.shard import subset_starts
+
+    assert subset_starts(1000, 0) is None          # off => full range
+    assert subset_starts(1000, 1000) is None       # >= G => full range
+    assert subset_starts(1000, 2000) is None
+    s = subset_starts(1000, 100)
+    assert s is not None and len(s) == 100
+    assert s.dtype == np.int32
+    assert len(np.unique(s)) == len(s)
+    assert s[0] == 0 and s[-1] < 1000
+    gaps = np.diff(s.astype(np.int64))
+    assert gaps.max() - gaps.min() <= 1            # evenly spaced
+    s7 = subset_starts(22, 7)
+    assert len(s7) == 7 and s7.max() < 22
+
+
+def test_shard_spec_validation_errors():
+    from g2vec_tpu.parallel.shard import ShardSpec
+
+    with pytest.raises(ValueError, match="rank"):
+        ShardSpec(rank=2, n_ranks=2, n_genes=100)
+    with pytest.raises(ValueError, match="embed_shards"):
+        ShardSpec(rank=0, n_ranks=2, n_genes=100, embed_shards=3)
+    with pytest.raises(ValueError, match="genes"):
+        ShardSpec(rank=0, n_ranks=4, n_genes=16, embed_shards=4)
+
+
+# ---------------------------------------------------------------------------
+# 2. Chunked KV transport at the size boundaries (fake client, no cluster)
+# ---------------------------------------------------------------------------
+
+class _FakeKV:
+    """String-API KV store double: same surface hostcomm touches. Gets of
+    missing keys 'time out' immediately (the DEADLINE_EXCEEDED shape the
+    real coordination service raises)."""
+
+    def __init__(self):
+        self.store = {}
+        self.sets = []
+
+    def key_value_set(self, key, value):
+        assert isinstance(value, str)       # the *_bytes APIs segfault
+        self.store[key] = value
+        self.sets.append(key)
+
+    def blocking_key_value_get(self, key, timeout_ms):
+        if key not in self.store:
+            raise RuntimeError(f"DEADLINE_EXCEEDED: key {key!r}")
+        return self.store[key]
+
+
+@pytest.mark.parametrize("size_delta", [-1, 0, 1])
+def test_chunked_roundtrip_at_chunk_boundary(size_delta):
+    from g2vec_tpu.parallel import hostcomm
+
+    cb = 1024
+    payload = (bytes(range(256)) * ((cb + 256 + 255) // 256))[:cb + size_delta]
+    kv = _FakeKV()
+    n = hostcomm.put_bytes_chunked("t/x", payload, client=kv,
+                                   chunk_bytes=cb)
+    assert n == (2 if size_delta == 1 else 1)
+    # The count key is published LAST: a reader that sees it knows every
+    # chunk is already present (no torn read window).
+    assert kv.sets[-1] == "t/x/n"
+    assert hostcomm.get_bytes_chunked("t/x", client=kv) == payload
+
+
+def test_chunked_roundtrip_empty_and_multichunk():
+    from g2vec_tpu.parallel import hostcomm
+
+    kv = _FakeKV()
+    hostcomm.put_bytes_chunked("t/empty", b"", client=kv, chunk_bytes=8)
+    assert hostcomm.get_bytes_chunked("t/empty", client=kv) == b""
+    big = os.urandom(5 * 1000 + 17)
+    n = hostcomm.put_bytes_chunked("t/big", big, client=kv,
+                                   chunk_bytes=1000)
+    assert n == 6
+    assert hostcomm.get_bytes_chunked("t/big", client=kv) == big
+
+
+def test_chunked_get_timeout_names_owner():
+    from g2vec_tpu.parallel import hostcomm
+    from g2vec_tpu.resilience.fleet import PeerTimeoutError
+
+    with pytest.raises(PeerTimeoutError, match=r"missing rank\(s\): \[3\]"):
+        hostcomm.get_bytes_chunked("t/absent", client=_FakeKV(),
+                                   deadline=0.01, owner=3)
+
+
+# ---------------------------------------------------------------------------
+# Shared pipeline fixtures/helpers (same dataset scale as test_stream.py)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="session")
+def shard_tsv(tmp_path_factory):
+    from g2vec_tpu.data.synthetic import SyntheticSpec, write_synthetic_tsv
+
+    spec = SyntheticSpec(
+        n_good=30, n_poor=26, module_size=16, shared_module_size=6,
+        n_background=24, n_expr_only=4, n_net_only=4, module_chords=3,
+        background_edges=40, noise=0.25, shift=1.4, seed=7)
+    return write_synthetic_tsv(
+        spec, str(tmp_path_factory.mktemp("shard_data")))
+
+
+def _cfg_dict(paths, out, **over):
+    base = dict(
+        expression_file=paths["expression"], clinical_file=paths["clinical"],
+        network_file=paths["network"], result_name=out,
+        lenPath=20, numRepetition=4, sizeHiddenlayer=32, epoch=8,
+        numBiomarker=10, seed=11, compute_dtype="float32",
+        walker_backend="native", train_mode="streaming", shard_paths=64)
+    base.update(over)
+    return base
+
+
+def _run(paths, out, **over):
+    from g2vec_tpu.config import G2VecConfig
+    from g2vec_tpu.pipeline import run
+
+    return run(G2VecConfig(**_cfg_dict(paths, out, **over)),
+               console=lambda s: None)
+
+
+def _read_files(result_name):
+    out = {}
+    for suffix in ("_biomarkers.txt", "_lgroups.txt", "_vectors.txt"):
+        with open(result_name + suffix, "rb") as f:
+            out[suffix] = f.read()
+    return out
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _rank_env(port: int, process_id: int, n_ranks: int) -> dict:
+    drop = ("PALLAS_AXON", "AXON_", "TPU_", "JAX_", "XLA_", "LIBTPU", "PJRT_")
+    env = {k: v for k, v in os.environ.items() if not k.startswith(drop)}
+    parts = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+             if p and "axon" not in p.lower()]
+    env["PYTHONPATH"] = os.pathsep.join([_REPO] + parts)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["G2VEC_COORDINATOR"] = f"127.0.0.1:{port}"
+    env["G2VEC_PROCESS_ID"] = str(process_id)
+    env["G2VEC_NUM_PROCESSES"] = str(n_ranks)
+    return env
+
+
+def _launch_fleet(tmp_path, cfg_dict, n_ranks, timeout=420):
+    """Run shard_worker.py on every rank; returns the Popen results as
+    (returncode, last-stdout-line-or-None, stderr) triples."""
+    cfg_path = tmp_path / "shard_cfg.json"
+    cfg_path.write_text(json.dumps(cfg_dict))
+    port = _free_port()
+    procs = [subprocess.Popen(
+        [sys.executable, _WORKER, str(cfg_path)],
+        env=_rank_env(port, i, n_ranks), cwd=_REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        for i in range(n_ranks)]
+    out = []
+    try:
+        for i, p in enumerate(procs):
+            try:
+                stdout, stderr = p.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                pytest.fail(f"rank {i} timed out after {timeout}s")
+            lines = [ln for ln in stdout.strip().splitlines() if ln]
+            out.append((p.returncode, lines[-1] if lines else None, stderr))
+    finally:
+        for q in procs:                     # a dead sibling must not wedge
+            if q.poll() is None:
+                q.kill()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# 3. Single-rank sharded mode is BYTE-identical to the unsharded path
+# ---------------------------------------------------------------------------
+
+@needs_native
+def test_single_rank_sharded_byte_identical(shard_tsv, tmp_path):
+    ref = _run(shard_tsv, str(tmp_path / "ref"))
+    sharded = _run(shard_tsv, str(tmp_path / "sh"),
+                   graph_shards=1, embed_shards=1)
+    assert _read_files(str(tmp_path / "sh")) == _read_files(
+        str(tmp_path / "ref"))
+    assert sharded.acc_val == ref.acc_val
+    assert sharded.n_paths == ref.n_paths
+    # walk_starts >= G is exactly "no cap" — same bytes again.
+    capped = _run(shard_tsv, str(tmp_path / "ws"),
+                  graph_shards=1, embed_shards=1,
+                  walk_starts=10 ** 6)
+    assert _read_files(str(tmp_path / "ws")) == _read_files(
+        str(tmp_path / "ref"))
+
+
+@needs_native
+def test_walk_starts_caps_volume_and_completes(shard_tsv, tmp_path):
+    ref = _run(shard_tsv, str(tmp_path / "ref"))
+    half = _run(shard_tsv, str(tmp_path / "half"),
+                walk_starts=ref.n_genes // 2)
+    assert half.n_paths < ref.n_paths           # genuinely fewer walks
+    assert half.n_paths > 0
+    assert len(half.biomarkers) == len(ref.biomarkers)   # still completes
+
+
+# ---------------------------------------------------------------------------
+# 4. TRUE 2-process run: statistical parity vs unsharded (PR 7 contract)
+# ---------------------------------------------------------------------------
+
+@needs_native
+def test_two_rank_sharded_statistical_parity(shard_tsv, tmp_path):
+    ref = _run(shard_tsv, str(tmp_path / "ref"), stream_patience=8)
+    cfg = _cfg_dict(shard_tsv, str(tmp_path / "fleet"),
+                    stream_patience=8, distributed=True,
+                    graph_shards=2, embed_shards=2,
+                    fleet_watchdog_deadline=120.0)
+    results = _launch_fleet(tmp_path, cfg, n_ranks=2)
+    parsed = []
+    for i, (rc, line, stderr) in enumerate(results):
+        assert rc == 0, f"rank {i} failed:\n{stderr[-3000:]}"
+        parsed.append(json.loads(line))
+    # Replicated decisions: both ranks computed identical selections.
+    assert parsed[0]["biomarkers"] == parsed[1]["biomarkers"]
+    assert parsed[0]["acc_val"] == pytest.approx(parsed[1]["acc_val"])
+    assert parsed[0]["n_paths"] == parsed[1]["n_paths"]
+    # Only the coordinator writes; the files exist and parse.
+    writers = [p for p in parsed if p["output_files"]]
+    assert len(writers) == 1 and writers[0]["process"] == 0
+    files = _read_files(str(tmp_path / "fleet"))
+    assert files["_vectors.txt"].count(b"\n") == ref.n_genes + 1
+    # The PR 7 statistical contract vs the unsharded run.
+    assert abs(parsed[0]["acc_val"] - ref.acc_val) <= 0.20
+    a, b = set(ref.biomarkers), set(parsed[0]["biomarkers"])
+    assert len(a & b) / max(len(a), 1) >= 0.6
+
+
+# ---------------------------------------------------------------------------
+# 5. Fault drills: the watchdog NAMES the rank that died mid-exchange
+# ---------------------------------------------------------------------------
+
+@needs_native
+def test_shard_exchange_sigkill_names_dead_rank(shard_tsv, tmp_path):
+    cfg = _cfg_dict(shard_tsv, str(tmp_path / "out"), distributed=True,
+                    graph_shards=2, embed_shards=2,
+                    fleet_watchdog_deadline=15.0,
+                    fault_plan="process=1,stage=shard_exchange,kind=sigkill")
+    results = _launch_fleet(tmp_path, cfg, n_ranks=2, timeout=300)
+    assert results[1][0] == -9                  # rank 1 really sigkilled
+    rc0, _, stderr0 = results[0]
+    assert rc0 != 0
+    assert "PeerTimeoutError" in stderr0
+    assert "missing rank(s): [1]" in stderr0
+
+
+@needs_native
+def test_embed_allreduce_sigkill_names_dead_rank(shard_tsv, tmp_path):
+    cfg = _cfg_dict(shard_tsv, str(tmp_path / "out"), distributed=True,
+                    graph_shards=2, embed_shards=2,
+                    fleet_watchdog_deadline=15.0,
+                    fault_plan="process=1,stage=embed_allreduce,"
+                               "kind=sigkill,epoch=3")
+    results = _launch_fleet(tmp_path, cfg, n_ranks=2, timeout=300)
+    assert results[1][0] == -9
+    rc0, _, stderr0 = results[0]
+    assert rc0 != 0
+    assert "PeerTimeoutError" in stderr0
+    assert "missing rank(s): [1]" in stderr0
+
+
+# ---------------------------------------------------------------------------
+# 6. Per-rank RSS below the MEASURED unsharded run at the same scale (slow)
+# ---------------------------------------------------------------------------
+
+@needs_native
+@pytest.mark.slow
+def test_sharded_rss_below_measured_unsharded_run(tmp_path):
+    """262k genes, H=256: measure the plain single-host run's peak RSS,
+    then the 2-rank sharded fleet's — every sharded rank must peak well
+    below the measured unsharded peak (<= 0.9x). The analytic
+    trainer-state bytes (4 x [G, H] f32) are NOT the bound: real peaks
+    carry ~1 GB process overhead plus unpack/exchange transients, so
+    the honest comparison is run-vs-run at the same scale (same framing
+    as bench.py --_shard_scale / BENCH_SHARD_SCALE.json)."""
+    from g2vec_tpu.data.synth import SynthGraphSpec, write_synth_graph_streamed
+
+    n_genes, hidden = 262_144, 256
+    spec = SynthGraphSpec(n_genes=n_genes, n_good=8, n_poor=8, seed=5)
+    paths = write_synth_graph_streamed(spec, str(tmp_path / "big"))
+    common = dict(sizeHiddenlayer=hidden, epoch=2, stream_patience=2,
+                  lenPath=12, numRepetition=2, shard_paths=256,
+                  walk_starts=2048, stream_eval_rows=256)
+    plain_cfg = _cfg_dict(paths, str(tmp_path / "plain"),
+                          graph_shards=0, embed_shards=0, **common)
+    (rc_p, line_p, stderr_p), = _launch_fleet(
+        tmp_path, plain_cfg, n_ranks=1, timeout=3600)
+    assert rc_p == 0, f"plain run failed:\n{stderr_p[-3000:]}"
+    plain_rss_kb = json.loads(line_p)["rss_kb"]
+
+    cfg = _cfg_dict(paths, str(tmp_path / "out"), distributed=True,
+                    graph_shards=2, embed_shards=2,
+                    fleet_watchdog_deadline=1800.0, **common)
+    results = _launch_fleet(tmp_path, cfg, n_ranks=2, timeout=3600)
+    for i, (rc, line, stderr) in enumerate(results):
+        assert rc == 0, f"rank {i} failed:\n{stderr[-3000:]}"
+        rss_kb = json.loads(line)["rss_kb"]
+        assert rss_kb <= 0.9 * plain_rss_kb, (
+            f"rank {i} peak RSS {rss_kb} KB not well below the measured "
+            f"unsharded peak {plain_rss_kb} KB at the same scale")
+
+
+# ---------------------------------------------------------------------------
+# Satellite: the --nodes-scaled streamed generator smoke
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("nodes", [400, 5000])
+def test_make_synth_graph_streamed_smoke(tmp_path, nodes):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "make_synth_graph.py"),
+         "--nodes", str(nodes), "--good", "4", "--poor", "4",
+         "--stream", "--out", str(tmp_path), "--prefix", f"s{nodes}"],
+        capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stderr[-500:]
+    summary = json.loads(proc.stdout)
+    assert summary["streamed"] is True
+    assert int(summary["n_genes"]) == nodes
+    with open(summary["expression"]) as f:
+        assert sum(1 for _ in f) == nodes + 1    # header + one row per gene
+    with open(summary["network"]) as f:
+        n_edges = sum(1 for _ in f) - 1
+    assert n_edges == int(summary["n_edges"])
+    assert n_edges >= nodes                      # connected + hubs
+
+
+def test_streamed_generator_chunk_independent():
+    from g2vec_tpu.data.synth import (iter_scale_free_edges,
+                                      make_scale_free_edges)
+
+    s1, d1 = make_scale_free_edges(500, 3, np.random.default_rng(5))
+    chunks = list(iter_scale_free_edges(500, 3, np.random.default_rng(5),
+                                        chunk_edges=37))
+    np.testing.assert_array_equal(np.concatenate([c[0] for c in chunks]), s1)
+    np.testing.assert_array_equal(np.concatenate([c[1] for c in chunks]), d1)

@@ -27,8 +27,9 @@ def main(argv=None) -> int:
         prog="make_synth_graph",
         description="Seeded scale-free synthetic dataset generator "
                     "(expression/clinical/network TSVs).")
-    p.add_argument("--genes", type=int, default=20000,
-                   help="gene count (default 20000)")
+    p.add_argument("--genes", "--nodes", dest="genes", type=int,
+                   default=20000,
+                   help="gene/node count (default 20000)")
     p.add_argument("--good", type=int, default=40,
                    help="good-prognosis samples (default 40)")
     p.add_argument("--poor", type=int, default=40,
@@ -46,20 +47,30 @@ def main(argv=None) -> int:
     p.add_argument("--out", type=str, required=True, metavar="DIR",
                    help="output directory (created if missing)")
     p.add_argument("--prefix", type=str, default="big")
+    p.add_argument("--stream", action="store_true",
+                   help="bounded-memory writer: edges and expression "
+                        "stream to disk in fixed chunks instead of "
+                        "materializing [S, G] + the edge list (auto at "
+                        ">= 200000 nodes; same formats, different rng "
+                        "stream layout than the in-memory writer)")
     args = p.parse_args(argv)
     if args.genes < args.attach + 2:
         p.error(f"--genes must be >= attach+2 = {args.attach + 2}")
     if args.good < 2 or args.poor < 2:
         p.error("--good/--poor must be >= 2 (PCC needs 2+ samples/group)")
 
-    from g2vec_tpu.data.synth import SynthGraphSpec, write_synth_graph
+    from g2vec_tpu.data.synth import (SynthGraphSpec, write_synth_graph,
+                                      write_synth_graph_streamed)
 
     spec = SynthGraphSpec(
         n_genes=args.genes, n_good=args.good, n_poor=args.poor,
         attach=args.attach, active_prob=args.active_prob,
         noise=args.noise, shift=args.shift, seed=args.seed)
-    paths = write_synth_graph(spec, args.out, prefix=args.prefix)
-    print(json.dumps({"spec": vars(args), **paths}, indent=1))
+    streamed = args.stream or args.genes >= 200_000
+    writer = write_synth_graph_streamed if streamed else write_synth_graph
+    paths = writer(spec, args.out, prefix=args.prefix)
+    print(json.dumps({"spec": vars(args), "streamed": streamed, **paths},
+                     indent=1))
     return 0
 
 
