@@ -172,6 +172,13 @@ CHAOS_JOBS = int(os.environ.get("G2VEC_BENCH_CHAOS_JOBS", "50"))
 CHAOS_SEED = int(os.environ.get("G2VEC_BENCH_CHAOS_SEED", "0"))
 CHAOS_BUDGET = float(os.environ.get("G2VEC_BENCH_CHAOS_BUDGET", "900"))
 CHAOS_ARTIFACT = "BENCH_CHAOS_SOAK.json"
+ROUTER_CHAOS_JOBS = int(os.environ.get("G2VEC_BENCH_ROUTER_JOBS", "50"))
+ROUTER_CHAOS_REPLICAS = int(os.environ.get("G2VEC_BENCH_ROUTER_REPLICAS",
+                                           "3"))
+ROUTER_CHAOS_SEED = int(os.environ.get("G2VEC_BENCH_ROUTER_SEED", "0"))
+ROUTER_CHAOS_BUDGET = float(os.environ.get("G2VEC_BENCH_ROUTER_BUDGET",
+                                           "1200"))
+ROUTER_CHAOS_ARTIFACT = "BENCH_ROUTER_CHAOS.json"
 
 # Million-node shard-scale sweep (parallel/shard.py + train/shard.py):
 # "genes:ranks" cells, run as real multi-process fleets of
@@ -1435,6 +1442,87 @@ def _chaos_soak() -> None:
         sys.exit(1)
 
 
+def _router_chaos_line(note) -> dict:
+    """Router-mode chaos soak: tools/chaos_soak.py --replicas N as a
+    subprocess. Acceptance = fleet-wide exactly-once accounting (every
+    acked job exactly one terminal event across all replicas + one
+    result record), sampled byte parity vs solo twins, drain rc 0, and
+    the replica-death-to-first-requeued-job latency distribution from
+    the router's failover events."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "G2V_CHAOS_JOBS": str(ROUTER_CHAOS_JOBS),
+           "G2V_CHAOS_REPLICAS": str(ROUTER_CHAOS_REPLICAS),
+           "G2V_CHAOS_SEED": str(ROUTER_CHAOS_SEED),
+           "G2V_CHAOS_BUDGET": str(ROUTER_CHAOS_BUDGET)}
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "chaos_soak.py")],
+        capture_output=True, text=True, env=env,
+        timeout=ROUTER_CHAOS_BUDGET + 180)
+    for ln in (proc.stderr or "").splitlines():
+        if ln.startswith("# "):
+            note(f"router-chaos {ln[2:]}")
+    try:
+        summary = json.loads(proc.stdout)
+    except ValueError:
+        raise RuntimeError(
+            f"router chaos soak emitted no summary "
+            f"(rc={proc.returncode}): "
+            f"{(proc.stderr or proc.stdout)[-400:]}")
+    accepted = summary.get("accepted", 0) or 1
+    accounted = accepted - len(summary.get("lost", ()))
+    return {
+        "metric": "router_chaos_accounted_fraction",
+        "value": round(accounted / accepted, 4), "unit": "fraction",
+        "ok": bool(summary.get("ok")) and proc.returncode == 0,
+        "jobs": summary.get("jobs"),
+        "replicas": summary.get("replicas"), "accepted": accepted,
+        "terminal_by_status": summary.get("terminal_by_status"),
+        "lost": len(summary.get("lost", ())),
+        "duplicated": len(summary.get("duplicated", ())),
+        "replica_kills": summary.get("replica_kills"),
+        "replica_drains": summary.get("replica_drains"),
+        "router_restarts": summary.get("router_restarts"),
+        "drain_exit_codes": summary.get("drain_exit_codes"),
+        "cancels_sent": summary.get("cancels_sent"),
+        "failovers": summary.get("failovers"),
+        "requeue_p50_s": summary.get("requeue_p50_s"),
+        "requeue_p99_s": summary.get("requeue_p99_s"),
+        "router_restart_p99_s": summary.get("router_restart_p99_s"),
+        "byte_checked": summary.get("byte_checked"),
+        "byte_identical": summary.get("byte_identical"),
+        "seed": summary.get("seed"),
+        "wall_s": round(time.time() - t0, 1),
+        "note": "seeded storm vs the replicated serve fleet (replica "
+                "SIGKILL with router-driven fence/migrate/relaunch, "
+                "synchronous replica drains, router SIGKILL+restart "
+                "with live-replica adoption); acceptance = fleet-wide "
+                "exactly-once accounting + sampled byte parity vs solo "
+                "twins; requeue_p99_s = replica-death-to-first-"
+                "requeued-job p99 from router failover events",
+    }
+
+
+def _router_chaos() -> None:
+    """Standalone mode: run the router chaos soak and (with
+    G2VEC_BENCH_ROUTER_WRITE=1) refresh the committed artifact."""
+    def note(msg):
+        print(f"# {msg}", file=sys.stderr, flush=True)
+
+    line = _router_chaos_line(note)
+    print(json.dumps(line), flush=True)
+    if os.environ.get("G2VEC_BENCH_ROUTER_WRITE") == "1":
+        repo = os.path.dirname(os.path.abspath(__file__))
+        with open(os.path.join(repo, ROUTER_CHAOS_ARTIFACT), "w") as f:
+            json.dump({"line": line, "code_key": _current_code_key(repo),
+                       "written_by": "bench.py --_router_chaos"}, f,
+                      indent=1)
+        note(f"wrote {ROUTER_CHAOS_ARTIFACT}")
+    if not line["ok"]:
+        sys.exit(1)
+
+
 def _shard_scale_line(note) -> dict:
     """Million-node shard-scale sweep — ROADMAP item 2's headline.
 
@@ -2599,6 +2687,8 @@ if __name__ == "__main__":
         _serve_ab()
     elif "--_stream_ab" in sys.argv:
         _stream_ab()
+    elif "--_router_chaos" in sys.argv:
+        _router_chaos()
     elif "--_chaos_soak" in sys.argv:
         _chaos_soak()
     elif "--_shard_scale" in sys.argv:

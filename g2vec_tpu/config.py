@@ -468,6 +468,32 @@ SERVE_JOB_KEYS = (
 _SERVE_JOB_REQUIRED = ("expression_file", "clinical_file", "network_file",
                        "result_name")
 
+#: Config fields EXCLUDED from the serve job-join key: per-lane variant
+#: axes (concrete on each LaneVariant by plan time, so the base default is
+#: irrelevant), output/stream locations, and daemon-owned infrastructure.
+#: Everything else must coincide for two jobs to share one engine batch —
+#: and, in a replicated fleet, for the router to hash them onto the SAME
+#: replica so shape-compatible jobs still join one warm bucket there.
+SERVE_JOIN_EXCLUDE = frozenset({
+    "result_name", "metrics_jsonl", "manifest", "batch_seeds",
+    "seed", "train_seed", "kmeans_seed", "learningRate", "epoch",
+    "patient_subsample", "subsample_seed",
+    "cache_dir", "compilation_cache", "profile_dir", "fault_plan"})
+
+
+def serve_join_key(cfg: "G2VecConfig") -> Tuple:
+    """The batch-compatibility key of a serve job's config.
+
+    Lives here (not serve/daemon.py) because both sides of the serving
+    plane need it without dragging in the engine: the daemon uses it to
+    merge queued jobs into one engine batch, and the router (serve/
+    router.py — a jax-free process) consistent-hashes it so compatible
+    jobs from different clients land on the same warm replica.
+    """
+    return tuple((f.name, repr(getattr(cfg, f.name)))
+                 for f in dataclasses.fields(cfg)
+                 if f.name not in SERVE_JOIN_EXCLUDE)
+
 
 def config_from_job(base: dict, defaults: Optional[G2VecConfig] = None
                     ) -> G2VecConfig:

@@ -48,3 +48,114 @@ class DrainRequested(JobInterrupted):
     it from the checkpoint."""
 
     reason = "drain"
+
+
+# ---------------------------------------------------------------------------
+# Replica health — the router's per-replica probe state machine.
+# ---------------------------------------------------------------------------
+
+#: healthy → suspect → dead → rejoining → healthy. ``suspect`` keeps the
+#: replica in the hash ring (a single missed probe is usually GC or a
+#: long compile, not death); ``dead`` removes it and triggers failover;
+#: ``rejoining`` answers probes again but takes no traffic until its
+#: stale journal is drained.
+REPLICA_STATES = ("healthy", "suspect", "dead", "rejoining")
+
+
+class ReplicaHealth:
+    """Pure probe-driven health state for one replica. No I/O, no clock
+    reads — the router feeds it ``on_probe(ok, journal_depth, now)`` and
+    acts on the returned transition, which keeps every edge unit-testable
+    as a table.
+
+    Thresholds: ``suspect_after`` consecutive failures demote healthy →
+    suspect, ``dead_after`` total consecutive failures declare dead (the
+    failover trigger — the router fences the process before re-queueing,
+    so a slow-but-alive replica can never double-execute), and a dead
+    replica that answers again must produce ``rejoin_after`` consecutive
+    OK probes **with an empty journal** before it is healthy: the empty-
+    journal gate is what forces a rejoining replica to drain stale work
+    (or have the router migrate it) before taking new traffic.
+
+    ``probe_interval(base)`` backs off exponentially for non-healthy
+    replicas so a dead host costs probes, not a probe *storm*.
+    """
+
+    def __init__(self, name: str, suspect_after: int = 1,
+                 dead_after: int = 3, rejoin_after: int = 2):
+        if not (1 <= suspect_after < dead_after):
+            raise ValueError("need 1 <= suspect_after < dead_after")
+        self.name = name
+        self.state = "healthy"
+        self.suspect_after = suspect_after
+        self.dead_after = dead_after
+        self.rejoin_after = rejoin_after
+        self.fails = 0          # consecutive failed probes
+        self.oks = 0            # consecutive OK probes (rejoin gate)
+        self.last_ok: float = 0.0
+        self.last_transition: float = 0.0
+        self.journal_depth: int = 0
+
+    @property
+    def in_ring(self) -> bool:
+        """Whether the hash ring may route new jobs here."""
+        return self.state in ("healthy", "suspect")
+
+    def probe_interval(self, base: float) -> float:
+        """Seconds until the next probe: ``base`` while healthy, doubled
+        per consecutive failure (capped at 8x) otherwise — plus nothing;
+        jitter is the caller's business."""
+        if self.state == "healthy":
+            return base
+        return base * min(8.0, 2.0 ** max(0, self.fails - 1))
+
+    def on_probe(self, ok: bool, journal_depth: int = 0,
+                 now: float = 0.0):
+        """Feed one probe outcome. Returns ``(old_state, new_state)`` on
+        a transition, else None."""
+        old = self.state
+        if ok:
+            self.fails = 0
+            self.oks += 1
+            self.last_ok = now
+            self.journal_depth = journal_depth
+            if old in ("healthy", "suspect"):
+                self.state = "healthy"
+            elif old == "dead":
+                self.state = "rejoining"
+                self.oks = 1        # this probe is the first of the gate
+            elif old == "rejoining":
+                if self.oks >= self.rejoin_after and journal_depth == 0:
+                    self.state = "healthy"
+        else:
+            self.fails += 1
+            self.oks = 0
+            if old == "rejoining":
+                self.state = "dead"     # flapped straight back out
+            elif old in ("healthy", "suspect"):
+                if self.fails >= self.dead_after:
+                    self.state = "dead"
+                elif self.fails >= self.suspect_after:
+                    self.state = "suspect"
+        if self.state != old:
+            self.last_transition = now
+            return (old, self.state)
+        return None
+
+    def force_dead(self, now: float = 0.0):
+        """The router *observed* death out-of-band (connection refused on
+        a forward, fence kill). Skips the probe count."""
+        old = self.state
+        self.state = "dead"
+        self.fails = max(self.fails, self.dead_after)
+        self.oks = 0
+        if old != "dead":
+            self.last_transition = now
+            return (old, "dead")
+        return None
+
+    def snapshot(self) -> dict:
+        return {"name": self.name, "state": self.state,
+                "fails": self.fails, "oks": self.oks,
+                "journal_depth": self.journal_depth,
+                "last_ok": self.last_ok}

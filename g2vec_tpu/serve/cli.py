@@ -6,12 +6,21 @@ Daemon::
         [--queue-depth 16] [--max-join 4] [--cache-dir DIR] \\
         [--metrics-jsonl F] [--platform cpu] [--supervise]
 
-Client (same flag, a client op instead of --state-dir)::
+Router (fleet front door; --state-dir becomes the fleet root)::
+
+    g2vec serve --replicas 3 --listen 0.0.0.0:7433 --state-dir /srv/g2vec \\
+        [--auth-token-file F] [--probe-interval 0.5] [--probe-deadline 2] \\
+        [--cache-dir DIR] [--queue-depth 16] [--max-join 4]
+
+Client (same flag, a client op instead of --state-dir; --socket accepts a
+UNIX path or a TCP host:port — a daemon or the router)::
 
     g2vec serve --socket /tmp/g2vec.sock --submit job.json [--tenant me] \\
-        [--priority interactive|batch] [--deadline-s SECS]
-    g2vec serve --socket /tmp/g2vec.sock --status | --ping | --shutdown
-    g2vec serve --socket /tmp/g2vec.sock --cancel JOB_ID | --drain
+        [--priority interactive|batch] [--deadline-s SECS] \\
+        [--auth-token-file F]
+    g2vec serve --socket host:7433 --status | --ping | --shutdown
+    g2vec serve --socket host:7433 --cancel JOB_ID | --drain
+    g2vec serve --socket host:7433 --drain-replica r1
 
 ``--submit`` streams the job's JSONL events to stdout and exits 0 on
 ``job_done``, 4 on ``rejected``, 5 on ``job_failed`` (or any other
@@ -37,10 +46,46 @@ def build_serve_parser() -> argparse.ArgumentParser:
                     "the device and every warm cache, accepting streaming "
                     "job manifests over a local UNIX socket with admission "
                     "control and shape-bucket-aware scheduling.")
-    p.add_argument("--socket", required=True, metavar="PATH",
-                   help="UNIX socket path the daemon listens on (clients "
-                        "connect here; curl --unix-socket works for "
-                        "/status).")
+    p.add_argument("--socket", default=None, metavar="ADDR",
+                   help="UNIX socket path the daemon listens on. Client "
+                        "ops also accept a TCP host:port here (a daemon's "
+                        "--listen address or the router). curl "
+                        "--unix-socket / plain curl work for /status.")
+    p.add_argument("--listen", type=str, default=None, metavar="HOST:PORT",
+                   help="TCP front door: ALSO listen on this address "
+                        "(port 0 = ephemeral; the bound address lands in "
+                        "<state-dir>/tcp_addr). Same JSONL protocol + "
+                        "GET /status as the UNIX socket.")
+    p.add_argument("--auth-token-file", type=str, default=None,
+                   metavar="FILE",
+                   help="Shared-secret tenancy: mutating ops (submit/"
+                        "cancel/drain/shutdown) must carry this file's "
+                        "token as 'auth_token'; status/ping stay open. "
+                        "In client mode, the token to send.")
+    p.add_argument("--read-deadline-s", type=float, default=30.0,
+                   metavar="S",
+                   help="Per-connection socket deadline (default 30): a "
+                        "stalled or byte-trickling client can hold an "
+                        "acceptor thread at most this long.")
+    p.add_argument("--max-request-bytes", type=int, default=0,
+                   metavar="N",
+                   help="Reject request lines over this size (default 0 "
+                        "= the protocol's 8 MiB line bound).")
+    # router mode
+    p.add_argument("--replicas", type=int, default=None, metavar="N",
+                   help="Router mode: front N daemon replicas under "
+                        "--state-dir (consistent-hash placement, health "
+                        "probes, exactly-once failover). Needs --listen.")
+    p.add_argument("--probe-interval", type=float, default=0.5,
+                   metavar="S",
+                   help="Router health-probe cadence for healthy replicas "
+                        "(default 0.5); unhealthy ones back off "
+                        "exponentially.")
+    p.add_argument("--probe-deadline", type=float, default=2.0,
+                   metavar="S",
+                   help="One probe's socket deadline (default 2); a "
+                        "replica that cannot answer /status within it "
+                        "fails the probe.")
     p.add_argument("--state-dir", default=None, metavar="DIR",
                    help="Daemon state root: jobs/ (journal of accepted, "
                         "unfinished jobs — re-queued on restart), "
@@ -105,7 +150,22 @@ def build_serve_parser() -> argparse.ArgumentParser:
                    help="Client mode: graceful drain — stop admitting, "
                         "checkpoint in-flight streaming jobs, journal "
                         "everything unfinished, exit 0.")
+    p.add_argument("--drain-replica", type=str, default=None,
+                   metavar="NAME",
+                   help="Client mode (router): drain one replica "
+                        "synchronously and relaunch it; prints the exit "
+                        "code the drained daemon returned.")
     return p
+
+
+def _read_token(path: Optional[str]) -> Optional[str]:
+    if not path:
+        return None
+    with open(path) as f:
+        tok = f.read().strip()
+    if not tok:
+        raise SystemExit(f"auth token file {path!r} is empty")
+    return tok
 
 
 def serve_main(argv: Optional[List[str]] = None) -> int:
@@ -113,7 +173,11 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
     from g2vec_tpu.serve import client
 
     if args.status or args.ping or args.shutdown or args.submit \
-            or args.cancel or args.drain:
+            or args.cancel or args.drain or args.drain_replica:
+        if not args.socket:
+            build_serve_parser().error(
+                "client ops need --socket (a UNIX path or host:port)")
+        token = _read_token(args.auth_token_file)
         try:
             if args.status:
                 print(json.dumps(client.status(args.socket), indent=1))
@@ -122,15 +186,30 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
                 print(json.dumps(client.ping(args.socket)))
                 return 0
             if args.shutdown:
-                print(json.dumps(client.shutdown(args.socket)))
-                return 0
-            if args.cancel:
-                ev = client.cancel(args.socket, args.cancel)
+                ev = client.shutdown(args.socket, auth_token=token)
                 print(json.dumps(ev))
-                return 0 if ev.get("event") != "error" else 4
+                return 0 if ev.get("event") not in ("rejected",
+                                                    "error") else 4
+            if args.cancel:
+                ev = client.cancel(args.socket, args.cancel,
+                                   auth_token=token)
+                print(json.dumps(ev))
+                return 0 if ev.get("event") not in ("rejected",
+                                                    "error") else 4
             if args.drain:
-                print(json.dumps(client.drain(args.socket)))
-                return 0
+                ev = client.drain(args.socket, auth_token=token)
+                print(json.dumps(ev))
+                return 0 if ev.get("event") not in ("rejected",
+                                                    "error") else 4
+            if args.drain_replica:
+                for ev in client.request(
+                        args.socket,
+                        {"op": "drain_replica",
+                         "replica": args.drain_replica,
+                         "auth_token": token}, timeout=600.0):
+                    print(json.dumps(ev))
+                    return 0 if ev.get("event") == "drained" else 4
+                return 4
             src = sys.stdin if args.submit == "-" else open(args.submit)
             with src:
                 job = json.load(src)
@@ -138,7 +217,8 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
                 events = client.submit_job(args.socket, job,
                                            tenant=args.tenant,
                                            priority=args.priority,
-                                           deadline_s=args.deadline_s)
+                                           deadline_s=args.deadline_s,
+                                           auth_token=token)
             except client.ServeConnectionLost as e:
                 print(json.dumps({"event": "connection_lost",
                                   "job_id": e.job_id, "error": str(e)}))
@@ -156,8 +236,41 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
 
     if not args.state_dir:
         build_serve_parser().error(
-            "daemon mode needs --state-dir (or pass a client op: "
+            "daemon/router mode needs --state-dir (or pass a client op: "
             "--submit/--status/--ping/--shutdown)")
+    if args.replicas is not None:
+        # Router mode: jax-free by construction — the replicas own the
+        # devices; this process only probes, places, and fails over.
+        if args.replicas < 1:
+            build_serve_parser().error("--replicas must be >= 1")
+        if not args.listen:
+            build_serve_parser().error("router mode needs --listen")
+        from g2vec_tpu.serve.router import Router, RouterOptions
+
+        fwd: List[str] = ["--queue-depth", str(args.queue_depth),
+                          "--max-join", str(args.max_join),
+                          "--job-retries", str(args.job_retries),
+                          "--read-deadline-s", str(args.read_deadline_s)]
+        if args.max_request_bytes:
+            fwd += ["--max-request-bytes", str(args.max_request_bytes)]
+        if args.cache_dir:
+            fwd += ["--cache-dir", args.cache_dir]
+        if args.platform:
+            fwd += ["--platform", args.platform]
+        if args.fault_plan:
+            fwd += ["--fault-plan", args.fault_plan]
+        opts = RouterOptions(
+            fleet_dir=args.state_dir, replicas=args.replicas,
+            listen=args.listen, probe_interval=args.probe_interval,
+            probe_deadline=args.probe_deadline,
+            auth_token=_read_token(args.auth_token_file),
+            read_deadline_s=args.read_deadline_s,
+            max_request_bytes=args.max_request_bytes,
+            metrics_jsonl=args.metrics_jsonl,
+            serve_argv=tuple(fwd))
+        return Router(opts).serve_forever()
+    if not args.socket:
+        build_serve_parser().error("daemon mode needs --socket")
     if args.supervise:
         from g2vec_tpu.resilience.supervisor import supervise_serve
 
@@ -187,5 +300,8 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
         socket_path=args.socket, state_dir=args.state_dir,
         queue_depth=args.queue_depth, max_join=args.max_join,
         job_retries=args.job_retries, cache_dir=args.cache_dir,
-        metrics_jsonl=args.metrics_jsonl, fault_plan=args.fault_plan)
+        metrics_jsonl=args.metrics_jsonl, fault_plan=args.fault_plan,
+        listen=args.listen, auth_token=_read_token(args.auth_token_file),
+        read_deadline_s=args.read_deadline_s,
+        max_request_bytes=args.max_request_bytes)
     return ServeDaemon(opts).serve_forever()

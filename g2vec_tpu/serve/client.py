@@ -1,11 +1,15 @@
-"""Client for the ``g2vec serve`` daemon (CLI, bench, and test currency).
+"""Client for ``g2vec serve`` daemons and routers (CLI, bench, tests).
 
-Talks the protocol.py JSONL dialect over the daemon's UNIX socket. The
-one failure mode worth a dedicated type: the daemon dying mid-job
-(SIGKILL, preemption) closes the stream without a terminal event —
-:class:`ServeConnectionLost` carries the job_id so the caller can fall
-back to :func:`poll_result`, which reads the result record the RELAUNCHED
-daemon writes after the journal re-queues the job.
+Talks the protocol.py JSONL dialect over a UNIX socket path or a TCP
+``host:port`` address — :func:`protocol.dial` picks the transport, so
+every helper here works unchanged against a single daemon or the
+replicated-fleet router. The one failure mode worth a dedicated type:
+the server dying mid-job (SIGKILL, preemption) closes the stream without
+a terminal event — :class:`ServeConnectionLost` carries the job_id so
+the caller can fall back to :func:`poll_result` (filesystem) or
+:func:`poll_result_net` (the ``result`` op, re-resolved through the
+router on every attempt), which read the durable record that survives
+any replica's death.
 """
 from __future__ import annotations
 
@@ -14,6 +18,7 @@ import os
 import random
 import socket
 import time
+import uuid
 from typing import Iterator, List, Optional
 
 from g2vec_tpu.serve import protocol
@@ -38,12 +43,11 @@ class ServeTimeout(TimeoutError):
 
 def request(socket_path: str, payload: dict,
             timeout: Optional[float] = None) -> Iterator[dict]:
-    """Send one request; yield the daemon's JSONL events until it closes
-    the stream. ``timeout`` bounds each socket read, not the whole job."""
-    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-    s.settimeout(timeout)
+    """Send one request; yield the server's JSONL events until it closes
+    the stream. ``timeout`` bounds each socket read, not the whole job.
+    ``socket_path`` may be a UNIX path or ``host:port``."""
+    s = protocol.dial(socket_path, timeout=timeout)
     try:
-        s.connect(socket_path)
         f = s.makefile("rwb")
         protocol.write_event(f, payload)
         while True:
@@ -64,7 +68,9 @@ _TERMINAL = ("job_done", "job_failed", "job_cancelled",
 def submit_job(socket_path: str, job: dict, tenant: str = "default",
                timeout: Optional[float] = None,
                priority: Optional[str] = None,
-               deadline_s: Optional[float] = None) -> List[dict]:
+               deadline_s: Optional[float] = None,
+               idem_key: Optional[str] = None,
+               auth_token: Optional[str] = None) -> List[dict]:
     """Submit ``job`` and stream its events to completion. Returns every
     event received ([..., terminal event] on success/failure, or
     [rejected] on admission refusal). Raises :class:`ServeConnectionLost`
@@ -78,6 +84,10 @@ def submit_job(socket_path: str, job: dict, tenant: str = "default",
         payload["priority"] = priority
     if deadline_s is not None:
         payload["deadline_s"] = deadline_s
+    if idem_key is not None:
+        payload["idem_key"] = idem_key
+    if auth_token is not None:
+        payload["auth_token"] = auth_token
     try:
         for ev in request(socket_path, payload, timeout=timeout):
             events.append(ev)
@@ -96,8 +106,12 @@ def submit_job(socket_path: str, job: dict, tenant: str = "default",
         f"{job_id or '<unacknowledged>'} finished", job_id=job_id)
 
 
-def _one(socket_path: str, op: str, timeout: Optional[float]) -> dict:
-    for ev in request(socket_path, {"op": op}, timeout=timeout):
+def _one(socket_path: str, op: str, timeout: Optional[float],
+         auth_token: Optional[str] = None, **fields) -> dict:
+    payload = {"op": op, **fields}
+    if auth_token is not None:
+        payload["auth_token"] = auth_token
+    for ev in request(socket_path, payload, timeout=timeout):
         return ev
     raise ServeConnectionLost(f"no response to {op!r}")
 
@@ -110,24 +124,25 @@ def ping(socket_path: str, timeout: Optional[float] = 5.0) -> dict:
     return _one(socket_path, "ping", timeout)
 
 
-def shutdown(socket_path: str, timeout: Optional[float] = 10.0) -> dict:
-    return _one(socket_path, "shutdown", timeout)
+def shutdown(socket_path: str, timeout: Optional[float] = 10.0,
+             auth_token: Optional[str] = None) -> dict:
+    return _one(socket_path, "shutdown", timeout, auth_token=auth_token)
 
 
 def cancel(socket_path: str, job_id: str,
-           timeout: Optional[float] = 10.0) -> dict:
+           timeout: Optional[float] = 10.0,
+           auth_token: Optional[str] = None) -> dict:
     """Cancel a queued (immediate) or running (cooperative, next
     shard/chunk boundary) job."""
-    for ev in request(socket_path, {"op": "cancel", "job_id": job_id},
-                      timeout=timeout):
-        return ev
-    raise ServeConnectionLost("no response to 'cancel'", job_id=job_id)
+    return _one(socket_path, "cancel", timeout, auth_token=auth_token,
+                job_id=job_id)
 
 
-def drain(socket_path: str, timeout: Optional[float] = 10.0) -> dict:
+def drain(socket_path: str, timeout: Optional[float] = 10.0,
+          auth_token: Optional[str] = None) -> dict:
     """Ask the daemon to drain gracefully: admission closes, in-flight
     streaming jobs checkpoint, everything unfinished stays journaled."""
-    return _one(socket_path, "drain", timeout)
+    return _one(socket_path, "drain", timeout, auth_token=auth_token)
 
 
 def submit_and_wait(socket_path: str, job: dict, tenant: str = "default",
@@ -138,42 +153,55 @@ def submit_and_wait(socket_path: str, job: dict, tenant: str = "default",
                     deadline_s: Optional[float] = None,
                     retries: int = 3, backoff: float = 0.25,
                     jitter: float = 0.25,
-                    rng: Optional[random.Random] = None) -> dict:
+                    rng: Optional[random.Random] = None,
+                    idem_key: Optional[str] = None,
+                    auth_token: Optional[str] = None) -> dict:
     """Submit a job and return its terminal record, surviving daemon
-    restarts.
+    restarts AND replica failover behind a router.
 
-    Transport-level failures retry with exponential backoff plus jitter
-    (``backoff * 2**attempt + U[0, jitter)`` seconds — the jitter keeps a
-    fleet of clients from re-dialing a relaunching daemon in lockstep).
-    Two distinct recovery paths:
+    Every attempt carries the same idempotency key (auto-minted when the
+    caller passes none), so a resubmission after a lost ack can never run
+    the job twice — the server dedups on the key and answers with the
+    original job_id. Transport-level failures retry with exponential
+    backoff plus jitter (``backoff * 2**attempt + U[0, jitter)`` seconds —
+    the jitter keeps a fleet of clients from re-dialing a relaunching
+    daemon in lockstep). Recovery paths:
 
-    - connect refused / reset BEFORE acceptance → resubmit (nothing was
-      journaled, so nothing is duplicated);
+    - connect refused / reset BEFORE acceptance → resubmit with the same
+      idem key (either nothing was journaled, or the dedup table
+      re-acks the original);
     - stream lost AFTER acceptance (:class:`ServeConnectionLost` with a
-      job_id) → the job is journaled; fall through to :func:`poll_result`
-      for the record the relaunched daemon writes. Never resubmit here —
-      that WOULD duplicate the job.
+      job_id) → the job is journaled somewhere; poll the durable record
+      via :func:`poll_result` when a ``state_dir`` is known, else via
+      :func:`poll_result_net` — which re-dials ``socket_path`` (the
+      router, typically) on every attempt, so the answer arrives even
+      after the job migrated replicas. Never resubmit here — the poll
+      is strictly read-only.
 
     Raises :class:`ServeTimeout` naming the job when all retries or the
     result poll expire."""
     rng = rng if rng is not None else random.Random()
+    if idem_key is None:
+        idem_key = f"c-{uuid.uuid4().hex}"
     last: Optional[BaseException] = None
     for attempt in range(retries + 1):
         try:
             events = submit_job(socket_path, job, tenant=tenant,
                                 timeout=timeout, priority=priority,
-                                deadline_s=deadline_s)
+                                deadline_s=deadline_s, idem_key=idem_key,
+                                auth_token=auth_token)
             return events[-1]
         except ServeConnectionLost as e:
             if e.job_id is not None:
-                if state_dir is None:
-                    raise ServeTimeout(
-                        f"stream to job {e.job_id} lost and no state_dir "
-                        f"to poll its durable record from",
-                        job_id=e.job_id) from e
-                return poll_result(state_dir, e.job_id,
-                                   deadline_s=poll_deadline_s)
-            last = e          # unacknowledged — safe to resubmit
+                if state_dir is not None:
+                    return poll_result(state_dir, e.job_id,
+                                       deadline_s=poll_deadline_s)
+                return poll_result_net(socket_path, e.job_id,
+                                       deadline_s=poll_deadline_s,
+                                       rng=rng)
+            last = e          # unacknowledged — the idem key makes the
+            #                   resubmit below safe even if the ack was
+            #                   written but never reached us
         except ServeTimeout:
             raise
         except (ConnectionError, FileNotFoundError, OSError) as e:
@@ -217,3 +245,43 @@ def poll_result(state_dir: str, job_id: str, deadline_s: float = 300.0,
         time.sleep(interval)
     raise ServeTimeout(f"no result record for job {job_id} within "
                        f"{deadline_s:.0f}s ({path})", job_id=job_id)
+
+
+def poll_result_net(socket_path: str, job_id: str,
+                    deadline_s: float = 300.0, interval: float = 0.5,
+                    jitter: float = 0.5,
+                    rng: Optional[random.Random] = None) -> dict:
+    """Wait for a job's durable terminal record via the ``result`` op —
+    the network twin of :func:`poll_result` for clients that cannot see
+    the server's filesystem (TCP mode, or any fleet behind the router).
+
+    Re-dials ``socket_path`` on EVERY attempt: when that address is the
+    router's, each poll re-resolves to whichever replica currently holds
+    the record, so the answer arrives even while the job is migrating
+    between replicas mid-failover. Strictly read-only — it can never
+    duplicate work, only observe it. Transport errors (the router itself
+    restarting, a replica relaunching) back off with jitter so a fleet
+    of waiting clients doesn't re-dial in lockstep; ``pending`` answers
+    poll at the flat ``interval``.
+
+    Raises :class:`ServeTimeout` naming ``job_id`` at the deadline."""
+    rng = rng if rng is not None else random.Random()
+    deadline = time.time() + deadline_s
+    fails = 0
+    while time.time() < deadline:
+        try:
+            for ev in request(socket_path,
+                              {"op": "result", "job_id": job_id},
+                              timeout=min(30.0, deadline_s)):
+                if ev.get("event") not in ("pending", "error"):
+                    return ev
+                break
+            fails = 0
+            time.sleep(interval)
+        except (OSError, ServeConnectionLost, protocol.ProtocolError):
+            fails += 1
+            time.sleep(min(8.0, interval * (2 ** min(fails, 4)))
+                       + rng.uniform(0.0, jitter))
+    raise ServeTimeout(f"no result record for job {job_id} within "
+                       f"{deadline_s:.0f}s (via {socket_path})",
+                       job_id=job_id)

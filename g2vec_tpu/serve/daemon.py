@@ -67,7 +67,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 from g2vec_tpu.batch.engine import (LaneVariant, ManifestError,
                                     ResidentEngine, _variant_from_dict,
                                     seed_sweep_variants)
-from g2vec_tpu.config import G2VecConfig, config_from_job
+from g2vec_tpu.config import (G2VecConfig, config_from_job,
+                              serve_join_key)
 from g2vec_tpu.resilience.lifecycle import (DrainRequested, JobCancelled,
                                             JobDeadlineExceeded,
                                             JobInterrupted)
@@ -84,21 +85,19 @@ PRIORITIES = ("interactive", "batch")
 #: scheduler joins them anyway) so admission stays per-tenant fair.
 MAX_JOB_LANES = 64
 
-#: Config fields EXCLUDED from the job-join key: per-lane variant axes
-#: (concrete on each LaneVariant by plan time, so the base default is
-#: irrelevant), output/stream locations, and daemon-owned infrastructure.
-#: Everything else must coincide for two jobs to share one engine batch.
-_JOIN_EXCLUDE = frozenset({
-    "result_name", "metrics_jsonl", "manifest", "batch_seeds",
-    "seed", "train_seed", "kmeans_seed", "learningRate", "epoch",
-    "patient_subsample", "subsample_seed",
-    "cache_dir", "compilation_cache", "profile_dir", "fault_plan"})
+#: The job-join key moved to config.serve_join_key (PR 11) so the router
+#: — a jax-free process — can consistent-hash it without importing the
+#: engine; this alias keeps the daemon's call sites and older tests alive.
+_join_key = serve_join_key
 
-
-def _join_key(cfg: G2VecConfig) -> Tuple:
-    return tuple((f.name, repr(getattr(cfg, f.name)))
-                 for f in dataclasses.fields(cfg)
-                 if f.name not in _JOIN_EXCLUDE)
+#: Client-generated idempotency keys (``idem_key`` in a submit payload).
+#: The daemon derives the job_id from the key, so the SAME submission —
+#: retried through a router after a replica death, or re-queued onto a
+#: survivor — maps onto one job everywhere: one journal entry, one
+#: streaming-cursor directory, one terminal record. Defined in
+#: protocol.py so the jax-free router shares the derivation.
+MAX_IDEM_KEY = protocol.MAX_IDEM_KEY
+idem_job_id = protocol.idem_job_id
 
 
 class QueueFull(RuntimeError):
@@ -118,6 +117,22 @@ class ServeOptions:
     cache_dir: Optional[str] = None
     metrics_jsonl: Optional[str] = None
     fault_plan: Optional[str] = None
+    #: TCP front door ("host:port", port 0 = ephemeral): a second listener
+    #: speaking the same JSONL protocol + HTTP /status. The UNIX socket
+    #: stays — local clients and the router keep their cheap path.
+    listen: Optional[str] = None
+    #: Shared-secret tenancy for the network listener: when set, every
+    #: MUTATING op (submit/cancel/drain/shutdown) must carry a matching
+    #: ``auth_token`` field or is rejected at admission. ``status``/
+    #: ``ping`` stay open — health probes must not need secrets.
+    auth_token: Optional[str] = None
+    #: Per-connection socket deadline: a client that stalls mid-request
+    #: (or stops reading its event stream) is disconnected instead of
+    #: holding a handler thread forever.
+    read_deadline_s: float = 30.0
+    #: Hard bound on one request line; an oversized request is answered
+    #: with a structured error, never buffered past this.
+    max_request_bytes: int = 0   # 0 = protocol.MAX_LINE_BYTES
 
 
 @dataclasses.dataclass
@@ -139,6 +154,9 @@ class ServeJob:
     #: to the client, not to whichever daemon incarnation runs the job).
     deadline_s: Optional[float] = None
     queued_at: float = 0.0       # set at each (re)queue; drives aging
+    #: Client-generated idempotency key; job_id is derived from it, so a
+    #: retried/re-routed submission dedups instead of duplicating.
+    idem_key: Optional[str] = None
     cancel_ev: threading.Event = dataclasses.field(
         default_factory=threading.Event)
 
@@ -285,11 +303,38 @@ class ServeDaemon:
         self._batches = 0
         self.jobs_done = 0
         self.jobs_failed = 0
+        self._last_beat = self._t0   # scheduler liveness, see /status
+        self.tcp_addr: Optional[Tuple[str, int]] = None
+        #: idem_key -> job_id for every job this state dir has seen
+        #: (journaled, running, or terminally recorded) — the dedup table
+        #: behind exactly-once acks. Rebuilt from disk at boot so a
+        #: relaunch keeps refusing duplicates it acked in a past life.
+        self._idem: Dict[str, str] = {}
+        self._load_idem_table()
         if opts.fault_plan:
             from g2vec_tpu.resilience.faults import install_plan
 
             install_plan(opts.fault_plan)
         self._recover_journal()
+
+    def _load_idem_table(self) -> None:
+        import json
+
+        for d, extract in ((self._jobs_dir,
+                            lambda r: r.get("payload", {}).get("idem_key")),
+                           (self._results_dir,
+                            lambda r: r.get("idem_key"))):
+            for fn in os.listdir(d):
+                if not fn.endswith(".json"):
+                    continue
+                try:
+                    with open(os.path.join(d, fn)) as f:
+                        rec = json.load(f)
+                except (OSError, ValueError):
+                    continue
+                key = extract(rec)
+                if isinstance(key, str) and key:
+                    self._idem[key] = rec.get("job_id", fn[:-5])
 
     # ---- admission --------------------------------------------------------
 
@@ -322,6 +367,12 @@ class ServeDaemon:
                 raise ValueError(f"'deadline_s' must be a positive number, "
                                  f"got {deadline_s!r}")
             deadline_s = float(deadline_s)
+        idem_key = payload.get("idem_key")
+        if idem_key is not None:
+            if not isinstance(idem_key, str) or not idem_key \
+                    or len(idem_key) > MAX_IDEM_KEY:
+                raise ValueError(f"'idem_key' must be a 1-{MAX_IDEM_KEY} "
+                                 f"char string, got {idem_key!r}")
         jobd = payload.get("job")
         if not isinstance(jobd, dict):
             raise ValueError("submit needs a 'job' object")
@@ -356,11 +407,20 @@ class ServeDaemon:
                                  f"lane outputs would overwrite each other")
         else:
             variants = [_variant_from_dict(0, {"name": "v"}, cfg)]
-        job = ServeJob(job_id=job_id or self._new_job_id(), tenant=tenant,
+        if job_id is None:
+            # The id is DERIVED from the idempotency key: the same
+            # submission lands on the same job_id on any daemon (journal
+            # entry, ckpt cursor dirs, and result record all share the
+            # name), which is what makes cross-replica failover resume
+            # instead of restart.
+            job_id = idem_job_id(idem_key) if idem_key \
+                else self._new_job_id()
+        job = ServeJob(job_id=job_id, tenant=tenant,
                        cfg=cfg, variants=variants, raw=payload,
                        submitted_at=(time.time() if submitted_at is None
                                      else submitted_at),
-                       priority=priority, deadline_s=deadline_s)
+                       priority=priority, deadline_s=deadline_s,
+                       idem_key=idem_key)
         job.join_key = _join_key(cfg)
         return job
 
@@ -375,6 +435,25 @@ class ServeDaemon:
                               detail=str(e)[:300])
             return {"event": "rejected", "error": "bad_job",
                     "detail": str(e)[:500]}
+        if job.idem_key is not None and job.idem_key in self._idem:
+            # Exactly-once ack: this submission (same client-generated
+            # idem_key) was already accepted by this state dir — maybe in
+            # a previous daemon incarnation, maybe re-routed here after a
+            # failover the client never saw. Never run it twice: answer
+            # with the ORIGINAL job_id; if it already finished, stream
+            # the durable record so the caller needn't even poll.
+            orig = self._idem[job.idem_key]
+            self.metrics.bind_job(orig).emit("job_deduped",
+                                             tenant=job.tenant)
+            resp = {"event": "accepted", "job_id": orig,
+                    "tenant": job.tenant, "deduped": True,
+                    "state_dir": self.opts.state_dir}
+            if subscriber is not None:
+                rec = self._read_result(orig)
+                if rec is not None:
+                    subscriber.put(rec)
+                subscriber.put(None)
+            return resp
         if self._stop.is_set() or self._draining:
             return {"event": "rejected",
                     "error": ("draining" if self._draining
@@ -391,6 +470,8 @@ class ServeDaemon:
                               f"--queue-depth cap ({self.opts.queue_depth})",
                     "queue_depth": self.opts.queue_depth,
                     "job_id": job.job_id}
+        if job.idem_key is not None:
+            self._idem[job.idem_key] = job.job_id
         self._journal(job)
         self._job_state(job.job_id, "queued", tenant=job.tenant,
                         priority=job.priority)
@@ -415,6 +496,25 @@ class ServeDaemon:
             os.unlink(os.path.join(self._jobs_dir, f"{job.job_id}.json"))
         except OSError:
             pass
+
+    def _read_result(self, job_id: str) -> Optional[dict]:
+        import json
+
+        path = os.path.join(self._results_dir, f"{job_id}.json")
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def journal_depth(self) -> int:
+        """Accepted-but-unfinished jobs on disk — what a relaunch would
+        re-queue, and what the router migrates off a dead replica."""
+        try:
+            return sum(1 for fn in os.listdir(self._jobs_dir)
+                       if fn.endswith(".json"))
+        except OSError:
+            return 0
 
     def _recover_journal(self) -> None:
         """Re-queue every journaled (accepted, unfinished) job — the
@@ -497,6 +597,7 @@ class ServeDaemon:
         record, journal removal, cursor cleanup, subscriber notice."""
         record = {"event": f"job_{status}", "job_id": job.job_id,
                   "tenant": job.tenant, "status": status, "detail": detail,
+                  "idem_key": job.idem_key,
                   "submitted_at": job.submitted_at,
                   "finished_at": time.time()}
         write_json_atomic(
@@ -677,6 +778,7 @@ class ServeDaemon:
         for j in batch:
             record = {"event": "job_done", "job_id": j.job_id,
                       "tenant": j.tenant, "status": "done",
+                      "idem_key": j.idem_key,
                       "variants": by_job.get(j.job_id, {}),
                       "batch": bid, "joined_jobs": len(batch),
                       "batch_wall_seconds": round(wall, 3),
@@ -779,7 +881,7 @@ class ServeDaemon:
                        classified: str) -> None:
         record = {"event": "job_failed", "job_id": job.job_id,
                   "tenant": job.tenant, "status": "failed", "error": err,
-                  "classified": classified,
+                  "idem_key": job.idem_key, "classified": classified,
                   "submitted_at": job.submitted_at,
                   "finished_at": time.time()}
         write_json_atomic(
@@ -804,7 +906,16 @@ class ServeDaemon:
         return {"event": "status", "pid": os.getpid(),
                 "uptime_s": round(time.time() - self._t0, 1),
                 "socket": self.opts.socket_path,
+                "listen": (f"{self.tcp_addr[0]}:{self.tcp_addr[1]}"
+                           if self.tcp_addr else None),
                 "state_dir": self.opts.state_dir,
+                #: Scheduler-loop liveness. Observability only — this age
+                #: grows through any long batch (step() blocks in the
+                #: engine), so the router's health machine keys on probe
+                #: reachability, never on this number.
+                "last_heartbeat_age_s": round(time.time()
+                                              - self._last_beat, 3),
+                "journal_depth": self.journal_depth(),
                 "queued": self._queue.depth(), "running": running,
                 "queued_by_priority": self._queue.depths(),
                 "draining": self._draining,
@@ -819,10 +930,29 @@ class ServeDaemon:
     # ---- socket front-end -------------------------------------------------
 
     def _handle_conn(self, conn: "socket.socket") -> None:
+        # Per-connection deadline: bounds the request read AND any later
+        # send to a client that stopped reading its event stream. A
+        # stalled or byte-trickling peer costs one thread for at most
+        # read_deadline_s, never forever (the PR 11 front-door contract,
+        # applied to the UNIX listener too).
+        try:
+            conn.settimeout(self.opts.read_deadline_s)
+        except OSError:
+            pass
+        max_bytes = self.opts.max_request_bytes or protocol.MAX_LINE_BYTES
         f = conn.makefile("rwb")
         try:
-            first = f.readline(protocol.MAX_LINE_BYTES)
+            try:
+                first = f.readline(max_bytes + 1)
+            except socket.timeout:
+                return                      # stalled before a full request
             if not first:
+                return
+            if len(first) > max_bytes and not first.endswith(b"\n"):
+                protocol.write_event(
+                    f, {"event": "error", "error": "oversized_request",
+                        "detail": f"request line exceeds the "
+                                  f"{max_bytes}-byte bound"})
                 return
             if first.startswith(b"GET "):
                 self._serve_http(f, first)
@@ -838,6 +968,19 @@ class ServeDaemon:
                                          "error": f"bad request: {e}"})
                 return
             op = req.get("op")
+            if self.opts.auth_token is not None \
+                    and op in ("submit", "cancel", "drain", "shutdown") \
+                    and req.get("auth_token") != self.opts.auth_token:
+                # Tenancy is checked AT ADMISSION: a mutating op without
+                # the shared secret never reaches planning or the queue.
+                # status/ping stay open — the router's health probes (and
+                # any curl) must not need credentials.
+                self.metrics.emit("auth_rejected", op=op)
+                protocol.write_event(
+                    f, {"event": "rejected", "error": "unauthorized",
+                        "detail": f"op {op!r} requires a valid "
+                                  f"'auth_token' on this listener"})
+                return
             if op == "submit":
                 sub: "queue.Queue" = queue.Queue()
                 resp = self.admit(req, subscriber=sub)
@@ -854,6 +997,22 @@ class ServeDaemon:
             elif op == "ping":
                 protocol.write_event(f, {"event": "pong",
                                          "pid": os.getpid()})
+            elif op == "result":
+                # Durable-record lookup: the network recovery path after
+                # a lost stream (client.poll_result_net) — works without
+                # filesystem access to the state dir.
+                job_id = req.get("job_id")
+                if not isinstance(job_id, str) or not job_id:
+                    protocol.write_event(
+                        f, {"event": "error",
+                            "error": "result needs a 'job_id' string"})
+                else:
+                    rec = self._read_result(job_id)
+                    protocol.write_event(
+                        f, rec if rec is not None else
+                        {"event": "pending", "job_id": job_id,
+                         "journaled": os.path.exists(os.path.join(
+                             self._jobs_dir, f"{job_id}.json"))})
             elif op == "cancel":
                 job_id = req.get("job_id")
                 if not isinstance(job_id, str) or not job_id:
@@ -914,6 +1073,7 @@ class ServeDaemon:
         def _sched():
             while not self._stop.is_set():
                 try:
+                    self._last_beat = time.time()
                     self.step(timeout=0.2)
                 except Exception as e:  # noqa: BLE001 — daemon must live
                     self.console(f"[serve] scheduler error: "
@@ -940,28 +1100,75 @@ class ServeDaemon:
         srv.bind(self.opts.socket_path)
         srv.listen(16)
         srv.settimeout(0.25)
-        self.metrics.emit("serve_start", pid=os.getpid(),
-                          socket=self.opts.socket_path,
-                          state_dir=self.opts.state_dir,
-                          queued=self._queue.depth())
-        self.console(f"[serve] listening on {self.opts.socket_path} "
-                     f"(state {self.opts.state_dir}, queue depth "
-                     f"{self.opts.queue_depth}, max join "
-                     f"{self.opts.max_join})")
-        try:
+        # Pidfile: the last-resort fence target. A router that restarts
+        # and cannot probe this replica (busy, wedged) must still be
+        # able to kill it before launching a successor on the same
+        # state dir — an unfenced zombie would race the successor on
+        # the same journal. Verified against /proc cmdline by the
+        # reader, so a recycled pid is never killed.
+        pid_file = os.path.join(self.opts.state_dir, "serve.pid")
+        with open(pid_file + ".tmp", "w") as fh:
+            fh.write(f"{os.getpid()}\n")
+        os.replace(pid_file + ".tmp", pid_file)
+        tcp_srv = None
+        if self.opts.listen:
+            host, port = protocol.parse_addr(self.opts.listen)
+            tcp_srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            tcp_srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            tcp_srv.bind((host, port))
+            tcp_srv.listen(64)
+            tcp_srv.settimeout(0.25)
+            self.tcp_addr = tcp_srv.getsockname()[:2]
+            # Discovery file: router/clients learn the ephemeral port
+            # (port 0 requests) without parsing our stderr.
+            addr_file = os.path.join(self.opts.state_dir, "tcp_addr")
+            with open(addr_file + ".tmp", "w") as fh:
+                fh.write(f"{self.tcp_addr[0]}:{self.tcp_addr[1]}\n")
+            os.replace(addr_file + ".tmp", addr_file)
+
+        def _accept_loop(lsock):
             while not self._stop.is_set():
                 try:
-                    conn, _ = srv.accept()
+                    conn, _ = lsock.accept()
                 except socket.timeout:
                     continue
                 except OSError:
                     break
                 threading.Thread(target=self._handle_conn, args=(conn,),
                                  name="g2v-serve-conn", daemon=True).start()
+
+        self.metrics.emit("serve_start", pid=os.getpid(),
+                          socket=self.opts.socket_path,
+                          listen=(f"{self.tcp_addr[0]}:{self.tcp_addr[1]}"
+                                  if self.tcp_addr else None),
+                          state_dir=self.opts.state_dir,
+                          queued=self._queue.depth())
+        self.console(f"[serve] listening on {self.opts.socket_path}"
+                     + (f" + tcp {self.tcp_addr[0]}:{self.tcp_addr[1]}"
+                        if self.tcp_addr else "")
+                     + f" (state {self.opts.state_dir}, queue depth "
+                       f"{self.opts.queue_depth}, max join "
+                       f"{self.opts.max_join})")
+        tcp_thread = None
+        if tcp_srv is not None:
+            tcp_thread = threading.Thread(target=_accept_loop,
+                                          args=(tcp_srv,),
+                                          name="g2v-serve-tcp", daemon=True)
+            tcp_thread.start()
+        try:
+            _accept_loop(srv)
         finally:
             srv.close()
+            if tcp_srv is not None:
+                tcp_srv.close()
+                if tcp_thread is not None:
+                    tcp_thread.join(timeout=2.0)
             try:
                 os.unlink(self.opts.socket_path)
+            except OSError:
+                pass
+            try:
+                os.unlink(pid_file)    # clean exit: nothing to fence
             except OSError:
                 pass
             sched.join(timeout=600.0)

@@ -1,4 +1,4 @@
-"""Wire protocol for ``g2vec serve``: JSONL over a local UNIX socket.
+"""Wire protocol for ``g2vec serve``: JSONL over a UNIX or TCP socket.
 
 One request object per connection, newline-terminated; the daemon answers
 with a stream of newline-delimited JSON events and closes the stream after
@@ -19,12 +19,36 @@ Requests::
      "priority": "interactive", "deadline_s": 120}       # both optional
     {"op": "status"} | {"op": "ping"} | {"op": "shutdown"}
     {"op": "cancel", "job_id": "j0001-..."}              # cooperative
+    {"op": "result", "job_id": "i..."}   # durable record or "pending"
     {"op": "drain"}     # stop admitting, checkpoint, journal, exit 0
+
+Addressing: an address containing ``host:port`` dials TCP, anything else
+is a UNIX socket path — :func:`parse_addr` / :func:`dial` keep client,
+router, and tooling on one resolver. TCP adds two request fields:
+``auth_token`` (checked at admission for mutating ops when the listener
+was started with a token) and ``idem_key`` (client-generated idempotency
+key; resubmits with the same key are acked once, see daemon.py).
 """
 from __future__ import annotations
 
+import hashlib
 import json
-from typing import IO, Optional
+import re
+import socket
+from typing import IO, Optional, Tuple, Union
+
+#: Client-generated idempotency keys (``idem_key`` in a submit payload).
+#: Lives here — not in daemon.py — because the jax-free router must
+#: derive job ids too (sticky routing: a key the fleet has seen resolves
+#: to its existing home replica, never to a fresh ring placement).
+MAX_IDEM_KEY = 128
+
+
+def idem_job_id(idem_key: str) -> str:
+    """Derive the job_id from the idempotency key. Same key -> same id
+    -> same journal/checkpoint/result names on ANY replica: the naming
+    scheme IS the exactly-once mechanism."""
+    return "i" + hashlib.sha256(idem_key.encode()).hexdigest()[:12]
 
 #: One line must fit a submit with a large manifest, with headroom; a
 #: longer line is a protocol error, not an OOM.
@@ -33,6 +57,39 @@ MAX_LINE_BYTES = 8 << 20
 
 class ProtocolError(ValueError):
     """A malformed request/response line."""
+
+
+#: ``host:port`` — hostname/IPv4 literal, no scheme. A bare path never
+#: matches (paths contain ``/`` or no colon), so UNIX sockets stay the
+#: default and nothing existing re-resolves.
+_TCP_ADDR = re.compile(r"^([A-Za-z0-9._-]+):([0-9]{1,5})$")
+
+
+def parse_addr(addr: str) -> Union[Tuple[str, int], str]:
+    """``"host:port"`` → ``(host, port)`` for TCP; anything else is
+    returned unchanged as a UNIX socket path."""
+    m = _TCP_ADDR.match(addr)
+    if m:
+        port = int(m.group(2))
+        if port > 65535:
+            raise ProtocolError(f"port out of range in {addr!r}")
+        return m.group(1), port
+    return addr
+
+
+def dial(addr: str, timeout: Optional[float] = None) -> socket.socket:
+    """Connect to a serve endpoint — TCP for ``host:port``, UNIX
+    otherwise. The returned socket has ``timeout`` applied (None = block
+    forever), matching both listeners' JSONL framing."""
+    parsed = parse_addr(addr)
+    if isinstance(parsed, tuple):
+        sock = socket.create_connection(parsed, timeout=timeout)
+    else:
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.settimeout(timeout)
+        sock.connect(parsed)
+    sock.settimeout(timeout)
+    return sock
 
 
 def write_event(f: IO[bytes], obj: dict) -> None:

@@ -1,0 +1,873 @@
+"""The serve fleet front door: one TCP listener over N daemon replicas.
+
+The router is the piece that turns ``g2vec serve`` from a single-host
+daemon into a fleet that survives replica death with zero lost and zero
+duplicated jobs:
+
+- **Placement** — a consistent-hash ring over the job's join key (the
+  same :func:`~g2vec_tpu.config.serve_join_key` the daemon batches on),
+  so shape-compatible jobs from different clients land on the SAME
+  replica and still join one warm batch. 64 virtual nodes per replica
+  keep the key movement on replica add/remove near the theoretical
+  1/N minimum.
+- **Health** — a per-replica probe loop (``status`` over TCP, with a
+  deadline) drives the healthy → suspect → dead → rejoining machine in
+  :class:`~g2vec_tpu.resilience.lifecycle.ReplicaHealth`; probes back
+  off exponentially for unhealthy replicas.
+- **Failover** — when a replica is declared dead the router *fences* it
+  (SIGKILL via :class:`~g2vec_tpu.resilience.supervisor.ReplicaFleet`,
+  so a slow-but-alive replica can never race a survivor), then walks
+  its journal: entries with a durable result record are dropped (the
+  PR 9 reconciliation), the rest have their streaming cursors copied to
+  a survivor and are resubmitted there — with the ORIGINAL idempotency
+  key, so the survivor's dedup table acks them exactly once even if the
+  router itself dies and retries the whole failover. Only after the
+  journal is empty is the replica relaunched; it rejoins the ring once
+  consecutive probes pass with an empty journal.
+
+The exactly-once argument, end to end: every routed job carries an
+idem-key (client-supplied or router-minted); the job_id is DERIVED from
+that key, so journal entries, cursor checkpoints, and result records
+keep their names across replicas; any resubmission — client retry after
+a lost ack, router failover, repeated failover after a router crash —
+therefore either dedups against a live admission table, reconciles
+against a result record, or resumes the same cursor. No path re-runs
+completed work, no path drops acked work.
+
+This module is deliberately **jax-free** (it imports config, protocol,
+lifecycle, supervisor, metrics — never daemon/engine): a router process
+boots in milliseconds and never competes with replicas for accelerator
+or heap.
+"""
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import glob
+import hashlib
+import json
+import os
+import queue
+import shutil
+import socket
+import threading
+import time
+import uuid
+from typing import Callable, Dict, List, Optional, Tuple
+
+from g2vec_tpu.config import G2VecConfig, config_from_job, serve_join_key
+from g2vec_tpu.resilience.lifecycle import ReplicaHealth
+from g2vec_tpu.resilience.supervisor import ReplicaFleet, ReplicaSpec
+from g2vec_tpu.serve import protocol
+from g2vec_tpu.utils.metrics import MetricsWriter
+
+#: Mutating ops — the only ones the auth token gates (probes stay open).
+_AUTH_OPS = ("submit", "cancel", "drain_replica", "shutdown")
+
+
+# ---------------------------------------------------------------------------
+# Consistent hashing
+# ---------------------------------------------------------------------------
+
+class HashRing:
+    """Consistent-hash ring with virtual nodes. ``lookup`` walks
+    clockwise past members the caller marks ineligible, so health is an
+    overlay — the ring itself only changes on add/remove, which is what
+    keeps key movement minimal."""
+
+    def __init__(self, vnodes: int = 64):
+        self.vnodes = vnodes
+        self._points: List[Tuple[int, str]] = []
+        self._members: set = set()
+
+    @staticmethod
+    def _h(key: str) -> int:
+        return int.from_bytes(
+            hashlib.sha256(key.encode()).digest()[:8], "big")
+
+    def add(self, name: str) -> None:
+        if name in self._members:
+            return
+        self._members.add(name)
+        for i in range(self.vnodes):
+            bisect.insort(self._points, (self._h(f"{name}#{i}"), name))
+
+    def remove(self, name: str) -> None:
+        self._members.discard(name)
+        self._points = [p for p in self._points if p[1] != name]
+
+    def members(self) -> List[str]:
+        return sorted(self._members)
+
+    def lookup(self, key: str, eligible=None) -> Optional[str]:
+        """Owner of ``key`` among ``eligible`` members (all, if None)."""
+        if not self._points:
+            return None
+        ok = self._members if eligible is None \
+            else (self._members & set(eligible))
+        if not ok:
+            return None
+        i = bisect.bisect_right(self._points, (self._h(key), "￿"))
+        for off in range(len(self._points)):
+            _, name = self._points[(i + off) % len(self._points)]
+            if name in ok:
+                return name
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Router
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class RouterOptions:
+    #: Fleet root: ``<fleet_dir>/rN/{sock,state/,serve.log}`` per replica,
+    #: plus ``router_addr`` / ``router.log`` / ``router-metrics.jsonl``.
+    fleet_dir: str
+    replicas: int = 2
+    listen: str = "127.0.0.1:0"
+    #: Probe cadence for healthy replicas; unhealthy ones back off
+    #: exponentially from this base (ReplicaHealth.probe_interval).
+    probe_interval: float = 0.5
+    #: Socket deadline on one probe — a replica that cannot answer
+    #: ``status`` within this is a failed probe.
+    probe_deadline: float = 2.0
+    suspect_after: int = 1
+    dead_after: int = 3
+    rejoin_after: int = 2
+    #: Shared secret: required on mutating client ops AND forwarded on
+    #: every replica request (replicas are started with the same token).
+    auth_token: Optional[str] = None
+    read_deadline_s: float = 30.0
+    max_request_bytes: int = 0
+    metrics_jsonl: Optional[str] = None
+    #: Extra argv appended to every replica's ``g2vec serve`` command
+    #: (cache dir, queue depth, fault plans for chaos drills, ...).
+    serve_argv: Tuple[str, ...] = ()
+    #: Grace before SIGKILL when fencing a dead-declared replica.
+    fence_grace_s: float = 1.0
+    vnodes: int = 64
+
+
+class Router:
+    """Health-checked, consistent-hashing front door over a ReplicaFleet.
+
+    Durable state lives ONLY in the replicas' state dirs (journal,
+    results, cursors, idem tables) — the router itself can be SIGKILLed
+    and relaunched at any point: on boot it probes each replica socket
+    and either *adopts* the live daemon (pid from its status) or runs
+    the same failover it would run for a mid-flight death.
+    """
+
+    def __init__(self, opts: RouterOptions,
+                 console: Callable[[str], None] = print):
+        self.opts = opts
+        self.console = console
+        os.makedirs(opts.fleet_dir, exist_ok=True)
+        self.metrics = MetricsWriter(
+            opts.metrics_jsonl
+            or os.path.join(opts.fleet_dir, "router-metrics.jsonl"),
+            append=True)
+        serve_argv = list(opts.serve_argv)
+        if opts.auth_token is not None:
+            tok_file = os.path.join(opts.fleet_dir, "auth_token")
+            with open(tok_file, "w") as fh:
+                fh.write(opts.auth_token)
+            os.chmod(tok_file, 0o600)
+            serve_argv += ["--auth-token-file", tok_file]
+        self.fleet = ReplicaFleet(opts.fleet_dir, opts.replicas,
+                                  serve_argv=serve_argv, console=console)
+        self.ring = HashRing(vnodes=opts.vnodes)
+        self.health: Dict[str, ReplicaHealth] = {}
+        for name in self.fleet.names():
+            self.ring.add(name)
+            self.health[name] = ReplicaHealth(
+                name, suspect_after=opts.suspect_after,
+                dead_after=opts.dead_after,
+                rejoin_after=opts.rejoin_after)
+        self._defaults = G2VecConfig()     # identical to the daemon's
+        self._hlock = threading.RLock()
+        self._stop = threading.Event()
+        self._assigned: Dict[str, str] = {}     # job_id -> replica name
+        self._requeue_latencies: List[float] = []
+        self.failovers = 0
+        self.jobs_routed = 0
+        self.tcp_addr: Optional[Tuple[str, int]] = None
+        self._t0 = time.time()
+
+    # ---- replica I/O ------------------------------------------------------
+
+    def _replica_addr(self, name: str) -> Optional[str]:
+        return self.fleet.replica(name).addr
+
+    def _request(self, name: str, req: dict,
+                 timeout: Optional[float] = None) -> dict:
+        """One request / one response to a replica (status, result,
+        cancel, drain — everything but the submit relay)."""
+        addr = self._replica_addr(name)
+        if not addr:
+            raise ConnectionError(f"replica {name} has no address yet")
+        out = dict(req)
+        if self.opts.auth_token is not None:
+            out.setdefault("auth_token", self.opts.auth_token)
+        sock = protocol.dial(addr, timeout=timeout
+                             if timeout is not None else 10.0)
+        try:
+            f = sock.makefile("rwb")
+            protocol.write_event(f, out)
+            ev = protocol.read_event(f)
+            if ev is None:
+                raise ConnectionError(f"replica {name} closed the stream")
+            return ev
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def probe(self, name: str) -> Tuple[bool, int]:
+        """One health probe: (reachable, journal_depth)."""
+        try:
+            st = self._request(name, {"op": "status"},
+                               timeout=self.opts.probe_deadline)
+            if st.get("event") != "status":
+                return False, 0
+            pid = st.get("pid")
+            spec = self.fleet.replica(name)
+            if spec.pid is None and isinstance(pid, int):
+                self.fleet.adopt(name, pid, st.get("listen"))
+            return True, int(st.get("journal_depth") or 0)
+        except (OSError, protocol.ProtocolError, ValueError):
+            return False, 0
+
+    # ---- placement --------------------------------------------------------
+
+    def _join_key_str(self, payload: dict) -> str:
+        """The placement key: the daemon's batching join key, stringified.
+        Raises ValueError for payloads the replica would reject — router
+        admission catches garbage before it costs a forward."""
+        jobd = payload.get("job")
+        if not isinstance(jobd, dict):
+            raise ValueError("submit needs a 'job' object")
+        base = dict(jobd)
+        base.pop("variants", None)
+        base.pop("seeds", None)
+        cfg = config_from_job(base, self._defaults)
+        return repr(serve_join_key(cfg))
+
+    def _eligible(self) -> List[str]:
+        with self._hlock:
+            return [n for n, h in self.health.items() if h.in_ring]
+
+    def pick_replica(self, payload: dict) -> Optional[str]:
+        return self.ring.lookup(self._join_key_str(payload),
+                                eligible=self._eligible())
+
+    # ---- failover ---------------------------------------------------------
+
+    def _dead_paths(self, name: str):
+        spec = self.fleet.replica(name)
+        return (os.path.join(spec.state_dir, "jobs"),
+                os.path.join(spec.state_dir, "results"),
+                os.path.join(spec.state_dir, "ckpt"))
+
+    def _failover(self, name: str, relaunch: bool = True) -> int:
+        """Fence a dead replica, migrate its journal to survivors, then
+        relaunch it. Returns the number of jobs re-queued. Serialized by
+        the probe loop (one failover at a time)."""
+        died_at = time.monotonic()
+        self.fleet.fence(name, grace_s=self.opts.fence_grace_s)
+        jobs_dir, results_dir, ckpt_dir = self._dead_paths(name)
+        entries = []
+        if os.path.isdir(jobs_dir):
+            for fn in sorted(os.listdir(jobs_dir)):
+                if fn.endswith(".json"):
+                    try:
+                        with open(os.path.join(jobs_dir, fn)) as fh:
+                            entries.append(json.load(fh))
+                    except (OSError, ValueError):
+                        self.console(f"[router] unreadable journal "
+                                     f"{name}/{fn}; leaving for the "
+                                     f"replica's own recovery")
+        requeued = 0
+        for rec in sorted(entries,
+                          key=lambda r: r.get("submitted_at", 0.0)):
+            job_id = rec.get("job_id")
+            payload = rec.get("payload")
+            if not isinstance(job_id, str) or not isinstance(payload, dict):
+                continue
+            jpath = os.path.join(jobs_dir, f"{job_id}.json")
+            if os.path.exists(os.path.join(results_dir,
+                                           f"{job_id}.json")):
+                # Died between result write and journal unlink: the job
+                # FINISHED. Reconcile, never re-run (exactly-once).
+                try:
+                    os.unlink(jpath)
+                except OSError:
+                    pass
+                self.metrics.emit("failover_reconciled", job_id=job_id,
+                                  from_replica=name)
+                continue
+            dup_home = next(
+                (n for n in self.fleet.names() if n != name
+                 and os.path.exists(os.path.join(self._dead_paths(n)[0],
+                                                 f"{job_id}.json"))),
+                None)
+            if dup_home is not None:
+                # Double-journaled (a crash inside a previous failover's
+                # resubmit-then-unlink window): the job already lives on
+                # another replica. Dropping the dead copy — NOT
+                # re-migrating it — is what keeps it exactly-once.
+                try:
+                    os.unlink(jpath)
+                except OSError:
+                    pass
+                self.metrics.emit("failover_reconciled", job_id=job_id,
+                                  from_replica=name, already_on=dup_home)
+                continue
+            try:
+                target = self.ring.lookup(self._join_key_str(payload),
+                                          eligible=[n for n in
+                                                    self._eligible()
+                                                    if n != name])
+            except (ValueError, TypeError):
+                target = None
+            if target is None:
+                # No survivor can take it — leave it journaled; the
+                # relaunched replica re-queues it itself (PR 9 path).
+                self.metrics.emit("failover_deferred", job_id=job_id,
+                                  from_replica=name)
+                continue
+            tgt_ckpt = self._dead_paths(target)[2]
+            for d in glob.glob(os.path.join(ckpt_dir, f"{job_id}.*")):
+                dst = os.path.join(tgt_ckpt, os.path.basename(d))
+                # Cursor migration: the survivor resumes mid-stream from
+                # the dead replica's last durable checkpoint.
+                shutil.copytree(d, dst, dirs_exist_ok=True)
+            try:
+                resp = self._request(target, dict(payload, op="submit"),
+                                     timeout=30.0)
+            except (OSError, protocol.ProtocolError) as e:
+                self.metrics.emit("failover_error", job_id=job_id,
+                                  from_replica=name, to_replica=target,
+                                  error=str(e)[:200])
+                continue           # journal entry stays; next pass retries
+            if resp.get("event") != "accepted":
+                self.metrics.emit("failover_error", job_id=job_id,
+                                  from_replica=name, to_replica=target,
+                                  error=str(resp)[:200])
+                continue
+            try:
+                os.unlink(jpath)   # after the survivor journaled it —
+            except OSError:        # a crash here double-journals, and the
+                pass               # idem table dedups the double
+            latency = time.monotonic() - died_at
+            self._requeue_latencies.append(latency)
+            self.failovers += 1
+            requeued += 1
+            with self._hlock:
+                self._assigned[job_id] = target
+            self.metrics.emit("failover", job_id=job_id,
+                              from_replica=name, to_replica=target,
+                              deduped=bool(resp.get("deduped")),
+                              latency_s=round(latency, 4))
+            self.console(f"[router] failover {job_id}: {name} -> "
+                         f"{target} ({latency:.2f}s after death)")
+        if relaunch and not self._stop.is_set():
+            try:
+                self.fleet.launch(name)
+                self.metrics.emit("replica_relaunched", replica=name)
+            except (RuntimeError, TimeoutError, OSError) as e:
+                self.metrics.emit("replica_relaunch_failed", replica=name,
+                                  error=str(e)[:200])
+                self.console(f"[router] relaunch of {name} failed: {e}")
+        return requeued
+
+    # ---- probe loop -------------------------------------------------------
+
+    def _probe_loop(self) -> None:
+        due = {n: 0.0 for n in self.fleet.names()}
+        while not self._stop.is_set():
+            now = time.monotonic()
+            for name, h in self.health.items():
+                if now < due[name]:
+                    continue
+                ok, jd = self.probe(name)
+                with self._hlock:
+                    trans = h.on_probe(ok, journal_depth=jd,
+                                       now=time.time())
+                due[name] = time.monotonic() \
+                    + h.probe_interval(self.opts.probe_interval)
+                if trans is not None:
+                    self.metrics.emit("replica_health", replica=name,
+                                      from_state=trans[0],
+                                      to_state=trans[1],
+                                      journal_depth=jd)
+                    self.console(f"[router] {name}: {trans[0]} -> "
+                                 f"{trans[1]} (journal {jd})")
+                    if trans[1] == "dead":
+                        self._failover(name)
+            self._stop.wait(0.05)
+
+    # ---- ops --------------------------------------------------------------
+
+    def status(self) -> dict:
+        with self._hlock:
+            reps = {}
+            for name, h in self.health.items():
+                spec = self.fleet.replica(name)
+                reps[name] = dict(h.snapshot(), addr=spec.addr,
+                                  pid=spec.pid, boots=spec.boots,
+                                  assigned=sum(
+                                      1 for r in self._assigned.values()
+                                      if r == name))
+            lats = sorted(self._requeue_latencies)
+        p99 = lats[min(len(lats) - 1,
+                       int(0.99 * len(lats)))] if lats else None
+        return {"event": "status", "role": "router", "pid": os.getpid(),
+                "uptime_s": round(time.time() - self._t0, 1),
+                "listen": (f"{self.tcp_addr[0]}:{self.tcp_addr[1]}"
+                           if self.tcp_addr else None),
+                "fleet_dir": self.opts.fleet_dir,
+                "replicas": reps,
+                "jobs_routed": self.jobs_routed,
+                "failovers": self.failovers,
+                "requeue_latency_p99_s": (round(p99, 4)
+                                          if p99 is not None else None),
+                "requeue_latencies_s": [round(v, 4) for v in lats]}
+
+    def _read_result_any(self, job_id: str) -> Optional[dict]:
+        """The durable result record from ANY replica's results dir —
+        the fleet is co-located with the router, so the read path skips
+        the network (and works while a replica is down)."""
+        for name in self.fleet.names():
+            path = os.path.join(self._dead_paths(name)[1],
+                                f"{job_id}.json")
+            try:
+                with open(path) as fh:
+                    return json.load(fh)
+            except (OSError, ValueError):
+                continue
+        return None
+
+    def _journaled_anywhere(self, job_id: str) -> bool:
+        return self._journal_owner(job_id) is not None
+
+    def _journal_owner(self, job_id: str) -> Optional[str]:
+        """The replica whose journal holds ``job_id``, or None. Three
+        stat calls — cheap enough to consult on every keyed submit."""
+        for n in self.fleet.names():
+            if os.path.exists(os.path.join(self._dead_paths(n)[0],
+                                           f"{job_id}.json")):
+                return n
+        return None
+
+    def handle_result(self, job_id: str) -> dict:
+        rec = self._read_result_any(job_id)
+        if rec is not None:
+            with self._hlock:
+                self._assigned.pop(job_id, None)
+            return rec
+        return {"event": "pending", "job_id": job_id,
+                "journaled": self._journaled_anywhere(job_id)}
+
+    def handle_cancel(self, job_id: str) -> dict:
+        """Broadcast: after a failover the client's idea of where the
+        job lives is stale, so ask every reachable replica."""
+        answers = []
+        for name in self.fleet.names():
+            try:
+                resp = self._request(name, {"op": "cancel",
+                                            "job_id": job_id},
+                                     timeout=5.0)
+            except (OSError, protocol.ProtocolError):
+                continue
+            answers.append(dict(resp, replica=name))
+            if resp.get("event") in ("cancelled", "cancelling"):
+                return dict(resp, replica=name)
+        return {"event": "error", "error": f"job {job_id!r} not found on "
+                                           f"any reachable replica",
+                "answers": answers}
+
+    def handle_drain_replica(self, name: str) -> dict:
+        """Synchronous graceful drain of one replica: forward ``drain``,
+        wait for the process to exit 0, relaunch it. The journal entries
+        it checkpoints re-queue on its OWN relaunch (no migration — this
+        is maintenance, not failure)."""
+        if name not in self.health:
+            return {"event": "error",
+                    "error": f"unknown replica {name!r}"}
+        with self._hlock:
+            h = self.health[name]
+            h.force_dead(now=time.time())   # out of the ring immediately
+        try:
+            resp = self._request(name, {"op": "drain"}, timeout=10.0)
+        except (OSError, protocol.ProtocolError) as e:
+            resp = {"event": "error", "error": str(e)[:200]}
+        rc = self.fleet.fence(name, grace_s=120.0)   # graceful wait
+        self.metrics.emit("replica_drained", replica=name, rc=rc)
+        try:
+            self.fleet.launch(name)
+        except (RuntimeError, TimeoutError, OSError) as e:
+            return {"event": "drained", "replica": name, "rc": rc,
+                    "relaunch_error": str(e)[:200],
+                    "drain_response": resp}
+        return {"event": "drained", "replica": name, "rc": rc,
+                "drain_response": resp}
+
+    # ---- submit relay -----------------------------------------------------
+
+    def _relay_submit(self, f, req: dict) -> None:
+        payload = {k: v for k, v in req.items() if k != "auth_token"}
+        if not payload.get("idem_key"):
+            # Router-minted key: even a client that never heard of idem
+            # keys gets exactly-once failover semantics.
+            payload["idem_key"] = f"r-{uuid.uuid4().hex}"
+        try:
+            self._join_key_str(payload)     # router-side admission check
+        except (ValueError, TypeError) as e:
+            protocol.write_event(f, {"event": "rejected",
+                                     "error": "bad_job",
+                                     "detail": str(e)[:500]})
+            return
+        jid = protocol.idem_job_id(payload["idem_key"])
+        # Sticky exactly-once routing: a key this fleet has already seen
+        # MUST resolve to its existing home, never to a fresh ring
+        # placement. The idem dedup table is per-replica, so routing a
+        # retried key to a DIFFERENT replica than the (alive) one that
+        # journaled it would run the job twice — the ring answers where
+        # a NEW key goes; the journals answer where an old one lives.
+        # Rescan-in-a-loop because the home can be mid-migration (its
+        # replica dead, the probe loop failing it over): the journal
+        # entry moves to a survivor, or the result record appears.
+        sticky_deadline = time.monotonic() + 120.0
+        last_beat = time.monotonic()
+        while time.monotonic() < sticky_deadline:
+            rec = self._read_result_any(jid)
+            if rec is not None:
+                # Already finished somewhere: ack + stream the durable
+                # record, exactly like a daemon-side dedup hit.
+                protocol.write_event(f, {"event": "accepted",
+                                         "job_id": jid, "deduped": True})
+                protocol.write_event(f, rec)
+                return
+            owner = self._journal_owner(jid)
+            if owner is None:
+                break                      # fresh key -> ring placement
+            if self.fleet.alive(owner) \
+                    and self._relay_to(f, owner, payload):
+                return
+            # Home unreachable (dead or dying): never fall through to a
+            # successor while its journal entry exists — wait for the
+            # probe loop's fence+migrate to move it, then rescan.
+            if time.monotonic() - last_beat > 5.0:
+                protocol.write_event(f, {"event": "failover_wait",
+                                         "job_id": jid, "stale": owner})
+                last_beat = time.monotonic()
+            time.sleep(0.25)
+        tried: List[str] = []
+        for _ in range(max(1, len(self.fleet.names()))):
+            target = self.ring.lookup(
+                self._join_key_str(payload),
+                eligible=[n for n in self._eligible() if n not in tried])
+            if target is None:
+                break
+            tried.append(target)
+            if self._relay_to(f, target, payload):
+                return
+        protocol.write_event(
+            f, {"event": "rejected", "error": "no_replicas",
+                "detail": f"no healthy replica reachable "
+                          f"(tried {tried or 'none'})"})
+
+    def _relay_to(self, f, target: str, payload: dict) -> bool:
+        """Forward one submit to ``target`` and relay its event stream.
+        Returns False if the replica was unreachable BEFORE acking (safe
+        to try the next ring successor — nothing was accepted)."""
+        out = dict(payload, op="submit")
+        if self.opts.auth_token is not None:
+            out["auth_token"] = self.opts.auth_token
+        addr = self._replica_addr(target)
+        if not addr:
+            return False
+        try:
+            sock = protocol.dial(addr, timeout=10.0)
+        except OSError:
+            with self._hlock:
+                self.health[target].force_dead(now=time.time())
+            return False
+        rf = sock.makefile("rwb")
+        try:
+            try:
+                protocol.write_event(rf, out)
+                first = protocol.read_event(rf)
+            except (OSError, protocol.ProtocolError):
+                first = None
+            if first is None:
+                return False               # died pre-ack: retry elsewhere
+            job_id = first.get("job_id")
+            if first.get("event") == "accepted" and job_id:
+                self.jobs_routed += 1
+                with self._hlock:
+                    self._assigned[job_id] = target
+                first = dict(first, replica=target)
+                self.metrics.emit("job_routed", job_id=job_id,
+                                  replica=target,
+                                  deduped=bool(first.get("deduped")))
+            protocol.write_event(f, first)
+            if first.get("event") != "accepted":
+                return True
+            sock.settimeout(None)          # a batch can run for minutes
+            terminal = False
+            while True:
+                try:
+                    ev = protocol.read_event(rf)
+                except (OSError, protocol.ProtocolError):
+                    ev = None
+                if ev is None:
+                    break
+                protocol.write_event(f, ev)
+                if ev.get("event", "").startswith("job_") \
+                        and ev.get("event") != "job_state":
+                    terminal = ev.get("event") in (
+                        "job_done", "job_failed", "job_cancelled",
+                        "job_deadline_exceeded")
+            if terminal:
+                with self._hlock:
+                    self._assigned.pop(job_id, None)
+                return True
+            # Stream died after the ack with no terminal event — the
+            # replica (or its connection) is gone. The job is journaled
+            # there; the probe loop will fail it over. Hold the client
+            # and poll the durable record instead of dropping them.
+            protocol.write_event(f, {"event": "stream_lost",
+                                     "job_id": job_id,
+                                     "replica": target,
+                                     "note": "replica connection lost "
+                                             "after ack; awaiting "
+                                             "failover result"})
+            self._await_result(f, job_id)
+            return True
+        finally:
+            try:
+                rf.close()
+                sock.close()
+            except OSError:
+                pass
+
+    def _await_result(self, f, job_id: str) -> None:
+        """Poll the fleet's durable records until the failed-over job
+        lands, streaming keepalives so a dead client ends the loop."""
+        last_beat = time.monotonic()
+        while not self._stop.is_set():
+            rec = self._read_result_any(job_id)
+            if rec is not None:
+                protocol.write_event(f, rec)
+                with self._hlock:
+                    self._assigned.pop(job_id, None)
+                return
+            if time.monotonic() - last_beat > 5.0:
+                # Raises to the caller when the client hung up.
+                protocol.write_event(f, {"event": "failover_wait",
+                                         "job_id": job_id})
+                last_beat = time.monotonic()
+            time.sleep(0.2)
+
+    # ---- front-end --------------------------------------------------------
+
+    def _handle_conn(self, conn: socket.socket) -> None:
+        try:
+            conn.settimeout(self.opts.read_deadline_s)
+        except OSError:
+            pass
+        max_bytes = self.opts.max_request_bytes or protocol.MAX_LINE_BYTES
+        f = conn.makefile("rwb")
+        try:
+            try:
+                first = f.readline(max_bytes + 1)
+            except socket.timeout:
+                return
+            if not first:
+                return
+            if len(first) > max_bytes and not first.endswith(b"\n"):
+                protocol.write_event(
+                    f, {"event": "error", "error": "oversized_request",
+                        "detail": f"request line exceeds the "
+                                  f"{max_bytes}-byte bound"})
+                return
+            if first.startswith(b"GET "):
+                self._serve_http(f, first)
+                return
+            try:
+                req = json.loads(first)
+                if not isinstance(req, dict):
+                    raise ValueError("request must be a JSON object")
+            except ValueError as e:
+                protocol.write_event(f, {"event": "error",
+                                         "error": f"bad request: {e}"})
+                return
+            op = req.get("op")
+            if self.opts.auth_token is not None and op in _AUTH_OPS \
+                    and req.get("auth_token") != self.opts.auth_token:
+                self.metrics.emit("auth_rejected", op=op)
+                protocol.write_event(
+                    f, {"event": "rejected", "error": "unauthorized",
+                        "detail": f"op {op!r} requires a valid "
+                                  f"'auth_token' on this listener"})
+                return
+            if op == "submit":
+                self._relay_submit(f, req)
+            elif op == "status":
+                protocol.write_event(f, self.status())
+            elif op == "ping":
+                protocol.write_event(f, {"event": "pong", "role": "router",
+                                         "pid": os.getpid()})
+            elif op == "result":
+                job_id = req.get("job_id")
+                if not isinstance(job_id, str) or not job_id:
+                    protocol.write_event(
+                        f, {"event": "error",
+                            "error": "result needs a 'job_id' string"})
+                else:
+                    protocol.write_event(f, self.handle_result(job_id))
+            elif op == "cancel":
+                job_id = req.get("job_id")
+                if not isinstance(job_id, str) or not job_id:
+                    protocol.write_event(
+                        f, {"event": "error",
+                            "error": "cancel needs a 'job_id' string"})
+                else:
+                    protocol.write_event(f, self.handle_cancel(job_id))
+            elif op == "drain_replica":
+                name = req.get("replica")
+                if not isinstance(name, str) or not name:
+                    protocol.write_event(
+                        f, {"event": "error",
+                            "error": "drain_replica needs a 'replica' "
+                                     "string"})
+                else:
+                    protocol.write_event(f,
+                                         self.handle_drain_replica(name))
+            elif op == "shutdown":
+                protocol.write_event(
+                    f, {"event": "shutting_down",
+                        "note": "replicas get SIGTERM (graceful drain); "
+                                "journaled jobs re-queue on next start"})
+                self._stop.set()
+            else:
+                protocol.write_event(f, {"event": "error",
+                                         "error": f"unknown op {op!r}"})
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            try:
+                f.close()
+                conn.close()
+            except OSError:
+                pass
+
+    def _serve_http(self, f, first: bytes) -> None:
+        parts = first.split()
+        path = parts[1].decode("latin-1") if len(parts) >= 2 else "/"
+        if path in ("/status", "/status/"):
+            body = json.dumps(self.status()).encode()
+            head = b"HTTP/1.0 200 OK\r\n"
+        else:
+            body = json.dumps({"error": f"unknown path {path!r}; "
+                                        f"try /status"}).encode()
+            head = b"HTTP/1.0 404 Not Found\r\n"
+        f.write(head + b"Content-Type: application/json\r\n"
+                + f"Content-Length: {len(body)}\r\n\r\n".encode() + body)
+        f.flush()
+
+    # ---- lifecycle --------------------------------------------------------
+
+    def boot_fleet(self) -> None:
+        """Launch or adopt every replica. A dead replica with a journal
+        gets the full failover treatment AFTER the survivors are up, so
+        its jobs migrate instead of waiting for its relaunch."""
+        live, dead = [], []
+        for name in self.fleet.names():
+            spec = self.fleet.replica(name)
+            addr_file = os.path.join(spec.state_dir, "tcp_addr")
+            if os.path.exists(addr_file):
+                with open(addr_file) as fh:
+                    spec.addr = fh.read().strip()
+                ok, jd = self.probe(name)
+                if ok:
+                    live.append(name)
+                    self.metrics.emit("replica_adopted", replica=name,
+                                      pid=spec.pid, journal_depth=jd)
+                    self.console(f"[router] adopted live replica {name} "
+                                 f"(pid {spec.pid})")
+                    continue
+            dead.append(name)
+        for name in dead:
+            jobs_dir = self._dead_paths(name)[0]
+            depth = len(glob.glob(os.path.join(jobs_dir, "*.json"))) \
+                if os.path.isdir(jobs_dir) else 0
+            if depth and live:
+                # Survivors exist: migrate, then relaunch (inside).
+                with self._hlock:
+                    self.health[name].force_dead(now=time.time())
+                self._failover(name)
+            else:
+                self.fleet.launch(name)
+            live.append(name)
+
+    def serve_forever(self) -> int:
+        import signal
+
+        self.boot_fleet()
+        host, port = protocol.parse_addr(self.opts.listen)
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((host, port))
+        srv.listen(64)
+        srv.settimeout(0.25)
+        self.tcp_addr = srv.getsockname()[:2]
+        addr_file = os.path.join(self.opts.fleet_dir, "router_addr")
+        with open(addr_file + ".tmp", "w") as fh:
+            fh.write(f"{self.tcp_addr[0]}:{self.tcp_addr[1]}\n")
+        os.replace(addr_file + ".tmp", addr_file)
+        with open(os.path.join(self.opts.fleet_dir, "router.pid"),
+                  "w") as fh:
+            fh.write(str(os.getpid()))
+
+        def _on_sigterm(*_):
+            self._stop.set()
+
+        try:
+            signal.signal(signal.SIGTERM, _on_sigterm)
+        except ValueError:
+            pass
+        prober = threading.Thread(target=self._probe_loop,
+                                  name="g2v-router-probe", daemon=True)
+        prober.start()
+        self.metrics.emit("router_start", pid=os.getpid(),
+                          listen=f"{self.tcp_addr[0]}:{self.tcp_addr[1]}",
+                          replicas=self.fleet.names())
+        self.console(f"[router] fronting {len(self.fleet.names())} "
+                     f"replica(s) on {self.tcp_addr[0]}:"
+                     f"{self.tcp_addr[1]} (fleet {self.opts.fleet_dir})")
+        try:
+            while not self._stop.is_set():
+                try:
+                    conn, _ = srv.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    break
+                threading.Thread(target=self._handle_conn, args=(conn,),
+                                 name="g2v-router-conn",
+                                 daemon=True).start()
+        finally:
+            srv.close()
+            prober.join(timeout=5.0)
+            self.fleet.stop_all(grace_s=60.0)
+            self.metrics.emit("router_stop", jobs_routed=self.jobs_routed,
+                              failovers=self.failovers)
+            self.metrics.close()
+            self.console(f"[router] stopped ({self.jobs_routed} job(s) "
+                         f"routed, {self.failovers} failover(s))")
+        return 0

@@ -1,0 +1,500 @@
+"""Replicated-fleet front door (serve/router.py + daemon TCP mode):
+consistent-hash placement, the replica health machine, idempotency-key
+exactly-once admission, listener hardening (auth, deadlines, size
+bounds), and the SIGKILL-a-replica-mid-streaming-job failover drill.
+
+The fast tests here are pure-unit (ring, health table) or in-process
+single-daemon (idem dedup across incarnations, the TCP listener) — no
+replica subprocesses, so they hold tier-1 cost. The full fleet drill
+(router + N daemon children + mid-job SIGKILL + byte parity) boots real
+processes and is ``slow``; the router chaos soak (tools/chaos_soak.py
+--replicas) storms the same machinery at scale.
+"""
+import json
+import os
+import shutil
+import signal
+import socket
+import threading
+import time
+
+import pytest
+
+from g2vec_tpu.resilience import faults
+
+pytestmark = pytest.mark.router
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv(faults.ENV_PLAN, raising=False)
+    monkeypatch.delenv(faults.ENV_STATE, raising=False)
+    faults._reset_for_tests()
+    yield
+    faults._reset_for_tests()
+
+
+@pytest.fixture(scope="module")
+def tsv_paths(tmp_path_factory):
+    from g2vec_tpu.data.synthetic import SyntheticSpec, write_synthetic_tsv
+
+    spec = SyntheticSpec(n_good=24, n_poor=20, module_size=12,
+                         n_background=24, n_expr_only=4, n_net_only=4,
+                         module_chords=2, background_edges=40, seed=7)
+    out = tmp_path_factory.mktemp("syn")
+    return write_synthetic_tsv(spec, str(out))
+
+
+def _job(tsv_paths, tmp_path, name, **overrides):
+    job = dict(
+        expression_file=tsv_paths["expression"],
+        clinical_file=tsv_paths["clinical"],
+        network_file=tsv_paths["network"],
+        result_name=os.path.join(str(tmp_path), "out", name),
+        lenPath=8, numRepetition=2, sizeHiddenlayer=16, epoch=30,
+        learningRate=0.05, numBiomarker=5, compute_dtype="float32",
+        walker_backend="device")
+    job.update(overrides)
+    return job
+
+
+def _daemon(tmp_path, **opt_overrides):
+    from g2vec_tpu.serve.daemon import ServeDaemon, ServeOptions
+
+    opts = ServeOptions(
+        socket_path=os.path.join(str(tmp_path), "serve.sock"),
+        state_dir=os.path.join(str(tmp_path), "state"), **opt_overrides)
+    return ServeDaemon(opts, console=lambda s: None)
+
+
+# ---------------------------------------------------------------------------
+# Consistent-hash ring
+# ---------------------------------------------------------------------------
+
+def test_hash_ring_minimal_movement_and_affinity():
+    from g2vec_tpu.serve.router import HashRing
+
+    ring = HashRing(vnodes=64)
+    for name in ("r0", "r1", "r2"):
+        ring.add(name)
+    keys = [f"jobkey-{i}" for i in range(1000)]
+    before = {k: ring.lookup(k) for k in keys}
+    # Same key -> same owner, always (placement is a pure function).
+    assert all(ring.lookup(k) == before[k] for k in keys)
+
+    # Adding a 4th replica moves ~1/4 of keys, never between survivors.
+    ring.add("r3")
+    after = {k: ring.lookup(k) for k in keys}
+    moved = [k for k in keys if after[k] != before[k]]
+    assert all(after[k] == "r3" for k in moved)
+    assert len(moved) < 450        # ~250 expected; far from rehash-all
+
+    # Removing it restores the original owner for every key.
+    ring.remove("r3")
+    assert all(ring.lookup(k) == before[k] for k in keys)
+
+    # Health overlay: an ineligible owner's keys fall to the clockwise
+    # successor without disturbing other keys' owners.
+    degraded = {k: ring.lookup(k, eligible=["r0", "r1"]) for k in keys}
+    assert all(degraded[k] == before[k] for k in keys
+               if before[k] != "r2")
+    assert all(degraded[k] in ("r0", "r1") for k in keys)
+    assert ring.lookup("anything", eligible=[]) is None
+
+
+def test_router_join_key_affinity(tsv_paths, tmp_path):
+    """Shape-compatible jobs (differing only in join-excluded fields:
+    seeds, result paths) hash to the SAME replica, so they can still
+    join one warm batch there."""
+    from g2vec_tpu.serve.router import Router, RouterOptions
+
+    r = Router(RouterOptions(fleet_dir=str(tmp_path / "fleet"),
+                             replicas=3), console=lambda s: None)
+    a = {"job": _job(tsv_paths, tmp_path, "a", train_seed=1)}
+    b = {"job": _job(tsv_paths, tmp_path, "b", train_seed=99,
+                     seed=5, kmeans_seed=7)}
+    incompat = {"job": _job(tsv_paths, tmp_path, "c",
+                            sizeHiddenlayer=32)}
+    assert r.pick_replica(a) == r.pick_replica(b)
+    assert r.pick_replica(a) in ("r0", "r1", "r2")
+    # A bad job raises at router admission (same ValueError contract as
+    # the daemon), never a silent misroute.
+    with pytest.raises((ValueError, TypeError)):
+        r.pick_replica({"job": "nope"})
+    # Different shape may land elsewhere — but must be deterministic.
+    assert r.pick_replica(incompat) == r.pick_replica(incompat)
+
+
+# ---------------------------------------------------------------------------
+# Replica health state machine
+# ---------------------------------------------------------------------------
+
+def test_replica_health_transition_matrix():
+    from g2vec_tpu.resilience.lifecycle import REPLICA_STATES, ReplicaHealth
+
+    h = ReplicaHealth("r0", suspect_after=1, dead_after=3,
+                      rejoin_after=2)
+    assert h.state == "healthy" and h.in_ring
+
+    # healthy --fail--> suspect (still in the ring: one missed probe is
+    # usually GC or a long compile, not death).
+    assert h.on_probe(False, now=1.0) == ("healthy", "suspect")
+    assert h.in_ring
+    # suspect --ok--> healthy (full recovery resets the fail count).
+    assert h.on_probe(True, 0, now=2.0) == ("suspect", "healthy")
+    assert h.fails == 0
+
+    # dead_after consecutive failures declare dead -> out of the ring.
+    assert h.on_probe(False, now=3.0) == ("healthy", "suspect")
+    assert h.on_probe(False, now=4.0) is None
+    assert h.on_probe(False, now=5.0) == ("suspect", "dead")
+    assert not h.in_ring
+
+    # dead --ok--> rejoining; NOT healthy until rejoin_after consecutive
+    # OKs AND an empty journal (the stale-journal drain gate).
+    assert h.on_probe(True, 4, now=6.0) == ("dead", "rejoining")
+    assert not h.in_ring
+    assert h.on_probe(True, 2, now=7.0) is None      # journal not drained
+    assert h.on_probe(True, 0, now=8.0) == ("rejoining", "healthy")
+    assert h.in_ring
+
+    # rejoining flaps straight back to dead on any failed probe.
+    h2 = ReplicaHealth("r1", dead_after=2)
+    h2.on_probe(False, now=1.0)
+    h2.on_probe(False, now=2.0)
+    assert h2.state == "dead"
+    h2.on_probe(True, 0, now=3.0)
+    assert h2.state == "rejoining"
+    assert h2.on_probe(False, now=4.0) == ("rejoining", "dead")
+
+    # Out-of-band death observation (fence, refused forward).
+    h3 = ReplicaHealth("r2")
+    assert h3.force_dead(now=1.0) == ("healthy", "dead")
+    assert h3.force_dead(now=2.0) is None     # idempotent
+
+    # Probe backoff: flat while healthy, exponential (capped) when not.
+    h4 = ReplicaHealth("r3")
+    assert h4.probe_interval(0.5) == 0.5
+    h4.on_probe(False, now=1.0)
+    h4.on_probe(False, now=2.0)
+    h4.on_probe(False, now=3.0)
+    assert h4.probe_interval(0.5) == 0.5 * 4.0
+    for _ in range(10):
+        h4.on_probe(False, now=4.0)
+    assert h4.probe_interval(0.5) == 0.5 * 8.0      # capped
+
+    assert tuple(REPLICA_STATES) == ("healthy", "suspect", "dead",
+                                     "rejoining")
+
+
+# ---------------------------------------------------------------------------
+# Idempotency keys: exactly-once admission
+# ---------------------------------------------------------------------------
+
+def test_idem_key_dedup_within_and_across_incarnations(
+        tsv_paths, tmp_path):
+    from g2vec_tpu.serve.daemon import idem_job_id
+
+    d = _daemon(tmp_path)
+    try:
+        payload = {"tenant": "a", "idem_key": "k-123",
+                   "job": _job(tsv_paths, tmp_path, "a1")}
+        ack = d.admit(dict(payload))
+        assert ack["event"] == "accepted"
+        assert ack["job_id"] == idem_job_id("k-123")
+        # Same key again: deduped ack names the ORIGINAL job, and
+        # nothing new is journaled or queued.
+        again = d.admit(dict(payload))
+        assert again["event"] == "accepted"
+        assert again.get("deduped") is True
+        assert again["job_id"] == ack["job_id"]
+        jdir = os.path.join(d.opts.state_dir, "jobs")
+        assert len(os.listdir(jdir)) == 1
+    finally:
+        d.close()
+
+    # A NEW daemon on the same state dir rebuilds the idem table from
+    # the journal — the duplicate is refused across incarnations too.
+    d2 = _daemon(tmp_path)
+    try:
+        again = d2.admit(dict(payload))
+        assert again.get("deduped") is True
+        assert again["job_id"] == ack["job_id"]
+    finally:
+        d2.close()
+
+
+def test_idem_key_closes_kill_between_accept_and_journal_window(
+        tsv_paths, tmp_path, monkeypatch):
+    """The nastiest ack window: a replica acks a submit, then dies
+    BEFORE the journal write hits disk. The client saw 'accepted'; no
+    durable trace exists. Because the job_id is derived from the idem
+    key, the client's safe resubmission (same key) recreates the exact
+    same job — same id, same journal path, same result record name —
+    so downstream there is still exactly one of everything."""
+    from g2vec_tpu.serve.daemon import ServeDaemon, idem_job_id
+
+    d = _daemon(tmp_path)
+    monkeypatch.setattr(ServeDaemon, "_journal",
+                        lambda self, job: None)     # die-before-journal
+    payload = {"tenant": "a", "idem_key": "k-window",
+               "job": _job(tsv_paths, tmp_path, "w1")}
+    try:
+        ack = d.admit(dict(payload))
+        assert ack["event"] == "accepted"
+        assert os.listdir(os.path.join(d.opts.state_dir, "jobs")) == []
+    finally:
+        d.close()
+    monkeypatch.undo()
+
+    d2 = _daemon(tmp_path)
+    try:
+        # Nothing durable survived, so this is NOT a dedup — it is a
+        # fresh admission that lands on the identical job_id.
+        ack2 = d2.admit(dict(payload))
+        assert ack2["event"] == "accepted"
+        assert ack2.get("deduped") is None
+        assert ack2["job_id"] == ack["job_id"] == idem_job_id("k-window")
+        # And NOW the same key dedups (journal exists).
+        ack3 = d2.admit(dict(payload))
+        assert ack3.get("deduped") is True
+    finally:
+        d2.close()
+
+
+def test_bad_idem_keys_reject_at_admission(tsv_paths, tmp_path):
+    d = _daemon(tmp_path)
+    try:
+        for bad in ("", "x" * 200, 7):
+            rej = d.admit({"idem_key": bad,
+                           "job": _job(tsv_paths, tmp_path, "x")})
+            assert rej["event"] == "rejected"
+            assert "idem_key" in rej["detail"]
+    finally:
+        d.close()
+
+
+# ---------------------------------------------------------------------------
+# TCP front door + listener hardening
+# ---------------------------------------------------------------------------
+
+def test_tcp_listener_status_auth_and_bounds(tsv_paths, tmp_path):
+    from g2vec_tpu.serve import client, protocol
+
+    d = _daemon(tmp_path, listen="127.0.0.1:0", auth_token="sekrit",
+                read_deadline_s=1.0, max_request_bytes=4096)
+    th = threading.Thread(target=d.serve_forever, daemon=True)
+    th.start()
+    try:
+        deadline = time.time() + 30
+        while d.tcp_addr is None and time.time() < deadline:
+            time.sleep(0.05)
+        assert d.tcp_addr is not None
+        addr = f"{d.tcp_addr[0]}:{d.tcp_addr[1]}"
+        # Discovery file matches the bound ephemeral port; pidfile (the
+        # fence target of last resort) names this process.
+        with open(os.path.join(d.opts.state_dir, "tcp_addr")) as f:
+            assert f.read().strip() == addr
+        with open(os.path.join(d.opts.state_dir, "serve.pid")) as f:
+            assert int(f.read()) == os.getpid()
+
+        # status over TCP: open (no token), carries the new fields.
+        st = client.status(addr)
+        assert st["event"] == "status"
+        assert st["listen"] == addr
+        assert st["journal_depth"] == 0
+        assert isinstance(st["last_heartbeat_age_s"], float)
+
+        # ping over TCP; plain HTTP GET /status on the same port.
+        assert client.ping(addr)["event"] == "pong"
+        s = protocol.dial(addr, timeout=5.0)
+        s.sendall(b"GET /status HTTP/1.0\r\n\r\n")
+        http = b""
+        while True:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            http += chunk
+        s.close()
+        assert http.startswith(b"HTTP/1.0 200")
+        assert b"journal_depth" in http
+
+        # Mutating op without the token: rejected at admission, nothing
+        # journaled.
+        evs = client.submit_job(addr, _job(tsv_paths, tmp_path, "t1"))
+        assert evs[-1]["event"] == "rejected"
+        assert evs[-1]["error"] == "unauthorized"
+        assert os.listdir(os.path.join(d.opts.state_dir, "jobs")) == []
+
+        # Wrong token: same refusal. Cancel is gated too.
+        evs = client.submit_job(addr, _job(tsv_paths, tmp_path, "t2"),
+                                auth_token="wrong")
+        assert evs[-1]["error"] == "unauthorized"
+        bad = next(client.request(addr, {"op": "cancel", "job_id": "x",
+                                         "auth_token": "nope"}))
+        assert bad["error"] == "unauthorized"
+
+        # Oversized request line: structured refusal, not an OOM.
+        s = protocol.dial(addr, timeout=5.0)
+        s.sendall(b"{" + b"x" * 8192)
+        f = s.makefile("rb")
+        ev = json.loads(f.readline())
+        assert ev["error"] == "oversized_request"
+        s.close()
+
+        # Read deadline: a silent client is disconnected, not parked on
+        # an acceptor thread forever. The same deadline now guards the
+        # UNIX listener (opts apply to both).
+        s = protocol.dial(addr, timeout=10.0)
+        t0 = time.time()
+        assert s.recv(1) == b""          # server closes on timeout
+        assert time.time() - t0 < 8.0
+        s.close()
+
+        # result op: pending for an unknown id (the poll path clients
+        # use after failover), journaled=False.
+        pend = next(client.request(addr, {"op": "result",
+                                          "job_id": "nope"}))
+        assert pend["event"] == "pending"
+        assert pend["journaled"] is False
+    finally:
+        d._stop.set()
+        th.join(timeout=15)
+        d.close()
+
+
+# ---------------------------------------------------------------------------
+# Fleet e2e: SIGKILL a replica mid-streaming-job, byte parity
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.skipif(not shutil.which("g++"),
+                    reason="streaming drill needs the native toolchain")
+def test_router_failover_mid_streaming_job_byte_identical(
+        tsv_paths, tmp_path):
+    """Boot a 2-replica fleet behind an in-process router, start a
+    streaming job, SIGKILL the replica running it, and require: the
+    client's submit stream still ends in the job's terminal record, a
+    ``failover`` metrics event names the migration, exactly one
+    terminal job_state event exists fleet-wide, and the outputs are
+    byte-identical to a solo uninterrupted run."""
+    from g2vec_tpu.serve import client
+    from g2vec_tpu.serve.router import Router, RouterOptions
+
+    fleet_dir = str(tmp_path / "fleet")
+    r = Router(RouterOptions(
+        fleet_dir=fleet_dir, replicas=2, listen="127.0.0.1:0",
+        probe_interval=0.3, probe_deadline=2.0,
+        serve_argv=("--platform", "cpu",
+                    "--cache-dir", str(tmp_path / "cache"))),
+        console=lambda s: None)
+    th = threading.Thread(target=r.serve_forever, daemon=True)
+    th.start()
+    result_holder = {}
+    try:
+        deadline = time.time() + 300
+        while r.tcp_addr is None and time.time() < deadline:
+            time.sleep(0.1)
+        assert r.tcp_addr is not None, "router never bound"
+        addr = f"{r.tcp_addr[0]}:{r.tcp_addr[1]}"
+
+        job = _job(tsv_paths, tmp_path, "stream1", epoch=400,
+                   train_mode="streaming", walker_backend="native",
+                   shard_paths=16, checkpoint_every=1)
+
+        def submit():
+            result_holder["rec"] = client.submit_and_wait(
+                addr, job, timeout=600, poll_deadline_s=600,
+                idem_key="drill-1")
+
+        sub = threading.Thread(target=submit, daemon=True)
+        sub.start()
+
+        # Wait until some replica journals the job, then kill that one.
+        victim = None
+        deadline = time.time() + 240
+        while victim is None and time.time() < deadline:
+            for name in r.fleet.names():
+                jdir = os.path.join(fleet_dir, name, "state", "jobs")
+                if os.path.isdir(jdir) and os.listdir(jdir):
+                    victim = name
+                    break
+            time.sleep(0.1)
+        assert victim is not None, "job never journaled on any replica"
+        # Kill the instant the first checkpoint lands: the job is
+        # provably mid-training (a fixed sleep races a warm cache — the
+        # job can finish inside it and no failover ever happens).
+        from g2vec_tpu.serve import protocol as _proto
+        jid = _proto.idem_job_id("drill-1")
+        ckpt_dir = os.path.join(fleet_dir, victim, "state", "ckpt")
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            if os.path.isdir(ckpt_dir) and any(
+                    jid in e for e in os.listdir(ckpt_dir)):
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("job never checkpointed on the victim")
+        res_path = os.path.join(fleet_dir, victim, "state", "results",
+                                f"{jid}.json")
+        assert not os.path.exists(res_path), \
+            "job finished before the kill could land — enlarge the job"
+        pid = r.fleet.replica(victim).pid
+        os.kill(pid, signal.SIGKILL)
+
+        sub.join(timeout=600)
+        assert not sub.is_alive(), "client never got a terminal record"
+        rec = result_holder["rec"]
+        assert rec["event"] == "job_done", rec
+        job_id = rec["job_id"]
+
+        # Failover event names the migration.
+        evs = []
+        with open(os.path.join(fleet_dir, "router-metrics.jsonl")) as f:
+            for line in f:
+                ev = json.loads(line)
+                if ev.get("event") == "failover":
+                    evs.append(ev)
+        assert any(ev["job_id"] == job_id and ev["from_replica"] == victim
+                   and ev["to_replica"] != victim and
+                   ev["latency_s"] >= 0 for ev in evs), evs
+
+        # Exactly one terminal job_state event fleet-wide.
+        terminal = 0
+        for name in r.fleet.names():
+            mpath = os.path.join(fleet_dir, name, "metrics.jsonl")
+            if not os.path.exists(mpath):
+                continue
+            with open(mpath) as f:
+                for line in f:
+                    try:
+                        ev = json.loads(line)
+                    except ValueError:
+                        continue
+                    if ev.get("event") == "job_state" \
+                            and ev.get("job_id") == job_id \
+                            and ev.get("state") == "done":
+                        terminal += 1
+        assert terminal == 1, f"{terminal} terminal events"
+
+        # Byte parity vs a solo uninterrupted twin.
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from g2vec_tpu.batch.engine import _variant_from_dict, lane_config
+        from g2vec_tpu.config import config_from_job
+        from g2vec_tpu.pipeline import run as solo_run
+
+        cfg = config_from_job(
+            {**job, "result_name": os.path.join(str(tmp_path), "out",
+                                                "solo1")})
+        v = _variant_from_dict(0, {"name": "v"}, cfg)
+        sres = solo_run(lane_config(cfg, v), console=lambda s: None)
+        outs = rec["variants"]["v"]["outputs"]
+        assert len(outs) == len(sres.output_files) > 0
+        for fa, fb in zip(sorted(outs), sorted(sres.output_files)):
+            with open(fa, "rb") as a, open(fb, "rb") as b:
+                assert a.read() == b.read(), f"{fa} != {fb}"
+    finally:
+        r._stop.set()
+        th.join(timeout=120)

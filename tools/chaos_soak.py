@@ -25,10 +25,22 @@ daemon-lifetime metrics JSONL), the journal is empty, and a sample of
 completed jobs is byte-identical to solo uninterrupted runs of the same
 configs.
 
+``--replicas N`` switches to **router mode**: the storm runs against a
+TCP router fronting N daemon replicas (serve/router.py). The op rotation
+becomes replica SIGKILL (the router must detect, fence, migrate the
+journal to survivors, and relaunch), synchronous replica drain (rc 0
+asserted), and router SIGKILL+restart (the new router must adopt the
+orphaned live replicas). The pass bar is the same exactly-once predicate
+computed fleet-wide — every acked job has exactly one terminal event
+across ALL replicas' metrics streams and exactly one result record
+across all results dirs — plus byte parity and the death-to-requeue
+latency distribution from the router's ``failover`` events.
+
 Scale knobs are flags with G2V_CHAOS_* env fallbacks so CI can shrink
 the soak (``G2V_CHAOS_JOBS=6 python tools/chaos_soak.py``). The
-committed artifact (BENCH_CHAOS_SOAK.json) is written by
-``bench.py --_chaos_soak``, which wraps this module.
+committed artifacts (BENCH_CHAOS_SOAK.json, BENCH_ROUTER_CHAOS.json) are
+written by ``bench.py --_chaos_soak`` / ``--_router_chaos``, which wrap
+this module.
 """
 from __future__ import annotations
 
@@ -100,6 +112,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Keep the workdir (logs, metrics, outputs).")
     p.add_argument("--json", type=str, default=None, metavar="PATH",
                    help="Also write the summary JSON here.")
+    p.add_argument("--replicas", type=int,
+                   default=_env_int("G2V_CHAOS_REPLICAS", 0),
+                   help="Router mode: storm a replicated fleet behind the "
+                        "TCP router instead of one daemon. Op rotation "
+                        "becomes replica SIGKILL / synchronous replica "
+                        "drain / router SIGKILL+restart / cancel; "
+                        "accounting spans every replica's results dir and "
+                        "metrics stream (0 = classic single-daemon mode).")
     return p
 
 
@@ -336,6 +356,466 @@ class Soak:
         return counts
 
 
+class RouterSoak(Soak):
+    """Soak state for router mode: one router subprocess fronting N
+    replica daemons it launches itself. The harness only ever kills
+    things — every heal (replica relaunch, journal migration, adoption
+    after a router restart) must come from the router, or the
+    accounting fails."""
+
+    def __init__(self, opts, workdir: str):
+        super().__init__(opts, workdir)
+        self.fleet = os.path.join(workdir, "fleet")
+        self.router_metrics = os.path.join(workdir, "router-metrics.jsonl")
+        self.router_log = os.path.join(workdir, "router.log")
+        self.addr: Optional[str] = None
+        self.router_restarts = 0
+        self.replica_kills = 0
+        self.replica_drains = 0
+
+    # ---- router lifecycle -------------------------------------------
+
+    def launch_router(self) -> None:
+        argv = [sys.executable, "-m", "g2vec_tpu", "serve",
+                "--replicas", str(self.opts.replicas),
+                "--listen", "127.0.0.1:0",
+                "--state-dir", self.fleet,
+                "--platform", "cpu",
+                "--cache-dir", os.path.join(self.wd, "cache"),
+                "--queue-depth", "64", "--max-join", "6",
+                "--probe-interval", "0.4", "--probe-deadline", "3.0",
+                "--metrics-jsonl", self.router_metrics]
+        addr_file = os.path.join(self.fleet, "router_addr")
+        try:
+            os.unlink(addr_file)
+        except OSError:
+            pass
+        log = open(self.router_log, "a")
+        self.proc = subprocess.Popen(argv, env=self.env, stdout=log,
+                                     stderr=subprocess.STDOUT)
+        log.close()
+        deadline = time.time() + 600
+        while time.time() < deadline:
+            if os.path.exists(addr_file):
+                with open(addr_file) as f:
+                    self.addr = f.read().strip()
+                if self.addr:
+                    return
+            if self.proc.poll() is not None:
+                raise RuntimeError(f"router died during boot "
+                                   f"(rc={self.proc.returncode}; log: "
+                                   f"{self.router_log})")
+            time.sleep(0.2)
+        raise RuntimeError(f"router never bound (log: {self.router_log})")
+
+    def router_status(self) -> Optional[dict]:
+        from g2vec_tpu.serve import client, protocol
+
+        try:
+            return client.status(self.addr, timeout=10.0)
+        except (OSError, client.ServeConnectionLost,
+                protocol.ProtocolError):
+            return None
+
+    # ---- fleet-wide accounting --------------------------------------
+
+    def _replica_dirs(self) -> List[str]:
+        return [os.path.join(self.fleet, f"r{i}")
+                for i in range(self.opts.replicas)]
+
+    def results(self) -> Dict[str, dict]:
+        out: Dict[str, dict] = {}
+        for rdir in self._replica_dirs():
+            resd = os.path.join(rdir, "state", "results")
+            if not os.path.isdir(resd):
+                continue
+            for fn in os.listdir(resd):
+                if fn.endswith(".json"):
+                    try:
+                        with open(os.path.join(resd, fn)) as f:
+                            out[fn[:-5]] = json.load(f)
+                    except (OSError, ValueError):
+                        pass
+        return out
+
+    def result_locations(self) -> Dict[str, List[str]]:
+        """job_id -> replica names holding a result record. More than
+        one means a job ran (terminally) on two replicas — a duplicate
+        the terminal-event count alone could miss."""
+        locs: Dict[str, List[str]] = {}
+        for i, rdir in enumerate(self._replica_dirs()):
+            resd = os.path.join(rdir, "state", "results")
+            if not os.path.isdir(resd):
+                continue
+            for fn in os.listdir(resd):
+                if fn.endswith(".json"):
+                    locs.setdefault(fn[:-5], []).append(f"r{i}")
+        return locs
+
+    def journal_ids(self) -> List[str]:
+        out = []
+        for rdir in self._replica_dirs():
+            jdir = os.path.join(rdir, "state", "jobs")
+            if os.path.isdir(jdir):
+                out += [fn[:-5] for fn in os.listdir(jdir)
+                        if fn.endswith(".json")]
+        return out
+
+    def terminal_event_counts(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for rdir in self._replica_dirs():
+            path = os.path.join(rdir, "metrics.jsonl")
+            try:
+                with open(path) as f:
+                    for line in f:
+                        try:
+                            ev = json.loads(line)
+                        except ValueError:
+                            continue
+                        if ev.get("event") == "job_state" \
+                                and ev.get("state") in TERMINAL_STATES:
+                            jid = ev.get("job_id")
+                            counts[jid] = counts.get(jid, 0) + 1
+            except OSError:
+                pass
+        return counts
+
+    def failover_events(self) -> List[dict]:
+        out = []
+        try:
+            with open(self.router_metrics) as f:
+                for line in f:
+                    try:
+                        ev = json.loads(line)
+                    except ValueError:
+                        continue
+                    if ev.get("event") == "failover":
+                        out.append(ev)
+        except OSError:
+            pass
+        return out
+
+    # ---- chaos ops ---------------------------------------------------
+
+    def _pick_replica(self) -> Optional[str]:
+        st = self.router_status()
+        if not st:
+            return None
+        reps = st.get("replicas") or {}
+        live = [n for n, r in reps.items()
+                if r.get("state") in ("healthy", "suspect")
+                and r.get("pid")]
+        if not live:
+            return None
+        name = self.rng.choice(sorted(live))
+        self._victim_pid = reps[name].get("pid")
+        return name
+
+    def op_replica_sigkill(self) -> None:
+        name = self._pick_replica()
+        if name is None:
+            self.note("chaos: replica SIGKILL skipped (none healthy)")
+            return
+        self.replica_kills += 1
+        self.note(f"chaos: SIGKILL replica {name} "
+                  f"(pid {self._victim_pid}, kill "
+                  f"#{self.replica_kills})")
+        try:
+            os.kill(self._victim_pid, signal.SIGKILL)
+        except OSError:
+            pass
+        # NO relaunch here: detection, fencing, journal migration, and
+        # the relaunch are all the router's job.
+
+    def op_replica_drain(self) -> None:
+        from g2vec_tpu.serve import client
+
+        name = self._pick_replica()
+        if name is None:
+            self.note("chaos: replica drain skipped (none healthy)")
+            return
+        self.replica_drains += 1
+        self.note(f"chaos: drain replica {name} "
+                  f"(drain #{self.replica_drains})")
+        try:
+            for ev in client.request(self.addr,
+                                     {"op": "drain_replica",
+                                      "replica": name}, timeout=600.0):
+                if ev.get("event") == "drained":
+                    self.drain_rcs.append(ev.get("rc", -1))
+                break
+        except (OSError, client.ServeConnectionLost):
+            self.note(f"drain of {name} lost its stream (router died?)")
+
+    def op_router_restart(self) -> None:
+        self.router_restarts += 1
+        self.note(f"chaos: SIGKILL router + restart "
+                  f"(#{self.router_restarts}) — replicas orphaned, "
+                  f"must be adopted")
+        try:
+            os.kill(self.proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        self.proc.wait()
+        t_down = time.time()
+        self.launch_router()
+        self.recoveries.append(time.time() - t_down)
+
+    def op_cancel_routed(self) -> None:
+        from g2vec_tpu.serve import client
+
+        results = self.results()
+        with self.lock:
+            pending = [jid for jid in self.acks if jid not in results]
+        if not pending:
+            return
+        jid = self.rng.choice(sorted(pending))
+        self.cancels_sent += 1
+        self.note(f"chaos: cancel {jid} (via router broadcast)")
+        try:
+            client.cancel(self.addr, jid, timeout=30.0)
+        except (OSError, client.ServeConnectionLost):
+            pass
+
+    def run_chaos_op(self, op: str) -> None:
+        if op == "replica_sigkill":
+            self.op_replica_sigkill()
+        elif op == "replica_drain":
+            self.op_replica_drain()
+        elif op == "router_restart":
+            self.op_router_restart()
+        elif op == "cancel":
+            self.op_cancel_routed()
+
+    # ---- submission --------------------------------------------------
+
+    def submit_one(self, k: int, job: dict) -> None:
+        """Submit through the router until acked. Unlike the classic
+        soak, EVERY attempt carries the same deterministic idem key, so
+        resubmitting after a lost ack is safe — the fleet acks the
+        original job exactly once (deduped=True on the repeat)."""
+        from g2vec_tpu.serve import client
+
+        rng = random.Random((self.opts.seed << 20) ^ k)
+        priority = "interactive" if rng.random() < 0.3 else "batch"
+        deadline_s = (round(rng.uniform(2.0, 8.0), 2)
+                      if rng.random() < 0.15 else None)
+        idem = f"soak-{self.opts.seed}-{k}"
+        for attempt in range(14):
+            try:
+                evs = client.submit_job(
+                    self.addr, job, tenant=f"t{k % 3}", timeout=600,
+                    priority=priority, deadline_s=deadline_s,
+                    idem_key=idem)
+                if evs and evs[-1].get("event") == "rejected":
+                    # Transient fleet states — retry with the SAME idem
+                    # key (safe by construction): the router had no
+                    # eligible replica yet, or the ring target was
+                    # caught mid-drain.
+                    if evs[-1].get("error") in ("no_replicas",
+                                                "draining"):
+                        raise OSError(f"fleet busy: {evs[-1]['error']}")
+                    with self.lock:
+                        self.rejected.append(k)
+                    return
+                jid = evs[0].get("job_id") if evs else None
+                if jid:
+                    with self.lock:
+                        self.acks[jid] = {"k": k, "job": job,
+                                          "deadline_s": deadline_s}
+                    return
+                break
+            except client.ServeConnectionLost as e:
+                if e.job_id:
+                    with self.lock:
+                        self.acks[e.job_id] = {"k": k, "job": job,
+                                               "deadline_s": deadline_s}
+                    return
+            except (client.ServeTimeout, OSError):
+                pass
+            time.sleep(min(5.0, 0.2 * (2 ** attempt))
+                       + rng.uniform(0.0, 0.25))
+        with self.lock:
+            self.unsubmitted.append(k)
+
+
+def run_router_soak(opts, workdir: str) -> dict:
+    """The replicated-fleet storm: N replicas behind the router, seeded
+    replica-SIGKILL / replica-drain / router-restart rotation, fleet-wide
+    exactly-once accounting, byte parity vs solo twins, and the
+    death-to-first-requeue latency distribution from the router's
+    ``failover`` events."""
+    from g2vec_tpu.data.synthetic import SyntheticSpec, write_synthetic_tsv
+    from g2vec_tpu.serve import client
+
+    soak = RouterSoak(opts, workdir)
+    native_ok = bool(shutil.which("g++")) and opts.stream_frac > 0
+    spec = SyntheticSpec(n_good=24, n_poor=20, module_size=12,
+                         n_background=24, n_expr_only=4, n_net_only=4,
+                         module_chords=2, background_edges=40, seed=7)
+    paths = write_synthetic_tsv(spec, os.path.join(workdir, "data"))
+    os.makedirs(os.path.join(workdir, "out"), exist_ok=True)
+
+    n = opts.jobs
+    n_ops = opts.chaos_ops or max(3, n // 8)
+    rng = soak.rng
+    arrivals, t = [], 0.0
+    for _ in range(n):
+        arrivals.append(t)
+        t += rng.expovariate(1.0 / opts.mean_arrival)
+    op_pool = ["replica_sigkill", "replica_drain", "router_restart",
+               "cancel", "replica_sigkill"]
+    ops = [op_pool[i % len(op_pool)] for i in range(n_ops)]
+    rng.shuffle(ops)
+
+    soak.note(f"router soak: {n} jobs over {opts.replicas} replicas "
+              f"(stream_frac={opts.stream_frac if native_ok else 0}), "
+              f"{n_ops} chaos ops {ops}, seed {opts.seed}")
+    soak.launch_router()
+
+    threads: List[threading.Thread] = []
+
+    def arrival_loop():
+        t0 = time.time()
+        jobs = [soak.make_job(k, paths, native_ok) for k in range(n)]
+        for k in range(n):
+            now = time.time() - t0
+            if now < arrivals[k]:
+                time.sleep(arrivals[k] - now)
+            th = threading.Thread(target=soak.submit_one,
+                                  args=(k, jobs[k]), daemon=True)
+            th.start()
+            threads.append(th)
+
+    arr = threading.Thread(target=arrival_loop, daemon=True)
+    arr.start()
+
+    deadline = soak.t0 + opts.budget_s
+    next_chaos = time.time() + rng.uniform(1.0, opts.chaos_every)
+    budget_blown = False
+    while True:
+        if time.time() > deadline:
+            budget_blown = True
+            soak.note("BUDGET BLOWN — abandoning the storm")
+            break
+        if soak.proc.poll() is not None:
+            # The router must never die except when we kill it.
+            soak.note(f"router self-death rc={soak.proc.returncode} — "
+                      f"restarting (counts against it)")
+            soak.launch_router()
+        if ops and time.time() >= next_chaos:
+            soak.run_chaos_op(ops.pop(0))
+            next_chaos = time.time() + rng.uniform(
+                0.5 * opts.chaos_every, 1.5 * opts.chaos_every)
+        if not ops and not arr.is_alive() \
+                and all(not th.is_alive() for th in threads):
+            with soak.lock:
+                acked = set(soak.acks)
+            if acked and acked <= set(soak.results()) \
+                    and not soak.journal_ids():
+                break
+        time.sleep(0.25)
+
+    arr.join(timeout=60)
+    for th in threads:
+        th.join(timeout=120)
+    while not budget_blown and time.time() < deadline:
+        if soak.proc.poll() is not None:
+            soak.launch_router()
+        with soak.lock:
+            acked = set(soak.acks)
+        if acked <= set(soak.results()) and not soak.journal_ids():
+            break
+        time.sleep(0.5)
+    try:
+        client.shutdown(soak.addr)
+        soak.proc.wait(timeout=180)
+    except (OSError, client.ServeConnectionLost,
+            subprocess.TimeoutExpired):
+        soak.proc.kill()
+        soak.proc.wait()
+
+    # ---- accounting --------------------------------------------------
+    results = soak.results()
+    locations = soak.result_locations()
+    with soak.lock:
+        acks = dict(soak.acks)
+    lost = sorted(jid for jid in acks if jid not in results)
+    term_counts = soak.terminal_event_counts()
+    duplicated = sorted(set(
+        [jid for jid, c in term_counts.items() if c > 1]
+        + [jid for jid, where in locations.items() if len(where) > 1]))
+    by_status: Dict[str, int] = {}
+    for jid in acks:
+        st = results.get(jid, {}).get("status", "LOST")
+        by_status[st] = by_status.get(st, 0) + 1
+
+    failovers = soak.failover_events()
+    requeue_lat = [ev.get("latency_s", 0.0) for ev in failovers]
+
+    # ---- byte parity vs solo twins -----------------------------------
+    done_ids = [jid for jid in acks
+                if results.get(jid, {}).get("status") == "done"]
+    sample = sorted(done_ids)[:max(0, opts.verify)]
+    byte_checked, byte_identical, mismatches = 0, 0, []
+    if sample:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from g2vec_tpu.batch.engine import _variant_from_dict, lane_config
+        from g2vec_tpu.config import config_from_job
+        from g2vec_tpu.pipeline import run as solo_run
+
+        for jid in sample:
+            k = acks[jid]["k"]
+            job = acks[jid]["job"]
+            cfg = config_from_job(
+                {**job, "result_name": os.path.join(workdir, "out",
+                                                    f"solo{k}")})
+            v = _variant_from_dict(0, {"name": "v"}, cfg)
+            sres = solo_run(lane_config(cfg, v), console=lambda s: None)
+            outs = results[jid]["variants"]["v"]["outputs"]
+            byte_checked += 1
+            same = True
+            for fa, fb in zip(sorted(outs), sorted(sres.output_files)):
+                with open(fa, "rb") as a, open(fb, "rb") as b:
+                    if a.read() != b.read():
+                        same = False
+                        mismatches.append(f"{jid}: {fa} != {fb}")
+            byte_identical += int(same)
+            soak.note(f"parity {jid} (job{k}): "
+                      f"{'identical' if same else 'MISMATCH'}")
+
+    ok = (not budget_blown and not lost and not duplicated
+          and not soak.unsubmitted and not soak.journal_ids()
+          and by_status.get("failed", 0) == 0
+          and byte_identical == byte_checked
+          # rc None = the drained replica was ADOPTED (router restarted
+          # mid-soak; not our child, so no exit code is collectible) —
+          # the drain itself still completed synchronously.
+          and all(rc in (0, None) for rc in soak.drain_rcs))
+    return {
+        "ok": ok, "mode": "router", "seed": opts.seed, "jobs": n,
+        "replicas": opts.replicas,
+        "accepted": len(acks), "rejected": len(soak.rejected),
+        "unsubmitted": len(soak.unsubmitted),
+        "terminal_by_status": by_status,
+        "lost": lost, "duplicated": duplicated,
+        "journal_leftover": soak.journal_ids(),
+        "replica_kills": soak.replica_kills,
+        "replica_drains": soak.replica_drains,
+        "router_restarts": soak.router_restarts,
+        "drain_exit_codes": soak.drain_rcs,
+        "cancels_sent": soak.cancels_sent,
+        "failovers": len(failovers),
+        "requeue_p50_s": _percentile(requeue_lat, 0.5),
+        "requeue_p99_s": _percentile(requeue_lat, 0.99),
+        "router_restart_p99_s": _percentile(soak.recoveries, 0.99),
+        "byte_checked": byte_checked, "byte_identical": byte_identical,
+        "mismatches": mismatches,
+        "budget_blown": budget_blown,
+        "wall_s": round(time.time() - soak.t0, 1),
+    }
+
+
 def _percentile(vals: List[float], q: float) -> float:
     if not vals:
         return 0.0
@@ -510,7 +990,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     workdir = opts.workdir or tempfile.mkdtemp(prefix="g2vec-chaos-")
     os.makedirs(workdir, exist_ok=True)
     try:
-        summary = run_soak(opts, workdir)
+        summary = (run_router_soak(opts, workdir) if opts.replicas
+                   else run_soak(opts, workdir))
     finally:
         if not opts.keep and not opts.workdir:
             shutil.rmtree(workdir, ignore_errors=True)

@@ -42,6 +42,7 @@ while [ "$arms" -lt "$MAX_ARMS" ] && [ "$(date +%s)" -lt "$DEADLINE" ]; do
             tests/test_fleet.py tests/test_fleet_e2e.py \
             tests/test_overlap_cache.py tests/test_batch_engine.py \
             tests/test_serve.py tests/test_stream.py tests/test_shard.py \
+            tests/test_router.py \
             -q -m "not slow" \
             -p no:cacheprovider >/tmp/fault_matrix_arm$arms.log 2>&1; then
         echo "[watch_loop] WARNING: fault/fleet matrix FAILED on arm $arms (log: /tmp/fault_matrix_arm$arms.log)"
