@@ -86,6 +86,13 @@ def build_serve_parser() -> argparse.ArgumentParser:
                    help="One probe's socket deadline (default 2); a "
                         "replica that cannot answer /status within it "
                         "fails the probe.")
+    p.add_argument("--sticky-deadline", type=float, default=120.0,
+                   metavar="S",
+                   help="How long a keyed submit waits for an already-"
+                        "journaled key's home replica to recover before "
+                        "it is refused with retry_later (default 120); "
+                        "never ring-placed elsewhere, which would run "
+                        "the job twice.")
     p.add_argument("--state-dir", default=None, metavar="DIR",
                    help="Daemon state root: jobs/ (journal of accepted, "
                         "unfinished jobs — re-queued on restart), "
@@ -267,6 +274,7 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
             read_deadline_s=args.read_deadline_s,
             max_request_bytes=args.max_request_bytes,
             metrics_jsonl=args.metrics_jsonl,
+            sticky_deadline_s=args.sticky_deadline,
             serve_argv=tuple(fwd))
         return Router(opts).serve_forever()
     if not args.socket:

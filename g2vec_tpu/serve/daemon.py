@@ -56,6 +56,7 @@ import dataclasses
 import glob
 import os
 import queue
+import re
 import shutil
 import socket
 import threading
@@ -98,6 +99,11 @@ _join_key = serve_join_key
 #: protocol.py so the jax-free router shares the derivation.
 MAX_IDEM_KEY = protocol.MAX_IDEM_KEY
 idem_job_id = protocol.idem_job_id
+
+#: Explicit ``job_id`` on a submit payload (router failover resubmits of
+#: keyless jobs — see router._failover). job_ids name files under the
+#: state dir, so the charset is locked down: no separators, no dotfiles.
+_JOB_ID_RE = re.compile(r"[A-Za-z0-9][A-Za-z0-9._-]{0,79}")
 
 
 class QueueFull(RuntimeError):
@@ -309,7 +315,11 @@ class ServeDaemon:
         #: (journaled, running, or terminally recorded) — the dedup table
         #: behind exactly-once acks. Rebuilt from disk at boot so a
         #: relaunch keeps refusing duplicates it acked in a past life.
+        #: Guarded by _idem_lock: admit() runs on per-connection threads,
+        #: and lookup + reservation must be one atomic step or two
+        #: concurrent same-key submits both miss the table and both run.
         self._idem: Dict[str, str] = {}
+        self._idem_lock = threading.Lock()
         self._load_idem_table()
         if opts.fault_plan:
             from g2vec_tpu.resilience.faults import install_plan
@@ -413,10 +423,29 @@ class ServeDaemon:
             # entry, ckpt cursor dirs, and result record all share the
             # name), which is what makes cross-replica failover resume
             # instead of restart.
-            job_id = idem_job_id(idem_key) if idem_key \
-                else self._new_job_id()
+            if idem_key:
+                job_id = idem_job_id(idem_key)
+            elif payload.get("job_id") is not None:
+                # Keyless jobs have no derivable id, so a router
+                # failover resubmit passes the journaled job_id through
+                # explicitly — the migrated checkpoint cursors and the
+                # client's poll handle keep their names.
+                explicit = payload["job_id"]
+                if not isinstance(explicit, str) \
+                        or not _JOB_ID_RE.fullmatch(explicit):
+                    raise ValueError(
+                        f"'job_id' must match {_JOB_ID_RE.pattern!r}, "
+                        f"got {explicit!r}")
+                job_id = explicit
+            else:
+                job_id = self._new_job_id()
+        # Never journal the admission secret: raw is persisted verbatim
+        # to <state>/jobs/*.json (and re-sent on failover, where the
+        # router attaches its own token), so the shared auth_token must
+        # not outlive the admission check.
+        raw = {k: v for k, v in payload.items() if k != "auth_token"}
         job = ServeJob(job_id=job_id, tenant=tenant,
-                       cfg=cfg, variants=variants, raw=payload,
+                       cfg=cfg, variants=variants, raw=raw,
                        submitted_at=(time.time() if submitted_at is None
                                      else submitted_at),
                        priority=priority, deadline_s=deadline_s,
@@ -435,26 +464,41 @@ class ServeDaemon:
                               detail=str(e)[:300])
             return {"event": "rejected", "error": "bad_job",
                     "detail": str(e)[:500]}
-        if job.idem_key is not None and job.idem_key in self._idem:
-            # Exactly-once ack: this submission (same client-generated
+        reserved = False
+        if job.idem_key is not None:
+            # Exactly-once ack: if this submission (same client-generated
             # idem_key) was already accepted by this state dir — maybe in
             # a previous daemon incarnation, maybe re-routed here after a
-            # failover the client never saw. Never run it twice: answer
+            # failover the client never saw — never run it twice: answer
             # with the ORIGINAL job_id; if it already finished, stream
-            # the durable record so the caller needn't even poll.
-            orig = self._idem[job.idem_key]
-            self.metrics.bind_job(orig).emit("job_deduped",
-                                             tenant=job.tenant)
-            resp = {"event": "accepted", "job_id": orig,
-                    "tenant": job.tenant, "deduped": True,
-                    "state_dir": self.opts.state_dir}
-            if subscriber is not None:
-                rec = self._read_result(orig)
-                if rec is not None:
-                    subscriber.put(rec)
-                subscriber.put(None)
-            return resp
+            # the durable record so the caller needn't even poll. The
+            # lookup and the reservation are ONE step under _idem_lock:
+            # admit() runs on per-connection threads, and an unlocked
+            # check-then-insert lets two concurrent same-key submits (a
+            # client retrying after an ack timeout, a failover resubmit
+            # racing a sticky retry) both miss the table and both run.
+            with self._idem_lock:
+                orig = self._idem.get(job.idem_key)
+                if orig is None:
+                    self._idem[job.idem_key] = job.job_id
+                    reserved = True
+            if not reserved:
+                return self._deduped_ack(orig, job.tenant, subscriber)
+        elif isinstance(payload.get("job_id"), str) \
+                and self._has_durable_trace(job.job_id):
+            # Keyless failover resubmit (explicit job_id, see _plan_job)
+            # that this state dir already journaled, ran, or finished —
+            # e.g. a router retrying a migration whose unlink raced a
+            # crash. Same exactly-once answer as the idem path.
+            return self._deduped_ack(job.job_id, job.tenant, subscriber)
+
+        def _unreserve() -> None:
+            if reserved:
+                with self._idem_lock:
+                    self._idem.pop(job.idem_key, None)
+
         if self._stop.is_set() or self._draining:
+            _unreserve()
             return {"event": "rejected",
                     "error": ("draining" if self._draining
                               else "shutting_down"),
@@ -463,6 +507,7 @@ class ServeDaemon:
         try:
             self._queue.push(job)
         except QueueFull:
+            _unreserve()
             self.metrics.bind_job(job.job_id).emit(
                 "job_rejected", error="queue_full", tenant=job.tenant)
             return {"event": "rejected", "error": "queue_full",
@@ -470,8 +515,6 @@ class ServeDaemon:
                               f"--queue-depth cap ({self.opts.queue_depth})",
                     "queue_depth": self.opts.queue_depth,
                     "job_id": job.job_id}
-        if job.idem_key is not None:
-            self._idem[job.idem_key] = job.job_id
         self._journal(job)
         self._job_state(job.job_id, "queued", tenant=job.tenant,
                         priority=job.priority)
@@ -482,6 +525,33 @@ class ServeDaemon:
                 "tenant": job.tenant, "n_lanes": len(job.variants),
                 "priority": job.priority,
                 "state_dir": self.opts.state_dir}
+
+    def _deduped_ack(self, job_id: str, tenant: str,
+                     subscriber: Optional["queue.Queue"]) -> dict:
+        """The exactly-once duplicate answer: ack the ORIGINAL job_id,
+        and if it already finished stream the durable record."""
+        self.metrics.bind_job(job_id).emit("job_deduped", tenant=tenant)
+        resp = {"event": "accepted", "job_id": job_id,
+                "tenant": tenant, "deduped": True,
+                "state_dir": self.opts.state_dir}
+        if subscriber is not None:
+            rec = self._read_result(job_id)
+            if rec is not None:
+                subscriber.put(rec)
+            subscriber.put(None)
+        return resp
+
+    def _has_durable_trace(self, job_id: str) -> bool:
+        """Whether this state dir already owns ``job_id`` — journaled
+        (queued or running survives a relaunch), running, or terminally
+        recorded. The keyless analogue of an _idem hit."""
+        if os.path.exists(os.path.join(self._jobs_dir,
+                                       f"{job_id}.json")) \
+                or os.path.exists(os.path.join(self._results_dir,
+                                               f"{job_id}.json")):
+            return True
+        with self._lock:
+            return job_id in self._running
 
     # ---- journal / crash recovery ----------------------------------------
 

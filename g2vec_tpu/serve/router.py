@@ -147,6 +147,11 @@ class RouterOptions:
     #: Grace before SIGKILL when fencing a dead-declared replica.
     fence_grace_s: float = 1.0
     vnodes: int = 64
+    #: How long a keyed submit waits for an already-journaled key's home
+    #: replica to recover (or its entry to migrate) before the submit is
+    #: refused with ``retry_later`` — never ring-placed, which would run
+    #: the job twice.
+    sticky_deadline_s: float = 120.0
 
 
 class Router:
@@ -187,6 +192,18 @@ class Router:
                 rejoin_after=opts.rejoin_after)
         self._defaults = G2VecConfig()     # identical to the daemon's
         self._hlock = threading.RLock()
+        #: One lock per replica: fence → migrate → relaunch must be
+        #: atomic per replica, whether the probe loop, boot_fleet, or an
+        #: admin drain initiates it — two of those interleaving would
+        #: fence/launch the same ReplicaSpec concurrently (and SIGKILL
+        #: the other's freshly relaunched process).
+        self._rep_locks = {n: threading.Lock()
+                           for n in self.fleet.names()}
+        #: Replicas mid-``drain_replica``: the probe loop skips them (a
+        #: draining replica flaps dead→rejoining→dead and would trigger
+        #: a failover that migrates the journal the drain contractually
+        #: leaves in place), and _failover refuses to run on them.
+        self._admin_draining: set = set()
         self._stop = threading.Event()
         self._assigned: Dict[str, str] = {}     # job_id -> replica name
         self._requeue_latencies: List[float] = []
@@ -273,8 +290,18 @@ class Router:
 
     def _failover(self, name: str, relaunch: bool = True) -> int:
         """Fence a dead replica, migrate its journal to survivors, then
-        relaunch it. Returns the number of jobs re-queued. Serialized by
-        the probe loop (one failover at a time)."""
+        relaunch it. Returns the number of jobs re-queued. Serialized
+        per replica via _rep_locks (the probe loop, boot_fleet, and
+        drain_replica connection handlers all get here), and suppressed
+        outright while an admin drain owns the replica — a maintenance
+        drain's journal must NOT migrate."""
+        with self._rep_locks[name]:
+            with self._hlock:
+                if name in self._admin_draining:
+                    return 0
+            return self._failover_locked(name, relaunch)
+
+    def _failover_locked(self, name: str, relaunch: bool) -> int:
         died_at = time.monotonic()
         self.fleet.fence(name, grace_s=self.opts.fence_grace_s)
         jobs_dir, results_dir, ckpt_dir = self._dead_paths(name)
@@ -344,9 +371,17 @@ class Router:
                 # Cursor migration: the survivor resumes mid-stream from
                 # the dead replica's last durable checkpoint.
                 shutil.copytree(d, dst, dirs_exist_ok=True)
+            out = dict(payload, op="submit")
+            if not payload.get("idem_key"):
+                # Keyless entry (submitted straight to the replica's
+                # socket, no router): there is no key to derive the id
+                # from, so pass the journaled job_id through explicitly
+                # — otherwise the survivor mints a fresh serial id, the
+                # migrated cursors (copied under the old id) are
+                # orphaned, and the client's poll handle goes dark.
+                out["job_id"] = job_id
             try:
-                resp = self._request(target, dict(payload, op="submit"),
-                                     timeout=30.0)
+                resp = self._request(target, out, timeout=30.0)
             except (OSError, protocol.ProtocolError) as e:
                 self.metrics.emit("failover_error", job_id=job_id,
                                   from_replica=name, to_replica=target,
@@ -392,6 +427,14 @@ class Router:
             for name, h in self.health.items():
                 if now < due[name]:
                     continue
+                with self._hlock:
+                    if name in self._admin_draining:
+                        # Intentionally down for maintenance: probing it
+                        # would flap dead→rejoining→dead and race the
+                        # drain's own fence+relaunch with a failover.
+                        due[name] = time.monotonic() \
+                            + self.opts.probe_interval
+                        continue
                 ok, jd = self.probe(name)
                 with self._hlock:
                     trans = h.on_probe(ok, journal_depth=jd,
@@ -493,27 +536,43 @@ class Router:
         """Synchronous graceful drain of one replica: forward ``drain``,
         wait for the process to exit 0, relaunch it. The journal entries
         it checkpoints re-queue on its OWN relaunch (no migration — this
-        is maintenance, not failure)."""
+        is maintenance, not failure). The _admin_draining flag keeps the
+        probe loop away for the duration (a half-drained replica answers
+        some probes and fails others, which would otherwise declare it
+        dead and fire a concurrent, journal-migrating failover), and the
+        per-replica lock waits out any failover already in flight before
+        touching the process."""
         if name not in self.health:
             return {"event": "error",
                     "error": f"unknown replica {name!r}"}
         with self._hlock:
-            h = self.health[name]
-            h.force_dead(now=time.time())   # out of the ring immediately
+            if name in self._admin_draining:
+                return {"event": "error",
+                        "error": f"replica {name!r} is already draining"}
+            self._admin_draining.add(name)
+            # Out of the ring immediately — no new placements land here.
+            self.health[name].force_dead(now=time.time())
         try:
-            resp = self._request(name, {"op": "drain"}, timeout=10.0)
-        except (OSError, protocol.ProtocolError) as e:
-            resp = {"event": "error", "error": str(e)[:200]}
-        rc = self.fleet.fence(name, grace_s=120.0)   # graceful wait
-        self.metrics.emit("replica_drained", replica=name, rc=rc)
-        try:
-            self.fleet.launch(name)
-        except (RuntimeError, TimeoutError, OSError) as e:
-            return {"event": "drained", "replica": name, "rc": rc,
-                    "relaunch_error": str(e)[:200],
-                    "drain_response": resp}
-        return {"event": "drained", "replica": name, "rc": rc,
-                "drain_response": resp}
+            with self._rep_locks[name]:
+                try:
+                    resp = self._request(name, {"op": "drain"},
+                                         timeout=10.0)
+                except (OSError, protocol.ProtocolError) as e:
+                    resp = {"event": "error", "error": str(e)[:200]}
+                rc = self.fleet.fence(name, grace_s=120.0)  # graceful
+                self.metrics.emit("replica_drained", replica=name, rc=rc)
+                try:
+                    self.fleet.launch(name)
+                except (RuntimeError, TimeoutError, OSError) as e:
+                    return {"event": "drained", "replica": name,
+                            "rc": rc,
+                            "relaunch_error": str(e)[:200],
+                            "drain_response": resp}
+                return {"event": "drained", "replica": name, "rc": rc,
+                        "drain_response": resp}
+        finally:
+            with self._hlock:
+                self._admin_draining.discard(name)
 
     # ---- submit relay -----------------------------------------------------
 
@@ -540,7 +599,7 @@ class Router:
         # Rescan-in-a-loop because the home can be mid-migration (its
         # replica dead, the probe loop failing it over): the journal
         # entry moves to a survivor, or the result record appears.
-        sticky_deadline = time.monotonic() + 120.0
+        sticky_deadline = time.monotonic() + self.opts.sticky_deadline_s
         last_beat = time.monotonic()
         while time.monotonic() < sticky_deadline:
             rec = self._read_result_any(jid)
@@ -565,6 +624,31 @@ class Router:
                                          "job_id": jid, "stale": owner})
                 last_beat = time.monotonic()
             time.sleep(0.25)
+        else:
+            # Sticky deadline expired with the key's journal entry still
+            # on an unrecovered replica (relaunch failing over and over).
+            # Ring-placing it now would hand the key to a survivor whose
+            # idem table has never seen it — the duplicate run the whole
+            # sticky scan exists to prevent. Refuse instead; the same
+            # idem_key retried later dedups or resumes wherever the
+            # entry finally lands.
+            rec = self._read_result_any(jid)
+            if rec is not None:
+                protocol.write_event(f, {"event": "accepted",
+                                         "job_id": jid, "deduped": True})
+                protocol.write_event(f, rec)
+                return
+            owner = self._journal_owner(jid)
+            if owner is not None:
+                self.metrics.emit("submit_retry_later", job_id=jid,
+                                  journal_owner=owner)
+                protocol.write_event(
+                    f, {"event": "rejected", "error": "retry_later",
+                        "job_id": jid,
+                        "detail": f"job is journaled on unrecovered "
+                                  f"replica {owner}; resubmit with the "
+                                  f"same idem_key once the fleet heals"})
+                return
         tried: List[str] = []
         for _ in range(max(1, len(self.fleet.names()))):
             target = self.ring.lookup(

@@ -264,6 +264,94 @@ def test_idem_key_closes_kill_between_accept_and_journal_window(
         d2.close()
 
 
+def test_concurrent_same_key_submits_admit_exactly_once(
+        tsv_paths, tmp_path):
+    """The dedup check and the table insert are one atomic step: N
+    threads (per-connection handlers) racing the same idem_key must
+    yield exactly ONE real admission — the rest get deduped acks — and
+    one journal entry."""
+    d = _daemon(tmp_path)
+    n = 8
+    barrier = threading.Barrier(n)
+    results = [None] * n
+
+    def hammer(i):
+        payload = {"tenant": "a", "idem_key": "k-race",
+                   "job": _job(tsv_paths, tmp_path, "race")}
+        barrier.wait()
+        results[i] = d.admit(payload)
+
+    try:
+        threads = [threading.Thread(target=hammer, args=(i,))
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert all(r is not None and r["event"] == "accepted"
+                   for r in results), results
+        assert len({r["job_id"] for r in results}) == 1
+        real = [r for r in results if not r.get("deduped")]
+        assert len(real) == 1, f"{len(real)} non-deduped admissions"
+        jdir = os.path.join(d.opts.state_dir, "jobs")
+        assert len(os.listdir(jdir)) == 1
+    finally:
+        d.close()
+
+
+def test_journal_never_persists_auth_token(tsv_paths, tmp_path):
+    """The shared secret is needed only at admission; the journal record
+    (plaintext, default file perms, resent verbatim on failover) must
+    not carry it."""
+    d = _daemon(tmp_path, auth_token="sekrit-token")
+    try:
+        ack = d.admit({"op": "submit", "auth_token": "sekrit-token",
+                       "tenant": "a", "idem_key": "k-tok",
+                       "job": _job(tsv_paths, tmp_path, "tok")})
+        assert ack["event"] == "accepted"
+        jpath = os.path.join(d.opts.state_dir, "jobs",
+                             f"{ack['job_id']}.json")
+        with open(jpath) as f:
+            text = f.read()
+        assert "sekrit-token" not in text
+        assert "auth_token" not in json.loads(text)["payload"]
+    finally:
+        d.close()
+
+
+def test_keyless_resubmit_preserves_explicit_job_id(tsv_paths, tmp_path):
+    """A keyless journal entry migrated by the router keeps its job_id
+    (cursors and the client's poll handle stay attached): the daemon
+    honors an explicit payload job_id, dedups a repeat of it against
+    its journal, and rejects ids that could escape the state dir."""
+    d = _daemon(tmp_path)
+    try:
+        payload = {"tenant": "a", "job_id": "j0007-deadbeef",
+                   "job": _job(tsv_paths, tmp_path, "kl")}
+        ack = d.admit(dict(payload))
+        assert ack["event"] == "accepted"
+        assert ack["job_id"] == "j0007-deadbeef"
+        # A router retrying the same migration (crash between the
+        # survivor's ack and the dead journal's unlink) dedups.
+        again = d.admit(dict(payload))
+        assert again.get("deduped") is True
+        assert again["job_id"] == "j0007-deadbeef"
+        jdir = os.path.join(d.opts.state_dir, "jobs")
+        assert len(os.listdir(jdir)) == 1
+        for bad in ("../escape", ".hidden", "a/b", "", "x" * 200, 7):
+            rej = d.admit({"job_id": bad,
+                           "job": _job(tsv_paths, tmp_path, "kl2")})
+            assert rej["event"] == "rejected", bad
+            assert "job_id" in rej["detail"]
+        # idem_key still wins over an explicit id (derivation rules).
+        both = d.admit({"idem_key": "k-boss", "job_id": "jignored-00",
+                        "job": _job(tsv_paths, tmp_path, "kl3")})
+        from g2vec_tpu.serve.daemon import idem_job_id
+        assert both["job_id"] == idem_job_id("k-boss")
+    finally:
+        d.close()
+
+
 def test_bad_idem_keys_reject_at_admission(tsv_paths, tmp_path):
     d = _daemon(tmp_path)
     try:
@@ -274,6 +362,83 @@ def test_bad_idem_keys_reject_at_admission(tsv_paths, tmp_path):
             assert "idem_key" in rej["detail"]
     finally:
         d.close()
+
+
+# ---------------------------------------------------------------------------
+# Sticky routing + drain/failover serialization
+# ---------------------------------------------------------------------------
+
+def _drain_events(f):
+    f.seek(0)
+    return [json.loads(line) for line in f.read().splitlines()]
+
+
+def test_sticky_deadline_rejects_instead_of_ring_placing(
+        tsv_paths, tmp_path):
+    """A key whose journal entry sits on an unrecovered replica must
+    NEVER fall through to a fresh ring placement when the sticky wait
+    expires — the survivor's idem table has not seen the key and would
+    run the job twice. The submit is refused with retry_later."""
+    import io
+
+    from g2vec_tpu.serve import protocol
+    from g2vec_tpu.serve.router import Router, RouterOptions
+
+    fleet_dir = str(tmp_path / "fleet")
+    r = Router(RouterOptions(fleet_dir=fleet_dir, replicas=2,
+                             sticky_deadline_s=0.6),
+               console=lambda s: None)
+    jid = protocol.idem_job_id("k-stuck")
+    jdir = os.path.join(fleet_dir, "r0", "state", "jobs")
+    os.makedirs(jdir)
+    with open(os.path.join(jdir, f"{jid}.json"), "w") as fh:
+        json.dump({"job_id": jid, "submitted_at": 1.0,
+                   "payload": {"idem_key": "k-stuck"}}, fh)
+
+    f = io.BytesIO()
+    r._relay_submit(f, {"op": "submit", "idem_key": "k-stuck",
+                        "job": _job(tsv_paths, tmp_path, "stuck")})
+    evs = _drain_events(f)
+    assert evs[-1]["event"] == "rejected"
+    assert evs[-1]["error"] == "retry_later"
+    assert evs[-1]["job_id"] == jid
+    assert "r0" in evs[-1]["detail"]
+    # The entry never moved and nothing was placed elsewhere.
+    assert os.listdir(jdir) == [f"{jid}.json"]
+
+    # A FRESH key still takes the ring-placement path (and, with no
+    # replica processes alive, gets the no_replicas refusal — not
+    # retry_later).
+    f2 = io.BytesIO()
+    r._relay_submit(f2, {"op": "submit", "idem_key": "k-fresh",
+                         "job": _job(tsv_paths, tmp_path, "fresh")})
+    evs2 = _drain_events(f2)
+    assert evs2[-1]["event"] == "rejected"
+    assert evs2[-1]["error"] == "no_replicas"
+
+
+def test_admin_drain_suppresses_failover(tmp_path):
+    """While drain_replica owns a replica, a probe-loop death
+    declaration must not fire the journal-migrating failover (the
+    maintenance contract is re-queue on OWN relaunch), and a second
+    concurrent drain of the same replica is refused."""
+    from g2vec_tpu.serve.router import Router, RouterOptions
+
+    r = Router(RouterOptions(fleet_dir=str(tmp_path / "fleet"),
+                             replicas=2), console=lambda s: None)
+    with r._hlock:
+        r._admin_draining.add("r0")
+    try:
+        assert r._failover("r0") == 0          # suppressed, no fence
+        resp = r.handle_drain_replica("r0")
+        assert resp["event"] == "error"
+        assert "already draining" in resp["error"]
+        # The untouched replica still fails over normally (no journal,
+        # nothing to migrate, relaunch skipped via relaunch=False).
+        assert r._failover("r1", relaunch=False) == 0
+    finally:
+        with r._hlock:
+            r._admin_draining.discard("r0")
 
 
 # ---------------------------------------------------------------------------
