@@ -21,6 +21,14 @@ def main(argv=None) -> int:
         from g2vec_tpu.serve.cli import serve_main
 
         return serve_main(argv[1:])
+    if argv and argv[0] == "analyze":
+        # Static-analysis suite: `g2vec analyze [--json] ...`
+        # (analyze/cli.py). Pure AST — never touches jax, so it is
+        # dispatched before any platform/env setup. Exit codes: 0
+        # clean, 1 findings, 2 usage.
+        from g2vec_tpu.analyze.cli import analyze_main
+
+        return analyze_main(argv[1:])
     from g2vec_tpu.config import config_from_args
 
     cfg = config_from_args(argv)

@@ -1,0 +1,36 @@
+"""g2vec check — the project-invariant static-analysis suite.
+
+The repo states a dozen load-bearing invariants only in prose: the
+exactly-once argument leans on ``_idem_lock`` discipline, the router is
+"jax-free" by contract, the fault-seam vocabulary is a registry humans
+kept in sync by grep. PR 11's review proved prose rots — an unlocked
+check-then-insert shipped in ``admit()`` and had to be caught by eye.
+This package turns those invariants into AST checkers that run in
+tier-1 (``python -m g2vec_tpu analyze``):
+
+- ``lock-discipline`` (locks.py): ``# guarded-by:`` annotations on
+  attributes of threaded classes; mutations outside the named lock,
+  check-then-act across a lock release, lock-order cycles.
+- ``jax-purity`` (purity.py): declared jax-free modules never reach
+  jax/jaxlib through the module-level import graph; no host bounces
+  (np.asarray / .item() / time.* / Python RNG) inside functions handed
+  to jit/vmap/while_loop; donated-buffer use-after-donate.
+- ``fault-seams`` (seams.py): every ``fault_point`` literal is a
+  declared seam, every declared seam has a call site and a test.
+- ``metrics-schema`` (events.py): every ``MetricsWriter`` emission
+  matches the declared event schema (utils/metrics_schema.py).
+- ``config-doc-drift`` (configdoc.py): CLI flags vs the README table,
+  SERVE_JOB_KEYS vs real config fields, serve payload keys vs the
+  protocol whitelist.
+
+Checkers are pure AST + text — they never import the code under
+analysis, so the suite runs in milliseconds on CPU with no jax init.
+Known findings live in the committed ANALYZE_BASELINE.json (shrink-only:
+new entries fail CI); deliberate exceptions carry an inline
+``# analyze: allow[<checker-id>] <reason>`` waiver.
+"""
+from g2vec_tpu.analyze.core import (AnalysisContext, Checker, Finding,
+                                    load_baseline, run_analysis)
+
+__all__ = ["AnalysisContext", "Checker", "Finding", "load_baseline",
+           "run_analysis"]

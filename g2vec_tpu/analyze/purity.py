@@ -1,0 +1,357 @@
+"""jax-purity: jax-free module boundaries + jit host-bounce lint.
+
+Three sub-checks, all pure AST:
+
+1. **jax-free closure.** The declared jax-free modules (the router
+   stack and the chaos tool must start fast and run on boxes with no
+   accelerator stack) may not reach ``jax``/``jaxlib`` through the
+   MODULE-LEVEL import graph. The walk models real import semantics:
+   importing ``g2vec_tpu.serve.daemon`` executes ``g2vec_tpu/__init__``
+   and ``g2vec_tpu/serve/__init__`` too, so a jax import smuggled into
+   a package init is caught even though no declared module names it.
+   Function-local (deferred) imports in *transitive* deps are the
+   repo's sanctioned lazy idiom and are allowed; a declared module
+   itself must not import jax anywhere, deferred or not.
+2. **jit host bounces.** Functions handed to ``jax.jit`` / ``vmap`` /
+   ``pmap`` / ``lax.while_loop`` / ``lax.scan`` / ``lax.fori_loop``
+   (decorator, ``partial(jit, ...)``, or direct call form) under
+   ``ops/`` and ``train/`` must not call ``np.asarray``/``np.array``,
+   ``.item()``, ``time.*``, or Python RNG (``random.*`` /
+   ``np.random.*``) — each is a trace-time constant or a silent
+   device→host sync (the PR 5 "np bounce" class).
+3. **use-after-donate.** After ``g = jax.jit(f, donate_argnums=(0,))``
+   and ``out = g(x)``, a later read of ``x`` in the same function is
+   a use of a donated (invalidated) buffer — flagged as a warning
+   unless the call rebinds the same name (the in-place update idiom).
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from g2vec_tpu.analyze.core import (AnalysisContext, Checker, Finding,
+                                    SourceFile)
+
+#: Modules that must never reach jax at import time (relpath -> why).
+JAX_FREE = {
+    "g2vec_tpu/serve/protocol.py":
+        "shared by the router process, which never imports jax",
+    "g2vec_tpu/serve/router.py":
+        "the front door must boot in milliseconds on accelerator-free "
+        "hosts",
+    "g2vec_tpu/resilience/lifecycle.py":
+        "imported by router and daemon alike; pure state machines",
+    "tools/chaos_soak.py":
+        "the soak harness supervises daemons, it never owns a device",
+}
+
+_JIT_WRAPPERS = {"jit", "vmap", "pmap"}
+_LAX_BODY_ARG = {"while_loop": (0, 1), "scan": (0,), "fori_loop": (2,),
+                 "cond": (1, 2)}
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class JaxPurityChecker(Checker):
+    id = "jax-purity"
+    description = ("jax-free module closure, host bounces inside jitted "
+                   "functions, donated-buffer reuse")
+    severity = "error"
+
+    def check(self, ctx: AnalysisContext) -> List[Finding]:
+        findings: List[Finding] = []
+        self._check_jax_free(ctx, findings)
+        for sf in (ctx.files("g2vec_tpu/ops")
+                   + ctx.files("g2vec_tpu/train")):
+            self._check_jit_purity(ctx, sf, findings)
+        return findings
+
+    # ---- jax-free import closure ------------------------------------------
+
+    def _module_files(self, ctx: AnalysisContext,
+                      modname: str) -> List[str]:
+        """Repo files executed by importing ``modname``: the module
+        itself plus every ancestor package ``__init__``. Empty for
+        external modules."""
+        parts = modname.split(".")
+        out: List[str] = []
+        for i in range(1, len(parts) + 1):
+            prefix = parts[:i]
+            pkg = "/".join(prefix) + "/__init__.py"
+            mod = "/".join(prefix) + ".py"
+            if ctx.file(pkg) is not None:
+                out.append(pkg)
+            elif i == len(parts) and ctx.file(mod) is not None:
+                out.append(mod)
+            elif i < len(parts) and ctx.file(mod) is not None:
+                # ``from g2vec_tpu.config import X``: config is a
+                # module, X an attribute.
+                out.append(mod)
+                break
+        return out
+
+    def _top_level_imports(self, sf: SourceFile) \
+            -> List[Tuple[str, int]]:
+        """(module name, line) for every import that executes at module
+        import time — module body including class bodies and top-level
+        try/if, excluding function bodies (the lazy idiom)."""
+        out: List[Tuple[str, int]] = []
+        tree = sf.tree
+        if tree is None:
+            return out
+
+        def visit(stmts):
+            for stmt in stmts:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    continue
+                if isinstance(stmt, ast.Import):
+                    for alias in stmt.names:
+                        out.append((alias.name, stmt.lineno))
+                elif isinstance(stmt, ast.ImportFrom):
+                    base = stmt.module or ""
+                    if stmt.level:
+                        # Relative import: anchor at the file's package.
+                        pkg = os.path.dirname(sf.relpath).replace("/",
+                                                                  ".")
+                        for _ in range(stmt.level - 1):
+                            pkg = pkg.rpartition(".")[0]
+                        base = f"{pkg}.{base}".rstrip(".") if base \
+                            else pkg
+                    if base:
+                        out.append((base, stmt.lineno))
+                        for alias in stmt.names:
+                            # ``from pkg import sub`` may bind a
+                            # submodule — the walk resolves both.
+                            out.append((f"{base}.{alias.name}",
+                                        stmt.lineno))
+                for field in ("body", "orelse", "finalbody"):
+                    sub = getattr(stmt, field, None)
+                    if sub:
+                        visit(sub)
+                for handler in getattr(stmt, "handlers", []):
+                    visit(handler.body)
+
+        visit(tree.body)
+        return out
+
+    def _check_jax_free(self, ctx: AnalysisContext,
+                        findings: List[Finding]) -> None:
+        for root, why in sorted(JAX_FREE.items()):
+            sf = ctx.file(root)
+            if sf is None:
+                continue
+            # A declared module must not import jax anywhere AT ALL,
+            # even deferred (that would just move the cost to runtime).
+            tree = sf.tree
+            if tree is not None:
+                for node in ast.walk(tree):
+                    names = []
+                    if isinstance(node, ast.Import):
+                        names = [(a.name, node.lineno)
+                                 for a in node.names]
+                    elif isinstance(node, ast.ImportFrom) and \
+                            node.module:
+                        names = [(node.module, node.lineno)]
+                    for name, line in names:
+                        top = name.split(".")[0]
+                        if top in ("jax", "jaxlib"):
+                            findings.append(ctx.finding(
+                                self, sf, line,
+                                f"{root} is declared jax-free ({why}) "
+                                f"but imports {name} directly"))
+            # BFS over module-level imports with parent chains.
+            parent: Dict[str, Tuple[Optional[str], int]] = {
+                root: (None, 0)}
+            queue = [root]
+            while queue:
+                cur = queue.pop(0)
+                cur_sf = ctx.file(cur)
+                if cur_sf is None:
+                    continue
+                for modname, line in self._top_level_imports(cur_sf):
+                    top = modname.split(".")[0]
+                    if top in ("jax", "jaxlib"):
+                        chain = [f"{top} (at line {line})"]
+                        hop: Optional[str] = cur
+                        while hop is not None:
+                            chain.append(hop)
+                            hop = parent[hop][0]
+                        findings.append(ctx.finding(
+                            self, sf, parent.get(cur, (None, 1))[1] or 1,
+                            f"{root} is declared jax-free ({why}) but "
+                            f"reaches jax at import time: "
+                            f"{' <- '.join(reversed(chain))}"))
+                        continue
+                    for dep in self._module_files(ctx, modname):
+                        if dep not in parent:
+                            parent[dep] = (cur,
+                                           line if cur == root
+                                           else parent[cur][1])
+                            queue.append(dep)
+
+    # ---- jit purity --------------------------------------------------------
+
+    def _check_jit_purity(self, ctx: AnalysisContext, sf: SourceFile,
+                          findings: List[Finding]) -> None:
+        tree = sf.tree
+        if tree is None:
+            return
+        defs: Dict[str, ast.AST] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, node)
+        staged: List[Tuple[ast.AST, str]] = []
+
+        def is_jit_ctor(call: ast.Call) -> Optional[str]:
+            name = _dotted(call.func)
+            if name is None:
+                return None
+            leaf = name.split(".")[-1]
+            if leaf in _JIT_WRAPPERS and \
+                    (name == leaf or name.startswith(("jax.", "lax."))):
+                return leaf
+            if leaf == "partial" and call.args:
+                inner = _dotted(call.args[0])
+                if inner and inner.split(".")[-1] in _JIT_WRAPPERS:
+                    return inner.split(".")[-1]
+            return None
+
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    tag = (is_jit_ctor(dec)
+                           if isinstance(dec, ast.Call)
+                           else (_dotted(dec) or "").split(".")[-1])
+                    if tag in _JIT_WRAPPERS:
+                        staged.append((node, f"@{tag}"))
+            elif isinstance(node, ast.Call):
+                name = _dotted(node.func)
+                tag = is_jit_ctor(node)
+                if tag and node.args:
+                    target = node.args[0]
+                    self._stage(target, defs, staged, tag)
+                if name:
+                    leaf = name.split(".")[-1]
+                    if leaf in _LAX_BODY_ARG and \
+                            ("lax" in name or "jax" in name):
+                        for pos in _LAX_BODY_ARG[leaf]:
+                            if pos < len(node.args):
+                                self._stage(node.args[pos], defs,
+                                            staged, leaf)
+        seen: Set[int] = set()
+        for fn, how in staged:
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            self._scan_staged(ctx, sf, fn, how, findings)
+        self._check_donate(ctx, sf, tree, findings)
+
+    def _stage(self, target: ast.AST, defs: Dict[str, ast.AST],
+               staged: List[Tuple[ast.AST, str]], how: str) -> None:
+        if isinstance(target, ast.Lambda):
+            staged.append((target, how))
+        elif isinstance(target, ast.Name) and target.id in defs:
+            staged.append((defs[target.id], how))
+
+    def _scan_staged(self, ctx: AnalysisContext, sf: SourceFile,
+                     fn: ast.AST, how: str,
+                     findings: List[Finding]) -> None:
+        name = getattr(fn, "name", "<lambda>")
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            dn = _dotted(node.func) or ""
+            bad = None
+            if dn in ("np.asarray", "numpy.asarray", "np.array",
+                      "numpy.array"):
+                bad = f"{dn} (host materialization at trace time)"
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "item" and not node.args:
+                bad = ".item() (device->host sync; a traced value " \
+                      "has no .item)"
+            elif dn.startswith("time."):
+                bad = f"{dn} (wall-clock is a trace-time constant " \
+                      f"inside a staged function)"
+            elif dn.startswith(("random.", "np.random.",
+                                "numpy.random.")):
+                bad = f"{dn} (Python/numpy RNG is trace-time state; " \
+                      f"use jax.random with an explicit key)"
+            if bad:
+                findings.append(ctx.finding(
+                    self, sf, node.lineno,
+                    f"{name} is staged via {how} but calls {bad}"))
+
+    # ---- donated buffers ---------------------------------------------------
+
+    def _check_donate(self, ctx: AnalysisContext, sf: SourceFile,
+                      tree: ast.Module,
+                      findings: List[Finding]) -> None:
+        donating: Dict[str, Tuple[int, ...]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                call = node.value
+                if not any(kw.arg == "donate_argnums"
+                           for kw in call.keywords):
+                    continue
+                try:
+                    spec = next(kw.value for kw in call.keywords
+                                if kw.arg == "donate_argnums")
+                    nums = ast.literal_eval(spec)
+                except (ValueError, StopIteration):
+                    continue
+                nums = (nums,) if isinstance(nums, int) else tuple(nums)
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        donating[t.id] = nums
+        if not donating:
+            return
+        for fn in ast.walk(tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            self._donate_in_fn(ctx, sf, fn, donating, findings)
+
+    def _donate_in_fn(self, ctx: AnalysisContext, sf: SourceFile,
+                      fn: ast.AST, donating: Dict[str, Tuple[int, ...]],
+                      findings: List[Finding]) -> None:
+        #: donated name -> line of the donating call
+        dead: Dict[str, int] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                call = node.value
+                cn = _dotted(call.func)
+                if cn in donating:
+                    rebound = {leaf.id for t in node.targets
+                               for leaf in ast.walk(t)
+                               if isinstance(leaf, ast.Name)}
+                    for pos in donating[cn]:
+                        if pos < len(call.args) and \
+                                isinstance(call.args[pos], ast.Name):
+                            arg = call.args[pos].id
+                            if arg not in rebound:
+                                dead[arg] = node.lineno
+                    for t in rebound:
+                        dead.pop(t, None)
+            elif isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, ast.Load) and \
+                    node.id in dead and node.lineno > dead[node.id]:
+                findings.append(ctx.finding(
+                    self, sf, node.lineno,
+                    f"{node.id} was donated to a jitted call "
+                    f"(donate_argnums) and read afterwards — the "
+                    f"buffer is invalidated on the device",
+                    severity="warning"))
+                dead.pop(node.id)

@@ -68,11 +68,15 @@ class OverlapScheduler:
     def __init__(self, max_workers: int = 4):
         self._ex = ThreadPoolExecutor(max_workers=max_workers,
                                       thread_name_prefix="g2v-overlap")
-        self._tasks: Dict[str, _Task] = {}
-        self._order: list = []
+        # _lock covers the registry shape (submit/prune/add_closer run
+        # on different threads); unlocked READS in result/as_completed/
+        # drain are safe because tasks are never mutated after submit —
+        # only their _Task fields change, via each task's own Event.
+        self._tasks: Dict[str, _Task] = {}      # guarded-by: _lock
+        self._order: list = []                  # guarded-by: _lock
         self._lock = threading.Lock()
         self._done_cv = threading.Condition()
-        self._closers: list = []
+        self._closers: list = []                # guarded-by: _lock
 
     # ---- submission -------------------------------------------------------
 
@@ -198,6 +202,11 @@ class OverlapScheduler:
             for t in victims:
                 self._tasks.pop(t.name, None)
                 try:
+                    # analyze: allow[lock-discipline] deliberate lock
+                    # drop above: waiting for in-flight victims under
+                    # _lock would deadlock submit(); the per-batch name-
+                    # prefix contract (nothing submits into a batch
+                    # being pruned) makes this re-acquire safe.
                     self._order.remove(t)
                 except ValueError:
                     pass

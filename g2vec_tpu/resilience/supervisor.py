@@ -292,18 +292,24 @@ class ReplicaSpec:
 
     ``proc`` is a Popen when this fleet launched the replica; after a
     router restart an *adopted* replica has only ``pid`` (learned from
-    its ``/status``) — fencing handles both."""
+    its ``/status``) — fencing handles both.
+
+    Concurrency: the fleet/spec objects carry no lock of their own. The
+    mutable fields below are guarded EXTERNALLY by the router's
+    per-replica lock (the dotted guarded-by form is documentation-only
+    to the lock-discipline checker — it records the contract without
+    pretending to verify a lock it cannot see from this file)."""
 
     name: str
     dir: str
     socket_path: str
     state_dir: str
     log_path: str
-    addr: Optional[str] = None
-    pid: Optional[int] = None
-    proc: Optional[object] = None       # subprocess.Popen
-    boots: int = 0
-    exits: int = 0
+    addr: Optional[str] = None          # guarded-by: Router._rep_locks
+    pid: Optional[int] = None           # guarded-by: Router._rep_locks
+    proc: Optional[object] = None       # guarded-by: Router._rep_locks
+    boots: int = 0                      # guarded-by: Router._rep_locks
+    exits: int = 0                      # guarded-by: Router._rep_locks
 
 
 class ReplicaFleet:
@@ -334,6 +340,9 @@ class ReplicaFleet:
         self.serve_argv = list(serve_argv or [])
         self.env = dict(env) if env is not None else dict(os.environ)
         self.console = console
+        #: name -> ReplicaSpec. The dict shape is fixed at construction;
+        #: per-spec mutation happens under Router._rep_locks[name].
+        # guarded-by: Router._rep_locks
         self.replicas: dict = {}
         for i in range(n):
             name = f"r{i}"

@@ -191,10 +191,13 @@ class _FairQueue:
     def __init__(self, depth: int, aging_s: float = 30.0):
         self._depth = depth
         self._aging_s = aging_s
+        # guarded-by: _lock
         self._tiers: Dict[str, "OrderedDict[str, deque]"] = {
             p: OrderedDict() for p in PRIORITIES}
-        self._n = 0
+        self._n = 0                  # guarded-by: _lock
         self._lock = threading.Lock()
+        # Holding _not_empty IS holding _lock (Condition wraps it) —
+        # the checker understands the aliasing.
         self._not_empty = threading.Condition(self._lock)
 
     def depth(self) -> int:
@@ -217,6 +220,8 @@ class _FairQueue:
             self._n += 1
             self._not_empty.notify()
 
+    # analyze: holds[_lock] — pop()'s wait loop already owns the
+    # Condition; the checker verifies every call site holds the lock.
     def _pop_tier(self, tier: "OrderedDict[str, deque]",
                   min_age: float = 0.0) -> Optional[ServeJob]:
         now = time.time()
@@ -299,25 +304,31 @@ class ServeDaemon:
         self.engine = ResidentEngine(cache_dir=opts.cache_dir)
         self._queue = _FairQueue(opts.queue_depth, aging_s=opts.aging_s)
         self._defaults = G2VecConfig()
-        self._running: Dict[str, ServeJob] = {}
+        #: In-flight jobs and the lifecycle counters below are touched
+        #: from the scheduler thread AND per-connection threads (admit,
+        #: cancel_job, /status) — every mutation under _lock; the
+        #: lock-discipline checker (analyze/locks.py) enforces it.
+        self._running: Dict[str, ServeJob] = {}   # guarded-by: _lock
         self._lock = threading.Lock()
         self._stop = threading.Event()
-        self._draining = False
+        self._draining = False       # racy-read bool by design: writers
+        #                            # converge, readers only see it late
+        # guarded-by: _lock
         self._state_counts: "Counter[str]" = Counter()
         self._t0 = time.time()
-        self._serial = 0
-        self._batches = 0
-        self.jobs_done = 0
-        self.jobs_failed = 0
+        self._serial = 0             # guarded-by: _lock
+        self._batches = 0            # scheduler-thread only
+        self.jobs_done = 0           # guarded-by: _lock
+        self.jobs_failed = 0         # guarded-by: _lock
         self._last_beat = self._t0   # scheduler liveness, see /status
         self.tcp_addr: Optional[Tuple[str, int]] = None
         #: idem_key -> job_id for every job this state dir has seen
         #: (journaled, running, or terminally recorded) — the dedup table
         #: behind exactly-once acks. Rebuilt from disk at boot so a
         #: relaunch keeps refusing duplicates it acked in a past life.
-        #: Guarded by _idem_lock: admit() runs on per-connection threads,
-        #: and lookup + reservation must be one atomic step or two
-        #: concurrent same-key submits both miss the table and both run.
+        #: guarded-by: _idem_lock — admit() runs on per-connection
+        #: threads, and lookup + reservation must be one atomic step or
+        #: two concurrent same-key submits both miss the table and run.
         self._idem: Dict[str, str] = {}
         self._idem_lock = threading.Lock()
         self._load_idem_table()
@@ -344,13 +355,19 @@ class ServeDaemon:
                     continue
                 key = extract(rec)
                 if isinstance(key, str) and key:
+                    # analyze: allow[lock-discipline] boot-time rebuild,
+                    # runs from __init__ before any connection thread
                     self._idem[key] = rec.get("job_id", fn[:-5])
 
     # ---- admission --------------------------------------------------------
 
     def _new_job_id(self) -> str:
-        self._serial += 1
-        return f"j{self._serial:04d}-{uuid.uuid4().hex[:8]}"
+        # admit() runs on per-connection threads: an unlocked increment
+        # can hand two concurrent keyless submits the same serial.
+        with self._lock:
+            self._serial += 1
+            serial = self._serial
+        return f"j{serial:04d}-{uuid.uuid4().hex[:8]}"
 
     def _plan_job(self, payload: dict, job_id: Optional[str] = None,
                   submitted_at: Optional[float] = None) -> ServeJob:
@@ -621,7 +638,8 @@ class ServeDaemon:
                 self.console(f"[serve] journal entry {job_id} already has "
                              f"a result record; dropping (exactly-once)")
                 continue
-            self._serial += 1          # keep new ids monotonic-ish
+            with self._lock:
+                self._serial += 1      # keep new ids monotonic-ish
             try:
                 job = self._plan_job(rec["payload"], job_id=job_id,
                                      submitted_at=rec.get("submitted_at"))
@@ -650,8 +668,11 @@ class ServeDaemon:
         (queued → started → (checkpointed|resumed)* → terminal, where
         terminal ∈ {done, failed, cancelled, deadline_exceeded}; ``drained``
         marks a checkpoint-and-requeue pause, not an end state). Every edge
-        lands in the metrics JSONL and the ``/status`` per-state counters."""
-        self._state_counts[state] += 1
+        lands in the metrics JSONL and the ``/status`` per-state counters.
+        Runs on the scheduler thread AND connection threads (admit /
+        cancel), racing the /status snapshot — hence the lock."""
+        with self._lock:
+            self._state_counts[state] += 1
         self.metrics.bind_job(job_id).emit("job_state", state=state, **info)
 
     def _cleanup_ckpt(self, job_id: str) -> None:
@@ -674,7 +695,8 @@ class ServeDaemon:
             os.path.join(self._results_dir, f"{job.job_id}.json"), record)
         self._unjournal(job)
         self._cleanup_ckpt(job.job_id)
-        self.jobs_failed += 1
+        with self._lock:
+            self.jobs_failed += 1
         self._job_state(job.job_id, status, detail=detail)
         self._notify(job, record)
         self._notify(job, None)
@@ -858,7 +880,8 @@ class ServeDaemon:
                 os.path.join(self._results_dir, f"{j.job_id}.json"), record)
             self._unjournal(j)
             self._cleanup_ckpt(j.job_id)
-            self.jobs_done += 1
+            with self._lock:
+                self.jobs_done += 1
             self._job_state(j.job_id, "done", batch=bid)
             self.metrics.bind_job(j.job_id).emit(
                 "job_done", tenant=j.tenant, batch=bid,
@@ -958,7 +981,8 @@ class ServeDaemon:
             os.path.join(self._results_dir, f"{job.job_id}.json"), record)
         self._unjournal(job)
         self._cleanup_ckpt(job.job_id)
-        self.jobs_failed += 1
+        with self._lock:
+            self.jobs_failed += 1
         self._job_state(job.job_id, "failed", classified=classified)
         self.metrics.bind_job(job.job_id).emit("job_failed", error=err,
                                                classified=classified)
@@ -973,6 +997,10 @@ class ServeDaemon:
 
         with self._lock:
             running = sorted(self._running)
+            # One consistent snapshot: copying the Counter while a
+            # connection thread bumps it can RuntimeError mid-iteration.
+            job_states = dict(self._state_counts)
+            jobs_done, jobs_failed = self.jobs_done, self.jobs_failed
         return {"event": "status", "pid": os.getpid(),
                 "uptime_s": round(time.time() - self._t0, 1),
                 "socket": self.opts.socket_path,
@@ -989,11 +1017,11 @@ class ServeDaemon:
                 "queued": self._queue.depth(), "running": running,
                 "queued_by_priority": self._queue.depths(),
                 "draining": self._draining,
-                "job_states": dict(self._state_counts),
+                "job_states": job_states,
                 "queue_depth_limit": self.opts.queue_depth,
                 "max_join": self.opts.max_join,
-                "jobs_done": self.jobs_done,
-                "jobs_failed": self.jobs_failed,
+                "jobs_done": jobs_done,
+                "jobs_failed": jobs_failed,
                 "engine": self.engine.status(),
                 "cache": cache_stats()}
 

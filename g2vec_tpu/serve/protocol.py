@@ -54,6 +54,15 @@ def idem_job_id(idem_key: str) -> str:
 #: longer line is a protocol error, not an OOM.
 MAX_LINE_BYTES = 8 << 20
 
+#: The submit-payload envelope vocabulary. Every key daemon.py or
+#: router.py reads off a submit payload must be declared here — the
+#: config/doc-drift checker (analyze/configdoc.py) enforces it, so a
+#: typo'd ``payload.get("pirority")`` fails tier-1 instead of silently
+#: returning the default. Job-CONTENT keys (the ``job`` object's
+#: fields) are governed separately by config.SERVE_JOB_KEYS.
+SUBMIT_KEYS = ("op", "job", "tenant", "priority", "deadline_s",
+               "idem_key", "job_id", "auth_token")
+
 
 class ProtocolError(ValueError):
     """A malformed request/response line."""

@@ -203,12 +203,17 @@ class Router:
         #: draining replica flaps dead→rejoining→dead and would trigger
         #: a failover that migrates the journal the drain contractually
         #: leaves in place), and _failover refuses to run on them.
-        self._admin_draining: set = set()
+        self._admin_draining: set = set()       # guarded-by: _hlock
         self._stop = threading.Event()
+        #: Routing table and counters: written by the probe loop's
+        #: failovers AND per-connection relay threads, snapshotted by
+        #: /status — all under _hlock (the lock-discipline checker
+        #: enforces every mutation site).
+        # guarded-by: _hlock
         self._assigned: Dict[str, str] = {}     # job_id -> replica name
-        self._requeue_latencies: List[float] = []
-        self.failovers = 0
-        self.jobs_routed = 0
+        self._requeue_latencies: List[float] = []   # guarded-by: _hlock
+        self.failovers = 0                      # guarded-by: _hlock
+        self.jobs_routed = 0                    # guarded-by: _hlock
         self.tcp_addr: Optional[Tuple[str, int]] = None
         self._t0 = time.time()
 
@@ -397,10 +402,13 @@ class Router:
             except OSError:        # a crash here double-journals, and the
                 pass               # idem table dedups the double
             latency = time.monotonic() - died_at
-            self._requeue_latencies.append(latency)
-            self.failovers += 1
             requeued += 1
+            # One critical section for the whole failover record: a
+            # /status racing these lines must never see the assignment
+            # without the counter (or copy the latency list mid-append).
             with self._hlock:
+                self._requeue_latencies.append(latency)
+                self.failovers += 1
                 self._assigned[job_id] = target
             self.metrics.emit("failover", job_id=job_id,
                               from_replica=name, to_replica=target,
@@ -465,6 +473,7 @@ class Router:
                                       1 for r in self._assigned.values()
                                       if r == name))
             lats = sorted(self._requeue_latencies)
+            jobs_routed, failovers = self.jobs_routed, self.failovers
         p99 = lats[min(len(lats) - 1,
                        int(0.99 * len(lats)))] if lats else None
         return {"event": "status", "role": "router", "pid": os.getpid(),
@@ -473,8 +482,8 @@ class Router:
                            if self.tcp_addr else None),
                 "fleet_dir": self.opts.fleet_dir,
                 "replicas": reps,
-                "jobs_routed": self.jobs_routed,
-                "failovers": self.failovers,
+                "jobs_routed": jobs_routed,
+                "failovers": failovers,
                 "requeue_latency_p99_s": (round(p99, 4)
                                           if p99 is not None else None),
                 "requeue_latencies_s": [round(v, 4) for v in lats]}
@@ -691,8 +700,10 @@ class Router:
                 return False               # died pre-ack: retry elsewhere
             job_id = first.get("job_id")
             if first.get("event") == "accepted" and job_id:
-                self.jobs_routed += 1
+                # Relay threads run concurrently: the count and the
+                # assignment move together under _hlock.
                 with self._hlock:
+                    self.jobs_routed += 1
                     self._assigned[job_id] = target
                 first = dict(first, replica=target)
                 self.metrics.emit("job_routed", job_id=job_id,

@@ -146,17 +146,17 @@ class ShardRing:
         if depth < 1:
             raise ValueError(f"ring depth must be >= 1, got {depth}")
         self.depth = depth
-        self._items: deque = deque()
+        self._items: deque = deque()            # guarded-by: _cv
         self._cv = threading.Condition()
-        self._error: Optional[BaseException] = None
-        self._finished = False
-        self._cancelled = False
+        self._error: Optional[BaseException] = None    # guarded-by: _cv
+        self._finished = False                  # guarded-by: _cv
+        self._cancelled = False                 # guarded-by: _cv
         # Accounting (read after the run; the lock covers writes).
-        self.occupancy_hw = 0        # max shards resident at once
-        self.peak_bytes = 0          # max bytes resident at once
-        self.shards_put = 0
-        self.wait_put_s = 0.0        # producer time blocked on a full ring
-        self.wait_get_s = 0.0        # consumer time blocked on an empty one
+        self.occupancy_hw = 0        # guarded-by: _cv — max shards resident
+        self.peak_bytes = 0          # guarded-by: _cv — max bytes resident
+        self.shards_put = 0          # guarded-by: _cv
+        self.wait_put_s = 0.0        # guarded-by: _cv — blocked on full ring
+        self.wait_get_s = 0.0        # guarded-by: _cv — blocked on empty one
 
     def put(self, shard: Shard) -> bool:
         """Enqueue; blocks while full. False = ring cancelled (consumer

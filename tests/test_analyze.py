@@ -1,0 +1,467 @@
+"""The static-analysis suite, tested the way it runs: fixture trees.
+
+Every checker gets (at least) a positive fixture — a tiny synthetic
+repo tree exhibiting the bug class — and a clean twin proving the
+checker is quiet on correct code. The suppression machinery (inline
+waivers, the shrink-only baseline) is pinned too, because a linter
+whose escape hatches silently fail teaches people to delete it.
+
+The two tests that matter most:
+
+- ``test_repo_is_clean`` runs the full suite over THIS repo with the
+  committed baseline — the CI gate that keeps the invariants true;
+- ``test_seeded_idem_race_is_caught`` re-introduces the PR 11 ``_idem``
+  bug (removing the ``with self._idem_lock:`` around admit()'s dedup
+  lookup) into a copy of daemon.py and asserts the lock-discipline
+  checker catches it. A race lint that cannot re-find the race that
+  motivated it is decoration.
+"""
+import os
+import re
+import threading
+import time
+
+import pytest
+
+from g2vec_tpu.analyze.core import (load_baseline, run_analysis,
+                                    save_baseline)
+
+pytestmark = pytest.mark.analyze
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tree(tmp_path, files):
+    """Write ``{relpath: source}`` under tmp_path; return the root."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    return str(tmp_path)
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline
+# ---------------------------------------------------------------------------
+
+_LOCKED_CLASS = '''\
+import threading
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []            # guarded-by: _lock
+
+    def ok(self):
+        with self._lock:
+            self._items.append(1)
+
+    def bad(self):
+        self._items.append(2)
+'''
+
+
+def test_lock_mutation_outside_lock_flagged(tmp_path):
+    root = _tree(tmp_path, {"box.py": _LOCKED_CLASS})
+    rep = run_analysis(root, checker_ids=["lock-discipline"])
+    assert len(rep.findings) == 1
+    f = rep.findings[0]
+    assert f.context == "Box.bad" and "_items" in f.message \
+        and "without holding" in f.message
+    # The in-lock mutation and the __init__ assignment stayed quiet.
+
+
+def test_lock_clean_code_is_quiet(tmp_path):
+    clean = _LOCKED_CLASS.replace(
+        "    def bad(self):\n        self._items.append(2)\n", "")
+    root = _tree(tmp_path, {"box.py": clean})
+    rep = run_analysis(root, checker_ids=["lock-discipline"])
+    assert rep.clean and not rep.findings
+
+
+def test_waiver_suppresses_and_requires_reason(tmp_path):
+    # A reasoned waiver suppresses; a bare allow[] is not a waiver.
+    waived = _LOCKED_CLASS.replace(
+        "        self._items.append(2)",
+        "        # analyze: allow[lock-discipline] single-threaded "
+        "teardown\n        self._items.append(2)")
+    root = _tree(tmp_path, {"box.py": waived})
+    rep = run_analysis(root, checker_ids=["lock-discipline"])
+    assert rep.clean and len(rep.waived) == 1
+
+    bare = _LOCKED_CLASS.replace(
+        "        self._items.append(2)",
+        "        # analyze: allow[lock-discipline]\n"
+        "        self._items.append(2)")
+    rep2 = run_analysis(_tree(tmp_path / "b", {"box.py": bare}),
+                        checker_ids=["lock-discipline"])
+    assert len(rep2.findings) == 1 and not rep2.waived
+
+
+def test_baseline_suppresses_then_goes_stale(tmp_path):
+    root = _tree(tmp_path, {"box.py": _LOCKED_CLASS})
+    base = str(tmp_path / "BASELINE.json")
+    rep = run_analysis(root, checker_ids=["lock-discipline"])
+    save_baseline(base, rep.findings)
+    assert len(load_baseline(base)) == 1
+
+    # Baselined: the finding no longer fails the run.
+    rep2 = run_analysis(root, checker_ids=["lock-discipline"],
+                        baseline_path=base)
+    assert rep2.clean and len(rep2.baselined) == 1
+
+    # Fix the code: the entry goes stale and FAILS (shrink-only).
+    fixed = _LOCKED_CLASS.replace("        self._items.append(2)",
+                                  "        pass")
+    root3 = _tree(tmp_path / "fixed", {"box.py": fixed})
+    rep3 = run_analysis(root3, checker_ids=["lock-discipline"],
+                        baseline_path=base)
+    assert not rep3.clean and len(rep3.stale_baseline) == 1
+
+
+def test_check_then_act_across_release(tmp_path):
+    src = '''\
+import threading
+
+class Table:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._idem = {}             # guarded-by: _lock
+
+    def admit(self, key):
+        with self._lock:
+            hit = self._idem.get(key)
+        if hit is None:
+            with self._lock:
+                self._idem[key] = "fresh"
+        return hit
+'''
+    rep = run_analysis(_tree(tmp_path, {"t.py": src}),
+                       checker_ids=["lock-discipline"])
+    msgs = [f.message for f in rep.findings]
+    assert any("check-then-act" in m and "_idem" in m for m in msgs)
+
+    # The atomic form — lookup and reservation one critical section —
+    # is exactly what the checker asks for, and it is quiet.
+    atomic = src.replace(
+        '''        with self._lock:
+            hit = self._idem.get(key)
+        if hit is None:
+            with self._lock:
+                self._idem[key] = "fresh"''',
+        '''        with self._lock:
+            hit = self._idem.get(key)
+            if hit is None:
+                self._idem[key] = "fresh"''')
+    rep2 = run_analysis(_tree(tmp_path / "ok", {"t.py": atomic}),
+                        checker_ids=["lock-discipline"])
+    assert rep2.clean
+
+
+def test_lock_order_cycle_rejected(tmp_path):
+    src = '''\
+import threading
+
+class TwoLocks:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+        self._n = 0                 # guarded-by: _a_lock
+
+    def forward(self):
+        with self._a_lock:
+            with self._b_lock:
+                pass
+
+    def backward(self):
+        with self._b_lock:
+            with self._a_lock:
+                pass
+'''
+    rep = run_analysis(_tree(tmp_path, {"t.py": src}),
+                       checker_ids=["lock-discipline"])
+    assert any("cycle" in f.message for f in rep.findings)
+
+
+def test_holds_contract_enforced(tmp_path):
+    src = '''\
+import threading
+
+class Q:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0                 # guarded-by: _lock
+
+    # analyze: holds[_lock]
+    def _bump(self):
+        self._n += 1
+
+    def good(self):
+        with self._lock:
+            self._bump()
+
+    def bad(self):
+        self._bump()
+'''
+    rep = run_analysis(_tree(tmp_path, {"q.py": src}),
+                       checker_ids=["lock-discipline"])
+    assert len(rep.findings) == 1
+    f = rep.findings[0]
+    assert f.context == "Q.bad" and "holds" in f.message
+
+
+def test_condition_wrapping_lock_is_aliased(tmp_path):
+    src = '''\
+import threading
+
+class Ring:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._items = []            # guarded-by: _lock
+
+    def put(self, x):
+        with self._not_empty:
+            self._items.append(x)
+            self._not_empty.notify()
+'''
+    rep = run_analysis(_tree(tmp_path, {"r.py": src}),
+                       checker_ids=["lock-discipline"])
+    assert rep.clean
+
+
+# ---------------------------------------------------------------------------
+# jax-purity
+# ---------------------------------------------------------------------------
+
+def test_jax_free_module_reaching_jax_flagged(tmp_path):
+    files = {
+        "g2vec_tpu/__init__.py": "",
+        "g2vec_tpu/serve/__init__.py": "",
+        # Declared jax-free, but reaches jax through a helper.
+        "g2vec_tpu/serve/protocol.py":
+            "from g2vec_tpu.serve import helper\n",
+        "g2vec_tpu/serve/helper.py": "import jax\n",
+    }
+    rep = run_analysis(_tree(tmp_path, files),
+                       checker_ids=["jax-purity"])
+    assert any("jax" in f.message and f.path.endswith("protocol.py")
+               for f in rep.findings)
+
+    # Severing the edge makes it quiet.
+    files["g2vec_tpu/serve/helper.py"] = "import os\n"
+    rep2 = run_analysis(_tree(tmp_path / "ok", files),
+                        checker_ids=["jax-purity"])
+    assert rep2.clean
+
+
+def test_staged_function_impurity_flagged(tmp_path):
+    files = {
+        "g2vec_tpu/__init__.py": "",
+        "g2vec_tpu/ops/__init__.py": "",
+        "g2vec_tpu/ops/kernel.py": '''\
+import jax
+import numpy as np
+
+@jax.jit
+def bad_step(x):
+    return np.asarray(x) + 1
+
+@jax.jit
+def good_step(x):
+    return x + 1
+''',
+    }
+    rep = run_analysis(_tree(tmp_path, files),
+                       checker_ids=["jax-purity"])
+    assert len(rep.findings) == 1
+    assert "np.asarray" in rep.findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# fault-seams
+# ---------------------------------------------------------------------------
+
+def test_seam_registry_enforced(tmp_path):
+    files = {
+        "g2vec_tpu/resilience/faults.py":
+            'SEAMS = ("alpha", "beta")\n',
+        "g2vec_tpu/core.py": '''\
+from g2vec_tpu.resilience.faults import fault_point
+
+def work():
+    fault_point("alpha")
+    fault_point("typo_seam")
+''',
+        "tests/test_core.py": 'PLAN = "stage=alpha,kind=crash"\n',
+    }
+    rep = run_analysis(_tree(tmp_path, files),
+                       checker_ids=["fault-seams"])
+    msgs = " | ".join(f.message for f in rep.findings)
+    assert "typo_seam" in msgs            # undeclared literal at a call
+    assert "beta" in msgs                 # declared but never called
+
+
+# ---------------------------------------------------------------------------
+# metrics-schema
+# ---------------------------------------------------------------------------
+
+def test_event_schema_enforced(tmp_path):
+    files = {
+        "g2vec_tpu/utils/metrics_schema.py": '''\
+EVENT_SCHEMAS = {
+    "boot": {"required": ["rank"], "optional": ["note"]},
+}
+''',
+        "g2vec_tpu/app.py": '''\
+def go(metrics, extra):
+    metrics.emit("boot", rank=0, note="hi")       # clean
+    metrics.emit("boot", nope=1)                  # unknown field + no rank
+    metrics.emit("mystery", x=1)                  # unknown kind
+    metrics.emit("boot", **extra)                 # splat: no missing check
+''',
+    }
+    rep = run_analysis(_tree(tmp_path, files),
+                       checker_ids=["metrics-schema"])
+    msgs = [f.message for f in rep.findings]
+    assert any("nope" in m for m in msgs)
+    assert any("rank" in m for m in msgs)
+    assert any("mystery" in m for m in msgs)
+    # Exactly the three: the clean site and the splat site are quiet.
+    assert len(msgs) == 3
+
+
+# ---------------------------------------------------------------------------
+# config-doc-drift
+# ---------------------------------------------------------------------------
+
+def test_readme_flag_drift_flagged(tmp_path):
+    files = {
+        "g2vec_tpu/config.py": '''\
+def build_parser(p):
+    p.add_argument("--documented-flag", type=int)
+    p.add_argument("--secret-flag", type=int)
+''',
+        "README.md": "Use `--documented-flag N` to tune things.\n",
+    }
+    rep = run_analysis(_tree(tmp_path, files),
+                       checker_ids=["config-doc-drift"])
+    assert len(rep.findings) == 1
+    assert "--secret-flag" in rep.findings[0].message
+
+    files["README.md"] += "Also `--secret-flag`.\n"
+    rep2 = run_analysis(_tree(tmp_path / "ok", files),
+                        checker_ids=["config-doc-drift"])
+    assert rep2.clean
+
+
+# ---------------------------------------------------------------------------
+# the repo itself
+# ---------------------------------------------------------------------------
+
+def test_repo_is_clean():
+    """The CI gate: the full suite over THIS repo, with the committed
+    baseline, has zero active findings and zero stale entries — and
+    finishes far inside the 30s budget."""
+    t0 = time.perf_counter()
+    rep = run_analysis(REPO, baseline_path=os.path.join(
+        REPO, "ANALYZE_BASELINE.json"))
+    elapsed = time.perf_counter() - t0
+    assert rep.checkers_run == ["lock-discipline", "jax-purity",
+                                "fault-seams", "metrics-schema",
+                                "config-doc-drift"]
+    assert not rep.findings, \
+        "\n".join(f"{f.location()}: [{f.checker}] {f.message}"
+                  for f in rep.findings)
+    assert not rep.stale_baseline
+    assert elapsed < 30.0
+
+
+def test_seeded_idem_race_is_caught(tmp_path):
+    """Re-introduce the PR 11 bug: strip the ``with self._idem_lock:``
+    around admit()'s dedup lookup in a COPY of daemon.py and prove the
+    lock-discipline checker finds the unlocked mutation."""
+    with open(os.path.join(REPO, "g2vec_tpu", "serve",
+                           "daemon.py")) as f:
+        src = f.read()
+    pat = re.compile(
+        r"^(\s*)with self\._idem_lock:\n"
+        r"(\1    orig = self\._idem\.get\(job\.idem_key\)\n"
+        r"\1    if orig is None:\n"
+        r"\1        self\._idem\[job\.idem_key\] = job\.job_id\n"
+        r"\1        reserved = True\n)", re.M)
+    m = pat.search(src)
+    assert m, "admit()'s idem critical section moved — update this test"
+    dedented = "".join(line[4:] if line.strip() else line
+                       for line in m.group(2).splitlines(keepends=True))
+    mutated = src[:m.start()] + dedented + src[m.end():]
+    root = _tree(tmp_path, {"g2vec_tpu/serve/daemon.py": mutated})
+    rep = run_analysis(root, checker_ids=["lock-discipline"])
+    hits = [f for f in rep.findings
+            if "_idem" in f.message and f.context == "ServeDaemon.admit"]
+    assert hits, [f.message for f in rep.findings]
+
+
+def test_unknown_checker_id_raises():
+    with pytest.raises(KeyError):
+        run_analysis(REPO, checker_ids=["no-such-checker"])
+
+
+# ---------------------------------------------------------------------------
+# regression tests for the races the checker surfaced (and we fixed)
+# ---------------------------------------------------------------------------
+
+def _daemon(tmp_path):
+    from g2vec_tpu.serve.daemon import ServeDaemon, ServeOptions
+    opts = ServeOptions(
+        socket_path=os.path.join(str(tmp_path), "serve.sock"),
+        state_dir=os.path.join(str(tmp_path), "state"))
+    return ServeDaemon(opts, console=lambda s: None)
+
+
+def _hammer(n_threads, fn):
+    errs = []
+
+    def run():
+        try:
+            fn()
+        except BaseException as e:      # pragma: no cover - diagnostic
+            errs.append(e)
+
+    ts = [threading.Thread(target=run) for _ in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs, errs
+
+
+def test_job_id_serial_has_no_lost_updates(tmp_path):
+    """``_serial += 1`` raced between connection threads before the fix:
+    two admits could read the same value and mint colliding serials.
+    After N increments from T threads the counter must be exactly N*T."""
+    d = _daemon(tmp_path)
+    ids = []
+
+    def mint():
+        for _ in range(200):
+            ids.append(d._new_job_id())
+
+    _hammer(8, mint)
+    assert d._serial == 8 * 200
+    serials = [i.split("-")[0] for i in ids]
+    assert len(set(serials)) == len(serials)
+
+
+def test_state_counts_have_no_lost_updates(tmp_path):
+    """``_state_counts[state] += 1`` runs on the scheduler thread AND
+    connection threads; unlocked, concurrent bumps vanish."""
+    d = _daemon(tmp_path)
+
+    def bump():
+        for _ in range(300):
+            d._job_state("jX", "queued")
+
+    _hammer(6, bump)
+    assert d._state_counts["queued"] == 6 * 300
+    assert d.status()["job_states"]["queued"] == 6 * 300

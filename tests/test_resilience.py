@@ -8,6 +8,7 @@ an uninterrupted run at the same seed, and the metrics JSONL must carry
 the supervisor's retry/resume events. The SIGKILL + child-process
 supervisor path is the slow-marked e2e test (test_supervisor_e2e.py).
 """
+import glob
 import json
 import os
 
@@ -259,6 +260,28 @@ def test_supervised_survives_corrupt_latest_checkpoint(tsv_paths, tmp_path):
     resumed_epochs = [e["step"] for e in events[idx + 1:]
                       if e["event"] == "epoch"]
     assert resumed_epochs and resumed_epochs[0] == 3   # prev ckpt: epoch 2
+
+
+def test_checkpoint_write_fault_crashes_before_write(tmp_path):
+    """The ``checkpoint_write`` seam fires BEFORE the savez: a crash
+    there must leave no partial checkpoint behind (the atomic-write
+    contract starts at the seam), and a prior good generation must
+    survive untouched for the resume to use."""
+    from g2vec_tpu.train import checkpoint as ck
+
+    d = str(tmp_path)
+    params = {"w": np.arange(6, dtype=np.float32).reshape(2, 3)}
+    opt = {"m": np.zeros((2, 3), np.float32)}
+    ck.save_state(d, params, opt, params, 4, 0.5, 0.6)
+    faults.install_plan("stage=checkpoint_write,kind=crash")
+    try:
+        with pytest.raises(faults.InjectedFault):
+            ck.save_state(d, params, opt, params, 9, 0.7, 0.8)
+    finally:
+        faults._reset_for_tests()
+    assert not glob.glob(os.path.join(d, "*.tmp*"))   # no torn write
+    restored = ck.load_state(d, params, opt)
+    assert restored[3] == 4                # the pre-crash generation
 
 
 def test_corrupt_checkpoint_unit_fallback(tmp_path):

@@ -124,3 +124,56 @@ def test_calibrate_real_missing_inputs_fail_fast(tmp_path):
     assert res.returncode == 2
     err = _json_lines(res.stdout)[-1]["error"]
     assert "G2VEC_CALIBRATE_NETWORK" in err
+
+
+# ---------------------------------------------------------------------------
+# g2vec analyze: the exit-code contract (0 clean / 1 findings / 2 usage)
+# ---------------------------------------------------------------------------
+
+def _run_analyze(*args, timeout=120):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run(
+        [sys.executable, "-m", "g2vec_tpu", "analyze", *args],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO)
+
+
+@pytest.mark.analyze
+def test_analyze_clean_repo_exits_zero():
+    res = _run_analyze("--json")
+    assert res.returncode == 0, res.stdout[-2000:] + res.stderr[-2000:]
+    report = json.loads(res.stdout)
+    assert report["clean"] is True
+    assert report["counts"]["active"] == 0
+    assert report["counts"]["stale_baseline"] == 0
+    assert len(report["checkers"]) == 5
+    assert report["elapsed_s"] < 30.0
+
+
+@pytest.mark.analyze
+def test_analyze_findings_exit_one(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import threading\n\n"
+        "class B:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._xs = []       # guarded-by: _lock\n\n"
+        "    def poke(self):\n"
+        "        self._xs.append(1)\n")
+    res = _run_analyze("--json", "--root", str(tmp_path))
+    assert res.returncode == 1, res.stdout[-2000:] + res.stderr[-2000:]
+    report = json.loads(res.stdout)
+    assert report["clean"] is False
+    assert report["counts"]["active"] == 1
+    f = report["findings"][0]
+    assert f["checker"] == "lock-discipline" and f["path"] == "bad.py"
+
+
+@pytest.mark.analyze
+def test_analyze_usage_errors_exit_two():
+    res = _run_analyze("--checker", "no-such-checker")
+    assert res.returncode == 2
+    assert "no-such-checker" in res.stderr
+    res2 = _run_analyze("--not-a-flag")
+    assert res2.returncode == 2

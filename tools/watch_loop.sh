@@ -49,6 +49,17 @@ while [ "$arms" -lt "$MAX_ARMS" ] && [ "$(date +%s)" -lt "$DEADLINE" ]; do
     else
         echo "[watch_loop] fault/fleet matrix green (arm $arms)"
     fi
+    # Static-analysis gate, every arm: the project-invariant lint suite
+    # (lock discipline, jax-purity boundaries, fault-seam and metrics
+    # schema registries, config/doc drift). Pure AST — sub-second, no
+    # jax init — so it runs unconditionally. Exit 1 means a real
+    # invariant regressed (or a baseline entry went stale); non-fatal
+    # like the matrix, but loud.
+    if ! "$PY" -m g2vec_tpu analyze >/tmp/analyze_arm$arms.log 2>&1; then
+        echo "[watch_loop] WARNING: static analysis FAILED on arm $arms (log: /tmp/analyze_arm$arms.log)"
+    else
+        echo "[watch_loop] static analysis green (arm $arms)"
+    fi
     # Chaos soak (every 3rd arm): the randomized fault storm against the
     # serve daemon — SIGKILL / drain / armed seams / cancels under
     # Poisson arrivals — shrunk to stay inside an arm's budget. The
