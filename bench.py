@@ -195,6 +195,17 @@ SHARD_SCALE_CELL_TIMEOUT = int(os.environ.get(
 SHARD_SCALE_RSS_FLAT = 1.3     # diagonal max/min per-rank peak RSS bound
 SHARD_SCALE_ARTIFACT = "BENCH_SHARD_SCALE.json"
 
+# Edge-partitioned CSR A/B (--edge-partition): full-CSR graph-sharded
+# fleet vs owner-range CSRs under BOTH boundary strategies (handoff,
+# halo) at the same scale — per-rank graph bytes, peak RSS, and path
+# throughput. Env-shrinkable for smoke tests.
+EDGE_AB_GENES = int(os.environ.get("G2VEC_BENCH_EDGE_GENES", "1048576"))
+EDGE_AB_RANKS = int(os.environ.get("G2VEC_BENCH_EDGE_RANKS", "4"))
+EDGE_AB_HIDDEN = int(os.environ.get("G2VEC_BENCH_EDGE_HIDDEN", "128"))
+EDGE_AB_STARTS = int(os.environ.get("G2VEC_BENCH_EDGE_STARTS", "2048"))
+EDGE_AB_TIMEOUT = int(os.environ.get("G2VEC_BENCH_EDGE_TIMEOUT", "3600"))
+EDGE_AB_ARTIFACT = "BENCH_EDGE_PARTITION.json"
+
 # Peak bf16 matmul throughput per chip, for the MFU estimate.
 _PEAK_FLOPS = {"v4": 275e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12}
 # HBM bandwidth per chip (bytes/s): the roofline's other axis. This
@@ -1753,6 +1764,208 @@ def _shard_scale() -> None:
         sys.exit(1)
 
 
+def _edge_ab_line(note) -> dict:
+    """Edge-partition A/B at one scale: ``full`` (graph-sharded fleet,
+    every rank holds the whole CSR) vs ``handoff`` vs ``halo``
+    (owner-range CSRs; boundary walks shipped vs boundary rows
+    replicated) — ``EDGE_AB_GENES`` genes across ``EDGE_AB_RANKS`` real
+    worker processes each.
+
+    Measured per arm: per-rank graph bytes (the tentpole — EXACT from
+    each rank's own ``edge_stats`` result line for the partitioned
+    arms, analytic for the full arm), per-rank peak RSS, wall time, and
+    end-to-end path throughput. Plus the contracts on the spot: the
+    partitioned arms' output files must be byte-identical to EACH OTHER
+    (same walks, different boundary strategy), and the edge arms run
+    under ``G2VEC_FORBID_FULL_NETWORK`` so any touch of the
+    unpartitioned reader fails the arm outright. The ``halo`` events
+    carry the replication overhead that PROFILE.md's
+    memory-vs-latency attribution cites.
+
+    No jax in THIS process — every measurement runs in worker children.
+    """
+    import shutil
+    import socket
+    import tempfile
+
+    from g2vec_tpu.data.synth import (SynthGraphSpec,
+                                      write_synth_graph_streamed)
+    from g2vec_tpu.io.readers import FORBID_FULL_NETWORK_ENV
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    worker = os.path.join(repo, "tests", "shard_worker.py")
+    n_genes, n_ranks = EDGE_AB_GENES, EDGE_AB_RANKS
+
+    def rank_env(port: int, process_id: int, extra: dict) -> dict:
+        drop = ("PALLAS_AXON", "AXON_", "TPU_", "JAX_", "XLA_", "LIBTPU",
+                "PJRT_")
+        env = {k: v for k, v in os.environ.items()
+               if not k.startswith(drop)}
+        parts = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                 if p and "axon" not in p.lower()]
+        env["PYTHONPATH"] = os.pathsep.join([repo] + parts)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["G2VEC_COORDINATOR"] = f"127.0.0.1:{port}"
+        env["G2VEC_PROCESS_ID"] = str(process_id)
+        env["G2VEC_NUM_PROCESSES"] = str(n_ranks)
+        env.update(extra)
+        return env
+
+    def launch(td: str, arm: str, cfg: dict, extra: dict) -> list:
+        cfg_path = os.path.join(td, f"{arm}_cfg.json")
+        with open(cfg_path, "w") as f:
+            json.dump(cfg, f)
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        procs = [subprocess.Popen(
+            [sys.executable, worker, cfg_path],
+            env=rank_env(port, i, extra), cwd=repo,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+            for i in range(n_ranks)]
+        parsed = []
+        try:
+            for i, p in enumerate(procs):
+                stdout, stderr = p.communicate(timeout=EDGE_AB_TIMEOUT)
+                if p.returncode != 0:
+                    raise RuntimeError(
+                        f"edge-ab {arm} rank {i}/{n_ranks} rc="
+                        f"{p.returncode}: {stderr[-400:]}")
+                parsed.append(json.loads(stdout.strip().splitlines()[-1]))
+        finally:
+            for q in procs:             # a dead sibling must not wedge
+                if q.poll() is None:
+                    q.kill()
+        return parsed
+
+    def arm_cfg(td: str, arm: str, paths: dict, mode: str) -> dict:
+        out = os.path.join(td, arm, "RES")
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        cfg = dict(
+            expression_file=paths["expression"],
+            clinical_file=paths["clinical"],
+            network_file=paths["network"], result_name=out,
+            lenPath=12, numRepetition=2, sizeHiddenlayer=EDGE_AB_HIDDEN,
+            epoch=2, numBiomarker=10, seed=11, compute_dtype="float32",
+            walker_backend="native", train_mode="streaming",
+            stream_patience=2, shard_paths=256,
+            walk_starts=EDGE_AB_STARTS, stream_eval_rows=512,
+            graph_shards=n_ranks, embed_shards=n_ranks,
+            edge_partition=mode)
+        if n_ranks > 1:
+            cfg.update(distributed=True,
+                       fleet_watchdog_deadline=float(EDGE_AB_TIMEOUT))
+        return cfg
+
+    def read_outputs(result_name: str) -> dict:
+        out = {}
+        for suffix in ("_biomarkers.txt", "_lgroups.txt", "_vectors.txt"):
+            with open(result_name + suffix, "rb") as f:
+                out[suffix] = f.read()
+        return out
+
+    arms = {}
+    with tempfile.TemporaryDirectory() as td:
+        spec = SynthGraphSpec(n_genes=n_genes, n_good=8, n_poor=8, seed=5)
+        t0 = time.time()
+        flat = write_synth_graph_streamed(
+            spec, os.path.join(td, "flat"), prefix="eg")
+        part = write_synth_graph_streamed(
+            spec, os.path.join(td, "part"), prefix="eg",
+            partitions=n_ranks)
+        note(f"edge-ab data: {n_genes} genes, {flat['n_edges']} edges, "
+             f"flat + {n_ranks}-way partitioned emission in "
+             f"{time.time() - t0:.1f}s")
+        for arm, mode, paths, extra in (
+                ("full", "off", flat, {}),
+                ("handoff", "handoff", part, {FORBID_FULL_NETWORK_ENV: "1"}),
+                ("halo", "halo", part, {FORBID_FULL_NETWORK_ENV: "1"})):
+            t0 = time.time()
+            parsed = launch(td, arm, arm_cfg(td, arm, paths, mode), extra)
+            wall = time.time() - t0
+            rss_mb = [p["rss_kb"] // 1024 for p in parsed]
+            rec = {
+                "mode": mode, "wall_s": round(wall, 1),
+                "per_rank_peak_rss_mb": rss_mb,
+                "max_rank_rss_mb": max(rss_mb),
+                "acc_val": round(parsed[0]["acc_val"], 4),
+                "n_paths": parsed[0]["n_paths"],
+                "paths_per_s": round(parsed[0]["n_paths"] / wall, 1)}
+            if mode == "off":
+                # Every rank holds the whole graph: both groups' CSRs,
+                # ~(G+1) int64 indptr + 8 B/edge (int32 index + f32
+                # weight) each. Analytic — the full path has no
+                # owner-range accounting to report.
+                rec["per_rank_graph_bytes"] = [
+                    2 * 8 * (n_genes + 1) + 8 * parsed[0]["n_edges"]
+                    ] * n_ranks
+                rec["graph_bytes_analytic"] = True
+            else:
+                rec["per_rank_graph_bytes"] = [
+                    p["edge_stats"]["csr_bytes"] for p in parsed]
+                rec["per_rank_owned_edges"] = [
+                    p["edge_stats"]["owned_edges"] for p in parsed]
+                if mode == "halo":
+                    rec["per_rank_halo_bytes"] = [
+                        p["edge_stats"]["halo_bytes"] for p in parsed]
+                    rec["halo_overhead_ratio"] = [
+                        round(p["edge_stats"]["halo_bytes"]
+                              / max(1, 8 * p["edge_stats"]["owned_edges"]),
+                              4) for p in parsed]
+                if "rounds" in parsed[0]["edge_stats"]:
+                    rec["handoff"] = {
+                        k: parsed[0]["edge_stats"][k]
+                        for k in ("shards", "rounds", "states_sent",
+                                  "batches", "peak_in_flight")}
+            rec["max_rank_graph_mb"] = round(
+                max(rec["per_rank_graph_bytes"]) / 2 ** 20, 1)
+            arms[arm] = rec
+            note(f"edge-ab {arm}: {wall:.1f}s, per-rank graph "
+                 f"{[round(b / 2 ** 20, 1) for b in rec['per_rank_graph_bytes']]}"
+                 f" MB, peak RSS {rss_mb} MB, acc {rec['acc_val']:.3f}")
+        identical = (read_outputs(os.path.join(td, "handoff", "RES"))
+                     == read_outputs(os.path.join(td, "halo", "RES")))
+        note(f"edge-ab handoff == halo outputs: {identical}")
+        shutil.rmtree(td, ignore_errors=True)
+
+    full_b = max(arms["full"]["per_rank_graph_bytes"])
+    edge_b = max(arms["handoff"]["per_rank_graph_bytes"])
+    return {
+        "metric": "edge_partition_per_rank_graph_mb",
+        "value": arms["handoff"]["max_rank_graph_mb"], "unit": "MB",
+        "vs_baseline": round(full_b / max(edge_b, 1), 2),
+        "n_genes": n_genes, "n_ranks": n_ranks,
+        "hidden": EDGE_AB_HIDDEN, "walk_starts": EDGE_AB_STARTS,
+        "arms": arms,
+        "handoff_equals_halo": identical,
+        "acc_band_vs_full": round(abs(arms["handoff"]["acc_val"]
+                                      - arms["full"]["acc_val"]), 4),
+        "note": "real multi-process fleets; partitioned arms read ONLY "
+                "their owned manifest parts (G2VEC_FORBID_FULL_NETWORK "
+                "armed) and hold owner-range CSRs; vs_baseline = full "
+                "arm's per-rank graph bytes over handoff's; paths/s is "
+                "end-to-end (walk production overlaps training)",
+    }
+
+
+def _edge_ab() -> None:
+    """Standalone mode: measure the edge-partition A/B and (with
+    G2VEC_BENCH_EDGE_WRITE=1) refresh the committed artifact."""
+    def note(msg):
+        print(f"# {msg}", file=sys.stderr, flush=True)
+
+    line = _edge_ab_line(note)
+    print(json.dumps(line), flush=True)
+    if os.environ.get("G2VEC_BENCH_EDGE_WRITE") == "1":
+        repo = os.path.dirname(os.path.abspath(__file__))
+        with open(os.path.join(repo, EDGE_AB_ARTIFACT), "w") as f:
+            json.dump({"line": line, "code_key": _current_code_key(repo),
+                       "written_by": "bench.py --_edge_ab"}, f, indent=1)
+        note(f"wrote {EDGE_AB_ARTIFACT}")
+    if not line["handoff_equals_halo"]:
+        sys.exit(1)
+
+
 def _run_measure_child(budget: int, child_env: dict,
                        first_metric_cutoff: int,
                        cmd: "list | None" = None) -> tuple:
@@ -2693,5 +2906,7 @@ if __name__ == "__main__":
         _chaos_soak()
     elif "--_shard_scale" in sys.argv:
         _shard_scale()
+    elif "--_edge_ab" in sys.argv:
+        _edge_ab()
     else:
         main()

@@ -151,6 +151,17 @@ class G2VecConfig:
                                      # group (evenly spaced subset; 0 =
                                      # every gene, the reference walk
                                      # volume — infeasible at 1M nodes)
+    edge_partition: str = "off"      # partition the CSR itself by owner
+                                     # gene range (parallel/shard.py):
+                                     # each rank loads/holds only its own
+                                     # rows' edges — the last single-host
+                                     # graph cap. Boundary walks:
+                                     # "handoff" ships suspended walk
+                                     # state to the owner rank; "halo"
+                                     # also replicates 1-hop boundary
+                                     # rows so most walks finish locally
+                                     # (byte-identical outputs either
+                                     # way). "off" = full CSR per rank
     stream_eval_rows: int = 0        # streaming val/probe buffer row cap
                                      # (0 = the 4096 default; each row is
                                      # ceil(G/8) bytes, so big-G runs may
@@ -359,6 +370,29 @@ class G2VecConfig:
                     f"--embed-shards ({self.embed_shards}) must equal "
                     f"--num-processes ({self.num_processes}): the gene "
                     f"range is split 1:1 across ranks")
+        if self.edge_partition not in ("off", "handoff", "halo"):
+            raise ValueError(
+                f"edge_partition must be off|handoff|halo, "
+                f"got {self.edge_partition}")
+        if self.edge_partition != "off":
+            if self.train_mode != "streaming":
+                raise ValueError(
+                    "--edge-partition partitions the STREAMING trainer's "
+                    "walk graph; add --train-mode streaming")
+            if self.walker_backend == "device":
+                raise ValueError(
+                    "--edge-partition needs the native sampler's resumable "
+                    "partial walks; --walker-backend device cannot")
+            if self.num_processes and self.num_processes > 1 \
+                    and not self.graph_shards:
+                raise ValueError(
+                    "multi-rank --edge-partition rides the graph-sharded "
+                    "producer's shard exchange; add --graph-shards")
+            if self.checkpoint_dir or self.resume:
+                raise ValueError(
+                    "--edge-partition does not compose with "
+                    "--checkpoint-dir/--resume yet — suspended cross-rank "
+                    "walk state is not checkpointable")
         if self.sampler_threads < 0:
             raise ValueError(
                 f"sampler_threads must be >= 0 (0 = all cores), "
@@ -456,9 +490,10 @@ SERVE_JOB_KEYS = (
     # its shard/ring geometry; the daemon still owns the device. Jobs with
     # different train_mode never _join_key-match, so a streaming job
     # cannot be folded into a full-batch bucket (serve/daemon.py).
-    # graph_shards/embed_shards/walk_starts/stream_eval_rows are
-    # deliberately ABSENT: the sharded mode spans processes — fleet
-    # topology is daemon infrastructure, not a per-job knob.
+    # graph_shards/embed_shards/walk_starts/edge_partition/
+    # stream_eval_rows are deliberately ABSENT: the sharded mode spans
+    # processes — fleet topology is daemon infrastructure, not a per-job
+    # knob.
     "train_mode", "shard_paths", "prefetch_depth", "stream_patience",
     # Streaming checkpoint cadence (shards between cursor writes). The
     # daemon owns WHERE checkpoints go (its state dir); a job may only
@@ -672,6 +707,18 @@ def build_parser() -> argparse.ArgumentParser:
                              "previous behavior exactly). Million-node "
                              "graphs need this: full walk volume scales "
                              "with G x reps x len.")
+    parser.add_argument("--edge-partition", type=str, default="off",
+                        choices=("off", "handoff", "halo"),
+                        help="Partition the CSR itself by owner gene range: "
+                             "each rank streams only its own rows' edges "
+                             "from disk (never the full edge list). "
+                             "Boundary-crossing walks: 'handoff' ships the "
+                             "suspended walk state to the owner rank; "
+                             "'halo' also replicates 1-hop boundary rows "
+                             "so most walks finish locally. Outputs are "
+                             "byte-identical either way. Requires "
+                             "--train-mode streaming; multi-rank runs also "
+                             "need --graph-shards (default off).")
     parser.add_argument("--stream-eval-rows", type=int, default=0,
                         metavar="M",
                         help="Rows kept for the streaming val split "
@@ -846,6 +893,7 @@ def config_from_args(argv=None) -> G2VecConfig:
         graph_shards=args.graph_shards,
         embed_shards=args.embed_shards,
         walk_starts=args.walk_starts,
+        edge_partition=args.edge_partition,
         stream_eval_rows=args.stream_eval_rows,
         epoch_superstep=args.epoch_superstep,
         donate_state=not args.no_donate,

@@ -212,7 +212,8 @@ def _streamed_expr_block(spec: SynthGraphSpec, labels: np.ndarray,
 
 def write_synth_graph_streamed(spec: SynthGraphSpec, out_dir: str,
                                prefix: str = "big",
-                               edge_chunk: int = 1 << 20) -> Dict[str, str]:
+                               edge_chunk: int = 1 << 20,
+                               partitions: int = 0) -> Dict[str, str]:
     """:func:`write_synth_graph` at million-node scale: every stage
     streams to disk in bounded chunks — the edge list never
     materializes (``iter_scale_free_edges``) and expression is
@@ -222,6 +223,17 @@ def write_synth_graph_streamed(spec: SynthGraphSpec, out_dir: str,
     Deterministic in ``spec`` and in ``edge_chunk``-independent bytes;
     NOT byte-identical to :func:`write_synth_graph` (different rng
     stream layout) — same distribution, same formats, same loaders.
+
+    ``partitions > 0`` writes the network PRE-PARTITIONED for
+    ``--edge-partition`` fleets: ``R`` part files (edges routed by the
+    owner of their src node under parallel/shard.edge_range splits over
+    node ids), a genes sidecar (the endpoint set, so ranks scan names
+    without touching edges), and a sha256 manifest
+    (utils/integrity) that io/readers verifies before a range read.
+    Because the generator emits edges in non-decreasing src order, every
+    src's edges land whole in one part in original relative order —
+    concatenating the parts in manifest order reproduces the
+    unpartitioned file's body exactly (the smoke-test contract).
     """
     G, S = spec.n_genes, spec.n_samples
     if G < spec.attach + 2:
@@ -254,14 +266,68 @@ def write_synth_graph_streamed(spec: SynthGraphSpec, out_dir: str,
                 "SG%07d%s\n" % (lo + j, row_fmt % tuple(expr[:, j]))
                 for j in range(hi - lo)))
 
-    n_edges = 0
     edge_rng = np.random.default_rng([spec.seed, 0])
-    with open(paths["network"], "w") as f:
-        f.write("src\tdest\n")
-        for src, dst in iter_scale_free_edges(G, spec.attach, edge_rng,
-                                              chunk_edges=edge_chunk):
-            f.write("".join("SG%07d\tSG%07d\n" % (a, b)
-                            for a, b in zip(src, dst)))
+    edge_iter = iter_scale_free_edges(G, spec.attach, edge_rng,
+                                      chunk_edges=edge_chunk)
+    if partitions <= 0:
+        n_edges = 0
+        with open(paths["network"], "w") as f:
+            f.write("src\tdest\n")
+            for src, dst in edge_iter:
+                f.write("".join("SG%07d\tSG%07d\n" % (a, b)
+                                for a, b in zip(src, dst)))
+                n_edges += len(src)
+        paths["n_edges"] = str(n_edges)
+        return paths
+
+    from g2vec_tpu.utils.integrity import sha256_file, write_json_atomic
+
+    bounds = np.array([p * G // partitions for p in range(partitions)],
+                      dtype=np.int64)
+    part_names = [f"{prefix}_NETWORK.part{p:03d}.txt"
+                  for p in range(partitions)]
+    part_edges = [0] * partitions
+    seen = np.zeros(G, dtype=bool)
+    files = [open(os.path.join(out_dir, name), "w") for name in part_names]
+    try:
+        for f in files:
+            f.write("src\tdest\n")
+        n_edges = 0
+        for src, dst in edge_iter:
+            seen[src] = True
+            seen[dst] = True
+            owner = np.searchsorted(bounds, src, side="right") - 1
+            for p in np.unique(owner):
+                sel = owner == p
+                files[p].write("".join(
+                    "SG%07d\tSG%07d\n" % (a, b)
+                    for a, b in zip(src[sel], dst[sel])))
+                part_edges[p] += int(sel.sum())
             n_edges += len(src)
+    finally:
+        for f in files:
+            f.close()
+    genes_name = f"{prefix}_NETWORK.genes.txt"
+    with open(os.path.join(out_dir, genes_name), "w") as f:
+        f.write("".join("SG%07d\n" % g for g in np.nonzero(seen)[0]))
+    hi_bounds = [int(bounds[p + 1]) if p + 1 < partitions else G
+                 for p in range(partitions)]
+    manifest_path = os.path.join(out_dir, f"{prefix}_NETWORK.manifest.json")
+    write_json_atomic(manifest_path, {
+        "format": "g2vec-network-partitions-v1",
+        "partitions": partitions,
+        "n_genes": G,
+        "genes_file": genes_name,
+        "files": [
+            {"name": part_names[p],
+             "sha256": sha256_file(os.path.join(out_dir, part_names[p])),
+             "n_edges": part_edges[p],
+             # Inclusive src NAME range of the part's node split — the
+             # reader prunes part files by name-range intersection.
+             "gene_lo": "SG%07d" % int(bounds[p]),
+             "gene_hi": "SG%07d" % (hi_bounds[p] - 1)}
+            for p in range(partitions)],
+    })
+    paths["network"] = manifest_path
     paths["n_edges"] = str(n_edges)
     return paths

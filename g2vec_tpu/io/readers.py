@@ -20,9 +20,16 @@ pure-Python path transparently.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Tuple
+import json
+import os
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
+
+#: When set (the edge-partition fleet tests set it in every rank's env),
+#: :func:`load_network` refuses to run — the acceptance pin that no code
+#: path materializes the full edge list under ``--edge-partition``.
+FORBID_FULL_NETWORK_ENV = "G2VEC_FORBID_FULL_NETWORK"
 
 
 @dataclasses.dataclass
@@ -127,6 +134,11 @@ def load_clinical(path: str) -> Dict[str, int]:
 
 def load_network(path: str) -> NetworkData:
     """Read a directed gene-interaction edge list (ref: G2Vec.py:455-476 contract)."""
+    if os.environ.get(FORBID_FULL_NETWORK_ENV):
+        raise RuntimeError(
+            f"load_network({path!r}) reached with {FORBID_FULL_NETWORK_ENV} "
+            "set — an --edge-partition run tried to materialize the full "
+            "edge list; use scan_network_genes + load_network_range")
     rows = _read_tsv_lines(path)
     if len(rows) < 1:
         raise ValueError(f"{path}: network file needs a header row")
@@ -139,3 +151,142 @@ def load_network(path: str) -> NetworkData:
         genes.add(row[0])
         genes.add(row[1])
     return NetworkData(edges=edges, genes=genes)
+
+
+# ---------------------------------------------------------------------------
+# Edge-partitioned loading (--edge-partition): the full edge list never
+# materializes on any rank. Gene NAMES are still scanned globally (the
+# sorted-common-intersection invariant needs the endpoint set — O(G)
+# strings, not O(E) edges); edges are then streamed a second time with a
+# src-index range filter, so a rank holds only its owned rows' edges.
+# Both the plain one-file network TSV and the pre-partitioned shard
+# layout written by ``tools/make_synth_graph.py --partitions R`` (part
+# files + sha256 manifest) feed the same two entry points.
+# ---------------------------------------------------------------------------
+
+
+def _iter_network_rows(path: str):
+    """Stream (lineno, src, dst) from a network TSV without holding the
+    file; same tolerance (rstrip, blank lines) as :func:`load_network`."""
+    with open(path) as fin:
+        header = fin.readline()
+        if not header:
+            raise ValueError(f"{path}: network file needs a header row")
+        for ln, line in enumerate(fin, start=2):
+            row = line.rstrip().split("\t")
+            if row == [""]:
+                continue
+            if len(row) < 2:
+                raise ValueError(
+                    f"{path}:{ln}: expected 'src\\tdest', got {row!r}")
+            yield ln, row[0], row[1]
+
+
+def scan_network_genes(path: str) -> set:
+    """Streamed endpoint gene set of a network TSV (or every part file
+    of a partition manifest) — the edge-partition substitute for
+    ``load_network(...).genes``; edges are discarded as read."""
+    if path.endswith(".json"):
+        manifest = read_partition_manifest(path)
+        base = os.path.dirname(os.path.abspath(path))
+        genes_path = os.path.join(base, manifest["genes_file"])
+        with open(genes_path) as f:
+            return {line.rstrip("\n") for line in f if line.rstrip("\n")}
+    genes: set = set()
+    for _, a, b in _iter_network_rows(path):
+        genes.add(a)
+        genes.add(b)
+    return genes
+
+
+def load_network_range(path: str, gene2idx: Dict[str, int], lo: int,
+                       hi: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Streamed ``restrict_network`` + ``edges_to_indices`` + src-range
+    filter in one pass: (src_idx, dst_idx) int32 arrays of the directed
+    edges whose endpoints are both common (in ``gene2idx``) and whose
+    src index falls in [lo, hi), file order preserved.
+
+    Order contract: dropping out-of-range-src edges commutes with both
+    the |PCC| threshold's first-occurrence dedup (the dedup key contains
+    src) and edges_to_csr's stable src sort (within-row order is file
+    order among SAME-src edges, all of which share this range) — so the
+    partitioned CSR's owned rows are byte-identical to the unpartitioned
+    CSR's same rows.
+    """
+    if path.endswith(".json"):
+        return _load_partitioned_range(path, gene2idx, lo, hi)
+    src: List[int] = []
+    dst: List[int] = []
+    for _, a, b in _iter_network_rows(path):
+        si = gene2idx.get(a)
+        if si is None or not (lo <= si < hi):
+            continue
+        di = gene2idx.get(b)
+        if di is None:
+            continue
+        src.append(si)
+        dst.append(di)
+    return (np.array(src, dtype=np.int32), np.array(dst, dtype=np.int32))
+
+
+def read_partition_manifest(path: str) -> dict:
+    """Load + schema-check a ``--partitions`` manifest (written by
+    tools/make_synth_graph.py via data/synth.py)."""
+    with open(path) as f:
+        manifest = json.load(f)
+    for key in ("format", "partitions", "genes_file", "files"):
+        if key not in manifest:
+            raise ValueError(f"{path}: partition manifest missing {key!r}")
+    if manifest["format"] != "g2vec-network-partitions-v1":
+        raise ValueError(
+            f"{path}: unknown partition manifest format "
+            f"{manifest['format']!r}")
+    if len(manifest["files"]) != manifest["partitions"]:
+        raise ValueError(
+            f"{path}: manifest lists {len(manifest['files'])} files for "
+            f"{manifest['partitions']} partitions")
+    for entry in manifest["files"]:
+        for key in ("name", "sha256", "n_edges", "gene_lo", "gene_hi"):
+            if key not in entry:
+                raise ValueError(
+                    f"{path}: manifest file entry missing {key!r}")
+    return manifest
+
+
+def _load_partitioned_range(manifest_path: str, gene2idx: Dict[str, int],
+                            lo: int, hi: int
+                            ) -> Tuple[np.ndarray, np.ndarray]:
+    """Range read over pre-partitioned shard files: only part files
+    whose NAME range can intersect the requested index range are opened
+    (gene indices are positions in the SORTED common list, so an index
+    range is a contiguous name range), and each opened file's sha256 is
+    verified against the manifest first.
+    """
+    from g2vec_tpu.utils.integrity import sha256_file
+
+    manifest = read_partition_manifest(manifest_path)
+    base = os.path.dirname(os.path.abspath(manifest_path))
+    if hi <= lo:
+        return (np.zeros(0, dtype=np.int32), np.zeros(0, dtype=np.int32))
+    # Names of the requested index range, in sorted-common order.
+    by_idx = sorted(gene2idx, key=gene2idx.get)
+    name_lo, name_hi = by_idx[lo], by_idx[hi - 1]
+    src_parts: List[np.ndarray] = []
+    dst_parts: List[np.ndarray] = []
+    for entry in manifest["files"]:
+        # Part holds src names in [gene_lo, gene_hi]; skip when the
+        # whole part sorts outside the requested name range.
+        if entry["gene_hi"] < name_lo or entry["gene_lo"] > name_hi:
+            continue
+        part = os.path.join(base, entry["name"])
+        digest = sha256_file(part)
+        if digest != entry["sha256"]:
+            raise ValueError(
+                f"{part}: sha256 mismatch vs manifest ({digest} != "
+                f"{entry['sha256']}) — partition file corrupt or stale")
+        s, d = load_network_range(part, gene2idx, lo, hi)
+        src_parts.append(s)
+        dst_parts.append(d)
+    if not src_parts:
+        return (np.zeros(0, dtype=np.int32), np.zeros(0, dtype=np.int32))
+    return np.concatenate(src_parts), np.concatenate(dst_parts)

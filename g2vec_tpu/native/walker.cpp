@@ -151,6 +151,105 @@ void walk_threaded(const int32_t* indptr, const int32_t* indices,
     for (auto& th : pool) th.join();
 }
 
+// Resumable walks over an availability-masked CSR (edge-partitioned
+// mode). Each walker carries explicit state — current gene, raw
+// splitmix64 state, and the path prefix taken so far — so a walk can
+// suspend at a partition boundary (the row for `cur` is not
+// materialized on this rank: avail[cur] == 0) and resume bit-identically
+// on the rank that owns it. The step body below is a literal copy of
+// walk_range's: same eligible scan, same cumulative order, same single
+// uniform01 draw per step, so a walk's draw sequence is independent of
+// where (or in how many pieces) it executes.
+void walk_partial_range(const int32_t* indptr, const int32_t* indices,
+                        const float* weights, int32_t n_genes,
+                        const uint8_t* avail, int32_t* cur, uint64_t* rng,
+                        int32_t* pos, int32_t* paths, int32_t len_path,
+                        uint8_t* status, int64_t max_degree, int64_t lo,
+                        int64_t hi) {
+    std::vector<uint8_t> visited(static_cast<size_t>(n_genes), 0);
+    std::vector<double> cumbuf(static_cast<size_t>(max_degree));
+    std::vector<int32_t> idxbuf(static_cast<size_t>(max_degree));
+    for (int64_t w = lo; w < hi; ++w) {
+        int32_t* path = paths + w * len_path;
+        int32_t plen = pos[w];
+        for (int32_t i = 0; i < plen; ++i) visited[path[i]] = 1;
+        int32_t c = cur[w];
+        uint64_t st = rng[w];
+        uint8_t suspended = 0;
+        while (plen < len_path) {
+            if (!avail[c]) {  // partition boundary: owner of c resumes
+                suspended = 1;
+                break;
+            }
+            const int32_t b = indptr[c], e = indptr[c + 1];
+            int32_t m = 0;
+            double total = 0.0;
+            for (int32_t k = b; k < e; ++k) {
+                const int32_t t = indices[k];
+                if (!visited[t] && weights[k] > 0.0f) {
+                    total += weights[k];
+                    cumbuf[m] = total;
+                    idxbuf[m] = t;
+                    ++m;
+                }
+            }
+            if (m == 0 || total <= 0.0) break;  // dead end
+            const double target = uniform01(st) * total;
+            int32_t lo_j = 0, hi_j = m;
+            while (lo_j < hi_j) {
+                const int32_t mid = lo_j + ((hi_j - lo_j) >> 1);
+                if (target < cumbuf[mid]) hi_j = mid;
+                else lo_j = mid + 1;
+            }
+            const int32_t nxt = idxbuf[lo_j < m ? lo_j : m - 1];
+            path[plen++] = nxt;
+            visited[nxt] = 1;
+            c = nxt;
+        }
+        cur[w] = c;
+        rng[w] = st;
+        pos[w] = plen;
+        status[w] = suspended;
+        for (int32_t i = 0; i < plen; ++i) visited[path[i]] = 0;
+    }
+}
+
+void walk_partial_threaded(const int32_t* indptr, const int32_t* indices,
+                           const float* weights, int32_t n_genes,
+                           const uint8_t* avail, int32_t* cur, uint64_t* rng,
+                           int32_t* pos, int32_t* paths, int64_t n_walkers,
+                           int32_t len_path, int32_t n_threads,
+                           uint8_t* status) {
+    if (len_path <= 0 || n_walkers <= 0) return;
+    int64_t max_degree = 1;
+    for (int32_t g = 0; g < n_genes; ++g)
+        max_degree = std::max<int64_t>(max_degree, indptr[g + 1] - indptr[g]);
+    if (n_threads <= 0) {
+        unsigned hw = std::thread::hardware_concurrency();
+        n_threads = hw ? static_cast<int32_t>(hw) : 1;
+    }
+    n_threads = static_cast<int32_t>(
+        std::min<int64_t>(n_threads, n_walkers));
+    if (n_threads == 1) {
+        walk_partial_range(indptr, indices, weights, n_genes, avail, cur,
+                           rng, pos, paths, len_path, status, max_degree, 0,
+                           n_walkers);
+        return;
+    }
+    std::vector<std::thread> pool;
+    pool.reserve(n_threads);
+    const int64_t chunk = (n_walkers + n_threads - 1) / n_threads;
+    for (int32_t t = 0; t < n_threads; ++t) {
+        const int64_t lo = t * chunk;
+        const int64_t hi = std::min<int64_t>(lo + chunk, n_walkers);
+        if (lo >= hi) break;
+        pool.emplace_back(walk_partial_range, indptr, indices, weights,
+                          n_genes, avail, cur, rng, pos, paths, len_path,
+                          status, max_degree, lo, hi);
+    }
+    for (auto& th : pool) th.join();
+}
+
 }  // namespace
 
 extern "C" {
@@ -177,6 +276,54 @@ void g2v_walk_packed(const int32_t* indptr, const int32_t* indices,
     walk_threaded<true>(indptr, indices, weights, n_genes, starts,
                         stream_ids, n_walkers, len_path, seed, n_threads,
                         nullptr, out, nbytes);
+}
+
+// The per-walker PRNG init walk_range performs inline: raw state =
+// seed ^ (stream_id * GOLDEN), then one discarded splitmix64 output to
+// decorrelate nearby stream ids. Exposed so the edge-partitioned path
+// can seed explicit walk states that continue the EXACT stream
+// g2v_walk_packed would have drawn from.
+void g2v_init_walk_state(uint64_t seed, const uint64_t* stream_ids,
+                         int64_t n, uint64_t* out) {
+    for (int64_t i = 0; i < n; ++i) {
+        uint64_t st = seed ^ (stream_ids[i] * 0x9e3779b97f4a7c15ULL);
+        splitmix64(st);
+        out[i] = st;
+    }
+}
+
+// Resume/run walks with explicit per-walker state over an
+// availability-masked CSR. cur/rng/pos/paths are IN-OUT; status[w] is
+// 1 when walker w suspended on an unavailable row (cur[w] names the
+// gene whose owner must resume it), 0 when it finished (full length or
+// dead end). Rows with avail[g] == 0 may have empty indptr spans — they
+// are never scanned.
+void g2v_walk_partial(const int32_t* indptr, const int32_t* indices,
+                      const float* weights, int32_t n_genes,
+                      const uint8_t* avail, int32_t* cur, uint64_t* rng,
+                      int32_t* pos, int32_t* paths, int64_t n_walkers,
+                      int32_t len_path, int32_t n_threads, uint8_t* status) {
+    walk_partial_threaded(indptr, indices, weights, n_genes, avail, cur,
+                          rng, pos, paths, n_walkers, len_path, n_threads,
+                          status);
+}
+
+// Pack finished [len_path] int32 paths (-1 padded) into
+// np.packbits-layout multi-hot rows, the same encoding g2v_walk_packed
+// emits — used by the shard owner to assemble remotely-completed walks
+// without ever expanding a [W, G] bool matrix.
+void g2v_pack_paths(const int32_t* paths, int64_t n_rows, int32_t len_path,
+                    uint8_t* out, int64_t nbytes) {
+    for (int64_t r = 0; r < n_rows; ++r) {
+        const int32_t* path = paths + r * len_path;
+        uint8_t* row = out + r * nbytes;
+        std::fill(row, row + nbytes, 0);
+        for (int32_t i = 0; i < len_path; ++i) {
+            const int32_t n = path[i];
+            if (n < 0) break;
+            row[n >> 3] |= static_cast<uint8_t>(0x80u >> (n & 7));
+        }
+    }
 }
 
 }  // extern "C"

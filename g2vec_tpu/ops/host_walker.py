@@ -324,6 +324,112 @@ def walk_shard(src: np.ndarray, dst: np.ndarray, w: np.ndarray,
     return out
 
 
+@dataclass
+class WalkStateBatch:
+    """Explicit, relocatable walk state for a batch of walkers — the
+    resumable form of the implicit per-walker state inside walk_range
+    (native/walker.cpp).
+
+    Keyed by GLOBAL walker index through ``row`` (the walker's row within
+    its shard-group block, rep-major — exactly walk_shard's layout), so a
+    walk produces identical bytes no matter which rank (or how many
+    ranks, in how many pieces) executes it: ``rng`` is the walker's raw
+    splitmix64 state — one fixed-constant advance per uniform draw — and
+    the visited mask is reconstructed by replaying ``paths``. The
+    edge-partitioned walk engine (parallel/shard.py) suspends batches at
+    partition boundaries, ships them to the rank owning ``cur``'s
+    adjacency row, and resumes them there bit-identically.
+    """
+
+    row: np.ndarray      # int32 [M] row index within the shard-group
+    cur: np.ndarray      # int32 [M] current gene (path tail)
+    rng: np.ndarray      # uint64 [M] raw splitmix64 state
+    pos: np.ndarray      # int32 [M] nodes taken so far (>= 1)
+    paths: np.ndarray    # int32 [M, len_path] path prefix, -1 padded
+
+    def __len__(self) -> int:
+        return self.row.shape[0]
+
+    def take(self, idx: np.ndarray) -> "WalkStateBatch":
+        return WalkStateBatch(
+            row=np.ascontiguousarray(self.row[idx]),
+            cur=np.ascontiguousarray(self.cur[idx]),
+            rng=np.ascontiguousarray(self.rng[idx]),
+            pos=np.ascontiguousarray(self.pos[idx]),
+            paths=np.ascontiguousarray(self.paths[idx]))
+
+    @staticmethod
+    def concat(batches: "list[WalkStateBatch]") -> "WalkStateBatch":
+        return WalkStateBatch(
+            row=np.concatenate([b.row for b in batches]),
+            cur=np.concatenate([b.cur for b in batches]),
+            rng=np.concatenate([b.rng for b in batches]),
+            pos=np.concatenate([b.pos for b in batches]),
+            paths=np.concatenate([b.paths for b in batches], axis=0))
+
+    @staticmethod
+    def empty(len_path: int) -> "WalkStateBatch":
+        return WalkStateBatch(
+            row=np.zeros(0, np.int32), cur=np.zeros(0, np.int32),
+            rng=np.zeros(0, np.uint64), pos=np.zeros(0, np.int32),
+            paths=np.zeros((0, len_path), np.int32))
+
+
+def shard_walk_states(plan: ShardPlan, shard: int, *, seed: int,
+                      starts: Optional[np.ndarray] = None) -> WalkStateBatch:
+    """Initial :class:`WalkStateBatch` for every walker of ``shard`` —
+    row order (rep-major) and PRNG streams exactly match walk_shard's,
+    so advancing these states to completion and packing the paths
+    reproduces walk_shard's rows byte-for-byte."""
+    from g2vec_tpu.native.walker_bindings import init_walk_state
+
+    if starts is not None and len(starts) != plan.n_starts:
+        raise ValueError(
+            f"plan.n_starts ({plan.n_starts}) must match len(starts) "
+            f"({len(starts)})")
+    lo, hi = plan.start_range(shard)
+    k = hi - lo
+    sub = (np.arange(lo, hi, dtype=np.int32) if starts is None
+           else np.ascontiguousarray(starts[lo:hi], dtype=np.int32))
+    start_col = np.tile(sub, plan.reps)
+    wids = (np.arange(plan.reps, dtype=np.uint64)[:, None]
+            * np.uint64(plan.n_starts)
+            + np.arange(lo, hi, dtype=np.uint64)[None, :]).ravel()
+    n = k * plan.reps
+    paths = np.full((n, plan.len_path), -1, np.int32)
+    paths[:, 0] = start_col
+    return WalkStateBatch(
+        row=np.arange(n, dtype=np.int32),
+        cur=np.ascontiguousarray(start_col),
+        rng=init_walk_state(seed, wids),
+        pos=np.ones(n, np.int32),
+        paths=paths)
+
+
+def advance_walk_states(states: WalkStateBatch, csr: tuple, n_genes: int,
+                        avail: np.ndarray, len_path: int,
+                        n_threads: int = 0) -> np.ndarray:
+    """Advance every walk in ``states`` IN PLACE over an
+    availability-masked CSR until it finishes (full length or dead end)
+    or suspends on a row this rank does not hold. Returns the [M] uint8
+    status array (0 finished, 1 suspended)."""
+    from g2vec_tpu.native.walker_bindings import walk_partial
+
+    indptr, indices, weights = csr
+    return walk_partial(indptr, indices, weights, n_genes, avail,
+                        states.cur, states.rng, states.pos, states.paths,
+                        len_path, n_threads=n_threads)
+
+
+def pack_finished_paths(paths: np.ndarray, n_genes: int,
+                        out: Optional[np.ndarray] = None) -> np.ndarray:
+    """Pack [M, len_path] finished paths into walk_shard's packed-row
+    encoding (native/walker_bindings.pack_paths)."""
+    from g2vec_tpu.native.walker_bindings import pack_paths
+
+    return pack_paths(paths, n_genes, out=out)
+
+
 def generate_path_set_native(src: np.ndarray, dst: np.ndarray, w: np.ndarray,
                              n_genes: int, *, len_path: int, reps: int,
                              seed: int, starts: Optional[np.ndarray] = None,

@@ -240,6 +240,397 @@ def make_shard_context(graph_shards: int, embed_shards: int, n_genes: int,
     return ShardContext(spec, deadline=deadline)
 
 
+# ---------------------------------------------------------------------------
+# Edge-partitioned CSR (--edge-partition): owner-range graph storage.
+#
+# PR 10's graph sharding divided the walk WORK; every rank still held the
+# full CSR — the last single-host cap on graph size. Here each rank
+# materializes only the adjacency rows of its own gene range (plus, in
+# halo mode, the 1-hop boundary neighbors' rows), and walks that step
+# onto a row this rank does not hold are SUSPENDED as explicit
+# WalkStateBatch state (ops/host_walker.py) and shipped to the owning
+# rank over the explicit-key KV transport. Both boundary strategies ride
+# the same engine: `handoff` ships every boundary crossing; `halo` keeps
+# replicated boundary rows so most walks finish locally and only
+# halo-escapes (2+ hops outside the range) fall back to handoff. Because
+# every walker's PRNG stream is keyed by global walker index and its raw
+# state travels with it, handoff and halo produce byte-identical rows —
+# and a single rank (full range) is byte-identical to unsharded.
+# ---------------------------------------------------------------------------
+
+
+def edge_range(rank: int, n_ranks: int, n_genes: int) -> Tuple[int, int]:
+    """Rank's owned gene range [lo, hi) on the edge-partition axis —
+    plain ``r*G/R`` splits (no byte alignment: this axis partitions CSR
+    *rows*; packed columns are the embed axis's concern)."""
+    if not (0 <= rank < max(1, n_ranks)):
+        raise ValueError(f"rank {rank} outside n_ranks {n_ranks}")
+    return (rank * n_genes // max(1, n_ranks),
+            (rank + 1) * n_genes // max(1, n_ranks))
+
+
+def edge_bounds(n_ranks: int, n_genes: int) -> np.ndarray:
+    """[R] int64 lower bounds of every rank's owned range (for
+    vectorized owner lookup via searchsorted)."""
+    return np.array([r * n_genes // n_ranks for r in range(n_ranks)],
+                    dtype=np.int64)
+
+
+def owners_of(genes: np.ndarray, bounds: np.ndarray) -> np.ndarray:
+    """Owning rank of each gene id under :func:`edge_bounds`."""
+    return (np.searchsorted(bounds, np.asarray(genes, dtype=np.int64),
+                            side="right") - 1).astype(np.int32)
+
+
+@dataclasses.dataclass
+class PartitionedCSR:
+    """One rank's partial view of a group's walk graph.
+
+    ``indptr`` spans the FULL gene axis (G+1 entries) but only rows with
+    ``avail[g] == 1`` hold data — owned rows always, plus halo rows in
+    halo mode. The native partial walker (g2v_walk_partial) suspends any
+    walk whose current gene has ``avail == 0`` instead of scanning it,
+    so an empty non-owned row can never masquerade as a dead end.
+    """
+
+    n_genes: int
+    lo: int
+    hi: int
+    indptr: np.ndarray
+    indices: np.ndarray
+    weights: np.ndarray
+    avail: np.ndarray               # uint8 [G]
+    halo_genes: np.ndarray          # int32, sorted, empty unless halo
+    owned_edges: int
+    halo_edges: int = 0
+
+    @property
+    def csr(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        return (self.indptr, self.indices, self.weights)
+
+    @property
+    def csr_bytes(self) -> int:
+        """Bytes this rank actually holds for the graph (indptr +
+        indices + weights + avail mask)."""
+        return (self.indptr.nbytes + self.indices.nbytes
+                + self.weights.nbytes + self.avail.nbytes)
+
+    @property
+    def halo_bytes(self) -> int:
+        """Bytes attributable to replicated halo rows (8 bytes/edge:
+        index + weight)."""
+        return 8 * self.halo_edges
+
+    @property
+    def halo_overhead_ratio(self) -> float:
+        """Halo bytes over owned-row bytes — the measured memory price
+        of completing boundary walks locally."""
+        owned = 8 * self.owned_edges
+        return (self.halo_bytes / owned) if owned else 0.0
+
+
+def build_partitioned_csr(src: np.ndarray, dst: np.ndarray, w: np.ndarray,
+                          n_genes: int, lo: int, hi: int) -> PartitionedCSR:
+    """Owned-rows-only CSR from a RANGE-FILTERED edge list (every
+    ``src`` must already be inside [lo, hi) — the reader/generator did
+    the filtering; this guards the contract instead of re-filtering,
+    so no code path here ever touches the full edge list)."""
+    src = np.asarray(src)
+    dst = np.asarray(dst)
+    w = np.asarray(w)
+    if src.size and (src.min() < lo or src.max() >= hi):
+        raise ValueError(
+            f"edge sources outside the owned range [{lo}, {hi}) — the "
+            f"range-filtered reader must only hand this rank its own rows")
+    if dst.size and (dst.min() < 0 or dst.max() >= n_genes):
+        raise ValueError(f"dst contains node ids outside [0, {n_genes})")
+    from g2vec_tpu.ops.host_walker import edges_to_csr
+
+    indptr, indices, weights = edges_to_csr(src, dst, w, n_genes)
+    avail = np.zeros(n_genes, dtype=np.uint8)
+    avail[lo:hi] = 1
+    return PartitionedCSR(
+        n_genes=n_genes, lo=lo, hi=hi, indptr=indptr, indices=indices,
+        weights=weights, avail=avail,
+        halo_genes=np.zeros(0, dtype=np.int32), owned_edges=int(src.size))
+
+
+def _savez_bytes(**arrays) -> bytes:
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def _loadz_bytes(raw: bytes) -> dict:
+    with np.load(io.BytesIO(raw), allow_pickle=False) as z:
+        return {k: z[k] for k in z.files}
+
+
+def build_halo_csr(pcsr: PartitionedCSR, *, rank: int, n_ranks: int,
+                   group: int, exchange=None,
+                   deadline: Optional[float] = None) -> PartitionedCSR:
+    """Collective halo build: replicate the 1-hop boundary neighbors'
+    rows onto this rank so most walks complete locally.
+
+    Two uniform all-pairs rounds over the explicit-key transport (safe
+    on any thread; here it runs on the main thread during trainer
+    setup): every rank first publishes, per peer, the sorted list of
+    boundary genes it wants from that peer's range, then serves the
+    requested row slices. A rank killed between the rounds (the
+    ``halo_build`` fault seam) leaves its requesters' receive waiting —
+    the transport deadline names it (tests/test_edge.py drill).
+    """
+    from g2vec_tpu.parallel import hostcomm
+    from g2vec_tpu.resilience.faults import fault_point
+
+    if n_ranks == 1:
+        return pcsr
+    if exchange is None:
+        exchange = hostcomm.exchange_bytes
+    bounds = edge_bounds(n_ranks, pcsr.n_genes)
+    outside = pcsr.indices[(pcsr.indices < pcsr.lo)
+                           | (pcsr.indices >= pcsr.hi)]
+    wants = np.unique(outside).astype(np.int64)
+    want_owner = owners_of(wants, bounds)
+    kw = dict(deadline=deadline) if deadline else {}
+    for b in range(n_ranks):
+        if b == rank:
+            continue
+        payload = _savez_bytes(genes=wants[want_owner == b].astype(np.int32))
+        exchange(f"halo/g{group}/want/{rank}to{b}", payload, rank, **kw)
+    requests = {}
+    for a in range(n_ranks):
+        if a == rank:
+            continue
+        raw = exchange(f"halo/g{group}/want/{a}to{rank}", None, a, **kw)
+        requests[a] = _loadz_bytes(raw)["genes"].astype(np.int64)
+    # The dead-server seam: a sigkill here (after the want lists, before
+    # any row payload) leaves every requester waiting on this rank's
+    # row publish; their deadline expiry names it.
+    fault_point("halo_build", epoch=group)
+    for b, req in requests.items():
+        counts = (pcsr.indptr[req + 1] - pcsr.indptr[req]).astype(np.int32)
+        slices = [pcsr.indices[pcsr.indptr[g]:pcsr.indptr[g + 1]]
+                  for g in req]
+        wslices = [pcsr.weights[pcsr.indptr[g]:pcsr.indptr[g + 1]]
+                   for g in req]
+        payload = _savez_bytes(
+            genes=req.astype(np.int32), counts=counts,
+            indices=(np.concatenate(slices) if slices
+                     else np.zeros(0, np.int32)),
+            weights=(np.concatenate(wslices) if wslices
+                     else np.zeros(0, np.float32)))
+        exchange(f"halo/g{group}/rows/{rank}to{b}", payload, rank, **kw)
+    halo_src, halo_dst, halo_w, halo_genes = [], [], [], []
+    for a in range(n_ranks):
+        if a == rank:
+            continue
+        raw = exchange(f"halo/g{group}/rows/{a}to{rank}", None, a, **kw)
+        z = _loadz_bytes(raw)
+        halo_genes.append(z["genes"].astype(np.int32))
+        halo_src.append(np.repeat(z["genes"].astype(np.int32),
+                                  z["counts"].astype(np.int64)))
+        halo_dst.append(z["indices"].astype(np.int32))
+        halo_w.append(z["weights"].astype(np.float32))
+    from g2vec_tpu.ops.host_walker import edges_to_csr
+
+    own_src = np.repeat(np.arange(pcsr.n_genes, dtype=np.int32),
+                        np.diff(pcsr.indptr).astype(np.int64))
+    src = np.concatenate([own_src] + halo_src)
+    dst = np.concatenate([pcsr.indices] + halo_dst)
+    w = np.concatenate([pcsr.weights] + halo_w)
+    indptr, indices, weights = edges_to_csr(src, dst, w, pcsr.n_genes)
+    genes = np.sort(np.concatenate(halo_genes)) if halo_genes \
+        else np.zeros(0, np.int32)
+    avail = pcsr.avail.copy()
+    avail[genes] = 1
+    return PartitionedCSR(
+        n_genes=pcsr.n_genes, lo=pcsr.lo, hi=pcsr.hi, indptr=indptr,
+        indices=indices, weights=weights, avail=avail, halo_genes=genes,
+        owned_edges=pcsr.owned_edges,
+        halo_edges=int(src.size - own_src.size))
+
+
+@dataclasses.dataclass
+class EdgeWalkStats:
+    """Handoff accounting across one run's shards (metrics ``handoff``
+    event + BENCH_EDGE_PARTITION.json)."""
+
+    shards: int = 0
+    rounds: int = 0
+    states_sent: int = 0            # suspended walk states shipped
+    batches: int = 0                # non-empty per-destination batches
+    peak_in_flight: int = 0         # max states in flight in one round
+
+
+@dataclasses.dataclass
+class EdgeContext:
+    """What the pipeline hands the streaming trainer for a MULTI-rank
+    edge-partitioned run: the per-group partial CSRs (halo-merged in
+    halo mode) plus the run-wide handoff accounting. Single-rank
+    edge-partitioned runs pass None — the range is the whole graph, so
+    the trainer's plain unsharded paths apply (byte-identity)."""
+
+    mode: str                       # "handoff" | "halo"
+    pcsrs: List[PartitionedCSR]     # one per prognosis group
+    stats: EdgeWalkStats
+
+
+def run_edge_walk(pcsr: PartitionedCSR, plan, shard_index: int, *,
+                  seed: int, owner: int, rank: int, n_ranks: int,
+                  starts: Optional[np.ndarray] = None, n_threads: int = 0,
+                  exchange=None, deadline: Optional[float] = None,
+                  key_prefix: str = "edge", cancelled=None,
+                  stats: Optional[EdgeWalkStats] = None
+                  ) -> Optional[np.ndarray]:
+    """Collectively walk one group's shard over partitioned CSRs.
+
+    ALL ranks call this for every (shard, group) in the same order — it
+    is a producer-thread collective over the explicit-key transport.
+    The shard owner seeds the initial WalkStateBatch (global-walker-index
+    PRNG streams); each round every rank advances the states it holds
+    (native partial walker), scatters locally-finished paths, and ships
+    suspended states to the rank owning their current gene, with
+    finished remote paths riding the same payloads back to the owner.
+    The round's payloads each carry the sender's outgoing-state count,
+    so every rank computes the same global in-flight total and the loop
+    terminates on the same round everywhere — the termination barrier
+    (one all-pairs round even when zero walks cross a partition).
+
+    Returns the shard-group's packed rows on the owner (walk_shard's
+    exact layout and bytes), None on the other ranks — or None anywhere
+    once ``cancelled()`` reports the consumer is gone.
+    """
+    import time as _time
+
+    from g2vec_tpu.ops.host_walker import (WalkStateBatch,
+                                           advance_walk_states,
+                                           pack_finished_paths,
+                                           shard_walk_states)
+    from g2vec_tpu.resilience.faults import fault_point
+
+    len_path = plan.len_path
+    n_rows = plan.group_rows(shard_index)
+    if n_ranks == 1:
+        states = shard_walk_states(plan, shard_index, seed=seed,
+                                   starts=starts)
+        status = advance_walk_states(states, pcsr.csr, pcsr.n_genes,
+                                     pcsr.avail, len_path,
+                                     n_threads=n_threads)
+        if status.any():
+            raise RuntimeError(
+                "single-rank edge-partitioned walk suspended — the full "
+                "range must be available")
+        return pack_finished_paths(states.paths, pcsr.n_genes)
+
+    from g2vec_tpu.parallel import hostcomm
+    from g2vec_tpu.resilience.fleet import PeerTimeoutError
+
+    if exchange is None:
+        exchange = hostcomm.exchange_bytes
+    budget = deadline if deadline else hostcomm.DEFAULT_DEADLINE_S
+    t_end = _time.monotonic() + budget
+
+    def _recv(key: str, src_rank: int) -> Optional[bytes]:
+        """Deadline-sliced receive that notices a cancelled consumer
+        (the _exchange_rows polling pattern, train/stream.py)."""
+        while True:
+            left = t_end - _time.monotonic()
+            if left <= 0:
+                # Let the transport raise its own naming of the dead peer.
+                return exchange(key, None, src_rank, deadline=1e-3)
+            try:
+                return exchange(key, None, src_rank,
+                                deadline=min(2.0, left))
+            except PeerTimeoutError:
+                if cancelled is not None and cancelled():
+                    return None
+
+    bounds = edge_bounds(n_ranks, pcsr.n_genes)
+    i_am_owner = rank == owner
+    pending = (shard_walk_states(plan, shard_index, seed=seed, starts=starts)
+               if i_am_owner else WalkStateBatch.empty(len_path))
+    done_paths = (np.full((n_rows, len_path), -1, np.int32)
+                  if i_am_owner else None)
+    n_done = 0
+    rnd = 0
+    if stats is not None:
+        stats.shards += 1
+    while True:
+        fin = WalkStateBatch.empty(len_path)
+        out: dict = {}
+        if len(pending):
+            status = advance_walk_states(pending, pcsr.csr, pcsr.n_genes,
+                                         pcsr.avail, len_path,
+                                         n_threads=n_threads)
+            fin = pending.take(np.nonzero(status == 0)[0])
+            sus = pending.take(np.nonzero(status == 1)[0])
+            dest = owners_of(sus.cur, bounds)
+            for b in range(n_ranks):
+                sel = np.nonzero(dest == b)[0]
+                if sel.size:
+                    out[b] = sus.take(sel)
+        if i_am_owner and len(fin):
+            done_paths[fin.row] = fin.paths
+            n_done += len(fin)
+            fin = WalkStateBatch.empty(len_path)
+        my_out = sum(len(b) for b in out.values())
+        if stats is not None:
+            stats.rounds += 1
+            stats.states_sent += my_out
+            stats.batches += sum(1 for b in out.values() if len(b))
+        # The mid-walk seam: a rank sigkilled here holds suspended walk
+        # state no other rank can reconstruct — the survivors' receive
+        # deadline names it (tests/test_edge.py drill).
+        fault_point("walk_handoff", epoch=shard_index)
+        for b in range(n_ranks):
+            if b == rank:
+                continue
+            batch = out.get(b, WalkStateBatch.empty(len_path))
+            f = fin if b == owner else WalkStateBatch.empty(len_path)
+            payload = _savez_bytes(
+                row=batch.row, cur=batch.cur, rng=batch.rng, pos=batch.pos,
+                paths=batch.paths, fin_row=f.row, fin_paths=f.paths,
+                live=np.array([my_out], np.int64))
+            exchange(f"{key_prefix}/{shard_index}/r{rnd}/{rank}to{b}",
+                     payload, rank)
+        incoming = [out[rank]] if rank in out else []
+        global_live = my_out
+        for a in range(n_ranks):
+            if a == rank:
+                continue
+            raw = _recv(f"{key_prefix}/{shard_index}/r{rnd}/{a}to{rank}", a)
+            if raw is None:
+                return None          # consumer gone; exit quietly
+            z = _loadz_bytes(raw)
+            global_live += int(z["live"][0])
+            if z["row"].size:
+                incoming.append(WalkStateBatch(
+                    row=z["row"].astype(np.int32),
+                    cur=z["cur"].astype(np.int32),
+                    rng=z["rng"].astype(np.uint64),
+                    pos=z["pos"].astype(np.int32),
+                    paths=z["paths"].astype(np.int32)))
+            if i_am_owner and z["fin_row"].size:
+                done_paths[z["fin_row"].astype(np.int64)] = \
+                    z["fin_paths"].astype(np.int32)
+                n_done += int(z["fin_row"].size)
+        if stats is not None:
+            stats.peak_in_flight = max(stats.peak_in_flight, global_live)
+        pending = (WalkStateBatch.concat(incoming) if incoming
+                   else WalkStateBatch.empty(len_path))
+        rnd += 1
+        if global_live == 0:
+            break
+    if not i_am_owner:
+        return None
+    if n_done != n_rows:
+        raise RuntimeError(
+            f"edge walk for shard {shard_index} terminated with "
+            f"{n_done}/{n_rows} rows assembled — protocol bug")
+    return pack_finished_paths(done_paths, pcsr.n_genes)
+
+
 def subset_starts(n_genes: int, walk_starts: int) -> Optional[np.ndarray]:
     """Evenly spaced start-gene subset for ``--walk-starts W`` (0/full =
     None — the every-gene-starts reference semantics, byte-identical to

@@ -63,6 +63,12 @@ class PipelineResult:
                                  # --train-mode streaming attribution
                                  # (train/stream.py StreamStats.as_dict();
                                  # empty for full-batch runs)
+    edge_stats: Dict = dataclasses.field(default_factory=dict)
+                                 # --edge-partition attribution for THIS
+                                 # rank (csr_bytes/halo bytes; only the
+                                 # coordinator has a metrics stream, so
+                                 # per-rank numbers ride the result);
+                                 # empty when edge partitioning is off
 
 
 def _background_warm(fn, console):
@@ -194,6 +200,7 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print,
                                       profile_dir=None)
 
     timer = StageTimer()
+    edge_attrib: Dict = {}       # this rank's --edge-partition attribution
     # A resumed run APPENDS: its records continue the interrupted attempt's
     # stream (and the supervisor's retry/resume events in between survive).
     metrics = MetricsWriter(cfg.metrics_jsonl, append=cfg.resume)
@@ -236,7 +243,19 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print,
         with timer.stage("load"):
             data = load_expression(cfg.expression_file, use_native=cfg.use_native_io)
             clinical = load_clinical(cfg.clinical_file)
-            network = load_network(cfg.network_file)
+            if cfg.edge_partition != "off":
+                # Edge-partitioned (--edge-partition): scan endpoint
+                # NAMES only (O(G) strings — the sorted-common invariant
+                # needs the set); the edges themselves stream later
+                # through the src-range-filtered reader, so the full
+                # edge list never materializes on any rank
+                # (io/readers.FORBID_FULL_NETWORK_ENV pins this).
+                from g2vec_tpu.io.readers import scan_network_genes
+
+                network = None
+                net_genes = scan_network_genes(cfg.network_file)
+            else:
+                network = load_network(cfg.network_file)
         _stage_edge("load")
 
         console(">>> 2. Preprocess data")
@@ -252,17 +271,38 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print,
                         "(fraction=%.3f, seed=%d)"
                         % (data.expr.shape[0], n_before,
                            cfg.patient_subsample, cfg.subsample_seed))
-            common = find_common_genes(network.genes, data.gene)
-            network = restrict_network(network, common)
-            data = restrict_data(data, common)
-            gene2idx = make_gene2idx(data.gene)
-            src, dst = edges_to_indices(network, gene2idx)
+            if cfg.edge_partition != "off":
+                # Streamed restrict_network + edges_to_indices with a
+                # src-index range filter: this rank reads only the edges
+                # of its OWNED gene range [ep_lo, ep_hi) — identical to
+                # the in-memory path's arrays restricted to that range
+                # (io/readers.load_network_range order contract).
+                from g2vec_tpu.io.readers import load_network_range
+                from g2vec_tpu.parallel.shard import edge_range
+
+                common = find_common_genes(net_genes, data.gene)
+                data = restrict_data(data, common)
+                gene2idx = make_gene2idx(data.gene)
+                ep_rank = jax.process_index() if cfg.distributed else 0
+                ep_ranks = jax.process_count() if cfg.distributed else 1
+                ep_lo, ep_hi = edge_range(ep_rank, ep_ranks, len(common))
+                src, dst = load_network_range(cfg.network_file, gene2idx,
+                                              ep_lo, ep_hi)
+            else:
+                common = find_common_genes(network.genes, data.gene)
+                network = restrict_network(network, common)
+                data = restrict_data(data, common)
+                gene2idx = make_gene2idx(data.gene)
+                src, dst = edges_to_indices(network, gene2idx)
         _stage_edge("preprocess")
         n_samples, n_genes = data.expr.shape
-        n_edges = len(network.edges)
+        n_edges = len(src)
         console("    n_samples: %d" % n_samples)
         console("    n_genes  : %d\t(common genes in both EXPRESSION and NETWORK)" % n_genes)
-        console("    n_edges  : %d\t(edges with the common genes)" % n_edges)
+        console("    n_edges  : %d\t(%s)" % (
+            n_edges,
+            "edges of this rank's owned gene range"
+            if cfg.edge_partition != "off" else "edges with the common genes"))
         metrics.emit("preprocess", n_samples=n_samples, n_genes=n_genes, n_edges=n_edges)
 
         console(">>> 3. Generate random paths from each group")
@@ -369,6 +409,63 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print,
                         expr_group, src, dst, threshold=cfg.pcc_threshold)
                     group_edges.append((np.asarray(s_k), np.asarray(d_k),
                                         np.asarray(w_k)))
+            edge_ctx = None
+            if cfg.edge_partition != "off":
+                # Owned-range CSRs from the range-filtered thresholded
+                # edges; halo mode then replicates the 1-hop boundary
+                # rows in a main-thread collective per group. At one
+                # rank the range is the whole graph and the trainer
+                # routes through the plain unsharded code paths (PR 10
+                # byte-identity convention), so edge_ctx stays None.
+                from g2vec_tpu.parallel.shard import (EdgeContext,
+                                                      EdgeWalkStats,
+                                                      build_halo_csr,
+                                                      build_partitioned_csr)
+
+                if ep_ranks > 1 and (shard_ctx is None
+                                     or not shard_ctx.spec.graph_shards):
+                    raise ValueError(
+                        "multi-rank --edge-partition needs --graph-shards "
+                        "(the shard exchange distributes finished rows)")
+                pcsrs = []
+                for gi, (s_k, d_k, w_k) in enumerate(group_edges):
+                    p = build_partitioned_csr(s_k, d_k, w_k, n_genes,
+                                              ep_lo, ep_hi)
+                    if cfg.edge_partition == "halo" and ep_ranks > 1:
+                        p = build_halo_csr(
+                            p, rank=ep_rank, n_ranks=ep_ranks, group=gi,
+                            deadline=(cfg.fleet_watchdog_deadline or None))
+                    pcsrs.append(p)
+                csr_bytes = sum(p.csr_bytes for p in pcsrs)
+                owned_edges = sum(p.owned_edges for p in pcsrs)
+                halo_edges = sum(p.halo_edges for p in pcsrs)
+                console(f"    [edge] {cfg.edge_partition}: rank {ep_rank}/"
+                        f"{ep_ranks} owns genes [{ep_lo}, {ep_hi}) — "
+                        f"{owned_edges} owned edges, {csr_bytes} CSR bytes"
+                        + (f", {halo_edges} halo edges"
+                           if cfg.edge_partition == "halo" else ""))
+                metrics.emit("edge_partition", mode=cfg.edge_partition,
+                             rank=ep_rank, n_ranks=ep_ranks,
+                             gene_lo=ep_lo, gene_hi=ep_hi,
+                             owned_edges=owned_edges, csr_bytes=csr_bytes)
+                edge_attrib = {
+                    "mode": cfg.edge_partition, "rank": ep_rank,
+                    "n_ranks": ep_ranks, "gene_lo": ep_lo, "gene_hi": ep_hi,
+                    "owned_edges": owned_edges, "csr_bytes": csr_bytes,
+                    "halo_edges": halo_edges,
+                    "halo_bytes": sum(p.halo_bytes for p in pcsrs)}
+                if cfg.edge_partition == "halo":
+                    metrics.emit(
+                        "halo", halo_edges=halo_edges,
+                        halo_bytes=sum(p.halo_bytes for p in pcsrs),
+                        halo_genes=sum(len(p.halo_genes) for p in pcsrs),
+                        overhead_ratio=(
+                            sum(p.halo_bytes for p in pcsrs)
+                            / max(1, 8 * owned_edges)))
+                if ep_ranks > 1:
+                    edge_ctx = EdgeContext(mode=cfg.edge_partition,
+                                           pcsrs=pcsrs,
+                                           stats=EdgeWalkStats())
             _stage_edge("paths")
             console("    [stream] walk shards stream from the sampler "
                     "pool; stage 4 overlaps stage 3")
@@ -407,7 +504,19 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print,
                     check=check, lifecycle=lifecycle,
                     on_epoch=on_epoch, console=console,
                     shard_ctx=shard_ctx, walk_starts=cfg.walk_starts,
+                    edge_ctx=edge_ctx,
                     eval_rows_cap=(cfg.stream_eval_rows or EVAL_ROWS_CAP))
+            if edge_ctx is not None:
+                st = edge_ctx.stats
+                metrics.emit("handoff", mode=edge_ctx.mode,
+                             shards=st.shards, rounds=st.rounds,
+                             states_sent=st.states_sent,
+                             batches=st.batches,
+                             peak_in_flight=st.peak_in_flight)
+                edge_attrib.update(
+                    shards=st.shards, rounds=st.rounds,
+                    states_sent=st.states_sent, batches=st.batches,
+                    peak_in_flight=st.peak_in_flight)
             _stage_edge("train")
             result = sres.train
             gene_freq = sres.gene_freq
@@ -774,7 +883,8 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print,
             sampler_threads=sampler_threads, overlap_saved_s=overlap_saved,
             walk_cache_hits=walk_cache_hits,
             stream_stats=(sres.stats.as_dict()
-                          if cfg.train_mode == "streaming" else {}))
+                          if cfg.train_mode == "streaming" else {}),
+            edge_stats=edge_attrib)
     finally:
         if overlap is not None:
             # Drain, never raise: the exception in flight (if any) is the

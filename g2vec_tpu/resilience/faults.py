@@ -113,7 +113,17 @@ SEAMS = ("load", "preprocess", "paths", "train", "lgroups", "biomarkers",
          # hidden activations to the per-step allreduce (epoch = global
          # step) — the same named-rank attribution for a death inside the
          # model-parallel reduction.
-         "shard_exchange", "embed_allreduce")
+         "shard_exchange", "embed_allreduce",
+         # Edge-partitioned CSR seams (parallel/shard.py with an
+         # EdgeContext): ``walk_handoff`` fires in the collective walk
+         # engine's round loop right before a rank publishes its
+         # suspended-walk batches (epoch = shard index) — a sigkill
+         # there takes walk state no other rank can reconstruct with it,
+         # and the survivors' receive deadline names the dead rank.
+         # ``halo_build`` fires between the halo want-list round and the
+         # row-ship round (epoch = group index) — a dead row SERVER at
+         # setup time, named by its requesters' deadline expiry.
+         "walk_handoff", "halo_build")
 
 
 class FaultPlanError(ValueError):

@@ -399,7 +399,7 @@ def train_cbow_streaming(
         lifecycle: Optional[Callable[[str, dict], None]] = None,
         on_epoch: Optional[Callable[[int, float, float, float], None]] = None,
         console: Callable[[str], None] = print,
-        shard_ctx=None, walk_starts: int = 0,
+        shard_ctx=None, walk_starts: int = 0, edge_ctx=None,
         ) -> StreamTrainResult:
     """Stream walk shards from the sampler pool straight into minibatch
     SGD; returns the trained embeddings plus the streaming twin of the
@@ -440,6 +440,17 @@ def train_cbow_streaming(
     gene, the reference semantics). Sharded runs do not compose with
     checkpoint/resume yet — the cursor would have to be a distributed
     snapshot.
+
+    ``edge_ctx`` (parallel/shard.EdgeContext) turns the graph-sharded
+    producer's SAMPLE step into a fleet collective: this rank holds only
+    its owned gene range's CSR rows (plus halo rows in halo mode), every
+    rank joins ``run_edge_walk`` for every shard (mid-walk handoff of
+    suspended walk state, termination barrier), and the shard owner
+    publishes the assembled rows over the same ``walk/{si}`` exchange —
+    so downstream of the producer nothing changes, and the rows are
+    byte-identical to the full-CSR mode's (the walk state carries its
+    PRNG stream). Requires graph sharding at >1 rank; single-rank
+    edge-partitioned runs pass None and use the plain paths below.
     """
     import jax
     import jax.numpy as jnp
@@ -480,6 +491,12 @@ def train_cbow_streaming(
         raise ValueError(
             f"shard context was built for {spec.n_genes} genes, trainer "
             f"got {n_genes}")
+    edge_multi = edge_ctx is not None
+    if edge_multi and not graph_multi:
+        raise ValueError(
+            "edge_ctx (multi-rank --edge-partition) rides the "
+            "graph-sharded producer's shard exchange; pass a multi-rank "
+            "graph-sharded shard_ctx or None")
 
     starts = subset_starts(n_genes, walk_starts)
     n_starts = n_genes if starts is None else len(starts)
@@ -490,11 +507,18 @@ def train_cbow_streaming(
                         shard_rows=plan.rows_per_shard,
                         ring_depth=prefetch_depth)
 
-    csr = []
-    for s, d, w in groups:
-        _group_edges_csr(np.asarray(s), np.asarray(d), n_genes)
-        csr.append(edges_to_csr(np.asarray(s), np.asarray(d),
-                                np.asarray(w), n_genes))
+    if edge_multi:
+        # The rank's PARTIAL per-group CSRs (already built and, in halo
+        # mode, halo-merged by the pipeline): the groups' edge lists
+        # cover only the owned gene range, so the full-graph walker
+        # below must never run on them (_rewalk raises instead).
+        csr = [p.csr for p in edge_ctx.pcsrs]
+    else:
+        csr = []
+        for s, d, w in groups:
+            _group_edges_csr(np.asarray(s), np.asarray(d), n_genes)
+            csr.append(edges_to_csr(np.asarray(s), np.asarray(d),
+                                    np.asarray(w), n_genes))
 
     def _walk_group(gi: int, shard_index: int) -> np.ndarray:
         s, d, w = groups[gi]
@@ -507,6 +531,19 @@ def train_cbow_streaming(
     def _walk_shard_rows(shard_index: int) -> np.ndarray:
         return np.concatenate([_walk_group(0, shard_index),
                                _walk_group(1, shard_index)], axis=0)
+
+    def _rewalk(shard_index: int) -> np.ndarray:
+        """Rewalk-on-corrupt hook for the spool. Edge-partitioned ranks
+        cannot rewalk alone — the shard's walks span every rank's CSR
+        rows and the collective has long since moved on — so a corrupt
+        spooled shard is terminal there instead of self-healing."""
+        if edge_multi:
+            raise SpoolIntegrityError(
+                f"shard {shard_index}: spooled bytes failed verification "
+                "and this rank holds only a partial CSR under "
+                "--edge-partition; re-walking needs the whole fleet — "
+                "restart the run")
+        return _walk_shard_rows(shard_index)
 
     def _shard_labels(shard_index: int) -> np.ndarray:
         n = plan.group_rows(shard_index)
@@ -556,28 +593,21 @@ def train_cbow_streaming(
 
     producer_wall = [0.0]
 
-    def _exchange_rows(si: int, owner: int) -> Optional[np.ndarray]:
-        """The graph-sharded producer's shard ``si``: the owner samples
-        and publishes (explicit-key chunked transport — this runs on the
-        PRODUCER thread, so the seq-numbered collectives are off limits;
-        parallel/hostcomm.py thread-safety note); the rest receive. The
-        receive polls in short slices, checking ``ring.cancelled``
-        between them, so a rank whose trainer already stopped returns
-        None instead of waiting out the transport deadline on a publish
-        that may never come."""
+    def _publish_rows(si: int, rows: np.ndarray, owner: int) -> None:
+        from g2vec_tpu.parallel import hostcomm
+
+        buf = io.BytesIO()
+        np.save(buf, rows, allow_pickle=False)
+        hostcomm.exchange_bytes(f"walk/{si}", buf.getvalue(), owner)
+
+    def _recv_exchanged_rows(si: int, owner: int) -> Optional[np.ndarray]:
+        """Peer side of the ``walk/{si}`` publish: polls in short
+        slices, checking ``ring.cancelled`` between them, so a rank
+        whose trainer already stopped returns None instead of waiting
+        out the transport deadline on a publish that may never come."""
         from g2vec_tpu.parallel import hostcomm
         from g2vec_tpu.resilience.fleet import PeerTimeoutError
 
-        if owner == spec.rank:
-            rows = _walk_shard_rows(si)
-            # The dead-owner seam: sigkill here (before the publish)
-            # leaves the peers' chunked get waiting; their deadline
-            # expiry names this rank (tests/test_shard.py drill).
-            fault_point("shard_exchange", epoch=si)
-            buf = io.BytesIO()
-            np.save(buf, rows, allow_pickle=False)
-            hostcomm.exchange_bytes(f"walk/{si}", buf.getvalue(), owner)
-            return rows
         budget = (shard_ctx.deadline if shard_ctx.deadline
                   else hostcomm.DEFAULT_DEADLINE_S)
         t_end = time.monotonic() + budget
@@ -596,12 +626,60 @@ def train_cbow_streaming(
                 if ring.cancelled:
                     return None
 
+    def _exchange_rows(si: int, owner: int) -> Optional[np.ndarray]:
+        """The graph-sharded producer's shard ``si``: the owner samples
+        and publishes (explicit-key chunked transport — this runs on the
+        PRODUCER thread, so the seq-numbered collectives are off limits;
+        parallel/hostcomm.py thread-safety note); the rest receive."""
+        if owner == spec.rank:
+            rows = _walk_shard_rows(si)
+            # The dead-owner seam: sigkill here (before the publish)
+            # leaves the peers' chunked get waiting; their deadline
+            # expiry names this rank (tests/test_shard.py drill).
+            fault_point("shard_exchange", epoch=si)
+            _publish_rows(si, rows, owner)
+            return rows
+        return _recv_exchanged_rows(si, owner)
+
+    def _edge_rows(si: int, owner: int) -> Optional[np.ndarray]:
+        """The edge-partitioned producer's shard ``si``: EVERY rank
+        joins the collective walk engine per group
+        (parallel/shard.run_edge_walk — partial walks on the local CSR
+        rows, suspended-state handoff, termination barrier; explicit
+        keys, so producer-thread safe). The owner then publishes the
+        assembled rows over the same ``walk/{si}`` exchange the
+        graph-sharded producer uses; downstream of here the two
+        producers are indistinguishable."""
+        from g2vec_tpu.parallel.shard import run_edge_walk
+
+        parts = []
+        for gi in (0, 1):
+            rows_g = run_edge_walk(
+                edge_ctx.pcsrs[gi], plan, si,
+                seed=(walk_seed << 1) | gi, owner=owner,
+                rank=spec.rank, n_ranks=spec.n_ranks, starts=starts,
+                n_threads=sampler_threads, deadline=shard_ctx.deadline,
+                key_prefix=f"edgewalk/g{gi}",
+                cancelled=lambda: ring.cancelled,
+                stats=edge_ctx.stats)
+            if rows_g is None and spec.rank == owner:
+                return None          # consumer gone mid-collective
+            parts.append(rows_g)
+        if owner == spec.rank:
+            rows = np.concatenate(parts, axis=0)
+            fault_point("shard_exchange", epoch=si)
+            _publish_rows(si, rows, owner)
+            return rows
+        return _recv_exchanged_rows(si, owner)
+
     def _produce():
         t0 = time.perf_counter()
         try:
             for si in range(start_shard, n_shards):
                 if graph_multi:
-                    rows = _exchange_rows(si, spec.shard_owner(si, n_shards))
+                    owner = spec.shard_owner(si, n_shards)
+                    rows = (_edge_rows(si, owner) if edge_multi
+                            else _exchange_rows(si, owner))
                     if rows is None:
                         return      # consumer gone while waiting
                 else:
@@ -864,7 +942,7 @@ def train_cbow_streaming(
     def _replay_iter(start: int = 0) -> Iterator[Shard]:
         for si in range(start, n_shards):
             fault_point("prefetch", epoch=si)
-            yield Shard(si, spool.load(si, _walk_shard_rows),
+            yield Shard(si, spool.load(si, _rewalk),
                         _shard_labels(si))
 
     def _device_feed(shards: Iterator[Shard], epoch0: bool):

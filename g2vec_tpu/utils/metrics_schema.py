@@ -47,6 +47,9 @@ EVENT_SCHEMAS = {
     'drain_begin': {
         "required": ['queued', 'running', 'source'],
         "optional": []},
+    'edge_partition': {
+        "required": ['csr_bytes', 'gene_hi', 'gene_lo', 'mode', 'n_ranks', 'owned_edges', 'rank'],
+        "optional": []},
     'epoch': {
         "required": ['acc_tr', 'acc_val', 'secs', 'step'],
         "optional": []},
@@ -76,6 +79,12 @@ EVENT_SCHEMAS = {
         "optional": []},
     'gave_up': {
         "required": ['attempt', 'classified', 'error'],
+        "optional": []},
+    'halo': {
+        "required": ['halo_bytes', 'halo_edges', 'halo_genes', 'overhead_ratio'],
+        "optional": []},
+    'handoff': {
+        "required": ['batches', 'mode', 'peak_in_flight', 'rounds', 'shards', 'states_sent'],
         "optional": []},
     'heartbeat': {
         "required": [],

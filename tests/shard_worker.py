@@ -32,8 +32,10 @@ def main() -> None:
         "biomarkers": list(res.biomarkers),
         "n_paths": int(res.n_paths),
         "n_genes": int(res.n_genes),
+        "n_edges": int(res.n_edges),
         "output_files": list(res.output_files),
         "rss_kb": int(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss),
+        "edge_stats": dict(res.edge_stats),
     }))
 
 

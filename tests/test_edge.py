@@ -1,0 +1,673 @@
+"""Edge-partitioned CSR suite (parallel/shard.py edge section, io/readers.py
+range readers, data/synth.py partitioned emission, --edge-partition
+pipeline wiring) — tier-1.
+
+Contracts pinned here:
+
+1. **Partitioning math**: ``edge_range`` tiles ``[0, G)`` exactly,
+   ``owners_of`` agrees with it, ``build_partitioned_csr`` rejects rows
+   outside the owned range.
+2. **Engine byte identity**: multi-rank ``run_edge_walk`` — under BOTH
+   boundary strategies (handoff batches, halo-replicated rows) —
+   reproduces ``walk_shard``'s rows byte-for-byte; a single full-range
+   rank is byte-identical with no exchange at all.
+3. **Handoff edge cases**: a walk whose LAST step lands on a foreign
+   gene terminates locally (no handoff); a handed-off walk that
+   dead-ends immediately at the boundary gene resumes and finishes on
+   the owner of that gene; a rank with nothing to send still publishes
+   its (empty) round payload; zero cross-partition walks still cost
+   exactly one all-pairs termination-barrier round.
+4. **Range-filtered readers**: partitioned emission concat-equals the
+   flat file, manifest sha256s verify (and corruption is caught), and
+   the ``G2VEC_FORBID_FULL_NETWORK`` pin proves ``--edge-partition``
+   runs never reach the unpartitioned reader.
+5. **1-rank pipeline byte identity**: ``--edge-partition handoff|halo``
+   at one process == plain streaming, byte-for-byte, under the pin.
+6. **2-rank fleet**: handoff ≡ halo byte-identical to each other under
+   the pin, within the PR 7 statistical band vs the unpartitioned run.
+7. **Fault drills**: a rank sigkilled at the ``walk_handoff`` /
+   ``halo_build`` seams is NAMED by the survivor's PeerTimeoutError.
+"""
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.edge
+
+HAVE_CXX = shutil.which("g++") is not None
+needs_native = pytest.mark.skipif(not HAVE_CXX, reason="no C++ toolchain")
+
+_WORKER = os.path.join(os.path.dirname(__file__), "shard_worker.py")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# 1. Partitioning math (no jax, no native, no processes)
+# ---------------------------------------------------------------------------
+
+def test_edge_range_tiles_exactly():
+    from g2vec_tpu.parallel.shard import edge_bounds, edge_range, owners_of
+
+    for n_genes, n_ranks in ((2, 2), (100, 3), (1000, 4), (9999, 7),
+                             (1 << 20, 4)):
+        bounds = edge_bounds(n_ranks, n_genes)
+        prev_hi = 0
+        for r in range(n_ranks):
+            lo, hi = edge_range(r, n_ranks, n_genes)
+            assert lo == prev_hi               # contiguous, no gaps/overlap
+            assert bounds[r] == lo
+            prev_hi = hi
+        assert prev_hi == n_genes              # ranges tile [0, G)
+        genes = np.arange(n_genes, dtype=np.int64)
+        owners = owners_of(genes, bounds)
+        for r in range(n_ranks):
+            lo, hi = edge_range(r, n_ranks, n_genes)
+            assert (owners[lo:hi] == r).all()  # owner lookup agrees
+    with pytest.raises(ValueError, match="rank"):
+        edge_range(2, 2, 100)
+
+
+def test_build_partitioned_csr_guards_owned_range():
+    from g2vec_tpu.parallel.shard import build_partitioned_csr
+
+    src = np.array([2, 3], np.int32)
+    dst = np.array([0, 5], np.int32)
+    w = np.ones(2, np.float32)
+    p = build_partitioned_csr(src, dst, w, 8, 2, 4)
+    assert p.avail[2:4].all() and not p.avail[:2].any() \
+        and not p.avail[4:].any()
+    assert p.owned_edges == 2 and p.halo_edges == 0
+    assert p.csr_bytes == (p.indptr.nbytes + p.indices.nbytes
+                           + p.weights.nbytes + p.avail.nbytes)
+    assert p.halo_bytes == 0 and p.halo_overhead_ratio == 0.0
+    with pytest.raises(ValueError, match="owned range"):
+        build_partitioned_csr(src, dst, w, 8, 3, 4)   # src 2 outside [3, 4)
+    with pytest.raises(ValueError, match="outside"):
+        build_partitioned_csr(src, np.array([0, 9], np.int32), w, 8, 2, 4)
+
+
+# ---------------------------------------------------------------------------
+# In-process fleet harness: ranks as threads over a local KV exchange
+# ---------------------------------------------------------------------------
+
+class _LocalExchange:
+    """exchange_bytes stand-in: a dict + condvar, PeerTimeoutError naming
+    the owner on deadline expiry (the real transport's shape)."""
+
+    def __init__(self):
+        self.store = {}
+        self.cv = threading.Condition()
+
+    def __call__(self, key, payload, owner, deadline=None, chunk_bytes=None):
+        from g2vec_tpu.resilience.fleet import PeerTimeoutError
+
+        if payload is not None:
+            with self.cv:
+                self.store[key] = payload
+                self.cv.notify_all()
+            return payload
+        t_end = time.monotonic() + (deadline or 30.0)
+        with self.cv:
+            while key not in self.store:
+                left = t_end - time.monotonic()
+                if left <= 0:
+                    raise PeerTimeoutError(
+                        f"local get {key!r} timed out; missing rank(s): "
+                        f"[{owner}]", collective=key, suspects=(owner,))
+                self.cv.wait(left)
+            return self.store[key]
+
+
+def _partition(src, dst, w, n_genes, rank, n_ranks):
+    from g2vec_tpu.parallel.shard import build_partitioned_csr, edge_range
+
+    lo, hi = edge_range(rank, n_ranks, n_genes)
+    m = (src >= lo) & (src < hi)
+    return build_partitioned_csr(src[m], dst[m], w[m], n_genes, lo, hi)
+
+
+def _build_halos(pcsrs, n_ranks):
+    from g2vec_tpu.parallel.shard import build_halo_csr
+
+    ex = _LocalExchange()
+    out, errs = [None] * n_ranks, []
+
+    def worker(r):
+        try:
+            out[r] = build_halo_csr(pcsrs[r], rank=r, n_ranks=n_ranks,
+                                    group=0, exchange=ex, deadline=20.0)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    ts = [threading.Thread(target=worker, args=(r,)) for r in range(n_ranks)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    if errs:
+        raise errs[0]
+    return out
+
+
+def _run_fleet(pcsrs, plan, si, seed, owner, n_ranks, *, starts=None,
+               stats=None):
+    from g2vec_tpu.parallel.shard import EdgeWalkStats, run_edge_walk
+
+    ex = _LocalExchange()
+    stats = stats if stats is not None else [EdgeWalkStats()] * n_ranks
+    results, errs = [None] * n_ranks, []
+
+    def worker(r):
+        try:
+            results[r] = run_edge_walk(
+                pcsrs[r], plan, si, seed=seed, owner=owner, rank=r,
+                n_ranks=n_ranks, starts=starts, n_threads=1, exchange=ex,
+                deadline=30.0, key_prefix=f"t/{seed}", stats=stats[r])
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    ts = [threading.Thread(target=worker, args=(r,)) for r in range(n_ranks)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    if errs:
+        raise errs[0]
+    return results
+
+
+def _rand_graph(n_genes, n_edges, seed):
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, n_genes, n_edges).astype(np.int32)
+    dst = rng.integers(0, n_genes, n_edges).astype(np.int32)
+    keep = src != dst
+    return src[keep], dst[keep], rng.random(int(keep.sum())).astype(
+        np.float32)
+
+
+# ---------------------------------------------------------------------------
+# 2. Engine byte identity: handoff == halo == walk_shard
+# ---------------------------------------------------------------------------
+
+@needs_native
+def test_engine_multirank_matches_walk_shard():
+    from g2vec_tpu.ops.host_walker import edges_to_csr, plan_shards, \
+        walk_shard
+
+    n_genes, len_path = 200, 12
+    src, dst, w = _rand_graph(n_genes, 2500, seed=7)
+    plan = plan_shards(n_genes, 3, 64, len_path=len_path)
+    csr = edges_to_csr(src, dst, w, n_genes)
+    for n_ranks in (2, 3):
+        pcsrs = [_partition(src, dst, w, n_genes, r, n_ranks)
+                 for r in range(n_ranks)]
+        halos = _build_halos(pcsrs, n_ranks)
+        for h in halos:                        # halo accounting sanity
+            assert h.halo_bytes == 8 * h.halo_edges
+            assert h.avail[h.halo_genes].all()
+            assert h.owned_edges == pcsrs[halos.index(h)].owned_edges
+        for si in range(min(plan.n_shards, 3)):
+            ref = walk_shard(src, dst, w, n_genes, plan, si, seed=11,
+                             n_threads=1, csr=csr)
+            owner = si % n_ranks
+            res = _run_fleet(pcsrs, plan, si, 11, owner, n_ranks)
+            hres = _run_fleet(halos, plan, si, 11, owner, n_ranks)
+            for r in range(n_ranks):
+                if r == owner:
+                    assert res[r].tobytes() == ref.tobytes()
+                    assert hres[r].tobytes() == ref.tobytes()
+                else:                          # only the owner gets rows
+                    assert res[r] is None and hres[r] is None
+
+
+@needs_native
+def test_engine_single_rank_identical_no_exchange():
+    from g2vec_tpu.ops.host_walker import edges_to_csr, plan_shards, \
+        walk_shard
+    from g2vec_tpu.parallel.shard import build_partitioned_csr, run_edge_walk
+
+    n_genes = 120
+    src, dst, w = _rand_graph(n_genes, 1200, seed=3)
+    plan = plan_shards(n_genes, 2, 64, len_path=10)
+    csr = edges_to_csr(src, dst, w, n_genes)
+    full = build_partitioned_csr(src, dst, w, n_genes, 0, n_genes)
+    for si in range(min(plan.n_shards, 2)):
+        ref = walk_shard(src, dst, w, n_genes, plan, si, seed=11,
+                         n_threads=1, csr=csr)
+        got = run_edge_walk(full, plan, si, seed=11, owner=0, rank=0,
+                            n_ranks=1, n_threads=1)   # exchange never needed
+        assert got.tobytes() == ref.tobytes()
+
+
+# ---------------------------------------------------------------------------
+# 3. Handoff edge cases (tiny deterministic graphs)
+# ---------------------------------------------------------------------------
+
+def _tiny(plan_starts, reps, len_path):
+    from g2vec_tpu.ops.host_walker import plan_shards
+
+    return plan_shards(plan_starts, reps, 1024, len_path=len_path)
+
+
+@needs_native
+def test_last_step_at_boundary_terminates_without_handoff():
+    """A walk whose FINAL slot is filled by a foreign gene is done —
+    pos==len_path wins over the availability check, so no state is ever
+    shipped for it (and termination still costs one all-zero round)."""
+    from g2vec_tpu.ops.host_walker import walk_shard
+    from g2vec_tpu.parallel.shard import EdgeWalkStats
+
+    n_genes = 2                                # rank 0 owns {0}, rank 1 {1}
+    src = np.array([0, 1], np.int32)           # 0 -> 1, 1 -> 0
+    dst = np.array([1, 0], np.int32)
+    w = np.ones(2, np.float32)
+    starts = np.array([0], np.int32)
+    plan = _tiny(1, 2, len_path=2)             # path = [0, 1], full at 1
+    pcsrs = [_partition(src, dst, w, n_genes, r, 2) for r in range(2)]
+    ref = walk_shard(src, dst, w, n_genes, plan, 0, seed=5, n_threads=1,
+                     starts=starts)
+    stats = [EdgeWalkStats() for _ in range(2)]
+    res = _run_fleet(pcsrs, plan, 0, 5, 0, 2, starts=starts, stats=stats)
+    assert res[0].tobytes() == ref.tobytes()
+    assert stats[0].states_sent == 0           # terminal step, no handoff
+    assert stats[0].batches == 0
+    assert stats[0].rounds == 1                # the termination barrier
+
+
+@needs_native
+def test_handoff_resumes_and_dead_ends_at_boundary_gene():
+    """Mid-walk handoff with the handed gene a dead end: the receiving
+    owner resumes, immediately dead-ends, and the finished row rides the
+    next round's payload back to the shard owner. Rank 1 has nothing to
+    send in round 0 — its EMPTY payload must still arrive (the empty
+    exchange round) or the live-count barrier would wedge."""
+    from g2vec_tpu.ops.host_walker import walk_shard
+    from g2vec_tpu.parallel.shard import EdgeWalkStats
+
+    n_genes = 2
+    src = np.array([0], np.int32)              # 0 -> 1; gene 1 dead-ends
+    dst = np.array([1], np.int32)
+    w = np.ones(1, np.float32)
+    starts = np.array([0], np.int32)
+    plan = _tiny(1, 2, len_path=6)             # room left when it suspends
+    pcsrs = [_partition(src, dst, w, n_genes, r, 2) for r in range(2)]
+    ref = walk_shard(src, dst, w, n_genes, plan, 0, seed=5, n_threads=1,
+                     starts=starts)
+    stats = [EdgeWalkStats() for _ in range(2)]
+    res = _run_fleet(pcsrs, plan, 0, 5, 0, 2, starts=starts, stats=stats)
+    assert res[0].tobytes() == ref.tobytes()
+    assert stats[0].states_sent == plan.group_rows(0)   # every rep crossed
+    assert stats[0].batches == 1               # one destination batch
+    assert stats[1].states_sent == 0           # rank 1 only finishes them
+    assert stats[0].rounds >= 2                # suspend round + return round
+    # Halo replication of gene 1's (empty) row finishes the same walks
+    # locally in ONE round — and the rows stay byte-identical.
+    halos = _build_halos(pcsrs, 2)
+    hstats = [EdgeWalkStats() for _ in range(2)]
+    hres = _run_fleet(halos, plan, 0, 5, 0, 2, starts=starts, stats=hstats)
+    assert hres[0].tobytes() == ref.tobytes()
+    assert hstats[0].states_sent == 0
+    assert hstats[0].rounds == 1
+
+
+@needs_native
+def test_handoff_with_exactly_one_step_remaining():
+    """Suspension with depth-1 remaining: the receiving rank takes one
+    step, fills the last slot, and the walk is done."""
+    from g2vec_tpu.ops.host_walker import walk_shard
+    from g2vec_tpu.parallel.shard import EdgeWalkStats, edge_range
+
+    n_genes = 3                                # rank 0 owns {0}, rank 1 {1,2}
+    assert edge_range(0, 2, 3) == (0, 1) and edge_range(1, 2, 3) == (1, 3)
+    src = np.array([0, 1, 2], np.int32)        # deterministic chain 0->1->2
+    dst = np.array([1, 2, 0], np.int32)
+    w = np.ones(3, np.float32)
+    starts = np.array([0], np.int32)
+    plan = _tiny(1, 2, len_path=3)             # suspend at 1 with ONE slot
+    pcsrs = [_partition(src, dst, w, n_genes, r, 2) for r in range(2)]
+    ref = walk_shard(src, dst, w, n_genes, plan, 0, seed=9, n_threads=1,
+                     starts=starts)
+    stats = [EdgeWalkStats() for _ in range(2)]
+    res = _run_fleet(pcsrs, plan, 0, 9, 0, 2, starts=starts, stats=stats)
+    assert res[0].tobytes() == ref.tobytes()
+    assert stats[0].states_sent == plan.group_rows(0)
+    assert ref[0].any()                        # rows are real multi-hot
+
+
+@needs_native
+def test_zero_cross_partition_walks_single_barrier_round():
+    """Two disconnected per-rank components, all starts in the owner's
+    range: nothing ever crosses, yet every rank still runs exactly one
+    all-pairs round (the termination barrier) and agrees to stop."""
+    from g2vec_tpu.ops.host_walker import walk_shard
+    from g2vec_tpu.parallel.shard import EdgeWalkStats
+
+    n_genes = 4                                # rank 0 owns {0,1}, rank 1 {2,3}
+    src = np.array([0, 1, 2, 3], np.int32)     # two closed 2-cycles
+    dst = np.array([1, 0, 3, 2], np.int32)
+    w = np.ones(4, np.float32)
+    starts = np.array([0, 1], np.int32)        # both in rank 0's range
+    plan = _tiny(2, 2, len_path=5)
+    pcsrs = [_partition(src, dst, w, n_genes, r, 2) for r in range(2)]
+    ref = walk_shard(src, dst, w, n_genes, plan, 0, seed=13, n_threads=1,
+                     starts=starts)
+    stats = [EdgeWalkStats() for _ in range(2)]
+    res = _run_fleet(pcsrs, plan, 0, 13, 0, 2, starts=starts, stats=stats)
+    assert res[0].tobytes() == ref.tobytes()
+    assert stats[0].states_sent == 0 and stats[1].states_sent == 0
+    assert stats[0].rounds == 1 and stats[1].rounds == 1
+    assert stats[0].peak_in_flight == 0
+
+
+# ---------------------------------------------------------------------------
+# 4. Range-filtered readers + partitioned emission
+# ---------------------------------------------------------------------------
+
+def _body(path):
+    with open(path, "rb") as f:
+        return f.read().split(b"\n", 1)[1]     # drop the header line
+
+
+def test_partitioned_emission_concat_equals_flat(tmp_path):
+    from g2vec_tpu.data.synth import SynthGraphSpec, write_synth_graph_streamed
+    from g2vec_tpu.io.readers import (load_network_range,
+                                      read_partition_manifest,
+                                      scan_network_genes)
+
+    spec = SynthGraphSpec(n_genes=1200, n_good=4, n_poor=4, seed=3)
+    flat = write_synth_graph_streamed(spec, str(tmp_path / "flat"),
+                                      prefix="f")["network"]
+    man = write_synth_graph_streamed(spec, str(tmp_path / "part"),
+                                     prefix="p", partitions=3)["network"]
+    assert man.endswith(".manifest.json")
+    m = read_partition_manifest(man)
+    base = os.path.dirname(man)
+    # Concatenated part bodies == the flat emission's body, byte-for-byte.
+    concat = b"".join(_body(os.path.join(base, e["name"]))
+                      for e in m["files"])
+    assert concat == _body(flat)
+    assert sum(e["n_edges"] for e in m["files"]) == concat.count(b"\n")
+    # Bytes are chunk-size independent (the streamed-generator contract).
+    man2 = write_synth_graph_streamed(spec, str(tmp_path / "part2"),
+                                      prefix="p", partitions=3,
+                                      edge_chunk=777)["network"]
+    for e in m["files"]:
+        with open(os.path.join(base, e["name"]), "rb") as a, \
+                open(os.path.join(os.path.dirname(man2), e["name"]),
+                     "rb") as b:
+            assert a.read() == b.read()
+    # Gene scans and range reads agree between flat file and manifest.
+    genes = sorted(scan_network_genes(flat))
+    assert scan_network_genes(man) == set(genes)
+    g2i = {g: i for i, g in enumerate(genes)}
+    for lo, hi in ((0, len(genes)), (0, len(genes) // 3),
+                   (len(genes) // 3, len(genes))):
+        fs, fd = load_network_range(flat, g2i, lo, hi)
+        ms, md = load_network_range(man, g2i, lo, hi)
+        np.testing.assert_array_equal(fs, ms)
+        np.testing.assert_array_equal(fd, md)
+        assert fs.size == 0 or (fs.min() >= lo and fs.max() < hi)
+
+
+def test_partition_manifest_detects_corruption(tmp_path):
+    from g2vec_tpu.data.synth import SynthGraphSpec, write_synth_graph_streamed
+    from g2vec_tpu.io.readers import (load_network_range,
+                                      read_partition_manifest,
+                                      scan_network_genes)
+
+    spec = SynthGraphSpec(n_genes=600, n_good=4, n_poor=4, seed=5)
+    man = write_synth_graph_streamed(spec, str(tmp_path), prefix="c",
+                                     partitions=2)["network"]
+    genes = sorted(scan_network_genes(man))
+    g2i = {g: i for i, g in enumerate(genes)}
+    load_network_range(man, g2i, 0, len(genes))          # clean read works
+    victim = os.path.join(os.path.dirname(man),
+                          read_partition_manifest(man)["files"][0]["name"])
+    with open(victim, "ab") as f:
+        f.write(b"SGBOGUS\tSGBOGUS\n")
+    with pytest.raises(ValueError, match="sha256"):
+        load_network_range(man, g2i, 0, len(genes))
+
+
+def test_forbid_full_network_pin(tmp_path, monkeypatch):
+    """The acceptance pin: under G2VEC_FORBID_FULL_NETWORK the
+    unpartitioned reader RAISES, while the streamed range path (what
+    --edge-partition uses) keeps working."""
+    from g2vec_tpu.io.readers import (FORBID_FULL_NETWORK_ENV, load_network,
+                                      load_network_range, scan_network_genes)
+
+    net = tmp_path / "net.txt"
+    net.write_text("src\tdest\nSGA\tSGB\nSGB\tSGC\n")
+    monkeypatch.setenv(FORBID_FULL_NETWORK_ENV, "1")
+    with pytest.raises(RuntimeError, match="scan_network_genes"):
+        load_network(str(net))
+    assert scan_network_genes(str(net)) == {"SGA", "SGB", "SGC"}
+    g2i = {"SGA": 0, "SGB": 1, "SGC": 2}
+    src, dst = load_network_range(str(net), g2i, 0, 2)
+    np.testing.assert_array_equal(src, [0, 1])
+    np.testing.assert_array_equal(dst, [1, 2])
+
+
+def test_make_synth_graph_partitions_cli(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "make_synth_graph.py"),
+         "--nodes", "600", "--good", "4", "--poor", "4",
+         "--partitions", "2", "--out", str(tmp_path), "--prefix", "cli"],
+        capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stderr[-500:]
+    summary = json.loads(proc.stdout)
+    assert summary["streamed"] is True         # --partitions implies --stream
+    assert summary["network"].endswith(".manifest.json")
+    from g2vec_tpu.io.readers import read_partition_manifest
+
+    m = read_partition_manifest(summary["network"])
+    assert m["partitions"] == 2 and len(m["files"]) == 2
+    assert sum(e["n_edges"] for e in m["files"]) == int(summary["n_edges"])
+
+
+# ---------------------------------------------------------------------------
+# Shared pipeline fixtures/helpers (test_shard.py's dataset scale)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="session")
+def edge_tsv(tmp_path_factory):
+    from g2vec_tpu.data.synthetic import SyntheticSpec, write_synthetic_tsv
+
+    spec = SyntheticSpec(
+        n_good=30, n_poor=26, module_size=16, shared_module_size=6,
+        n_background=24, n_expr_only=4, n_net_only=4, module_chords=3,
+        background_edges=40, noise=0.25, shift=1.4, seed=7)
+    return write_synthetic_tsv(
+        spec, str(tmp_path_factory.mktemp("edge_data")))
+
+
+def _cfg_dict(paths, out, **over):
+    base = dict(
+        expression_file=paths["expression"], clinical_file=paths["clinical"],
+        network_file=paths["network"], result_name=out,
+        lenPath=20, numRepetition=4, sizeHiddenlayer=32, epoch=8,
+        numBiomarker=10, seed=11, compute_dtype="float32",
+        walker_backend="native", train_mode="streaming", shard_paths=64)
+    base.update(over)
+    return base
+
+
+def _run(paths, out, **over):
+    from g2vec_tpu.config import G2VecConfig
+    from g2vec_tpu.pipeline import run
+
+    return run(G2VecConfig(**_cfg_dict(paths, out, **over)),
+               console=lambda s: None)
+
+
+def _read_files(result_name):
+    out = {}
+    for suffix in ("_biomarkers.txt", "_lgroups.txt", "_vectors.txt"):
+        with open(result_name + suffix, "rb") as f:
+            out[suffix] = f.read()
+    return out
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _rank_env(port: int, process_id: int, n_ranks: int,
+              extra: dict = None) -> dict:
+    drop = ("PALLAS_AXON", "AXON_", "TPU_", "JAX_", "XLA_", "LIBTPU", "PJRT_")
+    env = {k: v for k, v in os.environ.items() if not k.startswith(drop)}
+    parts = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+             if p and "axon" not in p.lower()]
+    env["PYTHONPATH"] = os.pathsep.join([_REPO] + parts)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["G2VEC_COORDINATOR"] = f"127.0.0.1:{port}"
+    env["G2VEC_PROCESS_ID"] = str(process_id)
+    env["G2VEC_NUM_PROCESSES"] = str(n_ranks)
+    env.update(extra or {})
+    return env
+
+
+def _launch_fleet(tmp_path, cfg_dict, n_ranks, timeout=420, extra_env=None,
+                  tag="edge_cfg"):
+    cfg_path = tmp_path / f"{tag}.json"
+    cfg_path.write_text(json.dumps(cfg_dict))
+    port = _free_port()
+    procs = [subprocess.Popen(
+        [sys.executable, _WORKER, str(cfg_path)],
+        env=_rank_env(port, i, n_ranks, extra_env), cwd=_REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        for i in range(n_ranks)]
+    out = []
+    try:
+        for i, p in enumerate(procs):
+            try:
+                stdout, stderr = p.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                pytest.fail(f"rank {i} timed out after {timeout}s")
+            lines = [ln for ln in stdout.strip().splitlines() if ln]
+            out.append((p.returncode, lines[-1] if lines else None, stderr))
+    finally:
+        for q in procs:                         # a dead sibling must not wedge
+            if q.poll() is None:
+                q.kill()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Config validation
+# ---------------------------------------------------------------------------
+
+def test_edge_partition_config_validation(edge_tsv, tmp_path):
+    from g2vec_tpu.config import G2VecConfig
+
+    def cfg(**over):
+        c = G2VecConfig(**_cfg_dict(edge_tsv, str(tmp_path / "o"), **over))
+        c.validate()
+        return c
+
+    cfg(edge_partition="handoff")              # the valid shapes construct
+    cfg(edge_partition="halo")
+    with pytest.raises(ValueError, match="edge_partition"):
+        cfg(edge_partition="bogus")
+    with pytest.raises(ValueError, match="streaming"):
+        cfg(edge_partition="handoff", train_mode="full")
+    with pytest.raises(ValueError, match="device"):
+        cfg(edge_partition="handoff", walker_backend="device")
+    with pytest.raises(ValueError, match="graph-shards"):
+        cfg(edge_partition="handoff", distributed=True, num_processes=2)
+    with pytest.raises(ValueError, match="checkpoint"):
+        cfg(edge_partition="handoff", checkpoint_dir=str(tmp_path / "ck"))
+
+
+# ---------------------------------------------------------------------------
+# 5. 1-rank pipeline byte identity, under the forbidden-reader pin
+# ---------------------------------------------------------------------------
+
+@needs_native
+def test_single_rank_edge_partition_byte_identical(edge_tsv, tmp_path,
+                                                   monkeypatch):
+    from g2vec_tpu.io.readers import FORBID_FULL_NETWORK_ENV
+
+    ref = _run(edge_tsv, str(tmp_path / "ref"))
+    # The pin: any touch of the unpartitioned reader now RAISES — an
+    # --edge-partition run that completes proves it stayed range-filtered.
+    monkeypatch.setenv(FORBID_FULL_NETWORK_ENV, "1")
+    for mode in ("handoff", "halo"):
+        res = _run(edge_tsv, str(tmp_path / mode), edge_partition=mode)
+        assert _read_files(str(tmp_path / mode)) == _read_files(
+            str(tmp_path / "ref")), f"1-rank {mode} != plain streaming"
+        assert res.acc_val == ref.acc_val
+        assert res.n_paths == ref.n_paths
+
+
+# ---------------------------------------------------------------------------
+# 6. TRUE 2-process fleets: handoff ≡ halo, PR 7 band vs unpartitioned
+# ---------------------------------------------------------------------------
+
+@needs_native
+def test_two_rank_handoff_equals_halo_fleet(edge_tsv, tmp_path):
+    from g2vec_tpu.io.readers import FORBID_FULL_NETWORK_ENV
+
+    ref = _run(edge_tsv, str(tmp_path / "ref"), stream_patience=8)
+    pin = {FORBID_FULL_NETWORK_ENV: "1"}
+    parsed = {}
+    for mode in ("handoff", "halo"):
+        cfg = _cfg_dict(edge_tsv, str(tmp_path / mode),
+                        stream_patience=8, distributed=True,
+                        graph_shards=2, embed_shards=2,
+                        edge_partition=mode, fleet_watchdog_deadline=120.0)
+        results = _launch_fleet(tmp_path, cfg, n_ranks=2, extra_env=pin,
+                                tag=mode)
+        for i, (rc, line, stderr) in enumerate(results):
+            assert rc == 0, f"{mode} rank {i} failed:\n{stderr[-3000:]}"
+        parsed[mode] = json.loads(results[0][1])
+    # The tentpole contract: the two boundary strategies are the SAME
+    # run — byte-identical outputs, not just statistically close.
+    assert _read_files(str(tmp_path / "handoff")) == _read_files(
+        str(tmp_path / "halo"))
+    assert parsed["handoff"]["acc_val"] == pytest.approx(
+        parsed["halo"]["acc_val"])
+    assert parsed["handoff"]["n_paths"] == parsed["halo"]["n_paths"]
+    # And the PR 7 statistical band vs the unpartitioned streaming run.
+    assert abs(parsed["handoff"]["acc_val"] - ref.acc_val) <= 0.20
+    a = set(ref.biomarkers)
+    b = set(parsed["handoff"]["biomarkers"])
+    assert len(a & b) / max(len(a), 1) >= 0.6
+
+
+# ---------------------------------------------------------------------------
+# 7. Fault drills: the survivor NAMES the rank that died at the seam
+# ---------------------------------------------------------------------------
+
+@needs_native
+def test_walk_handoff_sigkill_names_dead_rank(edge_tsv, tmp_path):
+    cfg = _cfg_dict(edge_tsv, str(tmp_path / "out"), distributed=True,
+                    graph_shards=2, embed_shards=2, edge_partition="handoff",
+                    fleet_watchdog_deadline=15.0,
+                    fault_plan="process=1,stage=walk_handoff,kind=sigkill")
+    results = _launch_fleet(tmp_path, cfg, n_ranks=2, timeout=300)
+    assert results[1][0] == -9                  # rank 1 really sigkilled
+    rc0, _, stderr0 = results[0]
+    assert rc0 != 0
+    assert "PeerTimeoutError" in stderr0
+    assert "missing rank(s): [1]" in stderr0
+
+
+@needs_native
+def test_halo_build_sigkill_names_dead_rank(edge_tsv, tmp_path):
+    cfg = _cfg_dict(edge_tsv, str(tmp_path / "out"), distributed=True,
+                    graph_shards=2, embed_shards=2, edge_partition="halo",
+                    fleet_watchdog_deadline=15.0,
+                    fault_plan="process=1,stage=halo_build,kind=sigkill")
+    results = _launch_fleet(tmp_path, cfg, n_ranks=2, timeout=300)
+    assert results[1][0] == -9
+    rc0, _, stderr0 = results[0]
+    assert rc0 != 0
+    assert "PeerTimeoutError" in stderr0
+    assert "missing rank(s): [1]" in stderr0

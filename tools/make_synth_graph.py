@@ -53,11 +53,19 @@ def main(argv=None) -> int:
                         "materializing [S, G] + the edge list (auto at "
                         ">= 200000 nodes; same formats, different rng "
                         "stream layout than the in-memory writer)")
+    p.add_argument("--partitions", type=int, default=0, metavar="R",
+                   help="write the network pre-partitioned into R "
+                        "per-rank shard files + a sha256 manifest "
+                        "(point --edge-partition runs at the manifest "
+                        ".json; implies --stream; concatenating the "
+                        "parts reproduces the unpartitioned file)")
     args = p.parse_args(argv)
     if args.genes < args.attach + 2:
         p.error(f"--genes must be >= attach+2 = {args.attach + 2}")
     if args.good < 2 or args.poor < 2:
         p.error("--good/--poor must be >= 2 (PCC needs 2+ samples/group)")
+    if args.partitions < 0:
+        p.error("--partitions must be >= 0")
 
     from g2vec_tpu.data.synth import (SynthGraphSpec, write_synth_graph,
                                       write_synth_graph_streamed)
@@ -66,9 +74,15 @@ def main(argv=None) -> int:
         n_genes=args.genes, n_good=args.good, n_poor=args.poor,
         attach=args.attach, active_prob=args.active_prob,
         noise=args.noise, shift=args.shift, seed=args.seed)
-    streamed = args.stream or args.genes >= 200_000
-    writer = write_synth_graph_streamed if streamed else write_synth_graph
-    paths = writer(spec, args.out, prefix=args.prefix)
+    streamed = args.stream or args.partitions > 0 or args.genes >= 200_000
+    if args.partitions > 0:
+        paths = write_synth_graph_streamed(spec, args.out,
+                                           prefix=args.prefix,
+                                           partitions=args.partitions)
+    else:
+        writer = (write_synth_graph_streamed if streamed
+                  else write_synth_graph)
+        paths = writer(spec, args.out, prefix=args.prefix)
     print(json.dumps({"spec": vars(args), "streamed": streamed, **paths},
                      indent=1))
     return 0
