@@ -137,6 +137,16 @@ BATCH_AB_EPOCHS = int(os.environ.get("G2VEC_BENCH_BATCH_EPOCHS", "30"))
 BATCH_AB_SCALE = int(os.environ.get("G2VEC_BENCH_BATCH_SCALE", "1"))
 BATCH_AB_ARTIFACT = "BENCH_BATCH_AB.json"
 
+# Scenario-engine A/B (stats/): a bootstrap stability study as ONE
+# lane-amortized --scenario process vs the pre-engine workflow (a fresh
+# process per replicate, each passing its derived seed by hand).
+# Defaults are CPU-safe tiny shapes; tests shrink further via these envs.
+SCN_AB_REPLICATES = int(os.environ.get("G2VEC_BENCH_SCN_REPLICATES", "6"))
+SCN_AB_REPS = int(os.environ.get("G2VEC_BENCH_SCN_REPS", "2"))
+SCN_AB_EPOCHS = int(os.environ.get("G2VEC_BENCH_SCN_EPOCHS", "30"))
+SCN_AB_SCALE = int(os.environ.get("G2VEC_BENCH_SCN_SCALE", "1"))
+SCN_AB_ARTIFACT = "BENCH_SCENARIO_AB.json"
+
 # Resident-service A/B (serve/daemon.py): Poisson job arrivals against the
 # warm daemon vs a fresh process per job at the SAME arrival schedule.
 # Defaults are CPU-safe tiny shapes; the subprocess tests shrink further.
@@ -755,6 +765,17 @@ def _hostonly() -> None:
              "unit": "runs/h", "vs_baseline": None,
              "chip_free_fallback": True,
              "error": f"{type(e).__name__}: {e}"[:400]}), flush=True)
+    # Scenario-engine throughput A/B (runs/hour): live when armed, else
+    # the committed artifact with provenance, else an honest null.
+    try:
+        print(json.dumps({**_scenario_ab_hostonly_line(note),
+                          "chip_free_fallback": True}), flush=True)
+    except Exception as e:  # noqa: BLE001 — headline line must still print
+        print(json.dumps(
+            {"metric": "scenario_runs_per_hour", "value": None,
+             "unit": "runs/h", "vs_baseline": None,
+             "chip_free_fallback": True,
+             "error": f"{type(e).__name__}: {e}"[:400]}), flush=True)
     line = _native_walker_line(
         src, dst, w, n_genes, baseline, note,
         {"baseline_host_walks_per_sec": round(baseline, 2),
@@ -934,6 +955,168 @@ def _batch_ab() -> None:
             json.dump({"line": line, "code_key": _current_code_key(repo),
                        "written_by": "bench.py --_batch_ab"}, f, indent=1)
         note(f"wrote {BATCH_AB_ARTIFACT}")
+
+
+def _scenario_ab_line(note) -> dict:
+    """Scenario-engine throughput A/B — the stats/ subsystem's headline.
+
+    Sequential baseline = the PRE-ENGINE stability study: one fresh
+    ``python -m g2vec_tpu`` process per bootstrap replicate, each handed
+    its resample seed by hand (``--subsample-mode bootstrap
+    --subsample-seed <derived>``) — exactly what a careful user would
+    script today, and exactly the N-runs-cost-Nx shape the scenario
+    engine kills. Scenario side = ONE ``--scenario bootstrap
+    --replicates N`` process: same replicates as shape-bucketed lanes
+    sharing stages 1-2 and compiles, plus the reduction. Both sides
+    min-of-``SCN_AB_REPS``. On-the-spot honesty check: every scenario
+    lane's three output files must be BYTE-IDENTICAL to its sequential
+    solo twin's (the seeds are the same derive_seed tree on both sides).
+
+    Runs with no jax in THIS process (children import it); usable from
+    the --_hostonly child.
+    """
+    import shutil
+    import tempfile
+
+    from g2vec_tpu.data.synthetic import SyntheticSpec, write_synthetic_tsv
+    from g2vec_tpu.stats.plan import derive_seed
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    n, reps, seed_root = SCN_AB_REPLICATES, SCN_AB_REPS, 7
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", "")}
+
+    def child(args, timeout=600):
+        proc = subprocess.run([sys.executable, "-m", "g2vec_tpu"] + args,
+                              capture_output=True, text=True, env=env,
+                              timeout=timeout)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"bench scenario child rc={proc.returncode}: "
+                f"{(proc.stderr or proc.stdout)[-400:]}")
+
+    with tempfile.TemporaryDirectory() as td:
+        spec = SyntheticSpec(
+            n_good=24, n_poor=20, module_size=12 * SCN_AB_SCALE,
+            n_background=24 * SCN_AB_SCALE, n_expr_only=4, n_net_only=4,
+            module_chords=2, background_edges=40 * SCN_AB_SCALE, seed=7)
+        paths = write_synthetic_tsv(spec, td)
+        base = [paths["expression"], paths["clinical"], paths["network"],
+                "RESULT", "-p", "8", "-r", "2", "-s", "16",
+                "-e", str(SCN_AB_EPOCHS), "-l", "0.05", "-n", "5",
+                "--compute-dtype", "float32", "--platform", "cpu",
+                "--seed", "0"]
+
+        def seq_rep(rep: int) -> float:
+            out = os.path.join(td, f"seq{rep}")
+            os.makedirs(out, exist_ok=True)
+            t0 = time.time()
+            for r in range(n):
+                args = list(base)
+                args[3] = os.path.join(out, f"s{r}")
+                child(args + ["--subsample-mode", "bootstrap",
+                              "--patient-subsample", "1.0",
+                              "--subsample-seed",
+                              str(derive_seed(seed_root, r, "bootstrap"))])
+            return time.time() - t0
+
+        def scn_rep(rep: int) -> float:
+            out = os.path.join(td, f"scn{rep}")
+            os.makedirs(out, exist_ok=True)
+            args = list(base)
+            args[3] = os.path.join(out, "m")
+            t0 = time.time()
+            child(args + ["--scenario", "bootstrap", "--replicates",
+                          str(n), "--scenario-seed", str(seed_root)])
+            return time.time() - t0
+
+        seq_walls, scn_walls = [], []
+        for rep in range(reps):
+            seq_walls.append(seq_rep(rep))
+            note(f"scenario A/B rep {rep}: sequential {n} replicates in "
+                 f"{seq_walls[-1]:.1f}s")
+            scn_walls.append(scn_rep(rep))
+            note(f"scenario A/B rep {rep}: one scenario process in "
+                 f"{scn_walls[-1]:.1f}s")
+        # Honesty check on the LAST rep: every scenario lane's files ==
+        # its hand-seeded sequential twin's, byte for byte.
+        identical = True
+        for r in range(n):
+            for suffix in ("biomarkers", "lgroups", "vectors"):
+                fa = os.path.join(td, f"seq{reps - 1}",
+                                  f"s{r}_{suffix}.txt")
+                fb = os.path.join(td, f"scn{reps - 1}",
+                                  f"m.b{r:03d}_{suffix}.txt")
+                with open(fa, "rb") as a, open(fb, "rb") as b:
+                    if a.read() != b.read():
+                        identical = False
+                        note(f"scenario A/B MISMATCH: replicate {r} "
+                             f"{suffix}")
+        stability = os.path.exists(os.path.join(
+            td, f"scn{reps - 1}", "m_stability.txt"))
+        shutil.rmtree(td, ignore_errors=True)
+
+    seq_rph = n / min(seq_walls) * 3600.0
+    scn_rph = n / min(scn_walls) * 3600.0
+    return {
+        "metric": "scenario_runs_per_hour", "value": round(scn_rph, 1),
+        "unit": "runs/h", "vs_baseline": round(scn_rph / seq_rph, 2),
+        "sequential_runs_per_hour": round(seq_rph, 1),
+        "sequential_wall_s": round(min(seq_walls), 2),
+        "scenario_wall_s": round(min(scn_walls), 2),
+        "replicates": n, "reps": reps, "epochs": SCN_AB_EPOCHS,
+        "scale": SCN_AB_SCALE, "bit_identical": identical,
+        "stability_artifact": stability,
+        "sequential_mode": "one fresh process per bootstrap replicate, "
+                           "seeds derived by hand (the pre-engine "
+                           "stability-study workflow)",
+        "note": "--scenario bootstrap: same derive_seed tree both sides; "
+                "lane outputs verified byte-identical to the hand-seeded "
+                "sequential replicates on the spot",
+    }
+
+
+def _scenario_ab_hostonly_line(note) -> dict:
+    """The scenario A/B's appearance in a --_hostonly round: measured
+    live when G2VEC_BENCH_SCN_AB=1 (several minutes of children), else
+    relayed from the committed BENCH_SCENARIO_AB.json artifact (produced
+    by ``bench.py --_scenario_ab``) with provenance, else an explicit
+    honest null naming the arming command."""
+    if os.environ.get("G2VEC_BENCH_SCN_AB") == "1":
+        return _scenario_ab_line(note)
+    art_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            SCN_AB_ARTIFACT)
+    try:
+        with open(art_path) as f:
+            art = json.load(f)
+        line = dict(art["line"])
+        line["from_artifact"] = (
+            f"{SCN_AB_ARTIFACT} (code_key {art.get('code_key')}; rerun "
+            f"'python bench.py --_scenario_ab' to refresh)")
+        return line
+    except (OSError, ValueError, KeyError):
+        return {"metric": "scenario_runs_per_hour", "value": None,
+                "unit": "runs/h", "vs_baseline": None,
+                "error": "no committed BENCH_SCENARIO_AB.json and "
+                         "G2VEC_BENCH_SCN_AB unset; arm with "
+                         "'python bench.py --_scenario_ab'"}
+
+
+def _scenario_ab() -> None:
+    """Standalone mode: measure the scenario A/B and (with
+    G2VEC_BENCH_SCN_WRITE=1) refresh the committed artifact."""
+    def note(msg):
+        print(f"# {msg}", file=sys.stderr, flush=True)
+
+    line = _scenario_ab_line(note)
+    print(json.dumps(line), flush=True)
+    if os.environ.get("G2VEC_BENCH_SCN_WRITE") == "1":
+        repo = os.path.dirname(os.path.abspath(__file__))
+        with open(os.path.join(repo, SCN_AB_ARTIFACT), "w") as f:
+            json.dump({"line": line, "code_key": _current_code_key(repo),
+                       "written_by": "bench.py --_scenario_ab"}, f,
+                      indent=1)
+        note(f"wrote {SCN_AB_ARTIFACT}")
 
 
 #: Child wrapper for the stream A/B: run the CLI in-process and report the
@@ -2896,6 +3079,8 @@ if __name__ == "__main__":
         _hostonly()
     elif "--_batch_ab" in sys.argv:
         _batch_ab()
+    elif "--_scenario_ab" in sys.argv:
+        _scenario_ab()
     elif "--_serve_ab" in sys.argv:
         _serve_ab()
     elif "--_stream_ab" in sys.argv:

@@ -85,6 +85,15 @@ def main(argv=None) -> int:
         from g2vec_tpu.parallel.distributed import initialize
 
         initialize(cfg.coordinator, cfg.process_id, cfg.num_processes)
+    if cfg.scenario:
+        # Statistical scenario engine: --scenario bootstrap|permutation|cv
+        # expands into a seeded replicate manifest, runs it as one lane
+        # batch, and reduces the outputs into <NAME>_stability.txt
+        # (stats/). Validated mutually exclusive with --manifest/--seeds.
+        from g2vec_tpu.stats.run import run_scenario
+
+        run_scenario(cfg)
+        return 0
     if cfg.manifest or cfg.batch_seeds:
         # Batch engine: N manifest lanes as shape-bucketed batched device
         # programs in THIS process (batch/engine.py). Validated

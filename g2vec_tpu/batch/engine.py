@@ -67,7 +67,8 @@ class ManifestError(ValueError):
 #: the engine refuses to guess about.
 _VARIANT_KEYS = ("name", "seed", "train_seed", "kmeans_seed",
                  "learningRate", "epoch", "patient_subsample",
-                 "subsample_seed")
+                 "subsample_seed", "subsample_mode", "cv_folds", "cv_fold",
+                 "permute_seed")
 _NAME_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
 
 
@@ -84,6 +85,10 @@ class LaneVariant:
     epoch: int
     patient_subsample: float
     subsample_seed: int
+    subsample_mode: str = "fraction"
+    cv_folds: int = 0
+    cv_fold: int = 0
+    permute_seed: Optional[int] = None
 
     def fingerprint(self) -> str:
         payload = json.dumps({k: getattr(self, k) for k in _VARIANT_KEYS},
@@ -95,49 +100,93 @@ class LaneVariant:
         fingerprint (utils/metrics.py bind_lane)."""
         return f"{self.index}:{self.fingerprint()}"
 
-    def expr_key(self) -> Optional[Tuple[float, int]]:
+    def expr_key(self) -> Optional[Tuple]:
         """Expression identity: lanes sharing it see byte-identical
-        expression matrices (None = the full un-subsampled data)."""
+        expression matrices (None = the full un-subsampled data).
+
+        ``permute_seed`` is deliberately NOT part of the key: a
+        permutation null shuffles labels for stage-6 scoring only, so
+        every null lane over one cohort shares that cohort's expression
+        — and therefore its graphs and walk products."""
+        if self.subsample_mode == "bootstrap":
+            return ("bootstrap", self.patient_subsample,
+                    self.subsample_seed)
+        if self.subsample_mode == "fold":
+            return ("fold", self.cv_folds, self.cv_fold,
+                    self.subsample_seed)
         if not self.patient_subsample:
             return None
         return (self.patient_subsample, self.subsample_seed)
 
 
-def _variant_from_dict(index: int, obj, cfg: G2VecConfig) -> LaneVariant:
+def _variant_from_dict(index: int, obj, cfg: G2VecConfig,
+                       origin: Optional[str] = None) -> LaneVariant:
+    """Validate one variant object. ``origin`` names WHERE the variant
+    came from when it was generated rather than hand-written — a
+    scenario-expanded replicate reports "manifest variant 3 (scenario
+    ab12cd, replicate 3)", not just its position in a list the user
+    never wrote."""
+    who = f"manifest variant {index}" + (f" ({origin})" if origin else "")
     if not isinstance(obj, dict):
         raise ManifestError(
-            f"manifest variant {index} must be an object, got "
-            f"{type(obj).__name__}")
+            f"{who} must be an object, got {type(obj).__name__}")
     unknown = sorted(set(obj) - set(_VARIANT_KEYS))
     if unknown:
         raise ManifestError(
-            f"manifest variant {index} has unknown key(s) {unknown}; "
+            f"{who} has unknown key(s) {unknown}; "
             f"allowed: {sorted(_VARIANT_KEYS)}")
 
     def _int(k, default, lo=0):
         v = obj.get(k, default)
         if not isinstance(v, int) or isinstance(v, bool) or v < lo:
             raise ManifestError(
-                f"manifest variant {index}: {k!r} must be an int >= {lo}, "
-                f"got {v!r}")
+                f"{who}: {k!r} must be an int >= {lo}, got {v!r}")
         return v
 
     lr = obj.get("learningRate", cfg.learningRate)
     if not isinstance(lr, (int, float)) or isinstance(lr, bool) or lr <= 0:
         raise ManifestError(
-            f"manifest variant {index}: 'learningRate' must be > 0, "
-            f"got {lr!r}")
+            f"{who}: 'learningRate' must be > 0, got {lr!r}")
     sub = obj.get("patient_subsample", cfg.patient_subsample)
     if not isinstance(sub, (int, float)) or isinstance(sub, bool) \
             or not (0.0 <= float(sub) <= 1.0):
         raise ManifestError(
-            f"manifest variant {index}: 'patient_subsample' must be 0 "
+            f"{who}: 'patient_subsample' must be 0 "
             f"(off) or in (0,1], got {sub!r}")
+    mode = obj.get("subsample_mode", cfg.subsample_mode)
+    if mode not in ("fraction", "bootstrap", "fold"):
+        raise ManifestError(
+            f"{who}: 'subsample_mode' must be "
+            f"fraction|bootstrap|fold, got {mode!r}")
+    cv_folds = _int("cv_folds", cfg.cv_folds)
+    cv_fold = _int("cv_fold", cfg.cv_fold)
+    if mode == "fold":
+        if cv_folds < 2:
+            raise ManifestError(
+                f"{who}: subsample_mode 'fold' needs 'cv_folds' >= 2, "
+                f"got {cv_folds}")
+        if cv_fold >= cv_folds:
+            raise ManifestError(
+                f"{who}: 'cv_fold' must be in [0, {cv_folds}), "
+                f"got {cv_fold}")
+        if float(sub):
+            raise ManifestError(
+                f"{who}: subsample_mode 'fold' derives the cohort from "
+                f"the fold partition; 'patient_subsample' must be 0")
+    elif cv_folds or cv_fold:
+        raise ManifestError(
+            f"{who}: 'cv_folds'/'cv_fold' are only meaningful with "
+            f"subsample_mode 'fold'")
+    pseed = obj.get("permute_seed", cfg.permute_seed)
+    if pseed is not None and (not isinstance(pseed, int)
+                              or isinstance(pseed, bool) or pseed < 0):
+        raise ManifestError(
+            f"{who}: 'permute_seed' must be null or an int >= 0, "
+            f"got {pseed!r}")
     name = obj.get("name", f"lane{index}")
     if not isinstance(name, str) or not _NAME_RE.match(name):
         raise ManifestError(
-            f"manifest variant {index}: 'name' must match "
-            f"{_NAME_RE.pattern}, got {name!r}")
+            f"{who}: 'name' must match {_NAME_RE.pattern}, got {name!r}")
     seed = _int("seed", cfg.seed)
     return LaneVariant(
         index=index, name=name, seed=seed,
@@ -148,7 +197,9 @@ def _variant_from_dict(index: int, obj, cfg: G2VecConfig) -> LaneVariant:
         learningRate=float(lr),
         epoch=_int("epoch", cfg.epoch, lo=1),
         patient_subsample=float(sub),
-        subsample_seed=_int("subsample_seed", cfg.subsample_seed))
+        subsample_seed=_int("subsample_seed", cfg.subsample_seed),
+        subsample_mode=mode, cv_folds=cv_folds, cv_fold=cv_fold,
+        permute_seed=pseed)
 
 
 def load_manifest(path: str, cfg: G2VecConfig) -> List[LaneVariant]:
@@ -212,10 +263,27 @@ def lane_config(cfg: G2VecConfig, v: LaneVariant) -> G2VecConfig:
         kmeans_seed=v.kmeans_seed, learningRate=v.learningRate,
         epoch=v.epoch, patient_subsample=v.patient_subsample,
         subsample_seed=v.subsample_seed,
+        subsample_mode=v.subsample_mode, cv_folds=v.cv_folds,
+        cv_fold=v.cv_fold, permute_seed=v.permute_seed,
         result_name=f"{cfg.result_name}.{v.name}",
-        manifest=None, batch_seeds=0, metrics_jsonl=None)
+        manifest=None, batch_seeds=0, metrics_jsonl=None,
+        scenario=None, replicates=0, folds=0)
     lane.validate()
     return lane
+
+
+def _lane_cohort(data, v: LaneVariant):
+    """The variant's patient cohort — the same derivation ``pipeline.run``
+    applies solo at stage 2, so the PR 5 byte-parity contract extends to
+    the bootstrap/fold cohort axes unchanged."""
+    from g2vec_tpu.preprocess import fold_cohort, subsample_patients
+
+    if v.subsample_mode == "bootstrap":
+        return subsample_patients(data, v.patient_subsample or 1.0,
+                                  v.subsample_seed, with_replacement=True)
+    if v.subsample_mode == "fold":
+        return fold_cohort(data, v.cv_folds, v.cv_fold, v.subsample_seed)
+    return subsample_patients(data, v.patient_subsample, v.subsample_seed)
 
 
 @dataclasses.dataclass
@@ -492,7 +560,7 @@ def _execute_lanes(engine: ResidentEngine, cfg: G2VecConfig,
                                       integrate_path_sets)
     from g2vec_tpu.parallel.mesh import make_mesh_context
     from g2vec_tpu.pipeline import PipelineResult, _background_warm
-    from g2vec_tpu.preprocess import subsample_patients
+    from g2vec_tpu.preprocess import permute_labels
     from g2vec_tpu.resilience.faults import fault_point, install_plan
     from g2vec_tpu.train.trainer import (LaneTrainSpec, train_cbow,
                                          train_cbow_lanes,
@@ -564,8 +632,7 @@ def _execute_lanes(engine: ResidentEngine, cfg: G2VecConfig,
         for v in variants:
             ek = v.expr_key()
             if ek not in lane_data:
-                lane_data[ek] = (data if ek is None else subsample_patients(
-                    data, v.patient_subsample, v.subsample_seed))
+                lane_data[ek] = data if ek is None else _lane_cohort(data, v)
 
         walker_backend = resolve_walker_backend(cfg)
         sampler_threads = (resolve_sampler_threads(cfg.sampler_threads)
@@ -829,13 +896,20 @@ def _execute_lanes(engine: ResidentEngine, cfg: G2VecConfig,
         fault_point("biomarkers")
         scores_host = [None] * n_lanes
         with timer.stage("biomarkers"):
+            # Scoring cohorts group on (expression identity, label view):
+            # a permutation-null lane shares the cohort's walks/graphs but
+            # scores against ITS shuffled labels, so it gets its own
+            # t-score group (pipeline.py applies the same view solo).
             by_expr: Dict = {}
             for li, v in enumerate(variants):
-                by_expr.setdefault(v.expr_key(), []).append(li)
-            for ek, lis in by_expr.items():
+                by_expr.setdefault((v.expr_key(), v.permute_seed),
+                                   []).append(li)
+            for (ek, pseed), lis in by_expr.items():
                 ldata = lane_data[ek]
-                expr_good = ldata.expr[ldata.label == 0]
-                expr_poor = ldata.expr[ldata.label == 1]
+                labels = (ldata.label if pseed is None
+                          else permute_labels(ldata.label, pseed))
+                expr_good = ldata.expr[labels == 0]
+                expr_poor = ldata.expr[labels == 1]
                 for lo in range(0, len(lis), cfg.lanes):
                     idx = lis[lo:lo + cfg.lanes]
                     scores = biomarker_scores_lanes(

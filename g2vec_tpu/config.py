@@ -68,6 +68,24 @@ class G2VecConfig:
                                      # patient resamples; this makes one
                                      # resample a first-class run config
     subsample_seed: int = 0          # PRNG seed for --patient-subsample
+    subsample_mode: str = "fraction"  # cohort derivation: "fraction" keeps a
+                                     # seeded stratified subset without
+                                     # replacement; "bootstrap" DRAWS the
+                                     # same count per class WITH replacement
+                                     # (a stability resample — fraction 0
+                                     # means full class size); "fold" trains
+                                     # on every fold except cv_fold of a
+                                     # seeded stratified cv_folds partition
+    cv_folds: int = 0                # stratified partition size for
+                                     # subsample_mode="fold" (0 otherwise)
+    cv_fold: int = 0                 # held-out fold index in [0, cv_folds)
+    permute_seed: Optional[int] = None  # permutation-null draw: shuffle the
+                                     # patient labels with this seed for the
+                                     # stage-6 prognostic scoring ONLY —
+                                     # walks, graphs and training keep the
+                                     # observed labels, so every null
+                                     # replicate shares one walk product
+                                     # (None = off)
     compat_lgroup_tiebreak: bool = False
     compute_dtype: str = "bfloat16"  # matmul dtype on TPU ("float32" for parity tests)
     param_dtype: str = "float32"
@@ -242,6 +260,20 @@ class G2VecConfig:
     lanes: int = 8                   # max lanes batched into one vmapped
                                      # trainer program (a bucket larger than
                                      # this splits into chunks)
+
+    # ---- statistical scenario engine (stats/) ----
+    scenario: Optional[str] = None   # bootstrap|permutation|cv: expand this
+                                     # base config into a seeded replicate
+                                     # manifest, execute it as engine lanes,
+                                     # and reduce the per-replicate outputs
+                                     # into <RESULT_NAME>_stability.txt
+    replicates: int = 0              # replicate count for
+                                     # scenario=bootstrap|permutation
+    folds: int = 0                   # fold count for scenario=cv (K >= 2)
+    scenario_seed: int = 0           # root of the scenario seed-derivation
+                                     # tree (stats/plan.py): every replicate
+                                     # seed is a stable hash of
+                                     # (root, index, role)
 
     # ---- multi-host (parallel/distributed.py) ----
     distributed: bool = False        # join the multi-process JAX runtime
@@ -443,6 +475,77 @@ class G2VecConfig:
             raise ValueError(
                 f"patient_subsample must be 0 (off) or in (0,1], "
                 f"got {self.patient_subsample}")
+        if self.subsample_mode not in ("fraction", "bootstrap", "fold"):
+            raise ValueError(
+                f"subsample_mode must be fraction|bootstrap|fold, "
+                f"got {self.subsample_mode}")
+        if self.subsample_mode == "fold":
+            if self.cv_folds < 2:
+                raise ValueError(
+                    f"--subsample-mode fold needs --cv-folds >= 2, "
+                    f"got {self.cv_folds}")
+            if not (0 <= self.cv_fold < self.cv_folds):
+                raise ValueError(
+                    f"--cv-fold must be in [0, {self.cv_folds}), "
+                    f"got {self.cv_fold}")
+            if self.patient_subsample:
+                raise ValueError(
+                    "--subsample-mode fold derives the cohort from the fold "
+                    "partition; --patient-subsample must be 0")
+        elif self.cv_folds or self.cv_fold:
+            raise ValueError(
+                "--cv-folds/--cv-fold are only meaningful with "
+                "--subsample-mode fold")
+        if self.permute_seed is not None and self.permute_seed < 0:
+            raise ValueError(
+                f"--permute-seed must be >= 0, got {self.permute_seed}")
+        if self.replicates < 0:
+            raise ValueError(
+                f"--replicates must be >= 0, got {self.replicates}")
+        if self.folds < 0:
+            raise ValueError(f"--folds must be >= 0, got {self.folds}")
+        if self.scenario is not None:
+            if self.scenario not in ("bootstrap", "permutation", "cv"):
+                raise ValueError(
+                    f"--scenario must be bootstrap|permutation|cv, "
+                    f"got {self.scenario}")
+            if self.manifest or self.batch_seeds:
+                raise ValueError(
+                    "--scenario IS a generated manifest; it is mutually "
+                    "exclusive with --manifest/--seeds")
+            if self.train_mode != "full":
+                raise ValueError(
+                    "--scenario executes replicates as batched full-mode "
+                    "lanes; --train-mode streaming does not compose")
+            if self.subsample_mode != "fraction" \
+                    or self.permute_seed is not None:
+                raise ValueError(
+                    "--scenario derives the per-replicate cohort/"
+                    "permutation axes itself; leave --subsample-mode/"
+                    "--permute-seed at their defaults")
+            if self.scenario == "cv":
+                if self.folds < 2:
+                    raise ValueError(
+                        f"--scenario cv needs --folds >= 2, "
+                        f"got {self.folds}")
+                if self.replicates:
+                    raise ValueError(
+                        "--scenario cv sizes itself with --folds, not "
+                        "--replicates")
+                if self.patient_subsample:
+                    raise ValueError(
+                        "--scenario cv derives each cohort from the fold "
+                        "partition; --patient-subsample must be 0")
+            else:
+                if self.replicates < 1:
+                    raise ValueError(
+                        f"--scenario {self.scenario} needs "
+                        f"--replicates >= 1, got {self.replicates}")
+                if self.folds:
+                    raise ValueError(
+                        "--folds is only meaningful with --scenario cv")
+        elif self.replicates or self.folds:
+            raise ValueError("--replicates/--folds need --scenario")
         if self.batch_seeds < 0:
             raise ValueError(
                 f"--seeds must be >= 0, got {self.batch_seeds}")
@@ -452,7 +555,7 @@ class G2VecConfig:
             raise ValueError(
                 "--manifest and --seeds are mutually exclusive (a manifest "
                 "already enumerates its variants)")
-        if self.manifest or self.batch_seeds:
+        if self.manifest or self.batch_seeds or self.scenario:
             for flag, name in ((self.distributed, "--distributed"),
                                (self.fleet_size, "--fleet-size"),
                                (self.supervise, "--supervise"),
@@ -460,9 +563,9 @@ class G2VecConfig:
                                (self.resume, "--resume")):
                 if flag:
                     raise ValueError(
-                        f"the batch engine (--manifest/--seeds) does not "
-                        f"compose with {name} yet — run lanes as separate "
-                        f"supervised jobs instead")
+                        f"the batch engine (--manifest/--seeds/--scenario) "
+                        f"does not compose with {name} yet — run lanes as "
+                        f"separate supervised jobs instead")
         if self.fault_plan:
             # Fail at config time with the offending token, not mid-run.
             from g2vec_tpu.resilience.faults import parse_plan
@@ -482,7 +585,8 @@ SERVE_JOB_KEYS = (
     "numBiomarker", "pcc_threshold", "val_fraction", "display_step",
     "n_lgroups", "kmeans_seed", "kmeans_iters", "decision_threshold",
     "score_mix", "seed", "train_seed", "patient_subsample",
-    "subsample_seed", "compat_lgroup_tiebreak", "compute_dtype",
+    "subsample_seed", "subsample_mode", "cv_folds", "cv_fold",
+    "permute_seed", "compat_lgroup_tiebreak", "compute_dtype",
     "param_dtype", "walker_batch", "walker_hbm_budget", "walker_backend",
     "sampler_threads", "fused_eval", "epoch_superstep", "donate_state",
     "use_native_io", "lanes",
@@ -513,6 +617,7 @@ SERVE_JOIN_EXCLUDE = frozenset({
     "result_name", "metrics_jsonl", "manifest", "batch_seeds",
     "seed", "train_seed", "kmeans_seed", "learningRate", "epoch",
     "patient_subsample", "subsample_seed",
+    "subsample_mode", "cv_folds", "cv_fold", "permute_seed",
     "cache_dir", "compilation_cache", "profile_dir", "fault_plan"})
 
 
@@ -609,6 +714,30 @@ def build_parser() -> argparse.ArgumentParser:
                              "--subsample-seed; 0 = off). One patient "
                              "resample as a first-class run config.")
     parser.add_argument("--subsample-seed", type=int, default=0)
+    parser.add_argument("--subsample-mode", type=str, default="fraction",
+                        choices=("fraction", "bootstrap", "fold"),
+                        help="Cohort derivation. 'fraction' (default): keep "
+                             "a seeded stratified --patient-subsample "
+                             "subset without replacement. 'bootstrap': "
+                             "DRAW the same count per label class WITH "
+                             "replacement (a stability resample; fraction "
+                             "0 means full class size). 'fold': train on "
+                             "every fold except --cv-fold of a seeded "
+                             "stratified --cv-folds partition.")
+    parser.add_argument("--cv-folds", type=int, default=0, metavar="K",
+                        help="Stratified partition size for "
+                             "--subsample-mode fold (K >= 2; all folds of "
+                             "one partition share --subsample-seed).")
+    parser.add_argument("--cv-fold", type=int, default=0, metavar="I",
+                        help="Held-out fold index in [0, K) for "
+                             "--subsample-mode fold; the run trains on the "
+                             "other K-1 folds.")
+    parser.add_argument("--permute-seed", type=int, default=None,
+                        help="Permutation-null draw: shuffle patient labels "
+                             "with this seed for the stage-6 prognostic "
+                             "scoring ONLY — walks, graphs and training "
+                             "keep the observed labels, so null replicates "
+                             "share one walk product (default: off).")
     parser.add_argument("--manifest", type=str, default=None, metavar="JSON",
                         help="Batch run manifest: a JSON list of variant "
                              "objects (seed/train_seed/kmeans_seed/"
@@ -628,6 +757,26 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--lanes", type=int, default=8, metavar="B",
                         help="Max lanes batched into one vmapped trainer "
                              "program (default 8); larger buckets split.")
+    parser.add_argument("--scenario", type=str, default=None,
+                        choices=("bootstrap", "permutation", "cv"),
+                        help="Statistical scenario engine (stats/): expand "
+                             "this base config into a seeded replicate "
+                             "manifest — bootstrap patient resamples, "
+                             "label-permutation nulls, or stratified CV "
+                             "folds — execute it as shape-bucketed engine "
+                             "lanes, and reduce the per-replicate outputs "
+                             "into RESULT_NAME_stability.txt.")
+    parser.add_argument("--replicates", type=int, default=0, metavar="N",
+                        help="Replicate count for --scenario "
+                             "bootstrap|permutation.")
+    parser.add_argument("--folds", type=int, default=0, metavar="K",
+                        help="Fold count for --scenario cv (K >= 2; one "
+                             "lane per held-out fold).")
+    parser.add_argument("--scenario-seed", type=int, default=0,
+                        help="Root of the scenario seed-derivation tree; "
+                             "every replicate's seed is a stable hash of "
+                             "(root, index, role), so a scenario rerun is "
+                             "byte-identical end to end.")
     parser.add_argument("--pcc-threshold", type=float, default=0.5)
     parser.add_argument("--val-fraction", type=float, default=0.2)
     parser.add_argument("--compat-lgroup-tiebreak", action="store_true",
@@ -874,9 +1023,17 @@ def config_from_args(argv=None) -> G2VecConfig:
         kmeans_seed=args.kmeans_seed,
         patient_subsample=args.patient_subsample,
         subsample_seed=args.subsample_seed,
+        subsample_mode=args.subsample_mode,
+        cv_folds=args.cv_folds,
+        cv_fold=args.cv_fold,
+        permute_seed=args.permute_seed,
         manifest=args.manifest,
         batch_seeds=args.batch_seeds,
         lanes=args.lanes,
+        scenario=args.scenario,
+        replicates=args.replicates,
+        folds=args.folds,
+        scenario_seed=args.scenario_seed,
         pcc_threshold=args.pcc_threshold,
         val_fraction=args.val_fraction,
         compat_lgroup_tiebreak=args.compat_lgroup_tiebreak,

@@ -7,6 +7,10 @@
   genes in global (sorted-intersection) order.
 - ``<NAME>_vectors.txt`` (ref: G2Vec.py:203-215): header
   ``GeneSymbol\\tV0...V{h-1}`` then ``gene`` + ``\\t%.6f`` per dim for ALL genes.
+- ``<NAME>_stability.txt`` (new — stats/): ``#``-prefixed scenario metadata
+  lines, a ``GeneSymbol\\t<col>...`` header, then one preformatted row per
+  gene in global order (the reducer renders every cell to a string so the
+  artifact is byte-deterministic by construction).
 """
 from __future__ import annotations
 
@@ -93,6 +97,35 @@ def write_vectors_sharded(result_name: str, vectors_local: np.ndarray,
                 for val in vector:
                     fout.write("\t%.6f" % val)
                 fout.write("\n")
+    return path
+
+
+def write_stability(result_name: str, scenario: str,
+                    meta: Sequence, columns: Sequence[str],
+                    genes: Sequence[str],
+                    rows: Sequence[Sequence[str]]) -> str:
+    """The scenario reducer's artifact: ``<NAME>_stability.txt``.
+
+    ``meta`` is an ordered sequence of ``(key, value)`` pairs rendered as
+    ``# key\\tvalue`` lines; ``rows`` holds ONE PREFORMATTED string per
+    cell (the reducer owns number formatting — "%.6f" floats, "na"
+    sentinels), so this writer concatenates bytes and nothing else.
+    """
+    if len(genes) != len(rows):
+        raise ValueError(f"write_stability: {len(genes)} genes vs "
+                         f"{len(rows)} rows")
+    path = result_name + "_stability.txt"
+    with open(path, "w") as fout:
+        fout.write("# g2vec stability v1\tscenario=%s\n" % scenario)
+        for key, value in meta:
+            fout.write("# %s\t%s\n" % (key, value))
+        fout.write("GeneSymbol\t" + "\t".join(columns) + "\n")
+        for gene, row in zip(genes, rows):
+            if len(row) != len(columns):
+                raise ValueError(
+                    f"write_stability: row for {gene!r} has {len(row)} "
+                    f"cells for {len(columns)} columns")
+            fout.write(gene + "\t" + "\t".join(row) + "\n")
     return path
 
 

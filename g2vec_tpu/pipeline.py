@@ -135,7 +135,8 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print,
                                       integrate_path_sets)
     from g2vec_tpu.parallel.mesh import make_mesh_context
     from g2vec_tpu.preprocess import (edges_to_indices, find_common_genes,
-                                      make_gene2idx, match_labels,
+                                      fold_cohort, make_gene2idx,
+                                      match_labels, permute_labels,
                                       restrict_data, restrict_network,
                                       subsample_patients)
     from g2vec_tpu.train.trainer import train_cbow
@@ -263,7 +264,26 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print,
         fleet.note_phase("preprocess")
         with timer.stage("preprocess"):
             data.label = match_labels(clinical, data.sample)
-            if cfg.patient_subsample:
+            if cfg.subsample_mode == "bootstrap":
+                n_before = data.expr.shape[0]
+                data = subsample_patients(data,
+                                          cfg.patient_subsample or 1.0,
+                                          cfg.subsample_seed,
+                                          with_replacement=True)
+                console("    patient bootstrap: drew %d/%d samples with "
+                        "replacement (fraction=%.3f, seed=%d)"
+                        % (data.expr.shape[0], n_before,
+                           cfg.patient_subsample or 1.0,
+                           cfg.subsample_seed))
+            elif cfg.subsample_mode == "fold":
+                n_before = data.expr.shape[0]
+                data = fold_cohort(data, cfg.cv_folds, cfg.cv_fold,
+                                   cfg.subsample_seed)
+                console("    patient folds: training on %d/%d samples "
+                        "(held-out fold %d/%d, seed=%d)"
+                        % (data.expr.shape[0], n_before, cfg.cv_fold,
+                           cfg.cv_folds, cfg.subsample_seed))
+            elif cfg.patient_subsample:
                 n_before = data.expr.shape[0]
                 data = subsample_patients(data, cfg.patient_subsample,
                                           cfg.subsample_seed)
@@ -788,6 +808,13 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print,
             fleet.note_phase("biomarkers")
             with timer.stage("biomarkers"):
                 labels_np = np.asarray(data.label)
+                if cfg.permute_seed is not None:
+                    # Permutation null: shuffled labels for the prognostic
+                    # scoring ONLY — walks/graphs/training above saw the
+                    # observed labels (stats/plan.py seed tree).
+                    labels_np = permute_labels(labels_np, cfg.permute_seed)
+                    console("    permutation null: stage-6 labels shuffled "
+                            "(permute_seed=%d)" % cfg.permute_seed)
                 expr_local = data.expr[:, spec.lo:spec.hi]
                 scores2_local = np.asarray(biomarker_scores_sharded(
                     emb, expr_local[labels_np == 0],
@@ -819,8 +846,17 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print,
             fault_point("biomarkers")
             fleet.note_phase("biomarkers")
             with timer.stage("biomarkers"):
+                scoring_label = data.label
+                if cfg.permute_seed is not None:
+                    # Permutation null: shuffled labels for the prognostic
+                    # scoring ONLY — walks/graphs/training above saw the
+                    # observed labels (stats/plan.py seed tree).
+                    scoring_label = permute_labels(data.label,
+                                                   cfg.permute_seed)
+                    console("    permutation null: stage-6 labels shuffled "
+                            "(permute_seed=%d)" % cfg.permute_seed)
                 biomarkers, _ = select_biomarkers(
-                    emb, data.expr, data.label, data.gene, lgroup_dev,
+                    emb, data.expr, scoring_label, data.gene, lgroup_dev,
                     cfg.numBiomarker, score_mix=cfg.score_mix)
                 lgroup_idx = np.asarray(lgroup_dev)   # writer-boundary copy
             _stage_edge("biomarkers")

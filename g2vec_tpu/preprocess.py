@@ -71,7 +71,7 @@ def restrict_data(data: ExpressionData, common_genes: List[str]) -> ExpressionDa
 
 
 def subsample_patients(data: ExpressionData, fraction: float,
-                       seed: int) -> ExpressionData:
+                       seed: int, with_replacement: bool = False) -> ExpressionData:
     """Keep a stratified, seeded ``fraction`` of patients per label class.
 
     The paper's biomarker validation protocol repeats the pipeline over
@@ -82,6 +82,13 @@ def subsample_patients(data: ExpressionData, fraction: float,
     permutation of the class's positions in file order; the kept rows stay
     in their original relative order, so downstream per-column statistics
     see a pure row subset.
+
+    With ``with_replacement=True`` this becomes a stratified bootstrap
+    resample: the same number of rows is DRAWN with replacement per class,
+    so a patient can appear multiple times (its expression row is
+    duplicated). Draws are re-taken, deterministically, until the class
+    has at least 2 distinct patients. Row order is still ascending file
+    order (duplicates adjacent), keeping the row-subset layout invariants.
     """
     if data.label is None:
         raise ValueError("subsample_patients needs matched labels "
@@ -89,6 +96,29 @@ def subsample_patients(data: ExpressionData, fraction: float,
     if not (0.0 < fraction <= 1.0):
         raise ValueError(f"subsample fraction must be in (0,1], got {fraction}")
     rng = np.random.default_rng(seed)
+    if with_replacement:
+        parts = []
+        for cls in (0, 1):
+            pos = np.nonzero(data.label == cls)[0]
+            if pos.size < 2:
+                raise ValueError(
+                    f"label class {cls} has only {pos.size} patient(s); "
+                    f"cannot subsample")
+            n_draw = min(pos.size, max(2, int(round(fraction * pos.size))))
+            # Same rng consumption order (class 0 then 1). Redraw until the
+            # resample spans >=2 distinct patients (ddof=1 floor); the loop
+            # is deterministic because the rng stream is.
+            draw = rng.choice(pos, size=n_draw, replace=True)
+            while np.unique(draw).size < 2:
+                draw = rng.choice(pos, size=n_draw, replace=True)
+            parts.append(draw)
+        rows = np.sort(np.concatenate(parts))
+        return ExpressionData(
+            sample=data.sample[rows].copy(),
+            gene=data.gene,
+            expr=np.ascontiguousarray(data.expr[rows]),
+            label=data.label[rows].copy(),
+        )
     keep = np.zeros(len(data.label), dtype=bool)
     for cls in (0, 1):
         pos = np.nonzero(data.label == cls)[0]
@@ -106,6 +136,61 @@ def subsample_patients(data: ExpressionData, fraction: float,
         expr=np.ascontiguousarray(data.expr[keep]),
         label=data.label[keep].copy(),
     )
+
+
+def fold_assignments(labels: np.ndarray, n_folds: int, seed: int) -> np.ndarray:
+    """Stratified fold ids, one per patient row: seeded and group-balanced.
+
+    Each label class is permuted independently (one rng, class order 0
+    then 1, mirroring subsample_patients) and dealt round-robin across the
+    folds, so per-class fold sizes differ by at most one. Every class must
+    leave >=2 patients in each training split (the ddof=1 floor) and put
+    >=1 patient in each held-out fold, otherwise a ValueError names the
+    class.
+    """
+    if labels is None:
+        raise ValueError("fold_assignments needs matched labels")
+    if n_folds < 2:
+        raise ValueError(f"n_folds must be >= 2, got {n_folds}")
+    folds = np.full(len(labels), -1, dtype=np.int32)
+    rng = np.random.default_rng(seed)
+    for cls in (0, 1):
+        pos = np.nonzero(labels == cls)[0]
+        if pos.size < n_folds:
+            raise ValueError(
+                f"label class {cls} has {pos.size} patient(s); cannot "
+                f"stratify into {n_folds} folds")
+        max_in_fold = -(-pos.size // n_folds)  # ceil
+        if pos.size - max_in_fold < 2:
+            raise ValueError(
+                f"label class {cls} has {pos.size} patient(s); a "
+                f"{n_folds}-fold training split would drop below 2")
+        order = rng.permutation(pos)
+        folds[order] = np.arange(order.size, dtype=np.int32) % n_folds
+    return folds
+
+
+def fold_cohort(data: ExpressionData, n_folds: int, fold: int,
+                seed: int) -> ExpressionData:
+    """Training cohort for one CV fold: every patient NOT in ``fold``.
+
+    All folds of a scenario share one ``fold_assignments`` partition (same
+    seed), so the k cohorts are complements of disjoint held-out sets.
+    """
+    if not (0 <= fold < n_folds):
+        raise ValueError(f"fold must be in [0, {n_folds}), got {fold}")
+    keep = fold_assignments(data.label, n_folds, seed) != fold
+    return ExpressionData(
+        sample=data.sample[keep].copy(),
+        gene=data.gene,
+        expr=np.ascontiguousarray(data.expr[keep]),
+        label=data.label[keep].copy(),
+    )
+
+
+def permute_labels(labels: np.ndarray, seed: int) -> np.ndarray:
+    """Seeded label shuffle (a permutation-null draw); input untouched."""
+    return np.random.default_rng(seed).permutation(labels)
 
 
 def make_gene2idx(genes: np.ndarray) -> Dict[str, int]:

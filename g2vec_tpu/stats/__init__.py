@@ -1,0 +1,29 @@
+"""Statistical scenario engine — one biomarker run into a defensible study.
+
+The paper's claim is a gene RANKING from one run; its evidential weight
+is how stable that ranking is under patient resampling, how it compares
+to a label-shuffled null, and how well it prognoses held-out patients.
+This package turns those three protocols into first-class runs:
+
+- ``plan.py``    — a :class:`ScenarioPlan` expands ``--scenario
+  bootstrap|permutation|cv`` into a seeded variant manifest (the seed
+  derivation tree makes every replicate a pure function of
+  ``--scenario-seed``);
+- ``run.py``     — executes the manifest as shape-bucketed lanes on the
+  resident batch engine (batch/engine.py), so replicates amortize
+  stages 1-2, walk products, and compiles exactly like any manifest;
+- ``serve.py``   — or submits one serve job per replicate with a
+  deterministic idempotency key each, so a long scenario survives
+  daemon SIGKILL/drain/replica failover with exactly-once accounting;
+- ``reduce.py``  — pure-numpy reducers folding per-replicate outputs
+  into ``<NAME>_stability.txt`` (io/writers.write_stability).
+
+Every sampled replicate is byte-identical to its solo twin run
+(``lane_config`` + the PR 5 parity contract), and a permutation scenario
+walks each (cohort, group) product exactly once — null lanes differ only
+in the stage-6 label view, so they all share one walk product through
+the SharedWalkTier.
+"""
+from g2vec_tpu.stats.plan import (ScenarioPlan, derive_seed,  # noqa: F401
+                                  expand_plan, plan_from_config,
+                                  scenario_id, scenario_variants)

@@ -1,0 +1,150 @@
+"""Scenario planning: ``--scenario`` → a seeded, deterministic manifest.
+
+A :class:`ScenarioPlan` is the tiny declarative core of the engine: the
+protocol name plus its size and a root seed. Everything downstream — per
+replicate subsample seeds, permutation draws, the fold partition — is
+derived from ``scenario_seed`` through one hash tree (:func:`derive_seed`),
+so a scenario is a pure function of its plan: rerunning with the same
+plan and inputs reproduces every replicate byte for byte, and any single
+replicate can be reproduced solo by copying its variant dict into a
+one-entry manifest (the solo-twin contract tested in test_scenario.py).
+
+Expansion targets the existing manifest schema (batch/engine.py
+``_variant_from_dict``): a scenario IS a generated manifest, which is why
+``--scenario`` is mutually exclusive with ``--manifest``/``--seeds`` and
+why both the lane path (stats/run.py) and the serve path (stats/serve.py)
+can execute the same variants unchanged.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from dataclasses import dataclass
+from typing import Dict, List, Tuple
+
+from g2vec_tpu.config import G2VecConfig
+
+# Config axes that change the numeric content of a replicate's outputs.
+# scenario_id hashes these plus the plan plus the input file BASENAMES —
+# never result_name or directories, so a rerun into a different output
+# directory keeps the same id and a byte-identical stability artifact.
+_ID_FIELDS = ("lenPath", "numRepetition", "sizeHiddenlayer", "epoch",
+              "learningRate", "numBiomarker", "pcc_threshold", "score_mix",
+              "seed", "train_seed", "kmeans_seed", "patient_subsample",
+              "subsample_seed", "compute_dtype", "walker_backend")
+
+
+@dataclass(frozen=True)
+class ScenarioPlan:
+    scenario: str        # "bootstrap" | "permutation" | "cv"
+    replicates: int = 0  # bootstrap/permutation replicate count
+    folds: int = 0       # cv fold count
+    scenario_seed: int = 0
+
+    @property
+    def n_variants(self) -> int:
+        if self.scenario == "bootstrap":
+            return self.replicates
+        if self.scenario == "permutation":
+            return self.replicates + 1  # + the observed lane
+        return self.folds
+
+
+def plan_from_config(cfg: G2VecConfig) -> ScenarioPlan:
+    if not cfg.scenario:
+        raise ValueError("plan_from_config: config has no --scenario")
+    return ScenarioPlan(scenario=cfg.scenario, replicates=cfg.replicates,
+                        folds=cfg.folds, scenario_seed=cfg.scenario_seed)
+
+
+def derive_seed(scenario_seed: int, index: int, role: str) -> int:
+    """One node of the scenario seed tree: a stable 31-bit seed per
+    (root, role, index). SHA-256 so adjacent indices are uncorrelated
+    and the tree is identical across platforms/processes."""
+    digest = hashlib.sha256(
+        f"g2vec-scenario:{scenario_seed}:{role}:{index}".encode()).digest()
+    return int.from_bytes(digest[:4], "big") & 0x7FFFFFFF
+
+
+def scenario_id(plan: ScenarioPlan, cfg: G2VecConfig) -> str:
+    """12-hex fingerprint naming this scenario in artifacts, metrics
+    events, and serve idempotency keys (``scn-<id>-<replicate>`` — the
+    key that makes daemon-restart resubmission dedup to exactly-once)."""
+    payload = {
+        "scenario": plan.scenario,
+        "replicates": plan.replicates,
+        "folds": plan.folds,
+        "scenario_seed": plan.scenario_seed,
+        "inputs": [os.path.basename(cfg.expression_file),
+                   os.path.basename(cfg.clinical_file),
+                   os.path.basename(cfg.network_file)],
+        "config": {k: getattr(cfg, k) for k in _ID_FIELDS},
+    }
+    blob = json.dumps(payload, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]
+
+
+def expand_plan(plan: ScenarioPlan, cfg: G2VecConfig
+                ) -> List[Tuple[Dict, str]]:
+    """Expand the plan into (variant-dict, origin) pairs in manifest
+    order. Variant dicts use the engine's manifest schema verbatim;
+    ``origin`` is the human name threaded into validation errors
+    (satellite: errors must name the scenario and replicate).
+    """
+    out: List[Tuple[Dict, str]] = []
+    if plan.scenario == "bootstrap":
+        if plan.replicates < 1:
+            raise ValueError("bootstrap scenario needs --replicates >= 1")
+        frac = cfg.patient_subsample or 1.0
+        for r in range(plan.replicates):
+            out.append(({"name": "b%03d" % r,
+                         "subsample_mode": "bootstrap",
+                         "patient_subsample": frac,
+                         "subsample_seed": derive_seed(
+                             plan.scenario_seed, r, "bootstrap")},
+                        "replicate %d" % r))
+    elif plan.scenario == "permutation":
+        if plan.replicates < 1:
+            raise ValueError("permutation scenario needs --replicates >= 1")
+        # Lane 0 is the OBSERVED run: same cohort, unshuffled labels. The
+        # nulls differ from it only in permute_seed, which is deliberately
+        # outside expr_key() — all R+1 lanes share one walk product, so a
+        # cold engine walks each (cohort, group) exactly once.
+        out.append(({"name": "obs"}, "observed"))
+        for r in range(plan.replicates):
+            out.append(({"name": "p%03d" % r,
+                         "permute_seed": derive_seed(
+                             plan.scenario_seed, r, "permutation")},
+                        "replicate %d" % r))
+    elif plan.scenario == "cv":
+        if plan.folds < 2:
+            raise ValueError("cv scenario needs --folds >= 2")
+        # One shared stratified partition; fold k's lane trains on the
+        # complement of fold k. All folds share the partition seed so the
+        # union of held-out sets covers every patient exactly once.
+        part_seed = derive_seed(plan.scenario_seed, 0, "folds")
+        for k in range(plan.folds):
+            out.append(({"name": "f%02d" % k,
+                         "subsample_mode": "fold",
+                         "cv_folds": plan.folds,
+                         "cv_fold": k,
+                         "subsample_seed": part_seed},
+                        "fold %d" % k))
+    else:
+        raise ValueError(f"unknown scenario {plan.scenario!r}")
+    return out
+
+
+def scenario_variants(plan: ScenarioPlan, cfg: G2VecConfig):
+    """Expand and validate through the engine's own manifest validator,
+    so scenario-generated variants obey exactly the constraints a
+    hand-written manifest would — with errors that name their origin."""
+    from g2vec_tpu.batch.engine import _variant_from_dict
+
+    sid = scenario_id(plan, cfg)
+    variants = []
+    for i, (obj, origin) in enumerate(expand_plan(plan, cfg)):
+        variants.append(_variant_from_dict(
+            i, obj, cfg, origin=f"scenario {sid}, {origin}"))
+    return sid, variants

@@ -1,0 +1,145 @@
+"""Scenario execution over a serve fleet: one job per replicate,
+one idempotency key each.
+
+A long scenario on the lane path dies with its process. Submitted
+through serve, every replicate is a separate durable job whose id is
+``idem_job_id("scn-<scenario_id>-<name>")`` — deterministic, so after a
+daemon SIGKILL, a drain, or replica failover the client simply
+resubmits: replicates that already ran dedup to their existing result
+record (exactly-once), replicates in flight resume from their
+checkpoints, and the final stability artifact is byte-identical to the
+lane-path run of the same plan (both paths share reduce_scenario and
+the solo-parity contract).
+
+This module is pure client + reducer: the daemon needs no scenario
+concept. Replicate variants ride the existing manifest schema inside
+each job dict, and the reducer reads biomarker lists back from the
+``variants`` map of the durable result records.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from g2vec_tpu.config import G2VecConfig, config_from_job
+from g2vec_tpu.stats.plan import (expand_plan, plan_from_config,
+                                  scenario_variants)
+from g2vec_tpu.stats.run import ScenarioResult, write_scenario_artifact
+from g2vec_tpu.utils.metrics import MetricsWriter
+
+
+def _load_reduction_dataset(cfg: G2VecConfig):
+    """The preprocessed full-cohort dataset, mirrored step for step from
+    ResidentEngine.dataset — the reducer runs client-side, possibly on a
+    machine that is not a serve replica, so it loads its own copy."""
+    from g2vec_tpu.io.readers import (load_clinical, load_expression,
+                                      load_network)
+    from g2vec_tpu.preprocess import (find_common_genes, match_labels,
+                                      restrict_data)
+
+    data = load_expression(cfg.expression_file,
+                           use_native=cfg.use_native_io)
+    clinical = load_clinical(cfg.clinical_file)
+    network = load_network(cfg.network_file)
+    data.label = match_labels(clinical, data.sample)
+    common = find_common_genes(network.genes, data.gene)
+    return restrict_data(data, common)
+
+
+def _read_biomarkers(path: str) -> List[str]:
+    with open(path) as f:
+        lines = [ln.rstrip("\n") for ln in f]
+    if not lines or lines[0] != "GeneSymbol":
+        raise ValueError(f"{path}: not a biomarkers file")
+    return [ln for ln in lines[1:] if ln]
+
+
+def run_scenario_serve(socket_path: str, base_job: dict, *,
+                       scenario: str, replicates: int = 0, folds: int = 0,
+                       scenario_seed: int = 0, state_dir: str,
+                       tenant: str = "default",
+                       timeout: Optional[float] = 10.0,
+                       poll_deadline_s: float = 300.0, retries: int = 3,
+                       priority: Optional[str] = None,
+                       deadline_s: Optional[float] = None,
+                       auth_token: Optional[str] = None,
+                       metrics_jsonl: Optional[str] = None,
+                       console: Callable[[str], None] = print
+                       ) -> ScenarioResult:
+    """Run a scenario as per-replicate serve jobs and reduce locally.
+
+    ``base_job`` is an ordinary serve job dict (SERVE_JOB_KEYS only —
+    the scenario axes are passed explicitly and expanded client-side).
+    Submission is sequential and restart-safe: each replicate's
+    idempotency key is a pure function of the scenario id and replicate
+    name, so calling this function again after any failure re-converges
+    on the same jobs and the same artifact.
+    """
+    from g2vec_tpu.serve import client
+
+    import dataclasses as _dc
+
+    cfg = config_from_job(dict(base_job))
+    cfg = _dc.replace(cfg, scenario=scenario, replicates=replicates,
+                      folds=folds, scenario_seed=scenario_seed)
+    cfg.validate()
+    plan = plan_from_config(cfg)
+    # Validate the full expansion up front through the engine's manifest
+    # validator (errors name "scenario <id>, replicate <i>") before any
+    # job reaches the fleet.
+    sid, variants = scenario_variants(plan, cfg)
+    metrics = MetricsWriter(metrics_jsonl)
+    try:
+        ev = {"scenario": plan.scenario, "scenario_id": sid,
+              "scenario_seed": plan.scenario_seed,
+              "n_variants": len(variants), "via": "serve"}
+        if plan.scenario == "cv":
+            ev["folds"] = plan.folds
+        else:
+            ev["replicates"] = plan.replicates
+        metrics.emit("scenario", **ev)
+        console(f"scenario {plan.scenario} ({sid}): {len(variants)} "
+                f"replicate jobs via {socket_path}")
+        lists_by_name: Dict[str, List[str]] = {}
+        for i, (obj, origin) in enumerate(expand_plan(plan, cfg)):
+            name = obj["name"]
+            job = dict(base_job)
+            job.pop("seeds", None)
+            job["variants"] = [obj]
+            idem = f"scn-{sid}-{name}"
+            try:
+                rec = client.submit_and_wait(
+                    socket_path, job, tenant=tenant, state_dir=state_dir,
+                    timeout=timeout, poll_deadline_s=poll_deadline_s,
+                    retries=retries, priority=priority,
+                    deadline_s=deadline_s, idem_key=idem,
+                    auth_token=auth_token)
+            except Exception as exc:
+                raise RuntimeError(
+                    f"scenario {sid}, {origin}: {exc}") from exc
+            if rec.get("status") != "done":
+                raise RuntimeError(
+                    f"scenario {sid}, {origin}: job {rec.get('job_id')} "
+                    f"ended with {rec.get('event')}")
+            vrec = rec["variants"][name]
+            bio_paths = [p for p in vrec["outputs"]
+                         if p.endswith("_biomarkers.txt")]
+            if len(bio_paths) != 1:
+                raise RuntimeError(
+                    f"scenario {sid}, {origin}: expected one biomarkers "
+                    f"output, got {vrec['outputs']}")
+            lists_by_name[name] = _read_biomarkers(bio_paths[0])
+            metrics.emit("replicate", name=name, index=i,
+                         n_selected=len(set(lists_by_name[name])),
+                         acc_val=float(vrec.get("acc_val") or 0.0))
+            console(f"scenario {sid}: {origin} done "
+                    f"({len(lists_by_name[name])} biomarker lines)")
+        data = _load_reduction_dataset(cfg)
+        path, columns, extras = write_scenario_artifact(
+            plan, sid, cfg, data, variants, lists_by_name, metrics)
+        console(f"scenario {sid}: wrote {path}")
+        return ScenarioResult(scenario=plan.scenario, scenario_id=sid,
+                              output=path, columns=columns,
+                              n_variants=len(variants), extras=extras,
+                              walk_stats={})
+    finally:
+        metrics.close()

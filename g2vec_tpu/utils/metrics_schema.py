@@ -146,6 +146,9 @@ EVENT_SCHEMAS = {
     'replica_relaunched': {
         "required": ['replica'],
         "optional": []},
+    'replicate': {
+        "required": ['acc_val', 'index', 'n_selected', 'name'],
+        "optional": []},
     'resume': {
         "required": ['attempt', 'checkpoint_dir'],
         "optional": []},
@@ -158,6 +161,9 @@ EVENT_SCHEMAS = {
     'router_stop': {
         "required": ['failovers', 'jobs_routed'],
         "optional": []},
+    'scenario': {
+        "required": ['n_variants', 'scenario', 'scenario_id', 'scenario_seed', 'via'],
+        "optional": ['folds', 'replicates']},
     'scheduler_error': {
         "required": ['error'],
         "optional": []},
@@ -173,6 +179,9 @@ EVENT_SCHEMAS = {
     'serve_supervised_done': {
         "required": ['attempts'],
         "optional": []},
+    'stability': {
+        "required": ['n_genes', 'output', 'scenario_id'],
+        "optional": ['acc_mean', 'ci_hi', 'ci_lo', 'columns', 'n_replicates']},
     'straggler_warning': {
         "required": ['factor', 'median_seconds', 'rank', 'seconds', 'stage'],
         "optional": []},

@@ -66,6 +66,105 @@ def test_match_labels_ok_and_missing():
         match_labels({"S1": 0}, np.array(["S1", "S3"]))
 
 
+def _labeled(n_good=10, n_poor=8):
+    n = n_good + n_poor
+    rng = np.random.default_rng(0)
+    data = ExpressionData(
+        sample=np.array([f"S{i:02d}" for i in range(n)]),
+        gene=np.array(["A", "B", "C"]),
+        expr=rng.normal(size=(n, 3)).astype(np.float32),
+    )
+    data.label = np.array([0] * n_good + [1] * n_poor)
+    return data
+
+
+def test_bootstrap_resample_deterministic_and_stratified():
+    from g2vec_tpu.preprocess import subsample_patients
+
+    data = _labeled()
+    a = subsample_patients(data, 1.0, seed=3, with_replacement=True)
+    b = subsample_patients(data, 1.0, seed=3, with_replacement=True)
+    np.testing.assert_array_equal(a.sample, b.sample)
+    np.testing.assert_array_equal(a.expr, b.expr)
+    # Stratified: per-class draw counts equal the class sizes at f=1.0.
+    assert (a.label == 0).sum() == 10 and (a.label == 1).sum() == 8
+    # With replacement: some patient must repeat at full fraction
+    # (P(no repeat) is vanishingly small), and rows stay sorted by
+    # original position so duplicates are adjacent row copies.
+    assert len(set(a.sample)) < len(a.sample)
+    order = np.argsort(
+        [int(s[1:]) for s in a.sample], kind="stable")
+    np.testing.assert_array_equal(order, np.arange(len(a.sample)))
+    c = subsample_patients(data, 1.0, seed=4, with_replacement=True)
+    assert list(c.sample) != list(a.sample)
+
+
+def test_bootstrap_resample_keeps_two_distinct_per_class():
+    from g2vec_tpu.preprocess import subsample_patients
+
+    data = _labeled(n_good=2, n_poor=2)
+    # Any seed: the redraw loop guarantees >=2 distinct patients per
+    # class even when a 2-row class would often draw one patient twice.
+    for seed in range(20):
+        r = subsample_patients(data, 1.0, seed, with_replacement=True)
+        for cls in (0, 1):
+            assert len(set(r.sample[r.label == cls])) >= 2, seed
+
+
+def test_fold_assignments_partition_and_stratification():
+    from g2vec_tpu.preprocess import fold_assignments
+
+    data = _labeled(n_good=10, n_poor=8)
+    folds = fold_assignments(data.label, 3, seed=5)
+    # A partition: every patient lands in exactly one fold.
+    assert folds.min() == 0 and folds.max() == 2
+    # Stratified: per-class fold sizes differ by at most one.
+    for cls in (0, 1):
+        sizes = [((folds == k) & (data.label == cls)).sum()
+                 for k in range(3)]
+        assert max(sizes) - min(sizes) <= 1
+    np.testing.assert_array_equal(
+        folds, fold_assignments(data.label, 3, seed=5))
+    assert list(folds) != list(fold_assignments(data.label, 3, seed=6))
+
+
+def test_fold_assignments_rejects_thin_classes():
+    from g2vec_tpu.preprocess import fold_assignments
+
+    data = _labeled(n_good=10, n_poor=2)
+    with pytest.raises(ValueError, match="class 1"):
+        fold_assignments(data.label, 3, seed=0)
+    with pytest.raises(ValueError, match="n_folds"):
+        fold_assignments(data.label, 1, seed=0)
+
+
+def test_fold_cohort_is_complement_row_subset():
+    from g2vec_tpu.preprocess import fold_assignments, fold_cohort
+
+    data = _labeled()
+    folds = fold_assignments(data.label, 3, seed=5)
+    for k in range(3):
+        cohort = fold_cohort(data, 3, k, seed=5)
+        want = data.sample[folds != k]
+        np.testing.assert_array_equal(cohort.sample, want)
+        np.testing.assert_array_equal(cohort.expr,
+                                      data.expr[folds != k])
+    with pytest.raises(ValueError, match="fold"):
+        fold_cohort(data, 3, 3, seed=5)
+
+
+def test_permute_labels_seeded_and_pure():
+    from g2vec_tpu.preprocess import permute_labels
+
+    data = _labeled()
+    before = data.label.copy()
+    a = permute_labels(data.label, 7)
+    np.testing.assert_array_equal(data.label, before)  # input untouched
+    np.testing.assert_array_equal(a, permute_labels(data.label, 7))
+    assert sorted(a) == sorted(before)
+    assert list(a) != list(before)
+
+
 def test_synthetic_dataset_shapes(small_dataset, small_spec):
     expression, clinical, network, membership = small_dataset
     common = find_common_genes(network.genes, expression.gene)

@@ -1,0 +1,457 @@
+"""Scenario engine (stats/): reducers against hand-built inputs, plan
+determinism, replicate-vs-solo byte parity, permutation walk accounting,
+CV fold invariants, and the serve-path chaos drill.
+
+The scenario contract extends the PR 5 parity contract one level up:
+``--scenario`` is a generated manifest, so every sampled replicate must
+be byte-identical to its solo twin, and the reduced stability artifact
+must be a deterministic function of (plan, inputs) alone — rerunning the
+same plan into a different directory reproduces it byte for byte, on
+the lane path and the serve path alike."""
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from g2vec_tpu.config import G2VecConfig
+
+pytestmark = pytest.mark.scenario
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# Reduction layer: hand-built replicate outputs with known answers
+# ---------------------------------------------------------------------------
+
+def test_selection_stats_known_frequencies_and_ranks():
+    from g2vec_tpu.stats.reduce import selection_stats
+
+    genes = ["A", "B", "C", "D"]
+    reps = [["A", "B"], ["A", "C"], ["B", "A"]]
+    s = selection_stats(genes, reps)
+    np.testing.assert_array_equal(s["n_sel"], [3, 2, 1, 0])
+    np.testing.assert_allclose(s["sel_freq"], [1.0, 2 / 3, 1 / 3, 0.0])
+    # A's ranks: 1, 1, 2 -> mean 4/3, var ddof=0 = 2/9.
+    assert s["mean_rank"][0] == pytest.approx(4 / 3)
+    assert s["rank_var"][0] == pytest.approx(2 / 9)
+    # D never selected: na sentinels downstream.
+    assert np.isnan(s["mean_rank"][3]) and np.isnan(s["rank_var"][3])
+
+
+def test_selection_stats_duplicate_lines_count_once():
+    """A gene can top BOTH L-group blocks of a biomarker file; the first
+    line fixes its rank and the duplicate adds nothing."""
+    from g2vec_tpu.stats.reduce import selection_stats
+
+    s = selection_stats(["A", "B"], [["A", "B", "A"]])
+    np.testing.assert_array_equal(s["n_sel"], [1, 1])
+    assert s["mean_rank"][0] == 1.0 and s["mean_rank"][1] == 2.0
+    with pytest.raises(ValueError, match="unknown gene"):
+        selection_stats(["A"], [["A", "Z"]])
+
+
+def test_perm_pvalues_add_one_allties_and_zero_variance():
+    from g2vec_tpu.stats.reduce import perm_pvalues
+
+    # Zero-variance gene: t = 0 observed AND in every null — all ties,
+    # p must be exactly 1, never 0.
+    p = perm_pvalues(np.array([0.0]), np.zeros((4, 1)))
+    np.testing.assert_allclose(p, [1.0])
+    # Add-one estimator: 1 of 2 nulls >= observed -> (1+1)/(1+2).
+    p = perm_pvalues(np.array([2.0]), np.array([[1.0], [3.0]]))
+    np.testing.assert_allclose(p, [2 / 3])
+    # A never-beaten gene still gets the 1/(1+R) floor.
+    p = perm_pvalues(np.array([9.0]), np.array([[1.0], [3.0]]))
+    np.testing.assert_allclose(p, [1 / 3])
+
+
+def test_bh_fdr_known_values_and_cap():
+    from g2vec_tpu.stats.reduce import bh_fdr
+
+    q = bh_fdr(np.array([0.005, 0.009, 0.05, 0.5]))
+    # p*m/rank = [.02, .018, .0667, .5]; reversed running min fixes the
+    # non-monotone head.
+    np.testing.assert_allclose(q, [0.018, 0.018, 0.2 / 3, 0.5])
+    np.testing.assert_allclose(bh_fdr(np.array([1.0, 1.0])), [1.0, 1.0])
+
+
+def test_np_tscores_matches_device_op():
+    from g2vec_tpu.ops.stats import tscores
+    from g2vec_tpu.stats.reduce import np_tscores
+
+    rng = np.random.default_rng(1)
+    good = rng.normal(size=(9, 6)).astype(np.float32)
+    poor = rng.normal(loc=0.5, size=(7, 6)).astype(np.float32)
+    np.testing.assert_allclose(np_tscores(good, poor),
+                               np.asarray(tscores(good, poor)),
+                               rtol=1e-4, atol=1e-5)
+    # Exact-zero pooled variance is well-defined in the float64 host
+    # twin: the guarded branch emits 0 (and perm p-values become 1).
+    good[:, 2] = 3.0
+    poor[:, 2] = 3.0
+    assert np_tscores(good, poor)[2] == 0.0
+
+
+def test_percentile_ci_and_centroid_accuracy():
+    from g2vec_tpu.stats.reduce import centroid_accuracy, percentile_ci
+
+    lo, hi = percentile_ci([0.5, 0.6, 0.7, 0.8, 0.9])
+    assert lo == pytest.approx(np.percentile(
+        [0.5, 0.6, 0.7, 0.8, 0.9], 2.5))
+    assert hi == pytest.approx(np.percentile(
+        [0.5, 0.6, 0.7, 0.8, 0.9], 97.5))
+    train_x = np.array([[0.0], [0.0], [2.0], [2.0]])
+    train_y = np.array([0, 0, 1, 1])
+    # Separable test points + one EXACT tie (x=1): ties predict class 0.
+    acc = centroid_accuracy(train_x, train_y,
+                            np.array([[0.1], [1.9], [1.0]]),
+                            np.array([0, 1, 0]))
+    assert acc == 1.0
+    with pytest.raises(ValueError, match="lost a class"):
+        centroid_accuracy(train_x, np.zeros(4, dtype=int),
+                          train_x, train_y)
+
+
+def test_reduce_cv_extras_carry_ci():
+    from g2vec_tpu.stats.reduce import reduce_cv
+
+    cols, rows, extras = reduce_cv(["A", "B"], [["A"], ["A", "B"]],
+                                   [0.5, 0.9])
+    assert cols == ["sel_freq", "n_sel", "mean_rank", "rank_var"]
+    assert rows[0][0] == "1.000000" and rows[1][0] == "0.500000"
+    assert extras["acc_mean"] == pytest.approx(0.7)
+    assert extras["ci_lo"] <= 0.7 <= extras["ci_hi"]
+    assert extras["fold_acc"] == ["0.500000", "0.900000"]
+
+
+# ---------------------------------------------------------------------------
+# Planning: seed tree, scenario id, origin-named validation errors
+# ---------------------------------------------------------------------------
+
+def _plan_cfg(**overrides):
+    defaults = dict(expression_file="E.tsv", clinical_file="C.tsv",
+                    network_file="N.tsv", result_name="out")
+    defaults.update(overrides)
+    return G2VecConfig(**defaults)
+
+
+def test_expand_plan_deterministic_and_seed_tree_distinct():
+    from g2vec_tpu.stats.plan import ScenarioPlan, derive_seed, expand_plan
+
+    cfg = _plan_cfg()
+    plan = ScenarioPlan("bootstrap", replicates=4, scenario_seed=9)
+    a, b = expand_plan(plan, cfg), expand_plan(plan, cfg)
+    assert a == b
+    seeds = [obj["subsample_seed"] for obj, _ in a]
+    assert len(set(seeds)) == 4
+    # Roles are separate branches of the tree: a permutation replicate
+    # never reuses a bootstrap replicate's seed.
+    assert derive_seed(9, 0, "bootstrap") != derive_seed(9, 0, "permutation")
+    assert derive_seed(9, 0, "bootstrap") != derive_seed(10, 0, "bootstrap")
+    # Permutation: lane 0 is the observed run with NO permute_seed.
+    pplan = ScenarioPlan("permutation", replicates=2, scenario_seed=9)
+    objs = expand_plan(pplan, cfg)
+    assert objs[0] == ({"name": "obs"}, "observed")
+    assert all("permute_seed" in o for o, _ in objs[1:])
+    # CV: all folds share ONE partition seed.
+    cplan = ScenarioPlan("cv", folds=3, scenario_seed=9)
+    cobjs = expand_plan(cplan, cfg)
+    assert len({o["subsample_seed"] for o, _ in cobjs}) == 1
+    assert [o["cv_fold"] for o, _ in cobjs] == [0, 1, 2]
+
+
+def test_scenario_id_ignores_output_paths_not_inputs():
+    from g2vec_tpu.stats.plan import ScenarioPlan, scenario_id
+
+    plan = ScenarioPlan("bootstrap", replicates=3, scenario_seed=1)
+    base = scenario_id(plan, _plan_cfg())
+    # Output location and input DIRECTORIES are not identity: a rerun
+    # elsewhere must produce the same id (and artifact bytes).
+    assert scenario_id(plan, _plan_cfg(
+        result_name="/tmp/other/out",
+        expression_file="/data/elsewhere/E.tsv")) == base
+    assert scenario_id(plan, _plan_cfg(expression_file="E2.tsv")) != base
+    assert scenario_id(plan, _plan_cfg(seed=5)) != base
+    assert scenario_id(
+        ScenarioPlan("bootstrap", replicates=3, scenario_seed=2),
+        _plan_cfg()) != base
+
+
+def test_scenario_validation_errors_name_scenario_and_replicate():
+    """Satellite: a scenario-expanded variant failing manifest validation
+    must say which scenario and which replicate — not just 'variant 3'."""
+    from g2vec_tpu.batch.engine import ManifestError
+    from g2vec_tpu.stats.plan import (ScenarioPlan, scenario_id,
+                                      scenario_variants)
+
+    cfg = _plan_cfg(patient_subsample=1.5)  # invalid fraction
+    plan = ScenarioPlan("bootstrap", replicates=2, scenario_seed=0)
+    sid = scenario_id(plan, cfg)
+    with pytest.raises(ManifestError) as ei:
+        scenario_variants(plan, cfg)
+    msg = str(ei.value)
+    assert f"scenario {sid}" in msg and "replicate 0" in msg
+    # Hand-written manifests keep their plain origin.
+    from g2vec_tpu.batch.engine import _variant_from_dict
+    with pytest.raises(ManifestError, match=r"manifest variant 0:"):
+        _variant_from_dict(0, {"subsample_mode": "bogus"}, _plan_cfg())
+
+
+def test_config_gates_scenario_flags():
+    cfg = _plan_cfg(scenario="bootstrap")
+    with pytest.raises(ValueError, match="--replicates"):
+        cfg.validate()
+    with pytest.raises(ValueError, match="--scenario"):
+        _plan_cfg(replicates=3).validate()
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        _plan_cfg(scenario="bootstrap", replicates=2,
+                  batch_seeds=4).validate()
+    with pytest.raises(ValueError, match="--folds"):
+        _plan_cfg(scenario="cv").validate()
+    _plan_cfg(scenario="cv", folds=3).validate()
+    _plan_cfg(scenario="permutation", replicates=5).validate()
+
+
+# ---------------------------------------------------------------------------
+# End-to-end scenarios on the lane substrate
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tsv_paths(tmp_path_factory):
+    from g2vec_tpu.data.synthetic import SyntheticSpec, write_synthetic_tsv
+
+    spec = SyntheticSpec(n_good=24, n_poor=20, module_size=12,
+                         n_background=24, n_expr_only=4, n_net_only=4,
+                         module_chords=2, background_edges=40, seed=7)
+    out = tmp_path_factory.mktemp("syn")
+    return write_synthetic_tsv(spec, str(out))
+
+
+def _cfg(tsv_paths, tmp_path, sub, **overrides):
+    os.makedirs(os.path.join(str(tmp_path), sub), exist_ok=True)
+    defaults = dict(
+        expression_file=tsv_paths["expression"],
+        clinical_file=tsv_paths["clinical"],
+        network_file=tsv_paths["network"],
+        result_name=os.path.join(str(tmp_path), sub, "out"),
+        lenPath=8, numRepetition=2, sizeHiddenlayer=16, epoch=30,
+        learningRate=0.05, numBiomarker=5, compute_dtype="float32",
+        kmeans_iters=50, seed=0, walker_backend="device",
+    )
+    defaults.update(overrides)
+    return G2VecConfig(**defaults)
+
+
+def test_bootstrap_scenario_deterministic_and_solo_twin_parity(
+        tsv_paths, tmp_path):
+    """The two headline guarantees in one run: rerunning the same plan
+    into a different directory reproduces the stability artifact byte
+    for byte, and a sampled replicate is byte-identical to its solo
+    twin (pipeline.run over lane_config of the expanded variant)."""
+    from g2vec_tpu.batch.engine import lane_config
+    from g2vec_tpu.pipeline import run as solo_run
+    from g2vec_tpu.stats.run import run_scenario
+
+    kw = dict(scenario="bootstrap", replicates=3, scenario_seed=11)
+    cfg_a = _cfg(tsv_paths, tmp_path, "a", **kw)
+    res_a = run_scenario(cfg_a, console=lambda s: None)
+    cfg_b = _cfg(tsv_paths, tmp_path, "b", **kw)
+    res_b = run_scenario(cfg_b, console=lambda s: None)
+    with open(res_a.output, "rb") as fa, open(res_b.output, "rb") as fb:
+        assert fa.read() == fb.read()
+    assert res_a.scenario_id == res_b.scenario_id
+
+    # Solo twin of replicate b001: same variant, fresh process-state run.
+    from g2vec_tpu.stats.plan import plan_from_config, scenario_variants
+    _, variants = scenario_variants(plan_from_config(cfg_a), cfg_a)
+    v = variants[1]
+    solo_cfg = lane_config(_cfg(tsv_paths, tmp_path, "solo", **kw), v)
+    sr = solo_run(solo_cfg, console=lambda s: None)
+    for suffix in ("_biomarkers.txt", "_lgroups.txt", "_vectors.txt"):
+        lane_file = cfg_a.result_name + ".b001" + suffix
+        twin = [p for p in sr.output_files if p.endswith(suffix)][0]
+        with open(lane_file, "rb") as a, open(twin, "rb") as b:
+            assert a.read() == b.read(), f"{lane_file} differs from twin"
+
+    # The resamples differ: replicate selections are not all identical.
+    head = open(res_a.output).readline()
+    assert head == "# g2vec stability v1\tscenario=bootstrap\n"
+
+
+def test_permutation_scenario_walks_each_group_exactly_once(
+        tsv_paths, tmp_path):
+    """Acceptance: permutation lanes differ only at stage-6 labels, so a
+    COLD engine samples exactly the 2 (cohort, group) walk products and
+    every null lane shares them — asserted from walk-tier accounting."""
+    from g2vec_tpu.stats.run import run_scenario
+
+    cfg = _cfg(tsv_paths, tmp_path, "perm", scenario="permutation",
+               replicates=2, scenario_seed=5,
+               metrics_jsonl=os.path.join(str(tmp_path), "perm.jsonl"))
+    res = run_scenario(cfg, console=lambda s: None)
+    assert res.n_variants == 3  # obs + 2 nulls
+    assert res.walk_stats["walked"] == 2
+    assert res.walk_stats["lane_shared"] == 4  # 3 lanes * 2 - 2
+    lines = open(res.output).read().splitlines()
+    assert lines[0].endswith("scenario=permutation")
+    header = lines[[i for i, ln in enumerate(lines)
+                    if ln.startswith("GeneSymbol")][0]]
+    assert header.split("\t")[1:] == ["t_obs", "p_value", "q_value",
+                                      "selected_obs"]
+    # p-values live in (0, 1]; the add-one floor for R=2 is 1/3.
+    rows = [ln.split("\t") for ln in lines if not ln.startswith(("#",
+                                                                 "Gene"))]
+    ps = np.array([float(r[2]) for r in rows])
+    # cells are "%.6f"-rendered, so allow formatting granularity
+    assert ps.min() >= 1 / 3 - 1e-6 and ps.max() <= 1.0
+    # Metrics stream: one scenario event, one replicate event per lane,
+    # one stability event.
+    evs = [json.loads(ln) for ln in open(cfg.metrics_jsonl)]
+    kinds = [e["event"] for e in evs]
+    assert kinds.count("scenario") == 1
+    assert kinds.count("replicate") == 3
+    assert kinds.count("stability") == 1
+    scn = evs[kinds.index("scenario")]
+    assert scn["via"] == "lanes" and scn["n_variants"] == 3
+
+
+def test_cv_scenario_artifact_and_fold_invariants(tsv_paths, tmp_path):
+    from g2vec_tpu.preprocess import fold_assignments
+    from g2vec_tpu.stats.plan import derive_seed
+    from g2vec_tpu.stats.run import run_scenario
+
+    cfg = _cfg(tsv_paths, tmp_path, "cv", scenario="cv", folds=3,
+               scenario_seed=5)
+    res = run_scenario(cfg, console=lambda s: None)
+    assert res.n_variants == 3
+    assert 0.0 <= res.extras["ci_lo"] <= res.extras["acc_mean"] \
+        <= res.extras["ci_hi"] <= 1.0
+    lines = open(res.output).read().splitlines()
+    meta = dict(ln[2:].split("\t") for ln in lines
+                if ln.startswith("# ") and "\t" in ln[2:])
+    assert meta["folds"] == "3"
+    accs = [float(x) for x in meta["fold_acc"].split(",")]
+    assert len(accs) == 3
+    assert np.mean(accs) == pytest.approx(float(meta["acc_mean"]),
+                                          abs=1e-6)
+    # The partition the reducer scored against covers every patient
+    # exactly once and is reproducible from the plan's seed tree.
+    from g2vec_tpu.io.readers import load_clinical, load_expression
+    from g2vec_tpu.preprocess import match_labels
+    data = load_expression(cfg.expression_file)
+    labels = match_labels(load_clinical(cfg.clinical_file), data.sample)
+    folds = fold_assignments(labels, 3, derive_seed(5, 0, "folds"))
+    assert (folds >= 0).all() and set(folds) == {0, 1, 2}
+
+
+def test_cli_scenario_dispatch(tsv_paths, tmp_path):
+    """python -m g2vec_tpu EXPR CLIN NET NAME --scenario ... writes the
+    stability artifact (the __main__ branch, through the real parser)."""
+    out = os.path.join(str(tmp_path), "cli", "out")
+    os.makedirs(os.path.dirname(out))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": REPO + os.pathsep + os.environ.get(
+               "PYTHONPATH", "")}
+    proc = subprocess.run(
+        [sys.executable, "-m", "g2vec_tpu", tsv_paths["expression"],
+         tsv_paths["clinical"], tsv_paths["network"], out, "-p", "8",
+         "-r", "2", "-s", "16", "-e", "10", "-l", "0.05", "-n", "5",
+         "--compute-dtype", "float32", "--platform", "cpu",
+         "--walker-backend", "device", "--scenario", "bootstrap",
+         "--replicates", "2", "--scenario-seed", "3"],
+        capture_output=True, text=True, env=env, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-800:]
+    assert os.path.exists(out + "_stability.txt")
+    assert "scenario bootstrap" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# Serve path: exactly-once replicates across a daemon SIGKILL
+# ---------------------------------------------------------------------------
+
+def _spawn_daemon(tmp_path, extra=()):
+    sock = os.path.join(str(tmp_path), "g.sock")
+    state = os.path.join(str(tmp_path), "state")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": REPO + os.pathsep + os.environ.get(
+               "PYTHONPATH", "")}
+    log = open(os.path.join(str(tmp_path), "daemon.log"), "w")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "g2vec_tpu", "serve", "--socket", sock,
+         "--state-dir", state, "--platform", "cpu",
+         "--cache-dir", os.path.join(str(tmp_path), "cache"), *extra],
+        env=env, stdout=log, stderr=subprocess.STDOUT)
+    return proc, sock, state
+
+
+def test_serve_scenario_survives_sigkill_exactly_once(tsv_paths, tmp_path):
+    """Chaos acceptance: a scenario submitted as serve jobs rides out a
+    mid-scenario daemon SIGKILL — every replicate accounted exactly once
+    (one durable result record each, resubmission dedups), and the final
+    artifact is byte-identical to the lane-path run of the same plan."""
+    from g2vec_tpu.serve import client
+    from g2vec_tpu.stats.run import run_scenario
+    from g2vec_tpu.stats.serve import run_scenario_serve
+
+    proc, sock, state = _spawn_daemon(
+        tmp_path, extra=("--supervise", "--supervise-backoff", "0.1",
+                         "--fault-plan", "stage=train,kind=sigkill"))
+    try:
+        assert client.wait_ready(sock, 120), "daemon never became ready"
+        os.makedirs(os.path.join(str(tmp_path), "srv"))
+        base_job = dict(
+            expression_file=tsv_paths["expression"],
+            clinical_file=tsv_paths["clinical"],
+            network_file=tsv_paths["network"],
+            result_name=os.path.join(str(tmp_path), "srv", "out"),
+            lenPath=8, numRepetition=2, sizeHiddenlayer=16, epoch=10,
+            learningRate=0.05, numBiomarker=5, compute_dtype="float32",
+            walker_backend="device")
+        kw = dict(scenario="bootstrap", replicates=2, scenario_seed=11,
+                  state_dir=state, timeout=300, poll_deadline_s=240,
+                  console=lambda s: None)
+        res = run_scenario_serve(sock, base_job, **kw)
+        assert os.path.exists(res.output)
+        # Exactly-once: one durable result record per replicate, each
+        # carrying the scenario idempotency key.
+        recs = []
+        for fn in sorted(os.listdir(os.path.join(state, "results"))):
+            with open(os.path.join(state, "results", fn)) as f:
+                recs.append(json.load(f))
+        assert len(recs) == 2
+        assert sorted(r["idem_key"] for r in recs) == [
+            f"scn-{res.scenario_id}-b000", f"scn-{res.scenario_id}-b001"]
+        assert all(r["status"] == "done" for r in recs)
+
+        # Resubmitting the whole scenario dedups: same records, same
+        # artifact bytes, no third result file.
+        art1 = open(res.output, "rb").read()
+        res2 = run_scenario_serve(sock, base_job, **kw)
+        assert open(res2.output, "rb").read() == art1
+        assert len(os.listdir(os.path.join(state, "results"))) == 2
+
+        # Byte parity with the lane path: same plan, local engine.
+        lane_cfg = G2VecConfig(**{
+            **base_job,
+            "result_name": os.path.join(str(tmp_path), "lane", "out")},
+            scenario="bootstrap", replicates=2, scenario_seed=11)
+        os.makedirs(os.path.join(str(tmp_path), "lane"))
+        lres = run_scenario(lane_cfg, console=lambda s: None)
+        assert open(lres.output, "rb").read() == art1
+
+        client.shutdown(sock)
+        assert proc.wait(timeout=60) == 0
+    finally:
+        if proc.poll() is None:
+            try:
+                os.kill(proc.pid, signal.SIGTERM)
+            except OSError:
+                pass
+            proc.kill()
+            proc.wait()
