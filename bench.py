@@ -190,6 +190,22 @@ ROUTER_CHAOS_BUDGET = float(os.environ.get("G2VEC_BENCH_ROUTER_BUDGET",
                                            "1200"))
 ROUTER_CHAOS_ARTIFACT = "BENCH_ROUTER_CHAOS.json"
 
+# Interactive query plane (serve/inventory.py + ops/knn.py): seeded
+# Poisson query load against a replicated fleet, concurrent with
+# training jobs, one replica SIGKILLed mid-run. Cold = first touch of a
+# freshly published bundle (mmap + manifest sha); warm = everything
+# after. Acceptance: warm p99 under QUERY_P99_MS for both neighbors and
+# topk_biomarkers, zero query errors, and a kernel-vs-disk exactness
+# spot check. Env-shrinkable.
+QUERY_JOBS = int(os.environ.get("G2VEC_BENCH_QUERY_JOBS", "6"))
+QUERY_BG_JOBS = int(os.environ.get("G2VEC_BENCH_QUERY_BG_JOBS", "3"))
+QUERY_REPLICAS = int(os.environ.get("G2VEC_BENCH_QUERY_REPLICAS", "3"))
+QUERY_SEED = int(os.environ.get("G2VEC_BENCH_QUERY_SEED", "0"))
+QUERY_RATE = float(os.environ.get("G2VEC_BENCH_QUERY_RATE", "40"))
+QUERY_DURATION = float(os.environ.get("G2VEC_BENCH_QUERY_DURATION", "25"))
+QUERY_P99_MS = float(os.environ.get("G2VEC_BENCH_QUERY_P99_MS", "10"))
+QUERY_ARTIFACT = "BENCH_QUERY.json"
+
 # Million-node shard-scale sweep (parallel/shard.py + train/shard.py):
 # "genes:ranks" cells, run as real multi-process fleets of
 # tests/shard_worker.py over the KV transport. The diagonal (constant
@@ -1717,6 +1733,293 @@ def _router_chaos() -> None:
         sys.exit(1)
 
 
+def _query_latency_line(note) -> dict:
+    """Interactive query plane under realistic duress — the PR 15 proof.
+
+    One router fronting QUERY_REPLICAS daemon replicas. Warmup jobs
+    (distinct trainer shapes, so the join-key ring spreads them) publish
+    one bundle each; then a seeded Poisson stream of neighbors /
+    topk_biomarkers / meta queries runs for QUERY_DURATION seconds WHILE
+    background training jobs occupy the fleet, and one bundle-owning
+    replica is SIGKILLed mid-window — queries against its bundles must
+    keep answering from the router's shared-disk read path. Cold
+    latency (first touch: mmap + manifest sha256) is measured per
+    bundle before the storm; the exactness spot check recomputes one
+    neighbors answer from the bundle bytes with ops/knn in THIS process
+    and demands float-exact agreement.
+
+    No jax in this process: the fleet children import it; the local
+    recompute is numpy-only by the query plane's design.
+    """
+    import random
+    import shutil
+    import signal
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from g2vec_tpu.data.synthetic import SyntheticSpec, write_synthetic_tsv
+    from g2vec_tpu.ops import knn
+    from g2vec_tpu.serve import client as sclient
+    from g2vec_tpu.serve import protocol
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", "")}
+    rng = random.Random(QUERY_SEED)
+
+    def _pct(xs, q):
+        s = sorted(xs)
+        return round(s[min(len(s) - 1, int(round(q * (len(s) - 1))))], 3)
+
+    wd = tempfile.mkdtemp(prefix="g2v-query-")
+    fleet = os.path.join(wd, "fleet")
+    router_log = os.path.join(wd, "router.log")
+    proc = None
+    try:
+        spec = SyntheticSpec(n_good=24, n_poor=20, module_size=12,
+                             n_background=24, n_expr_only=4, n_net_only=4,
+                             module_chords=2, background_edges=40, seed=7)
+        paths = write_synthetic_tsv(spec, wd)
+
+        argv = [sys.executable, "-m", "g2vec_tpu", "serve",
+                "--replicas", str(QUERY_REPLICAS),
+                "--listen", "127.0.0.1:0", "--state-dir", fleet,
+                "--platform", "cpu",
+                "--cache-dir", os.path.join(wd, "cache"),
+                "--queue-depth", "64", "--max-join", "6",
+                "--probe-interval", "0.4", "--probe-deadline", "3.0",
+                "--metrics-jsonl", os.path.join(wd, "router-metrics.jsonl")]
+        log = open(router_log, "a")
+        proc = subprocess.Popen(argv, env=env, stdout=log,
+                                stderr=subprocess.STDOUT)
+        log.close()
+        addr_file = os.path.join(fleet, "router_addr")
+        deadline = time.time() + 600
+        addr = None
+        while time.time() < deadline:
+            if os.path.exists(addr_file):
+                with open(addr_file) as f:
+                    addr = f.read().strip()
+                if addr:
+                    break
+            if proc.poll() is not None:
+                raise RuntimeError(f"router died during boot (rc="
+                                   f"{proc.returncode}; log: {router_log})")
+            time.sleep(0.2)
+        if not addr:
+            raise RuntimeError(f"router never bound (log: {router_log})")
+        note(f"router up at {addr} ({QUERY_REPLICAS} replicas)")
+
+        def job(name, hidden, epochs=30):
+            return {"expression_file": paths["expression"],
+                    "clinical_file": paths["clinical"],
+                    "network_file": paths["network"],
+                    "result_name": os.path.join(wd, "out", name),
+                    "lenPath": 8, "numRepetition": 2,
+                    "sizeHiddenlayer": hidden, "epoch": epochs,
+                    "learningRate": 0.05, "numBiomarker": 5,
+                    "compute_dtype": "float32",
+                    "walker_backend": "device"}
+
+        os.makedirs(os.path.join(wd, "out"), exist_ok=True)
+        # Warmup: distinct trainer shapes so the join-key ring spreads
+        # the bundles over the fleet instead of batching them together.
+        hiddens = [16, 24, 32, 20, 28, 36, 40, 48, 12, 44][:QUERY_JOBS]
+        job_ids = [None] * len(hiddens)
+
+        def run_warm(i):
+            evs = list(sclient.submit_job(
+                addr, job(f"w{i}", hiddens[i]), timeout=900.0))
+            jid = next((e.get("job_id") for e in evs
+                        if e.get("event") == "accepted"), None)
+            if any(e.get("event") == "job_done" for e in evs):
+                job_ids[i] = jid
+
+        t_warm = time.time()
+        threads = [threading.Thread(target=run_warm, args=(i,))
+                   for i in range(len(hiddens))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=900)
+        bundles = {}          # job_id -> (replica, bundle_dir, genes)
+        for jid in job_ids:
+            if jid is None:
+                continue
+            for i in range(QUERY_REPLICAS):
+                d = os.path.join(fleet, f"r{i}", "state", "inventory",
+                                 jid, "v")
+                if os.path.isdir(d):
+                    with open(os.path.join(d, "genes.txt")) as f:
+                        genes = [ln.rstrip("\n") for ln in f]
+                    bundles[jid] = (f"r{i}", d, genes)
+        note(f"warmup: {len(bundles)}/{len(hiddens)} bundles published "
+             f"in {time.time() - t_warm:.1f}s on "
+             f"{sorted({v[0] for v in bundles.values()})}")
+        if not bundles:
+            raise RuntimeError("no bundles published — nothing to query")
+
+        # Background training load for the whole query window.
+        def run_bg(i):
+            try:
+                for _ in sclient.submit_job(
+                        addr, job(f"bg{i}", 16 + 4 * i, epochs=300),
+                        timeout=900.0):
+                    pass
+            except (OSError, sclient.ServeConnectionLost,
+                    sclient.ServeTimeout):
+                pass
+        bg = [threading.Thread(target=run_bg, args=(i,), daemon=True)
+              for i in range(QUERY_BG_JOBS)]
+        for t in bg:
+            t.start()
+
+        def one_query(**kw):
+            t0 = time.time()
+            resp = sclient.query(addr, timeout=30.0, **kw)
+            return (time.time() - t0) * 1e3, resp
+
+        jids = sorted(bundles)
+        cold = []
+        for jid in jids:
+            ms, resp = one_query(q="neighbors", job_id=jid,
+                                 gene=bundles[jid][2][0], k=10)
+            if resp.get("event") != "query_result":
+                raise RuntimeError(f"cold query failed: {resp}")
+            cold.append(ms)
+        note(f"cold first-touch: p50 {_pct(cold, 0.5)}ms "
+             f"max {max(cold):.1f}ms over {len(cold)} bundles")
+
+        # The seeded Poisson storm, with a mid-window replica SIGKILL.
+        victim = bundles[jids[0]][0]
+        st = sclient.status(addr, timeout=10.0)
+        victim_pid = (st.get("replicas") or {}).get(victim, {}).get("pid")
+        kill_at = time.time() + QUERY_DURATION * 0.4
+        killed = False
+        warm = {"neighbors": [], "topk_biomarkers": [], "meta": []}
+        router_local = []
+        errors = []
+        end = time.time() + QUERY_DURATION
+        while time.time() < end:
+            if not killed and time.time() >= kill_at and victim_pid:
+                note(f"SIGKILL replica {victim} (pid {victim_pid}) "
+                     f"mid-window")
+                try:
+                    os.kill(victim_pid, signal.SIGKILL)
+                except OSError:
+                    pass
+                killed = True
+            jid = rng.choice(jids)
+            genes = bundles[jid][2]
+            op = rng.choice(("neighbors", "neighbors", "topk_biomarkers",
+                             "meta"))
+            kw = {"q": op, "job_id": jid}
+            if op == "neighbors":
+                kw.update(gene=rng.choice(genes), k=rng.randint(5, 50))
+            elif op == "topk_biomarkers":
+                kw.update(k=rng.randint(5, 20))
+            try:
+                ms, resp = one_query(**kw)
+            except (OSError, protocol.ProtocolError) as e:
+                errors.append(f"{type(e).__name__}: {e}"[:120])
+                continue
+            if resp.get("event") != "query_result":
+                errors.append(str(resp)[:120])
+                continue
+            warm[op].append(ms)
+            if resp.get("served_by") == "router":
+                router_local.append(ms)
+            time.sleep(rng.expovariate(QUERY_RATE))
+
+        # Exactness spot check: recompute one answer from the bundle
+        # bytes in THIS process; the served result must be float-exact.
+        jid = jids[-1]
+        _, bdir, genes = bundles[jid]
+        emb = np.load(os.path.join(bdir, "embeddings.npy"))
+        norms = np.load(os.path.join(bdir, "norms.npy"))
+        gi = rng.randrange(len(genes))
+        _, resp = one_query(q="neighbors", job_id=jid, gene=genes[gi],
+                            k=7)
+        idx, sims = knn.cosine_topk(emb, norms, emb[gi], 7, exclude=gi)
+        exact = (resp.get("neighbors") == [genes[i] for i in idx]
+                 and resp.get("sims") == [float(s) for s in sims])
+        note(f"exactness spot check: {'ok' if exact else 'MISMATCH'}")
+
+        n_warm = sum(len(v) for v in warm.values())
+        nb_p99 = _pct(warm["neighbors"], 0.99) if warm["neighbors"] else None
+        tk_p99 = (_pct(warm["topk_biomarkers"], 0.99)
+                  if warm["topk_biomarkers"] else None)
+        ok = (exact and not errors and killed and bool(router_local)
+              and nb_p99 is not None and nb_p99 < QUERY_P99_MS
+              and tk_p99 is not None and tk_p99 < QUERY_P99_MS)
+        return {
+            "metric": "query_warm_neighbors_p99_ms", "value": nb_p99,
+            "unit": "ms", "ok": ok,
+            "replicas": QUERY_REPLICAS, "bundles": len(bundles),
+            "queries_warm": n_warm, "query_errors": len(errors),
+            "errors_sample": errors[:5],
+            "cold_p50_ms": _pct(cold, 0.5),
+            "cold_p99_ms": _pct(cold, 0.99),
+            "warm_neighbors_p50_ms": _pct(warm["neighbors"], 0.5)
+            if warm["neighbors"] else None,
+            "warm_neighbors_p99_ms": nb_p99,
+            "warm_topk_p50_ms": _pct(warm["topk_biomarkers"], 0.5)
+            if warm["topk_biomarkers"] else None,
+            "warm_topk_p99_ms": tk_p99,
+            "warm_meta_p50_ms": _pct(warm["meta"], 0.5)
+            if warm["meta"] else None,
+            "router_local_queries": len(router_local),
+            "router_local_p99_ms": _pct(router_local, 0.99)
+            if router_local else None,
+            "replica_killed": victim if killed else None,
+            "bg_training_jobs": QUERY_BG_JOBS,
+            "exactness_ok": exact, "p99_budget_ms": QUERY_P99_MS,
+            "seed": QUERY_SEED, "rate_hz": QUERY_RATE,
+            "duration_s": QUERY_DURATION,
+            "note": "seeded Poisson neighbors/topk_biomarkers/meta load "
+                    "vs a replicated fleet under concurrent training; "
+                    "one bundle-owning replica SIGKILLed mid-window "
+                    "(router_local_* = queries answered from the "
+                    "router's shared-disk failover read path); cold = "
+                    "first touch paying mmap + manifest sha256",
+        }
+    finally:
+        if proc is not None and proc.poll() is None:
+            try:
+                from g2vec_tpu.serve import client as sclient2
+
+                with open(os.path.join(fleet, "router_addr")) as f:
+                    sclient2.shutdown(f.read().strip(), timeout=15.0)
+            except Exception:
+                pass
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        shutil.rmtree(wd, ignore_errors=True)
+
+
+def _query_latency() -> None:
+    """Standalone mode: run the query-plane latency proof and (with
+    G2VEC_BENCH_QUERY_WRITE=1) refresh the committed artifact."""
+    def note(msg):
+        print(f"# {msg}", file=sys.stderr, flush=True)
+
+    line = _query_latency_line(note)
+    print(json.dumps(line), flush=True)
+    if os.environ.get("G2VEC_BENCH_QUERY_WRITE") == "1":
+        repo = os.path.dirname(os.path.abspath(__file__))
+        with open(os.path.join(repo, QUERY_ARTIFACT), "w") as f:
+            json.dump({"line": line, "code_key": _current_code_key(repo),
+                       "written_by": "bench.py --_query_latency"}, f,
+                      indent=1)
+        note(f"wrote {QUERY_ARTIFACT}")
+    if not line["ok"]:
+        sys.exit(1)
+
+
 def _shard_scale_line(note) -> dict:
     """Million-node shard-scale sweep — ROADMAP item 2's headline.
 
@@ -3087,6 +3390,8 @@ if __name__ == "__main__":
         _stream_ab()
     elif "--_router_chaos" in sys.argv:
         _router_chaos()
+    elif "--_query_latency" in sys.argv:
+        _query_latency()
     elif "--_chaos_soak" in sys.argv:
         _chaos_soak()
     elif "--_shard_scale" in sys.argv:

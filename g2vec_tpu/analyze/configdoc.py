@@ -10,16 +10,19 @@ Three registries drift independently today:
   which config fields a submitted job may set. A key that is not a
   real dataclass field is accepted-then-ignored — the worst kind of
   API lie.
-- **Serve payload keys vs protocol.SUBMIT_KEYS.** daemon.py/router.py
-  read submit-payload keys by string; the jax-free protocol module
-  owns the envelope vocabulary. A payload key read in the daemon but
-  absent from the whitelist is either a typo or an undocumented
-  protocol extension.
+- **Serve payload keys vs the protocol vocabularies.** daemon.py/
+  router.py read request-envelope keys by string; the jax-free
+  protocol module owns the vocabularies. Each envelope has a
+  conventional variable name, and every key read through that name is
+  linted against its tuple: ``payload`` → ``SUBMIT_KEYS``, ``qreq``
+  (query requests) → ``QUERY_KEYS``, ``rreq`` (result requests) →
+  ``RESULT_KEYS``. A key read in the daemon but absent from its
+  whitelist is either a typo or an undocumented protocol extension.
 
 Everything is AST + text: flags from ``add_argument`` literals, fields
 from the dataclass's annotated assignments, payload keys from
-``payload["k"]`` / ``payload.get("k")`` subscripts on the conventional
-``payload`` name.
+``<name>["k"]`` / ``<name>.get("k")`` subscripts on the conventional
+envelope names above.
 """
 from __future__ import annotations
 
@@ -35,6 +38,11 @@ PROTOCOL_FILE = "g2vec_tpu/serve/protocol.py"
 README = "README.md"
 _PAYLOAD_FILES = ("g2vec_tpu/serve/daemon.py",
                   "g2vec_tpu/serve/router.py")
+#: Conventional envelope variable name -> the protocol tuple its key
+#: reads are linted against.
+_ENVELOPES = {"payload": "SUBMIT_KEYS",
+              "qreq": "QUERY_KEYS",
+              "rreq": "RESULT_KEYS"}
 
 
 def _tuple_of_str(tree: ast.Module, name: str) -> Optional[Set[str]]:
@@ -123,33 +131,41 @@ class ConfigDocChecker(Checker):
         proto = ctx.file(PROTOCOL_FILE)
         if proto is None or proto.tree is None:
             return
-        whitelist = _tuple_of_str(proto.tree, "SUBMIT_KEYS")
-        if whitelist is None:
+        whitelists = {}
+        for var, tuple_name in _ENVELOPES.items():
+            wl = _tuple_of_str(proto.tree, tuple_name)
+            if wl is not None:
+                whitelists[var] = (tuple_name, wl)
+        if not whitelists:
             return
         for rel in _PAYLOAD_FILES:
             sf = ctx.file(rel)
             if sf is None or sf.tree is None:
                 continue
             for node in ast.walk(sf.tree):
-                key = line = None
+                var = key = line = None
                 if isinstance(node, ast.Subscript) and \
                         isinstance(node.value, ast.Name) and \
-                        node.value.id == "payload" and \
+                        node.value.id in whitelists and \
                         isinstance(node.slice, ast.Constant) and \
                         isinstance(node.slice.value, str):
-                    key, line = node.slice.value, node.lineno
+                    var, key, line = (node.value.id, node.slice.value,
+                                      node.lineno)
                 elif isinstance(node, ast.Call) and \
                         isinstance(node.func, ast.Attribute) and \
                         node.func.attr == "get" and \
                         isinstance(node.func.value, ast.Name) and \
-                        node.func.value.id == "payload" and \
+                        node.func.value.id in whitelists and \
                         node.args and \
                         isinstance(node.args[0], ast.Constant) and \
                         isinstance(node.args[0].value, str):
-                    key, line = node.args[0].value, node.lineno
-                if key is not None and key not in whitelist:
-                    findings.append(ctx.finding(
-                        self, sf, line,
-                        f"payload key {key!r} is read here but not "
-                        f"whitelisted in protocol.SUBMIT_KEYS — typo "
-                        f"or undocumented protocol extension"))
+                    var, key, line = (node.func.value.id,
+                                      node.args[0].value, node.lineno)
+                if key is not None:
+                    tuple_name, wl = whitelists[var]
+                    if key not in wl:
+                        findings.append(ctx.finding(
+                            self, sf, line,
+                            f"{var} key {key!r} is read here but not "
+                            f"whitelisted in protocol.{tuple_name} — "
+                            f"typo or undocumented protocol extension"))

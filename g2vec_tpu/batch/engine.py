@@ -951,7 +951,8 @@ def _execute_lanes(engine: ResidentEngine, cfg: G2VecConfig,
                     n_path_genes=len(payloads[li][2]),
                     train_history=r.history, acc_val=r.acc_val,
                     walker_backend=walker_backend,
-                    sampler_threads=sampler_threads))
+                    sampler_threads=sampler_threads,
+                    biomarker_scores=scores_host[li]))
                 lane_metrics[li].emit("done", outputs=outputs,
                                       stop_epoch=r.stop_epoch)
                 for path in outputs:

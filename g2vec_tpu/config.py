@@ -221,6 +221,14 @@ class G2VecConfig:
     metrics_jsonl: Optional[str] = None
     use_native_io: bool = True       # use the C++ TSV reader when available
     debug_nans: bool = False
+    emit_inventory: bool = False     # also publish the binary query-plane
+                                     # bundle <RESULT_NAME>_inventory/
+                                     # (float32 embeddings + norms +
+                                     # scores + gene table + sha256
+                                     # manifest — io/writers.py), so an
+                                     # offline run is servable by
+                                     # pointing `g2vec serve
+                                     # --inventory-dir` at its directory
 
     # ---- resilience (resilience/) ----
     supervise: bool = False          # wrap the run in the auto-resume
@@ -932,6 +940,14 @@ def build_parser() -> argparse.ArgumentParser:
                              "full-state gather (dir must be shared).")
     parser.add_argument("--metrics-jsonl", type=str, default=None,
                         help="Write structured per-stage/per-epoch metrics here.")
+    parser.add_argument("--emit-inventory", action="store_true",
+                        help="Also publish RESULT_NAME_inventory/ — the "
+                             "query plane's binary bundle (float32 "
+                             "embeddings + row norms + prognostic scores "
+                             "+ gene table, sha256-manifested). Byte-"
+                             "identical to what the serve daemon "
+                             "publishes for the same config; `g2vec "
+                             "serve --inventory-dir` makes it queryable.")
     parser.add_argument("--no-native-io", action="store_true",
                         help="Disable the C++ TSV reader.")
     parser.add_argument("--debug-nans", action="store_true")
@@ -1067,6 +1083,7 @@ def config_from_args(argv=None) -> G2VecConfig:
         resume=args.resume,
         checkpoint_layout=args.checkpoint_layout,
         metrics_jsonl=args.metrics_jsonl,
+        emit_inventory=args.emit_inventory,
         use_native_io=not args.no_native_io,
         debug_nans=args.debug_nans,
         supervise=args.supervise,

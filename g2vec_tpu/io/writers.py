@@ -11,12 +11,27 @@
   lines, a ``GeneSymbol\\t<col>...`` header, then one preformatted row per
   gene in global order (the reducer renders every cell to a string so the
   artifact is byte-deterministic by construction).
+- ``<NAME>_inventory/`` (new — the query plane's binary bundle,
+  ``--emit-inventory`` solo / published by the serve daemon on job
+  completion): float32 ``embeddings.npy`` ``[G, H]`` + precomputed
+  ``norms.npy`` row L2 norms + ``scores.npy`` ``[2, G]`` prognostic
+  scores + ``genes.txt`` + ``meta.json``, sealed by a sha256
+  ``MANIFEST.json`` (utils/integrity). One writer serves both paths, so
+  a served bundle's array files are byte-identical to its solo twin's.
 """
 from __future__ import annotations
 
-from typing import Sequence
+import os
+import shutil
+from typing import Optional, Sequence
 
 import numpy as np
+
+#: Bundle files whose bytes must match between a solo ``--emit-inventory``
+#: run and the serve daemon's publication of the same config (meta.json
+#: carries run-context fields — job id, publish source — and is excluded).
+INVENTORY_ARRAYS = ("embeddings.npy", "norms.npy", "scores.npy", "genes.txt")
+INVENTORY_MANIFEST = "MANIFEST.json"
 
 
 def write_biomarkers(result_name: str, biomarkers: Sequence[str]) -> str:
@@ -127,6 +142,68 @@ def write_stability(result_name: str, scenario: str,
                     f"cells for {len(columns)} columns")
             fout.write(gene + "\t" + "\t".join(row) + "\n")
     return path
+
+
+def write_inventory_bundle(bundle_dir: str, embeddings: np.ndarray,
+                           genes: Sequence[str],
+                           scores: Optional[np.ndarray],
+                           meta: dict) -> str:
+    """Publish one query-plane bundle at ``bundle_dir`` (atomically).
+
+    The whole bundle is staged in a ``.tmp.<pid>`` sibling and renamed
+    into place, so a reader never maps a torn half-written directory —
+    it sees either the old bundle, the new one, or nothing. The sha256
+    manifest (written last, atomically itself) is the read-side
+    integrity gate: serve/inventory.py refuses to map a bundle whose
+    manifest is missing or whose hashes mismatch.
+
+    ``scores`` may be ``None`` for a partial republication from the
+    durable record's text outputs (the ``[2, G]`` score matrix is not
+    recoverable from them); ``meta["has_scores"]`` records which kind
+    this bundle is.
+    """
+    from g2vec_tpu.utils.integrity import sha256_file, write_json_atomic
+
+    embeddings = np.asarray(embeddings, dtype=np.float32)
+    if embeddings.ndim != 2 or embeddings.shape[0] != len(genes):
+        raise ValueError(
+            f"write_inventory_bundle: embeddings {embeddings.shape} vs "
+            f"{len(genes)} genes")
+    from g2vec_tpu.ops.knn import row_norms
+
+    bundle_dir = os.path.abspath(bundle_dir)
+    tmp = f"{bundle_dir}.tmp.{os.getpid()}"
+    shutil.rmtree(tmp, ignore_errors=True)
+    os.makedirs(tmp)
+    np.save(os.path.join(tmp, "embeddings.npy"), embeddings,
+            allow_pickle=False)
+    np.save(os.path.join(tmp, "norms.npy"), row_norms(embeddings),
+            allow_pickle=False)
+    if scores is not None:
+        scores = np.asarray(scores, dtype=np.float32)
+        if scores.ndim != 2 or scores.shape[1] != embeddings.shape[0]:
+            raise ValueError(
+                f"write_inventory_bundle: scores {scores.shape} vs "
+                f"[*, {embeddings.shape[0]}] expected")
+        np.save(os.path.join(tmp, "scores.npy"), scores,
+                allow_pickle=False)
+    with open(os.path.join(tmp, "genes.txt"), "w") as fout:
+        for gene in genes:
+            fout.write("%s\n" % gene)
+    meta = dict(meta, n_genes=int(embeddings.shape[0]),
+                hidden=int(embeddings.shape[1]),
+                has_scores=scores is not None)
+    write_json_atomic(os.path.join(tmp, "meta.json"), meta)
+    files = {}
+    for name in sorted(os.listdir(tmp)):
+        files[name] = {"sha256": sha256_file(os.path.join(tmp, name)),
+                       "bytes": os.path.getsize(os.path.join(tmp, name))}
+    write_json_atomic(os.path.join(tmp, INVENTORY_MANIFEST),
+                      {"format": "g2vec-inventory-v1", "files": files})
+    shutil.rmtree(bundle_dir, ignore_errors=True)
+    os.makedirs(os.path.dirname(bundle_dir), exist_ok=True)
+    os.rename(tmp, bundle_dir)
+    return bundle_dir
 
 
 def write_vectors(result_name: str, vectors: np.ndarray,

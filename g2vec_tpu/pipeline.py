@@ -25,6 +25,7 @@ Differences from the reference, all deliberate (SURVEY.md §7):
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
@@ -69,6 +70,13 @@ class PipelineResult:
                                  # coordinator has a metrics stream, so
                                  # per-rank numbers ride the result);
                                  # empty when edge partitioning is off
+    biomarker_scores: Optional[np.ndarray] = None
+                                 # [2, G] float32 prognostic score stack
+                                 # (good row 0 / poor row 1) — the query
+                                 # plane's topk_biomarkers vector, kept
+                                 # so the serve daemon can publish the
+                                 # inventory bundle without recomputing
+                                 # stage 6
 
 
 def _background_warm(fn, console):
@@ -127,7 +135,7 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print,
     # chance to set platform env vars (see __main__.py).
     import jax
 
-    from g2vec_tpu.analysis import select_biomarkers
+    from g2vec_tpu.analysis import biomarker_scores_device, top_biomarkers
     from g2vec_tpu.io.readers import load_clinical, load_expression, load_network
     from g2vec_tpu.io.writers import write_biomarkers, write_lgroups, write_vectors
     from g2vec_tpu.ops.graph import neighbor_table, thresholded_edges
@@ -855,10 +863,18 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print,
                                                    cfg.permute_seed)
                     console("    permutation null: stage-6 labels shuffled "
                             "(permute_seed=%d)" % cfg.permute_seed)
-                biomarkers, _ = select_biomarkers(
-                    emb, data.expr, scoring_label, data.gene, lgroup_dev,
-                    cfg.numBiomarker, score_mix=cfg.score_mix)
+                # select_biomarkers split open so the full [2, G] score
+                # stack survives to the result (the query plane's
+                # topk_biomarkers bundle vector) — identical arithmetic,
+                # same two calls select_biomarkers makes internally.
+                scoring_label = np.asarray(scoring_label)
+                scores2 = np.asarray(biomarker_scores_device(
+                    emb, data.expr[scoring_label == 0],
+                    data.expr[scoring_label == 1], lgroup_dev,
+                    cfg.score_mix))
                 lgroup_idx = np.asarray(lgroup_dev)   # writer-boundary copy
+                biomarkers, _ = top_biomarkers(scores2, lgroup_idx,
+                                               data.gene, cfg.numBiomarker)
             _stage_edge("biomarkers")
 
         console(">>> 7. Save results")
@@ -893,6 +909,27 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print,
                     write_lgroups(cfg.result_name, lgroup_idx, data.gene),
                     write_vectors(cfg.result_name, result.w_ih, data.gene),
                 ]
+            if cfg.emit_inventory and write_outputs:
+                if embed_sharded:
+                    # The embedding never exists whole on one rank in
+                    # sharded mode — the bundle would defeat the cap.
+                    console("    --emit-inventory skipped: embedding is "
+                            "gene-range sharded")
+                else:
+                    from g2vec_tpu.io.writers import write_inventory_bundle
+
+                    bundle = write_inventory_bundle(
+                        cfg.result_name + "_inventory",
+                        np.asarray(result.w_ih, dtype=np.float32),
+                        list(data.gene), scores2,
+                        {"source": "solo",
+                         "result_name": os.path.basename(cfg.result_name)})
+                    console("    %s" % bundle)
+                    metrics.emit(
+                        "inventory", bundle=os.path.basename(bundle),
+                        bytes=sum(os.path.getsize(os.path.join(bundle, f))
+                                  for f in os.listdir(bundle)),
+                        outcome="published")
         _stage_edge("save")
         for path in outputs:
             console("    %s" % path)
@@ -920,7 +957,7 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print,
             walk_cache_hits=walk_cache_hits,
             stream_stats=(sres.stats.as_dict()
                           if cfg.train_mode == "streaming" else {}),
-            edge_stats=edge_attrib)
+            edge_stats=edge_attrib, biomarker_scores=scores2)
     finally:
         if overlap is not None:
             # Drain, never raise: the exception in flight (if any) is the

@@ -21,6 +21,12 @@ UNIX path or a TCP host:port — a daemon or the router)::
     g2vec serve --socket host:7433 --status | --ping | --shutdown
     g2vec serve --socket host:7433 --cancel JOB_ID | --drain
     g2vec serve --socket host:7433 --drain-replica r1
+    g2vec serve --socket host:7433 --query list
+    g2vec serve --socket host:7433 --query neighbors --query-job i1234 \\
+        --query-gene TP53 --query-k 10 [--query-variant v]
+    g2vec serve --socket host:7433 --query topk_biomarkers --query-job i1234
+    g2vec serve --socket host:7433 --result JOB_ID \\
+        [--fields event,variants] [--max-bytes 65536]
 
 ``--submit`` streams the job's JSONL events to stdout and exits 0 on
 ``job_done``, 4 on ``rejected``, 5 on ``job_failed`` (or any other
@@ -120,6 +126,28 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--fault-plan", type=str, default=None, metavar="SPEC",
                    help="Fault-injection spec for chaos drills "
                         "(resilience/faults.py grammar).")
+    # query plane (daemon-side knobs)
+    p.add_argument("--inventory-budget-bytes", type=int,
+                   default=256 << 20, metavar="N",
+                   help="Byte budget for the memory-mapped bundle LRU "
+                        "(default 256 MiB); least-recently-queried "
+                        "bundles unmap when the mapped set exceeds it.")
+    p.add_argument("--query-cache-entries", type=int, default=128,
+                   metavar="N",
+                   help="Entries in the keyed query-result LRU "
+                        "(default 128).")
+    p.add_argument("--inventory-dir", type=str, default=None,
+                   metavar="DIR",
+                   help="Extra inventory root beyond <state-dir>/"
+                        "inventory — point the daemon at a directory of "
+                        "solo --emit-inventory bundles to make them "
+                        "queryable.")
+    p.add_argument("--max-result-bytes", type=int, default=0, metavar="N",
+                   help="Server-side cap on one 'result' response "
+                        "(default 0 = the 8 MiB line bound); over-cap "
+                        "records answer with a structured "
+                        "oversized_result error naming the available "
+                        "fields.")
     # watchdog
     p.add_argument("--supervise", action="store_true",
                    help="Run the daemon under the relaunch watchdog: a "
@@ -162,6 +190,37 @@ def build_serve_parser() -> argparse.ArgumentParser:
                    help="Client mode (router): drain one replica "
                         "synchronously and relaunch it; prints the exit "
                         "code the drained daemon returned.")
+    # client query plane
+    p.add_argument("--query", type=str, default=None,
+                   choices=("neighbors", "topk_biomarkers", "meta",
+                            "list"),
+                   help="Client mode: one read-plane query against a "
+                        "daemon or the router (token-gated — query "
+                        "responses carry tenant embeddings/scores).")
+    p.add_argument("--query-job", type=str, default=None,
+                   metavar="JOB_ID",
+                   help="Bundle address for --query: the job whose "
+                        "published embedding bundle to read (or a solo "
+                        "bundle's directory name under --inventory-dir).")
+    p.add_argument("--query-variant", type=str, default=None,
+                   metavar="NAME",
+                   help="Variant lane of --query-job (optional when the "
+                        "job has exactly one).")
+    p.add_argument("--query-gene", type=str, default=None, metavar="SYM",
+                   help="Gene symbol for --query neighbors.")
+    p.add_argument("--query-k", type=int, default=10, metavar="K",
+                   help="Result count for --query neighbors / "
+                        "topk_biomarkers (default 10).")
+    p.add_argument("--result", type=str, default=None, metavar="JOB_ID",
+                   help="Client mode: fetch a job's durable terminal "
+                        "record via the 'result' op.")
+    p.add_argument("--fields", type=str, default=None, metavar="K1,K2",
+                   help="Comma-separated top-level record keys --result "
+                        "should return (default: all).")
+    p.add_argument("--max-bytes", type=int, default=None, metavar="N",
+                   help="Client-side cap on the --result response; an "
+                        "over-cap record answers oversized_result with "
+                        "the available field names.")
     return p
 
 
@@ -180,7 +239,8 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
     from g2vec_tpu.serve import client
 
     if args.status or args.ping or args.shutdown or args.submit \
-            or args.cancel or args.drain or args.drain_replica:
+            or args.cancel or args.drain or args.drain_replica \
+            or args.query or args.result:
         if not args.socket:
             build_serve_parser().error(
                 "client ops need --socket (a UNIX path or host:port)")
@@ -206,6 +266,23 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
             if args.drain:
                 ev = client.drain(args.socket, auth_token=token)
                 print(json.dumps(ev))
+                return 0 if ev.get("event") not in ("rejected",
+                                                    "error") else 4
+            if args.query:
+                ev = client.query(args.socket, args.query,
+                                  job_id=args.query_job,
+                                  variant=args.query_variant,
+                                  gene=args.query_gene,
+                                  k=args.query_k, auth_token=token)
+                print(json.dumps(ev, indent=1))
+                return 0 if ev.get("event") == "query_result" else 4
+            if args.result:
+                ev = client.result(
+                    args.socket, args.result,
+                    fields=(args.fields.split(",") if args.fields
+                            else None),
+                    max_bytes=args.max_bytes, auth_token=token)
+                print(json.dumps(ev, indent=1))
                 return 0 if ev.get("event") not in ("rejected",
                                                     "error") else 4
             if args.drain_replica:
@@ -257,9 +334,17 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
         fwd: List[str] = ["--queue-depth", str(args.queue_depth),
                           "--max-join", str(args.max_join),
                           "--job-retries", str(args.job_retries),
-                          "--read-deadline-s", str(args.read_deadline_s)]
+                          "--read-deadline-s", str(args.read_deadline_s),
+                          "--inventory-budget-bytes",
+                          str(args.inventory_budget_bytes),
+                          "--query-cache-entries",
+                          str(args.query_cache_entries)]
         if args.max_request_bytes:
             fwd += ["--max-request-bytes", str(args.max_request_bytes)]
+        if args.max_result_bytes:
+            fwd += ["--max-result-bytes", str(args.max_result_bytes)]
+        if args.inventory_dir:
+            fwd += ["--inventory-dir", args.inventory_dir]
         if args.cache_dir:
             fwd += ["--cache-dir", args.cache_dir]
         if args.platform:
@@ -275,6 +360,8 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
             max_request_bytes=args.max_request_bytes,
             metrics_jsonl=args.metrics_jsonl,
             sticky_deadline_s=args.sticky_deadline,
+            inventory_budget_bytes=args.inventory_budget_bytes,
+            max_result_bytes=args.max_result_bytes,
             serve_argv=tuple(fwd))
         return Router(opts).serve_forever()
     if not args.socket:
@@ -311,5 +398,9 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
         metrics_jsonl=args.metrics_jsonl, fault_plan=args.fault_plan,
         listen=args.listen, auth_token=_read_token(args.auth_token_file),
         read_deadline_s=args.read_deadline_s,
-        max_request_bytes=args.max_request_bytes)
+        max_request_bytes=args.max_request_bytes,
+        inventory_budget_bytes=args.inventory_budget_bytes,
+        query_cache_entries=args.query_cache_entries,
+        inventory_dir=args.inventory_dir,
+        max_result_bytes=args.max_result_bytes)
     return ServeDaemon(opts).serve_forever()

@@ -145,6 +145,46 @@ def drain(socket_path: str, timeout: Optional[float] = 10.0,
     return _one(socket_path, "drain", timeout, auth_token=auth_token)
 
 
+def query(socket_path: str, q: str, job_id: Optional[str] = None,
+          variant: Optional[str] = None, gene: Optional[str] = None,
+          k: Optional[int] = None, timeout: Optional[float] = 30.0,
+          auth_token: Optional[str] = None) -> dict:
+    """One read-plane query (``neighbors`` / ``topk_biomarkers`` /
+    ``meta`` / ``list``) against a daemon or the router — the router
+    routes it to the bundle's home replica and answers from disk itself
+    when that replica is dead. Token-gated like the mutators: query
+    responses carry tenant embeddings/scores."""
+    fields = {"q": q}
+    if job_id is not None:
+        fields["job_id"] = job_id
+    if variant is not None:
+        fields["variant"] = variant
+    if gene is not None:
+        fields["gene"] = gene
+    if k is not None:
+        fields["k"] = k
+    return _one(socket_path, "query", timeout, auth_token=auth_token,
+                **fields)
+
+
+def result(socket_path: str, job_id: str,
+           fields: Optional[List[str]] = None,
+           max_bytes: Optional[int] = None,
+           timeout: Optional[float] = 30.0,
+           auth_token: Optional[str] = None) -> dict:
+    """One ``result`` lookup with the PR 15 response bounds: ``fields``
+    selects top-level record keys, ``max_bytes`` caps the serialized
+    response (an over-cap record comes back as a structured
+    ``oversized_result`` error naming the available fields)."""
+    extra = {}
+    if fields is not None:
+        extra["fields"] = fields
+    if max_bytes is not None:
+        extra["max_bytes"] = max_bytes
+    return _one(socket_path, "result", timeout, auth_token=auth_token,
+                job_id=job_id, **extra)
+
+
 def submit_and_wait(socket_path: str, job: dict, tenant: str = "default",
                     state_dir: Optional[str] = None,
                     timeout: Optional[float] = None,

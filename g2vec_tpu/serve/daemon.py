@@ -59,6 +59,7 @@ import queue
 import re
 import shutil
 import socket
+import sys
 import threading
 import time
 import uuid
@@ -73,7 +74,7 @@ from g2vec_tpu.config import (G2VecConfig, config_from_job,
 from g2vec_tpu.resilience.lifecycle import (DrainRequested, JobCancelled,
                                             JobDeadlineExceeded,
                                             JobInterrupted)
-from g2vec_tpu.serve import protocol
+from g2vec_tpu.serve import inventory, protocol
 from g2vec_tpu.utils.integrity import write_json_atomic
 from g2vec_tpu.utils.metrics import MetricsWriter
 
@@ -139,6 +140,21 @@ class ServeOptions:
     #: Hard bound on one request line; an oversized request is answered
     #: with a structured error, never buffered past this.
     max_request_bytes: int = 0   # 0 = protocol.MAX_LINE_BYTES
+    #: Query plane (PR 15): byte budget for the memory-mapped bundle
+    #: LRU — resident cost is mapped PAGES the kernels touch, so this
+    #: bounds address-space bookkeeping, not copies.
+    inventory_budget_bytes: int = 256 << 20
+    #: Entries in the keyed query-result LRU (results are tiny —
+    #: k genes + k floats — so a count bound suffices).
+    query_cache_entries: int = 128
+    #: Extra catalog root beyond ``<state>/inventory`` — point the
+    #: daemon at a directory of solo ``--emit-inventory`` bundles to
+    #: make them queryable without a serve job.
+    inventory_dir: Optional[str] = None
+    #: Server-side cap on one ``result`` response; an over-cap record
+    #: becomes a structured ``oversized_result`` error (see
+    #: protocol.bound_record). 0 = protocol.MAX_LINE_BYTES.
+    max_result_bytes: int = 0
 
 
 @dataclasses.dataclass
@@ -300,6 +316,25 @@ class ServeDaemon:
         for d in (self._jobs_dir, self._results_dir, self._spool_dir):
             os.makedirs(d, exist_ok=True)
         self._ckpt_dir = os.path.join(opts.state_dir, "ckpt")
+        #: The query plane's read substrate: bundles published under
+        #: <state>/inventory/<job_id>/<variant>/ (plus an optional
+        #: --inventory-dir of solo bundles), memory-mapped behind a
+        #: byte-budgeted LRU. The catalog rebuilds itself from disk on
+        #: demand, so boot needs no replay.
+        self._inventory_dir = os.path.join(opts.state_dir, "inventory")
+        roots = [self._inventory_dir]
+        if opts.inventory_dir:
+            roots.append(opts.inventory_dir)
+        self.catalog = inventory.InventoryCatalog(
+            roots, budget_bytes=opts.inventory_budget_bytes)
+        #: Cached scan_bundles view for query resolution. This daemon
+        #: is the only writer of its inventory root, so the cache is
+        #: exact between publishes: every publish/republish resets it,
+        #: and any resolution MISS rescans before erroring (which also
+        #: picks up bundles dropped into an external --inventory-dir).
+        #: Whole-dict swaps are GIL-atomic; no lock needed.
+        self._inv_known: Dict[str, str] = {}
+        self.qcache = inventory.QueryCache(opts.query_cache_entries)
         self.metrics = MetricsWriter(opts.metrics_jsonl, append=True)
         self.engine = ResidentEngine(cache_dir=opts.cache_dir)
         self._queue = _FairQueue(opts.queue_depth, aging_s=opts.aging_s)
@@ -859,6 +894,7 @@ class ServeDaemon:
         by_job: Dict[str, Dict] = {}
         for (j, v), lane in zip(lane_owner, res.lanes):
             outs = self._route_outputs(j, v, lane)
+            self._publish_inventory(j, v, lane)
             by_job.setdefault(j.job_id, {})[v.name] = {
                 "outputs": outs, "stop_epoch": len(lane.train_history),
                 "acc_val": lane.acc_val}
@@ -952,6 +988,89 @@ class ServeDaemon:
             outs.append(dest)
         return outs
 
+    def _publish_inventory(self, job: ServeJob, v: LaneVariant,
+                           lane) -> None:
+        """Publish the lane's query-plane bundle under
+        ``<state>/inventory/<job_id>/<variant>/``. Publication failure
+        is a metrics event, never a job failure — the durable record
+        and the text outputs stay the source of truth, and the bundle
+        can be lazily rebuilt from them (:meth:`_republish`)."""
+        from g2vec_tpu.io.writers import write_inventory_bundle
+
+        key = f"{job.job_id}/{v.name}"
+        dest = os.path.join(self._inventory_dir, job.job_id, v.name)
+        if lane.embeddings is None:
+            self.metrics.emit("inventory", bundle=key, bytes=0,
+                              outcome="skipped",
+                              error="lane carried no embedding table")
+            return
+        try:
+            write_inventory_bundle(
+                dest, lane.embeddings, list(lane.genes),
+                lane.biomarker_scores,
+                {"source": "serve", "job_id": job.job_id,
+                 "variant": v.name, "tenant": job.tenant})
+        except (OSError, ValueError) as e:
+            self.metrics.emit("inventory", bundle=key, bytes=0,
+                              outcome="publish_failed",
+                              error=f"{type(e).__name__}: {e}"[:200])
+            return
+        # A re-run of the same job_id replaces the bundle: drop any
+        # stale mapping + cached results so readers see the new bytes,
+        # and reset the resolution cache so the new key is visible
+        # (and omitted-variant auto-resolve stays exact).
+        self.catalog.invalidate(key)
+        self.qcache.invalidate_bundle(key)
+        self._inv_known = {}
+        self.metrics.emit(
+            "inventory", bundle=key,
+            bytes=sum(os.path.getsize(os.path.join(dest, fn))
+                      for fn in os.listdir(dest)),
+            outcome="published")
+
+    def _republish(self, job_id: str, key: str) -> bool:
+        """Rebuild a lost/torn/tampered bundle from the durable
+        record's ``_vectors.txt`` output. Partial by design: the
+        ``[2, G]`` score matrix is not recoverable from text outputs,
+        so the republished bundle answers ``neighbors``/``meta`` but
+        ``topk_biomarkers`` returns ``scores_unavailable``."""
+        variant = key.split("/", 1)[1] if "/" in key else None
+        rec = self._read_result(job_id)
+        vec_path = None
+        if rec is not None and variant is not None:
+            outs = rec.get("variants", {}).get(variant, {}) \
+                      .get("outputs", [])
+            vec_path = next((p for p in outs
+                             if p.endswith("_vectors.txt")), None)
+        if vec_path is None or not os.path.exists(vec_path):
+            self.metrics.emit("inventory", bundle=key, bytes=0,
+                              outcome="republish_unavailable")
+            return False
+        from g2vec_tpu.io.writers import write_inventory_bundle
+
+        dest = os.path.join(self._inventory_dir, job_id, variant)
+        try:
+            genes, emb = inventory.read_vectors_txt(vec_path)
+            write_inventory_bundle(
+                dest, emb, genes, None,
+                {"source": "republish", "job_id": job_id,
+                 "variant": variant,
+                 "from": os.path.basename(vec_path)})
+        except (OSError, ValueError) as e:
+            self.metrics.emit("inventory", bundle=key, bytes=0,
+                              outcome="republish_failed",
+                              error=f"{type(e).__name__}: {e}"[:200])
+            return False
+        self.catalog.invalidate(key)
+        self.qcache.invalidate_bundle(key)
+        self._inv_known = {}
+        self.metrics.emit(
+            "inventory", bundle=key,
+            bytes=sum(os.path.getsize(os.path.join(dest, fn))
+                      for fn in os.listdir(dest)),
+            outcome="republished")
+        return True
+
     def _fail_or_requeue(self, job: ServeJob, err: str,
                          classified: str) -> None:
         if classified == "retryable" and job.attempts < self.opts.job_retries:
@@ -989,6 +1108,84 @@ class ServeDaemon:
         self._notify(job, record)
         self._notify(job, None)
 
+    # ---- query plane ------------------------------------------------------
+
+    def _resolve_bundle(self, job_id: str, variant) \
+            -> Tuple[Optional[str], Optional[dict]]:
+        """Resolve against the cached disk view; only a resolution
+        that FAILS on the cache pays a rescan (then retries once on
+        the fresh view). Keeps the warm query path free of directory
+        walks without ever turning a publishable answer into an
+        error."""
+        key, err = inventory.resolve_bundle_key(
+            self._inv_known, job_id, variant)
+        if err is None:
+            return key, None
+        known = inventory.scan_bundles(self.catalog.roots)
+        self._inv_known = known
+        return inventory.resolve_bundle_key(known, job_id, variant)
+
+    def handle_query(self, qreq: dict) -> dict:
+        """The read plane: one ``query`` sub-op (inventory.QUERY_SUBOPS)
+        against this replica's bundles, behind the keyed result cache.
+        A torn/tampered bundle is lazily republished from the durable
+        record's text outputs and the query retried once — corruption
+        costs latency, never a wrong answer."""
+        q = qreq.get("q")
+        t0 = time.time()
+        if q == "list":
+            resp = {"event": "query_result", "q": "list",
+                    "bundles": self.catalog.listing()}
+            self.metrics.emit("query", q="list", cache="none",
+                              ms=round((time.time() - t0) * 1e3, 3))
+            return resp
+        if q not in inventory.QUERY_SUBOPS:
+            return {"event": "error", "error": "bad_query",
+                    "detail": f"unknown sub-op {q!r}; expected one of "
+                              f"{inventory.QUERY_SUBOPS}"}
+        job_id = qreq.get("job_id")
+        if not isinstance(job_id, str) or not job_id:
+            return {"event": "error", "error": "bad_query",
+                    "detail": "query needs a 'job_id' string"}
+        key, err = self._resolve_bundle(job_id, qreq.get("variant"))
+        if err is not None:
+            return err
+        gene = qreq.get("gene")
+        if gene is not None and not isinstance(gene, str):
+            return {"event": "error", "error": "bad_query",
+                    "detail": f"'gene' must be a string, got {gene!r}"}
+        k = qreq.get("k", 10)
+        if not isinstance(k, int) or isinstance(k, bool):
+            return {"event": "error", "error": "bad_query",
+                    "detail": f"'k' must be an int, got {k!r}"}
+
+        def compute() -> dict:
+            try:
+                return inventory.run_query(self.catalog, q, key,
+                                           gene=gene, k=k)
+            except inventory.InventoryError as e:
+                if e.code in ("torn", "tampered") \
+                        and self._republish(job_id, key):
+                    return inventory.run_query(self.catalog, q, key,
+                                               gene=gene, k=k)
+                raise
+
+        try:
+            resp, was_hit = self.qcache.get_or_put(
+                inventory.cache_key(key, q, gene, k), compute)
+        except inventory.InventoryError as e:
+            self.metrics.emit("query", q=q, cache="miss", bundle=key,
+                              ms=round((time.time() - t0) * 1e3, 3),
+                              error=e.code)
+            return {"event": "error", "error": e.code,
+                    "detail": e.detail, "job_id": job_id, "bundle": key}
+        out = dict(resp)
+        out["event"] = "query_result"
+        self.metrics.emit("query", q=q,
+                          cache="hit" if was_hit else "miss", bundle=key,
+                          ms=round((time.time() - t0) * 1e3, 3))
+        return out
+
     # ---- status -----------------------------------------------------------
 
     def status(self) -> dict:
@@ -1023,7 +1220,9 @@ class ServeDaemon:
                 "jobs_done": jobs_done,
                 "jobs_failed": jobs_failed,
                 "engine": self.engine.status(),
-                "cache": cache_stats()}
+                "cache": cache_stats(),
+                "inventory": {**self.catalog.stats(),
+                              "query_cache": self.qcache.stats()}}
 
     # ---- socket front-end -------------------------------------------------
 
@@ -1067,12 +1266,15 @@ class ServeDaemon:
                 return
             op = req.get("op")
             if self.opts.auth_token is not None \
-                    and op in ("submit", "cancel", "drain", "shutdown") \
+                    and op in ("submit", "cancel", "drain", "shutdown",
+                               "query") \
                     and req.get("auth_token") != self.opts.auth_token:
                 # Tenancy is checked AT ADMISSION: a mutating op without
                 # the shared secret never reaches planning or the queue.
-                # status/ping stay open — the router's health probes (and
-                # any curl) must not need credentials.
+                # ``query`` is a READ but still gated — it exposes
+                # tenant embeddings/scores, not just health. status/
+                # ping stay open — the router's health probes (and any
+                # curl) must not need credentials.
                 self.metrics.emit("auth_rejected", op=op)
                 protocol.write_event(
                     f, {"event": "rejected", "error": "unauthorized",
@@ -1098,19 +1300,32 @@ class ServeDaemon:
             elif op == "result":
                 # Durable-record lookup: the network recovery path after
                 # a lost stream (client.poll_result_net) — works without
-                # filesystem access to the state dir.
-                job_id = req.get("job_id")
+                # filesystem access to the state dir. Bounded: the
+                # response honors the client's fields/max_bytes and the
+                # server's --max-result-bytes cap (protocol.bound_record)
+                # instead of streaming the whole record unconditionally.
+                rreq = req
+                job_id = rreq.get("job_id")
                 if not isinstance(job_id, str) or not job_id:
                     protocol.write_event(
                         f, {"event": "error",
                             "error": "result needs a 'job_id' string"})
                 else:
                     rec = self._read_result(job_id)
-                    protocol.write_event(
-                        f, rec if rec is not None else
-                        {"event": "pending", "job_id": job_id,
-                         "journaled": os.path.exists(os.path.join(
-                             self._jobs_dir, f"{job_id}.json"))})
+                    if rec is None:
+                        protocol.write_event(
+                            f, {"event": "pending", "job_id": job_id,
+                                "journaled": os.path.exists(os.path.join(
+                                    self._jobs_dir, f"{job_id}.json"))})
+                    else:
+                        protocol.write_event(f, protocol.bound_record(
+                            rec, rreq.get("fields"),
+                            rreq.get("max_bytes"),
+                            self.opts.max_result_bytes
+                            or protocol.MAX_LINE_BYTES))
+            elif op == "query":
+                qreq = req
+                protocol.write_event(f, self.handle_query(qreq))
             elif op == "cancel":
                 job_id = req.get("job_id")
                 if not isinstance(job_id, str) or not job_id:
@@ -1167,6 +1382,17 @@ class ServeDaemon:
         """Bind the socket, run the scheduler thread, serve until a
         ``shutdown`` op or SIGTERM. Returns the process exit code."""
         import signal
+
+        # Mixed interactive/batch process: query threads share the GIL
+        # with training lanes, and CPython's default 5 ms switch
+        # interval means a compute-bound training thread can park a
+        # 2 ms query behind one-to-two 5 ms GIL holds — the whole warm
+        # p99 budget lost to scheduling. 1 ms caps any single hold at
+        # ~1/10 of the query budget for a ~1% bytecode-dispatch tax on
+        # training (XLA/BLAS kernels release the GIL anyway). Scoped to
+        # the real daemon process, not library import, so tests and
+        # solo runs keep the interpreter default.
+        sys.setswitchinterval(1e-3)
 
         def _sched():
             while not self._stop.is_set():

@@ -1,0 +1,395 @@
+"""Memory-mapped embedding inventory — the query plane's read substrate.
+
+The batch plane (daemon/engine) produces embeddings; this module serves
+them at interactive rates without ever holding a full ``[G, H]`` table
+per query. A *bundle* is the binary directory written by
+``io/writers.write_inventory_bundle``::
+
+    <root>/<key>/
+        embeddings.npy   float32 [G, H]
+        norms.npy        float32 [G] precomputed row L2 norms
+        scores.npy       float32 [2, G] prognostic scores (optional)
+        genes.txt        one symbol per row, row order == array order
+        meta.json        lane/run metadata (job_id, variant, config echo)
+        MANIFEST.json    sha256 + byte size per file (utils/integrity)
+
+The daemon publishes one bundle per completed (job, variant) under
+``<state>/inventory/<job_id>/<variant>/``; solo runs with
+``--emit-inventory`` publish ``<result_name>_inventory/``. Both go
+through the same writer, so the array files are byte-identical twins.
+
+:class:`InventoryCatalog` rebuilds its view of the world from disk on
+every listing (boot needs no replay — the bundles ARE the catalog) and
+lazily memory-maps bundles behind a byte-budgeted LRU: ``np.load(...,
+mmap_mode='r')`` maps the arrays without copying, the cold-path
+manifest verification is the only full read a bundle ever gets, and
+queries touch O(block) pages via the blocked kernels in ``ops/knn.py``.
+A tampered or torn bundle raises :class:`InventoryError` with a
+structured code instead of serving corrupt rows.
+
+This module is deliberately **jax-free** (numpy + stdlib only): the
+router imports it for its failover read path, and the router must boot
+on accelerator-free hosts.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from g2vec_tpu.io.writers import INVENTORY_MANIFEST
+from g2vec_tpu.ops import knn
+
+#: Sub-ops a ``query`` request may name (protocol vocabulary; the CLI
+#: and daemon/router dispatch validate against this tuple).
+QUERY_SUBOPS = ("neighbors", "topk_biomarkers", "meta", "list")
+
+
+class InventoryError(Exception):
+    """A structured query-plane failure: ``code`` is wire-stable
+    (``not_found`` / ``torn`` / ``tampered`` / ``bad_query`` /
+    ``scores_unavailable``), ``detail`` is for humans."""
+
+    def __init__(self, code: str, detail: str):
+        super().__init__(f"{code}: {detail}")
+        self.code = code
+        self.detail = detail
+
+
+class _Bundle:
+    """One mapped bundle: mmap'd arrays + the eager gene index.
+
+    Immutable after construction — the catalog lock only guards the
+    LRU bookkeeping, never per-bundle state.
+    """
+
+    def __init__(self, path: str):
+        man_path = os.path.join(path, INVENTORY_MANIFEST)
+        try:
+            with open(man_path) as f:
+                manifest = json.load(f)
+        except FileNotFoundError:
+            raise InventoryError(
+                "torn", f"{path}: no {INVENTORY_MANIFEST} (interrupted "
+                f"publication or not a bundle)")
+        except ValueError as e:
+            raise InventoryError("torn", f"{man_path}: unparseable ({e})")
+        from g2vec_tpu.utils.integrity import sha256_file
+
+        files = manifest.get("files", {})
+        for name, want in sorted(files.items()):
+            fp = os.path.join(path, name)
+            if not os.path.exists(fp):
+                raise InventoryError("torn", f"{path}: manifest names "
+                                             f"{name} but it is missing")
+            if os.path.getsize(fp) != want.get("bytes"):
+                raise InventoryError(
+                    "tampered", f"{fp}: {os.path.getsize(fp)} bytes, "
+                                f"manifest says {want.get('bytes')}")
+            if sha256_file(fp) != want.get("sha256"):
+                raise InventoryError("tampered", f"{fp}: sha256 mismatch "
+                                                 f"vs manifest")
+        for required in ("embeddings.npy", "norms.npy", "genes.txt",
+                         "meta.json"):
+            if required not in files:
+                raise InventoryError(
+                    "torn", f"{path}: manifest lacks {required}")
+        self.path = path
+        self.embeddings = np.load(os.path.join(path, "embeddings.npy"),
+                                  mmap_mode="r", allow_pickle=False)
+        self.norms = np.load(os.path.join(path, "norms.npy"),
+                             mmap_mode="r", allow_pickle=False)
+        self.scores = None
+        if "scores.npy" in files:
+            self.scores = np.load(os.path.join(path, "scores.npy"),
+                                  mmap_mode="r", allow_pickle=False)
+        with open(os.path.join(path, "genes.txt")) as f:
+            self.genes: List[str] = [ln.rstrip("\n") for ln in f]
+        with open(os.path.join(path, "meta.json")) as f:
+            self.meta = json.load(f)
+        if self.embeddings.ndim != 2 or \
+                self.embeddings.shape[0] != len(self.genes):
+            raise InventoryError(
+                "tampered", f"{path}: embeddings {self.embeddings.shape} "
+                            f"vs {len(self.genes)} genes")
+        self.gene_index: Dict[str, int] = {
+            g: i for i, g in enumerate(self.genes)}
+        #: mapped-budget charge: the npy payloads (the mmap'd set).
+        self.nbytes = sum(int(w.get("bytes", 0))
+                          for n, w in files.items() if n.endswith(".npy"))
+
+
+def scan_bundles(roots: Sequence[str]) -> Dict[str, str]:
+    """key -> bundle dir, rebuilt from disk (depth <= 2 under each root:
+    ``<job_id>/<variant>/`` for served bundles, ``<name>_inventory/``
+    for solo ones). First root wins on key collision."""
+    found: Dict[str, str] = {}
+    for root in roots:
+        if not os.path.isdir(root):
+            continue
+        for d1 in sorted(os.listdir(root)):
+            p1 = os.path.join(root, d1)
+            if not os.path.isdir(p1) or d1.startswith("."):
+                continue
+            if os.path.exists(os.path.join(p1, INVENTORY_MANIFEST)):
+                found.setdefault(d1, p1)
+                continue
+            for d2 in sorted(os.listdir(p1)):
+                p2 = os.path.join(p1, d2)
+                if os.path.isdir(p2) and not d2.startswith(".") and \
+                        os.path.exists(os.path.join(p2,
+                                                    INVENTORY_MANIFEST)):
+                    found.setdefault(f"{d1}/{d2}", p2)
+    return found
+
+
+def resolve_bundle_key(known: Dict[str, str], job_id: str, variant) \
+        -> Tuple[Optional[str], Optional[dict]]:
+    """Map (job_id, variant?) onto one key of ``known`` (a
+    :func:`scan_bundles` result), or a structured error event. A
+    depth-1 key (a solo ``--emit-inventory`` bundle) matches ``job_id``
+    directly; served bundles live at ``<job_id>/<variant>`` and an
+    omitted variant resolves only when the job has exactly one. Shared
+    by the daemon and the router so both address bundles identically."""
+    if variant:
+        key = f"{job_id}/{variant}"
+        if key in known:
+            return key, None
+        return None, {
+            "event": "error", "error": "not_found",
+            "job_id": job_id, "detail": f"no bundle {key!r}",
+            "variants": sorted(k.split("/", 1)[1] for k in known
+                               if k.startswith(job_id + "/"))}
+    if job_id in known:
+        return job_id, None
+    cands = sorted(k for k in known if k.startswith(job_id + "/"))
+    if len(cands) == 1:
+        return cands[0], None
+    if not cands:
+        return None, {"event": "error", "error": "not_found",
+                      "job_id": job_id,
+                      "detail": f"no bundle for job {job_id!r}"}
+    return None, {
+        "event": "error", "error": "ambiguous_variant",
+        "job_id": job_id,
+        "detail": "job has several variants; pass 'variant'",
+        "variants": [c.split("/", 1)[1] for c in cands]}
+
+
+class InventoryCatalog:
+    """Byte-budgeted LRU of memory-mapped bundles over N disk roots.
+
+    ``get`` maps lazily (cold path pays one manifest verification —
+    the only full read) and evicts least-recently-used bundles until
+    the mapped set fits ``budget_bytes`` again. All LRU state is
+    guarded by one lock; the load itself also runs under it, which
+    serializes cold maps — acceptable because the warm path is a dict
+    hit and the bench pins cold-vs-warm separately.
+    """
+
+    def __init__(self, roots: Sequence[str], budget_bytes: int):
+        self.roots = [os.path.abspath(r) for r in roots]
+        self.budget_bytes = int(budget_bytes)
+        self._lock = threading.Lock()
+        #: key -> _Bundle in LRU order (last = most recent).
+        # guarded-by: _lock
+        self._mapped: "collections.OrderedDict[str, _Bundle]" = \
+            collections.OrderedDict()
+        self._bytes_mapped = 0      # guarded-by: _lock
+        self._evictions = 0         # guarded-by: _lock
+        self._map_errors = 0        # guarded-by: _lock
+        self._cold_maps = 0         # guarded-by: _lock
+
+    def get(self, key: str) -> _Bundle:
+        with self._lock:
+            hit = self._mapped.get(key)
+            if hit is not None:
+                self._mapped.move_to_end(key)
+                return hit
+            path = scan_bundles(self.roots).get(key)
+            if path is None:
+                raise InventoryError(
+                    "not_found", f"no bundle {key!r} under "
+                                 f"{self.roots} (known: "
+                                 f"{sorted(scan_bundles(self.roots))[:8]})")
+            try:
+                bundle = _Bundle(path)
+            except InventoryError:
+                self._map_errors += 1
+                raise
+            self._mapped[key] = bundle
+            self._bytes_mapped += bundle.nbytes
+            self._cold_maps += 1
+            while self._bytes_mapped > self.budget_bytes and \
+                    len(self._mapped) > 1:
+                _, old = self._mapped.popitem(last=False)
+                self._bytes_mapped -= old.nbytes
+                self._evictions += 1
+            return bundle
+
+    def invalidate(self, key: str) -> None:
+        with self._lock:
+            old = self._mapped.pop(key, None)
+            if old is not None:
+                self._bytes_mapped -= old.nbytes
+
+    def listing(self) -> List[dict]:
+        """Catalog view straight from disk (cheap: meta.json only,
+        nothing is mapped or verified)."""
+        out = []
+        for key, path in sorted(scan_bundles(self.roots).items()):
+            entry = {"bundle": key}
+            try:
+                with open(os.path.join(path, "meta.json")) as f:
+                    meta = json.load(f)
+                entry.update(
+                    n_genes=meta.get("n_genes"), hidden=meta.get("hidden"),
+                    has_scores=meta.get("has_scores"))
+            except (OSError, ValueError):
+                entry["torn"] = True
+            out.append(entry)
+        return out
+
+    def stats(self) -> dict:
+        cataloged = len(scan_bundles(self.roots))
+        with self._lock:
+            return {"bundles_cataloged": cataloged,
+                    "bundles_mapped": len(self._mapped),
+                    "bytes_mapped": self._bytes_mapped,
+                    "budget_bytes": self.budget_bytes,
+                    "cold_maps": self._cold_maps,
+                    "evictions": self._evictions,
+                    "map_errors": self._map_errors}
+
+
+class QueryCache:
+    """Small keyed LRU over fully-rendered query results.
+
+    Keys are ``(bundle, sub-op, args)`` strings; values are the exact
+    JSON-able response dicts. Entry-count bounded (results are tiny:
+    k genes + k floats), byte budgets stay the catalog's concern.
+    """
+
+    def __init__(self, capacity: int = 128):
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        #: key -> response dict, LRU order.
+        # guarded-by: _lock
+        self._entries: "collections.OrderedDict[str, dict]" = \
+            collections.OrderedDict()
+        self._hits = 0      # guarded-by: _lock
+        self._misses = 0    # guarded-by: _lock
+
+    def get_or_put(self, key: str, compute) -> Tuple[dict, bool]:
+        """One critical section around lookup+insert would hold the
+        lock across ``compute`` (a blocked matmul), so this is
+        deliberately lookup -> compute -> insert; two racing misses
+        both compute and the second insert wins — idempotent, queries
+        are pure reads."""
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is not None:
+                self._entries.move_to_end(key)
+                self._hits += 1
+                return hit, True
+            self._misses += 1
+        value = compute()
+        with self._lock:
+            self._entries[key] = value
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+        return value, False
+
+    def invalidate_bundle(self, bundle_key: str) -> None:
+        """Drop every cached result for one bundle (republication)."""
+        with self._lock:
+            for k in [k for k in self._entries
+                      if k.startswith(bundle_key + "\x00")]:
+                del self._entries[k]
+
+    def stats(self) -> dict:
+        with self._lock:
+            total = self._hits + self._misses
+            return {"entries": len(self._entries),
+                    "capacity": self.capacity,
+                    "hits": self._hits, "misses": self._misses,
+                    "hit_rate": round(self._hits / total, 4)
+                    if total else None}
+
+
+def cache_key(bundle: str, q: str, gene: Optional[str], k: int) -> str:
+    return "\x00".join((bundle, q, gene or "", str(int(k))))
+
+
+def run_query(catalog: InventoryCatalog, q: str, bundle_key: str,
+              gene: Optional[str] = None, k: int = 10,
+              block_rows: int = 8192) -> dict:
+    """Evaluate one ``neighbors`` / ``topk_biomarkers`` / ``meta``
+    sub-op against the catalog (``list`` is :meth:`InventoryCatalog.
+    listing` — it takes no bundle). Shared verbatim by the daemon and
+    the router's failover read path so both answer identically."""
+    if q not in ("neighbors", "topk_biomarkers", "meta"):
+        raise InventoryError("bad_query", f"unknown sub-op {q!r}; "
+                                          f"expected one of {QUERY_SUBOPS}")
+    k = int(k)
+    if q != "meta" and not (1 <= k <= 10000):
+        raise InventoryError("bad_query", f"k must be in [1, 10000], "
+                                          f"got {k}")
+    b = catalog.get(bundle_key)
+    if q == "meta":
+        return {"bundle": bundle_key, "meta": b.meta,
+                "mapped_bytes": b.nbytes, "n_genes": len(b.genes),
+                "hidden": int(b.embeddings.shape[1])}
+    if q == "neighbors":
+        if not gene:
+            raise InventoryError("bad_query",
+                                 "neighbors needs a 'gene' symbol")
+        gi = b.gene_index.get(gene)
+        if gi is None:
+            raise InventoryError("bad_query",
+                                 f"gene {gene!r} not in bundle "
+                                 f"{bundle_key!r}")
+        qvec = np.asarray(b.embeddings[gi], dtype=np.float32)
+        idx, sims = knn.cosine_topk(b.embeddings, b.norms, qvec, k,
+                                    exclude=gi, block_rows=block_rows)
+        return {"bundle": bundle_key, "gene": gene, "k": k,
+                "neighbors": [b.genes[i] for i in idx],
+                "sims": [float(s) for s in sims]}
+    # topk_biomarkers
+    if b.scores is None:
+        raise InventoryError(
+            "scores_unavailable",
+            f"bundle {bundle_key!r} was republished from the durable "
+            f"record's text outputs, which do not carry the [2, G] "
+            f"score matrix — re-run the job to restore it")
+    out = {"bundle": bundle_key, "k": k}
+    for row, group in enumerate(("good", "poor")):
+        idx, sc = knn.topk_scores(np.asarray(b.scores[row],
+                                             dtype=np.float32), k)
+        out[group] = {"genes": [b.genes[i] for i in idx],
+                      "scores": [float(s) for s in sc]}
+    return out
+
+
+def read_vectors_txt(path: str) -> Tuple[List[str], np.ndarray]:
+    """Parse a ``<NAME>_vectors.txt`` output back into (genes,
+    float32 [G, H]) — the lazy-republish source when a bundle is lost
+    or tampered but the durable record's text outputs survive."""
+    genes: List[str] = []
+    rows: List[List[float]] = []
+    with open(path) as f:
+        header = f.readline()
+        if not header.startswith("GeneSymbol"):
+            raise ValueError(f"{path}: not a vectors file")
+        for ln in f:
+            parts = ln.rstrip("\n").split("\t")
+            if len(parts) < 2:
+                continue
+            genes.append(parts[0])
+            rows.append([float(x) for x in parts[1:]])
+    return genes, np.asarray(rows, dtype=np.float32)

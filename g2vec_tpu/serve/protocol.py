@@ -19,7 +19,11 @@ Requests::
      "priority": "interactive", "deadline_s": 120}       # both optional
     {"op": "status"} | {"op": "ping"} | {"op": "shutdown"}
     {"op": "cancel", "job_id": "j0001-..."}              # cooperative
-    {"op": "result", "job_id": "i..."}   # durable record or "pending"
+    {"op": "result", "job_id": "i...",   # durable record or "pending";
+     "fields": ["event", "variants"],    # optional top-level selector
+     "max_bytes": 65536}                 # optional response size cap
+    {"op": "query", "q": "neighbors", "job_id": "i...",  # read plane:
+     "variant": "base", "gene": "TP53", "k": 10}         # see QUERY_KEYS
     {"op": "drain"}     # stop admitting, checkpoint, journal, exit 0
 
 Addressing: an address containing ``host:port`` dials TCP, anything else
@@ -63,9 +67,58 @@ MAX_LINE_BYTES = 8 << 20
 SUBMIT_KEYS = ("op", "job", "tenant", "priority", "deadline_s",
                "idem_key", "job_id", "auth_token")
 
+#: The query-request envelope vocabulary (the read plane's twin of
+#: SUBMIT_KEYS). daemon.py/router.py bind a query payload to the
+#: conventional name ``qreq`` and the same checker lints every
+#: ``qreq["k"]`` / ``qreq.get("k")`` site against this tuple. ``q``
+#: names the sub-op (inventory.QUERY_SUBOPS: neighbors /
+#: topk_biomarkers / meta / list); ``variant`` selects a lane of a
+#: multi-variant job (optional when the job has exactly one).
+QUERY_KEYS = ("op", "q", "job_id", "variant", "gene", "k", "auth_token")
+
+#: The result-request envelope vocabulary: ``rreq`` reads in
+#: daemon.py/router.py are linted against this tuple. ``fields``
+#: selects top-level record keys; ``max_bytes`` caps the serialized
+#: response (the server-side ``--max-result-bytes`` bound applies
+#: regardless — a giant durable record must not blow the line protocol
+#: in reverse).
+RESULT_KEYS = ("op", "job_id", "fields", "max_bytes", "auth_token")
+
 
 class ProtocolError(ValueError):
     """A malformed request/response line."""
+
+
+def bound_record(rec: dict, fields, max_bytes: Optional[int],
+                 server_cap: int) -> dict:
+    """Apply the ``result`` op's field selector and size bound.
+
+    ``fields`` (optional list) keeps only those top-level record keys
+    (plus ``event``/``job_id`` so the response stays self-describing);
+    the effective cap is the smaller of the client's ``max_bytes`` and
+    the server's ``--max-result-bytes``. An over-cap record becomes a
+    structured ``oversized_result`` error naming the available fields
+    so the client can re-ask for a subset — it is never truncated
+    mid-JSON. Shared by daemon and router so both listeners bound
+    identically.
+    """
+    cap = int(server_cap)
+    if max_bytes:
+        cap = min(cap, int(max_bytes))
+    if fields is not None:
+        if not (isinstance(fields, list)
+                and all(isinstance(k, str) for k in fields)):
+            return {"event": "error", "error": "bad_fields",
+                    "detail": "fields must be a list of strings"}
+        keep = set(fields) | {"event", "job_id"}
+        rec = {k: v for k, v in rec.items() if k in keep}
+    size = len(json.dumps(rec).encode())
+    if size > cap:
+        return {"event": "error", "error": "oversized_result",
+                "job_id": rec.get("job_id"), "bytes": size,
+                "max_bytes": cap,
+                "fields_available": sorted(rec.keys())}
+    return rec
 
 
 #: ``host:port`` — hostname/IPv4 literal, no scheme. A bare path never

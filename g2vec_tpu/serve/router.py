@@ -50,6 +50,7 @@ import os
 import queue
 import shutil
 import socket
+import sys
 import threading
 import time
 import uuid
@@ -58,11 +59,12 @@ from typing import Callable, Dict, List, Optional, Tuple
 from g2vec_tpu.config import G2VecConfig, config_from_job, serve_join_key
 from g2vec_tpu.resilience.lifecycle import ReplicaHealth
 from g2vec_tpu.resilience.supervisor import ReplicaFleet, ReplicaSpec
-from g2vec_tpu.serve import protocol
+from g2vec_tpu.serve import inventory, protocol
 from g2vec_tpu.utils.metrics import MetricsWriter
 
-#: Mutating ops — the only ones the auth token gates (probes stay open).
-_AUTH_OPS = ("submit", "cancel", "drain_replica", "shutdown")
+#: Token-gated ops: the mutators, plus ``query`` — a read, but one that
+#: exposes tenant embeddings/scores, not just health (probes stay open).
+_AUTH_OPS = ("submit", "cancel", "drain_replica", "shutdown", "query")
 
 
 # ---------------------------------------------------------------------------
@@ -152,6 +154,13 @@ class RouterOptions:
     #: refused with ``retry_later`` — never ring-placed, which would run
     #: the job twice.
     sticky_deadline_s: float = 120.0
+    #: Byte budget for the router's OWN mmap catalog — the failover read
+    #: path that answers queries for bundles whose home replica is dead
+    #: (the fleet's state dirs are co-located with the router).
+    inventory_budget_bytes: int = 256 << 20
+    #: Server-side cap on a relayed ``result`` response (see
+    #: protocol.bound_record). 0 = protocol.MAX_LINE_BYTES.
+    max_result_bytes: int = 0
 
 
 class Router:
@@ -214,6 +223,26 @@ class Router:
         self._requeue_latencies: List[float] = []   # guarded-by: _hlock
         self.failovers = 0                      # guarded-by: _hlock
         self.jobs_routed = 0                    # guarded-by: _hlock
+        #: Per-replica view of that replica's published bundles — the
+        #: failover READ path: when a bundle's home replica is dead the
+        #: router maps the bundle itself (shared filesystem) and
+        #: answers with the exact same inventory.run_query the daemon
+        #: uses, so reads survive replica death like writes do. Each
+        #: catalog is internally locked; this dict is immutable after
+        #: __init__.
+        self._inv_local: Dict[str, inventory.InventoryCatalog] = {
+            n: inventory.InventoryCatalog(
+                [os.path.join(self.fleet.replica(n).state_dir,
+                              "inventory")],
+                budget_bytes=opts.inventory_budget_bytes)
+            for n in self.fleet.names()}
+        #: job_id -> replica name, populated on first lookup. Bundle
+        #: placement is sticky (a job's bundle only ever appears on its
+        #: home replica's disk) and bundles are never deleted, so a
+        #: POSITIVE lookup stays valid forever; only misses pay the
+        #: disk scan. Plain dict: entry writes are idempotent, so
+        #: GIL-atomic get/setdefault need no extra lock.
+        self._owner_cache: Dict[str, str] = {}
         self.tcp_addr: Optional[Tuple[str, int]] = None
         self._t0 = time.time()
 
@@ -583,6 +612,109 @@ class Router:
             with self._hlock:
                 self._admin_draining.discard(name)
 
+    # ---- query plane ------------------------------------------------------
+
+    def _bundle_owner(self, job_id: str) -> Optional[str]:
+        """The replica whose inventory holds a bundle for ``job_id``,
+        or None. A disk scan — the same co-located-state trick as
+        _journal_owner, so it works whether the owner is alive or not.
+        The scan runs at most once per job_id: positive results are
+        cached forever (see _owner_cache), which keeps the per-query
+        hot path to a dict hit instead of O(replicas) directory walks;
+        not-found stays a fresh scan so a bundle published after a
+        miss is picked up."""
+        owner = self._owner_cache.get(job_id)
+        if owner is not None:
+            return owner
+        for name in self.fleet.names():
+            known = inventory.scan_bundles(self._inv_local[name].roots)
+            if job_id in known or any(k.startswith(job_id + "/")
+                                      for k in known):
+                return self._owner_cache.setdefault(job_id, name)
+        return None
+
+    def handle_query(self, qreq: dict) -> dict:
+        """Route a read to the bundle's home replica (whose mmap + query
+        caches are warm for it); answer locally from the shared state
+        dirs when that replica is dead — reads survive failover like
+        writes do. ``list`` fans out over alive replicas and merges in
+        a disk scan of the dead ones'."""
+        q = qreq.get("q")
+        t0 = time.time()
+        if q == "list":
+            merged: Dict[str, dict] = {}
+            for name in self.fleet.names():
+                if not self.fleet.alive(name):
+                    continue
+                try:
+                    resp = self._request(
+                        name, {"op": "query", "q": "list"}, timeout=5.0)
+                except (OSError, protocol.ProtocolError):
+                    continue
+                for ent in resp.get("bundles") or []:
+                    if isinstance(ent, dict) and ent.get("bundle"):
+                        merged.setdefault(ent["bundle"],
+                                          dict(ent, replica=name))
+            for name in self.fleet.names():
+                if self.fleet.alive(name):
+                    continue
+                for ent in self._inv_local[name].listing():
+                    merged.setdefault(ent["bundle"],
+                                      dict(ent, replica=name,
+                                           replica_down=True))
+            self.metrics.emit("query", q="list", cache="none",
+                              ms=round((time.time() - t0) * 1e3, 3))
+            return {"event": "query_result", "q": "list",
+                    "bundles": [merged[k] for k in sorted(merged)]}
+        job_id = qreq.get("job_id")
+        if not isinstance(job_id, str) or not job_id:
+            return {"event": "error", "error": "bad_query",
+                    "detail": "query needs a 'job_id' string"}
+        owner = self._bundle_owner(job_id)
+        if owner is None:
+            return {"event": "error", "error": "not_found",
+                    "job_id": job_id,
+                    "detail": f"no bundle for job {job_id!r} on any "
+                              f"replica"}
+        if self.fleet.alive(owner):
+            try:
+                resp = self._request(owner, dict(qreq), timeout=10.0)
+                self.metrics.emit(
+                    "query", q=q, cache="forwarded", served_by=owner,
+                    ms=round((time.time() - t0) * 1e3, 3))
+                return dict(resp, replica=owner)
+            except (OSError, protocol.ProtocolError):
+                # Fall through to the local read; let the probe loop
+                # confirm the death on its own cadence.
+                with self._hlock:
+                    self.health[owner].force_dead(now=time.time())
+        cat = self._inv_local[owner]
+        key, err = inventory.resolve_bundle_key(
+            inventory.scan_bundles(cat.roots), job_id,
+            qreq.get("variant"))
+        if err is not None:
+            return err
+        gene = qreq.get("gene")
+        if gene is not None and not isinstance(gene, str):
+            return {"event": "error", "error": "bad_query",
+                    "detail": f"'gene' must be a string, got {gene!r}"}
+        k = qreq.get("k", 10)
+        if not isinstance(k, int) or isinstance(k, bool):
+            return {"event": "error", "error": "bad_query",
+                    "detail": f"'k' must be an int, got {k!r}"}
+        try:
+            resp = inventory.run_query(cat, q, key, gene=gene, k=k)
+        except inventory.InventoryError as e:
+            self.metrics.emit("query", q=q, cache="router_local",
+                              served_by="router", error=e.code,
+                              ms=round((time.time() - t0) * 1e3, 3))
+            return {"event": "error", "error": e.code,
+                    "detail": e.detail, "job_id": job_id, "bundle": key}
+        self.metrics.emit("query", q=q, cache="router_local",
+                          served_by="router",
+                          ms=round((time.time() - t0) * 1e3, 3))
+        return dict(resp, event="query_result", served_by="router")
+
     # ---- submit relay -----------------------------------------------------
 
     def _relay_submit(self, f, req: dict) -> None:
@@ -818,13 +950,24 @@ class Router:
                 protocol.write_event(f, {"event": "pong", "role": "router",
                                          "pid": os.getpid()})
             elif op == "result":
-                job_id = req.get("job_id")
+                rreq = req
+                job_id = rreq.get("job_id")
                 if not isinstance(job_id, str) or not job_id:
                     protocol.write_event(
                         f, {"event": "error",
                             "error": "result needs a 'job_id' string"})
                 else:
-                    protocol.write_event(f, self.handle_result(job_id))
+                    resp = self.handle_result(job_id)
+                    if resp.get("event") != "pending":
+                        resp = protocol.bound_record(
+                            resp, rreq.get("fields"),
+                            rreq.get("max_bytes"),
+                            self.opts.max_result_bytes
+                            or protocol.MAX_LINE_BYTES)
+                    protocol.write_event(f, resp)
+            elif op == "query":
+                qreq = req
+                protocol.write_event(f, self.handle_query(qreq))
             elif op == "cancel":
                 job_id = req.get("job_id")
                 if not isinstance(job_id, str) or not job_id:
@@ -913,6 +1056,11 @@ class Router:
     def serve_forever(self) -> int:
         import signal
 
+        # Same GIL-handoff tuning as the daemon's serve loop: relay
+        # threads, the probe loop, and router-local failover reads all
+        # share this interpreter, and a forwarded query's wall includes
+        # every GIL hold on the relay path.
+        sys.setswitchinterval(1e-3)
         self.boot_fleet()
         host, port = protocol.parse_addr(self.opts.listen)
         srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)

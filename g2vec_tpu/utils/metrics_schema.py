@@ -89,6 +89,9 @@ EVENT_SCHEMAS = {
     'heartbeat': {
         "required": [],
         "optional": []},
+    'inventory': {
+        "required": ['bundle', 'bytes', 'outcome'],
+        "optional": ['error']},
     'job_accepted': {
         "required": ['n_lanes', 'priority', 'queued', 'tenant'],
         "optional": []},
@@ -131,6 +134,9 @@ EVENT_SCHEMAS = {
     'preprocess': {
         "required": ['n_edges', 'n_genes', 'n_samples'],
         "optional": []},
+    'query': {
+        "required": ['cache', 'ms', 'q'],
+        "optional": ['bundle', 'error', 'served_by']},
     'replica_adopted': {
         "required": ['journal_depth', 'pid', 'replica'],
         "optional": []},

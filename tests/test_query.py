@@ -1,0 +1,683 @@
+"""Interactive query plane (PR 15): blocked top-k kernel exactness vs
+the naive numpy reference, bundle publication/integrity (tamper + torn
+drills), the byte-budgeted mmap LRU, the daemon's ``query`` op (cache,
+token gating, lazy republish from the durable record), the bounded
+``result`` op, and the router's failover read path.
+
+The kernel-exactness tests use INTEGER-VALUED float32 embeddings: every
+dot product is a sum of small integers, exact in float32 under any
+summation order, so "blocked kernel == naive full sort" is a bitwise
+assertion with no BLAS-ordering caveats. The daemon tests reuse the
+in-process admit/step drive from test_serve.py; the lazy-republish and
+auth drills fabricate durable records directly so they stay jax-free.
+"""
+import dataclasses
+import json
+import os
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from g2vec_tpu.ops import knn
+from g2vec_tpu.serve import inventory, protocol
+
+pytestmark = pytest.mark.query
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def tsv_paths(tmp_path_factory):
+    from g2vec_tpu.data.synthetic import SyntheticSpec, write_synthetic_tsv
+
+    spec = SyntheticSpec(n_good=24, n_poor=20, module_size=12,
+                         n_background=24, n_expr_only=4, n_net_only=4,
+                         module_chords=2, background_edges=40, seed=7)
+    out = tmp_path_factory.mktemp("syn")
+    return write_synthetic_tsv(spec, str(out))
+
+
+def _job(tsv_paths, tmp_path, name, **overrides):
+    job = dict(
+        expression_file=tsv_paths["expression"],
+        clinical_file=tsv_paths["clinical"],
+        network_file=tsv_paths["network"],
+        result_name=os.path.join(str(tmp_path), "out", name),
+        lenPath=8, numRepetition=2, sizeHiddenlayer=16, epoch=30,
+        learningRate=0.05, numBiomarker=5, compute_dtype="float32",
+        walker_backend="device")
+    job.update(overrides)
+    return job
+
+
+def _daemon(tmp_path, **opt_overrides):
+    from g2vec_tpu.serve.daemon import ServeDaemon, ServeOptions
+
+    opts = ServeOptions(
+        socket_path=os.path.join(str(tmp_path), "serve.sock"),
+        state_dir=os.path.join(str(tmp_path), "state"), **opt_overrides)
+    return ServeDaemon(opts, console=lambda s: None)
+
+
+def _plant_bundle(dest, g=30, h=8, seed=0, with_scores=True):
+    """Write one real bundle from seeded arrays; returns what went in."""
+    from g2vec_tpu.io.writers import write_inventory_bundle
+
+    rng = np.random.default_rng(seed)
+    emb = rng.standard_normal((g, h)).astype(np.float32)
+    genes = [f"G{i:03d}" for i in range(g)]
+    scores = (rng.standard_normal((2, g)).astype(np.float32)
+              if with_scores else None)
+    write_inventory_bundle(dest, emb, genes, scores, {"source": "test"})
+    return emb, genes, scores
+
+
+def _roundtrip(d, req):
+    """One request over the daemon's real connection handler via a
+    socketpair — exercises the auth gate and the op dispatch without a
+    listener thread."""
+    a, b = socket.socketpair()
+    t = threading.Thread(target=d._handle_conn, args=(a,), daemon=True)
+    t.start()
+    f = b.makefile("rwb")
+    try:
+        protocol.write_event(f, req)
+        ev = protocol.read_event(f)
+    finally:
+        f.close()
+        b.close()
+        t.join(timeout=30)
+    return ev
+
+
+# ---------------------------------------------------------------------------
+# Kernel exactness: blocked top-k == naive full stable sort, bitwise
+# ---------------------------------------------------------------------------
+
+def _naive_cosine(emb, q, k, exclude=-1):
+    """The unblocked full-sort reference the kernels are pinned to:
+    one matmul, one stable descending sort (ties by ascending index)."""
+    emb = np.asarray(emb, dtype=np.float32)
+    q = np.asarray(q, dtype=np.float32)
+    g = emb.shape[0]
+    sims = emb @ q
+    norms = np.sqrt((emb * emb).sum(axis=1))
+    qn = np.float32(np.sqrt(np.dot(q, q)))
+    denom = norms * qn
+    ok = denom > 0
+    sims = np.where(ok, sims / np.where(ok, denom, 1), np.float32(-2.0))
+    if 0 <= exclude < g:
+        sims[exclude] = -np.inf
+    order = np.lexsort((np.arange(g), -sims))[:min(k, g)]
+    return order, sims[order]
+
+
+def _int_embeddings(g=257, h=8, seed=0):
+    rng = np.random.default_rng(seed)
+    emb = rng.integers(-5, 6, size=(g, h)).astype(np.float32)
+    emb[7] = 0.0                  # zero-norm row: must rank last, no nan
+    emb[100] = emb[3]             # exact duplicate: a forced tie
+    emb[101] = emb[3]
+    return emb
+
+
+@pytest.mark.parametrize("k", [1, 5, 50, 257, 400])
+@pytest.mark.parametrize("block_rows", [1, 13, 64, 8192])
+def test_cosine_topk_exact_vs_naive(k, block_rows):
+    emb = _int_embeddings()
+    norms = knn.row_norms(emb, block_rows=block_rows)
+    for exclude in (-1, 3):
+        q = emb[3]
+        idx, sims = knn.cosine_topk(emb, norms, q, k, exclude=exclude,
+                                    block_rows=block_rows)
+        ref_idx, ref_sims = _naive_cosine(emb, q, k, exclude=exclude)
+        assert np.array_equal(idx, ref_idx), \
+            f"k={k} block={block_rows} exclude={exclude}"
+        assert np.array_equal(sims, ref_sims)
+        assert not np.isnan(sims).any()
+
+
+def test_cosine_topk_ties_break_by_ascending_index():
+    emb = _int_embeddings()
+    norms = knn.row_norms(emb)
+    # Rows 3, 100, 101 are identical; excluding 3 leaves 100 and 101
+    # tied at similarity 1.0 — the winner must be the lower index.
+    idx, sims = knn.cosine_topk(emb, norms, emb[3], 2, exclude=3)
+    assert idx[0] == 100 and idx[1] == 101
+    assert sims[0] == sims[1]           # an exact tie, lower index first
+
+
+def test_cosine_topk_zero_norm_scores_minus_two():
+    emb = _int_embeddings()
+    norms = knn.row_norms(emb)
+    g = emb.shape[0]
+    idx, sims = knn.cosine_topk(emb, norms, emb[3], g)
+    assert sims[np.where(idx == 7)[0][0]] == np.float32(-2.0)
+    # A zero query degrades every similarity to -2.0, never nan/inf.
+    zidx, zsims = knn.cosine_topk(emb, norms, np.zeros(emb.shape[1]), 5)
+    assert np.all(zsims == np.float32(-2.0))
+    assert np.array_equal(zidx, np.arange(5))    # pure index tiebreak
+
+
+def test_topk_scores_exact_vs_naive():
+    rng = np.random.default_rng(1)
+    scores = rng.integers(-50, 51, size=301).astype(np.float32)
+    scores[10] = scores[200] = scores[20]         # forced 3-way tie
+    for k in (1, 7, 301, 500):
+        idx, vals = knn.topk_scores(scores, k)
+        order = np.lexsort((np.arange(301), -scores))[:min(k, 301)]
+        assert np.array_equal(idx, order)
+        assert np.array_equal(vals, scores[order])
+
+
+def test_row_norms_blocking_invariant():
+    emb = _int_embeddings(g=103)
+    outs = [knn.row_norms(emb, block_rows=b) for b in (1, 7, 64, 8192)]
+    for o in outs[1:]:
+        assert np.array_equal(outs[0], o)
+
+
+# ---------------------------------------------------------------------------
+# Bundle integrity + catalog: roundtrip, tamper, torn, LRU byte budget
+# ---------------------------------------------------------------------------
+
+def test_bundle_roundtrip_preserves_arrays(tmp_path):
+    dest = str(tmp_path / "inv" / "j1" / "v0")
+    emb, genes, scores = _plant_bundle(dest)
+    cat = inventory.InventoryCatalog([str(tmp_path / "inv")],
+                                     budget_bytes=1 << 30)
+    b = cat.get("j1/v0")
+    assert np.array_equal(np.asarray(b.embeddings), emb)
+    assert np.array_equal(np.asarray(b.norms), knn.row_norms(emb))
+    assert np.array_equal(np.asarray(b.scores), scores)
+    assert b.genes == genes and b.gene_index["G003"] == 3
+    assert b.meta["n_genes"] == len(genes) and b.meta["has_scores"]
+    # Warm get is the same mapping, not a remap.
+    assert cat.get("j1/v0") is b
+    assert cat.stats()["cold_maps"] == 1
+
+
+def test_tampered_bundle_is_refused(tmp_path):
+    dest = str(tmp_path / "inv" / "j1" / "v0")
+    _plant_bundle(dest)
+    path = os.path.join(dest, "embeddings.npy")
+    with open(path, "r+b") as f:             # same size, different bytes
+        f.seek(os.path.getsize(path) - 3)
+        orig = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([orig[0] ^ 0xFF]))
+    cat = inventory.InventoryCatalog([str(tmp_path / "inv")],
+                                     budget_bytes=1 << 30)
+    with pytest.raises(inventory.InventoryError) as ei:
+        cat.get("j1/v0")
+    assert ei.value.code == "tampered"
+    # Truncation is caught by the cheaper size check first.
+    with open(path, "r+b") as f:
+        f.truncate(os.path.getsize(path) - 8)
+    with pytest.raises(inventory.InventoryError) as ei:
+        cat.get("j1/v0")
+    assert ei.value.code == "tampered"
+    assert cat.stats()["map_errors"] == 2
+
+
+def test_torn_bundle_is_refused(tmp_path):
+    dest = str(tmp_path / "inv" / "j1" / "v0")
+    _plant_bundle(dest)
+    os.unlink(os.path.join(dest, "genes.txt"))   # manifest names it
+    cat = inventory.InventoryCatalog([str(tmp_path / "inv")],
+                                     budget_bytes=1 << 30)
+    with pytest.raises(inventory.InventoryError) as ei:
+        cat.get("j1/v0")
+    assert ei.value.code == "torn"
+    # Without a manifest the directory is not a bundle at all: it never
+    # enters the catalog, so the failure mode is not_found.
+    os.unlink(os.path.join(dest, inventory.INVENTORY_MANIFEST))
+    with pytest.raises(inventory.InventoryError) as ei:
+        cat.get("j1/v0")
+    assert ei.value.code == "not_found"
+
+
+def test_catalog_lru_respects_byte_budget(tmp_path):
+    root = str(tmp_path / "inv")
+    for i in range(4):
+        _plant_bundle(os.path.join(root, f"j{i}", "v0"), seed=i)
+    probe = inventory.InventoryCatalog([root], budget_bytes=1 << 30)
+    size = probe.get("j0/v0").nbytes
+    cat = inventory.InventoryCatalog([root], budget_bytes=2 * size)
+    for i in range(4):
+        cat.get(f"j{i}/v0")
+    st = cat.stats()
+    assert st["bytes_mapped"] <= 2 * size
+    assert st["bundles_mapped"] == 2
+    assert st["cold_maps"] == 4 and st["evictions"] == 2
+    assert st["bundles_cataloged"] == 4      # eviction unmaps, not deletes
+    # LRU order: j2/j3 survive, j0 remaps cold and evicts j2.
+    cat.get("j3/v0")
+    assert cat.stats()["cold_maps"] == 4
+    cat.get("j0/v0")
+    assert cat.stats()["cold_maps"] == 5
+    # A budget smaller than one bundle still maps (exactly) one.
+    tiny = inventory.InventoryCatalog([root], budget_bytes=1)
+    tiny.get("j1/v0")
+    assert tiny.stats()["bundles_mapped"] == 1
+
+
+def test_resolve_bundle_key_matrix():
+    known = {"ia/v0": "/x", "ia/v1": "/y", "ib/v0": "/z",
+             "solo_inventory": "/s"}
+    assert inventory.resolve_bundle_key(known, "ia", "v1") == ("ia/v1",
+                                                              None)
+    assert inventory.resolve_bundle_key(known, "ib", None) == ("ib/v0",
+                                                               None)
+    assert inventory.resolve_bundle_key(
+        known, "solo_inventory", None) == ("solo_inventory", None)
+    key, err = inventory.resolve_bundle_key(known, "ia", None)
+    assert key is None and err["error"] == "ambiguous_variant"
+    assert err["variants"] == ["v0", "v1"]
+    key, err = inventory.resolve_bundle_key(known, "ia", "v9")
+    assert key is None and err["error"] == "not_found"
+    assert err["variants"] == ["v0", "v1"]
+    key, err = inventory.resolve_bundle_key(known, "nope", None)
+    assert key is None and err["error"] == "not_found"
+
+
+def test_query_cache_lru_and_invalidation():
+    qc = inventory.QueryCache(capacity=2)
+    calls = []
+
+    def make(v):
+        def _c():
+            calls.append(v)
+            return {"v": v}
+        return _c
+
+    k1 = inventory.cache_key("b1", "neighbors", "G1", 5)
+    assert qc.get_or_put(k1, make(1)) == ({"v": 1}, False)
+    assert qc.get_or_put(k1, make(99)) == ({"v": 1}, True)
+    assert calls == [1]
+    qc.get_or_put(inventory.cache_key("b1", "neighbors", "G2", 5), make(2))
+    qc.get_or_put(inventory.cache_key("b2", "meta", None, 0), make(3))
+    # Capacity 2: k1 (the LRU entry) fell out.
+    assert qc.get_or_put(k1, make(4)) == ({"v": 4}, False)
+    st = qc.stats()
+    assert st["hits"] == 1 and st["misses"] == 4 and st["entries"] == 2
+    # Invalidation is bundle-scoped: b2 keys survive a b1 republish.
+    qc.invalidate_bundle("b1")
+    _, hit = qc.get_or_put(inventory.cache_key("b2", "meta", None, 0),
+                           make(5))
+    assert hit
+    _, hit = qc.get_or_put(k1, make(6))
+    assert not hit
+
+
+def test_run_query_against_planted_bundle(tmp_path):
+    dest = str(tmp_path / "inv" / "j1" / "v0")
+    emb, genes, scores = _plant_bundle(dest, g=40, h=8)
+    cat = inventory.InventoryCatalog([str(tmp_path / "inv")],
+                                     budget_bytes=1 << 30)
+    r = inventory.run_query(cat, "neighbors", "j1/v0", gene="G005", k=3)
+    # Plumbing check against the kernel itself (kernel-vs-naive
+    # exactness is pinned above on integer-valued data, where bitwise
+    # equality is summation-order-proof).
+    ridx, rsims = knn.cosine_topk(emb, knn.row_norms(emb), emb[5], 3,
+                                  exclude=5)
+    assert r["neighbors"] == [genes[i] for i in ridx]
+    assert r["sims"] == [float(s) for s in rsims]
+    t = inventory.run_query(cat, "topk_biomarkers", "j1/v0", k=4)
+    for row, group in enumerate(("good", "poor")):
+        gidx, gsc = knn.topk_scores(scores[row], 4)
+        assert t[group]["genes"] == [genes[i] for i in gidx]
+        assert t[group]["scores"] == [float(s) for s in gsc]
+    m = inventory.run_query(cat, "meta", "j1/v0")
+    assert m["n_genes"] == 40 and m["hidden"] == 8
+    # Structured refusals, not exceptions leaking numpy internals.
+    for bad in [dict(q="frobnicate"), dict(q="neighbors"),
+                dict(q="neighbors", gene="NOPE"),
+                dict(q="neighbors", gene="G005", k=0),
+                dict(q="neighbors", gene="G005", k=10001)]:
+        with pytest.raises(inventory.InventoryError) as ei:
+            inventory.run_query(cat, bad["q"], "j1/v0",
+                                gene=bad.get("gene"), k=bad.get("k", 10))
+        assert ei.value.code == "bad_query"
+    # A scores-less bundle (the republish shape) refuses biomarkers.
+    _plant_bundle(str(tmp_path / "inv" / "j2" / "v0"), with_scores=False)
+    with pytest.raises(inventory.InventoryError) as ei:
+        inventory.run_query(cat, "topk_biomarkers", "j2/v0", k=2)
+    assert ei.value.code == "scores_unavailable"
+    assert inventory.run_query(cat, "neighbors", "j2/v0", gene="G000",
+                               k=2)["neighbors"]
+
+
+# ---------------------------------------------------------------------------
+# Daemon: publication on completion, the query op, cache, solo parity
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def served(tsv_paths, tmp_path_factory):
+    """One completed served job with a published bundle, shared by the
+    read-only daemon tests below (none of them mutates the bundle)."""
+    base = tmp_path_factory.mktemp("served")
+    d = _daemon(base)
+    sub = d.admit({"tenant": "alice",
+                   "job": {**_job(tsv_paths, base, "q1"),
+                           "variants": [{"name": "v0",
+                                         "train_seed": 1}]}})
+    assert sub["event"] == "accepted"
+    assert d.step() == 1
+    return {"d": d, "job_id": sub["job_id"],
+            "key": f"{sub['job_id']}/v0",
+            "dir": os.path.join(d.opts.state_dir, "inventory",
+                                sub["job_id"], "v0")}
+
+
+def test_daemon_publishes_verified_bundle(served):
+    from g2vec_tpu.io.writers import INVENTORY_ARRAYS, INVENTORY_MANIFEST
+
+    for fn in INVENTORY_ARRAYS + (INVENTORY_MANIFEST, "meta.json"):
+        assert os.path.exists(os.path.join(served["dir"], fn)), fn
+    b = served["d"].catalog.get(served["key"])     # full sha256 verify
+    assert b.meta["source"] == "serve"
+    assert b.meta["job_id"] == served["job_id"]
+    assert b.meta["variant"] == "v0" and b.meta["tenant"] == "alice"
+    assert np.array_equal(np.asarray(b.norms),
+                          knn.row_norms(np.asarray(b.embeddings)))
+
+
+def test_daemon_query_ops_and_cache(served):
+    d = served["d"]
+    lst = d.handle_query({"q": "list"})
+    assert lst["event"] == "query_result"
+    assert any(e["bundle"] == served["key"] for e in lst["bundles"])
+
+    meta = d.handle_query({"q": "meta", "job_id": served["job_id"],
+                           "variant": "v0"})
+    assert meta["event"] == "query_result" and meta["hidden"] == 16
+
+    emb = np.load(os.path.join(served["dir"], "embeddings.npy"))
+    norms = np.load(os.path.join(served["dir"], "norms.npy"))
+    with open(os.path.join(served["dir"], "genes.txt")) as f:
+        genes = [ln.rstrip("\n") for ln in f]
+    gene = genes[0]
+    n1 = d.handle_query({"q": "neighbors", "job_id": served["job_id"],
+                         "gene": gene, "k": 4})    # variant auto-resolves
+    assert n1["event"] == "query_result" and n1["bundle"] == served["key"]
+    ridx, rsims = knn.cosine_topk(emb, norms, emb[0], 4, exclude=0)
+    assert n1["neighbors"] == [genes[i] for i in ridx]
+    assert n1["sims"] == [float(s) for s in rsims]
+
+    # Identical query again: answered from the result cache.
+    h0 = d.qcache.stats()["hits"]
+    n2 = d.handle_query({"q": "neighbors", "job_id": served["job_id"],
+                         "gene": gene, "k": 4})
+    assert {k: v for k, v in n2.items()} == {k: v for k, v in n1.items()}
+    assert d.qcache.stats()["hits"] == h0 + 1
+
+    tk = d.handle_query({"q": "topk_biomarkers",
+                         "job_id": served["job_id"], "k": 3})
+    scores = np.load(os.path.join(served["dir"], "scores.npy"))
+    for row, group in enumerate(("good", "poor")):
+        gidx, gsc = knn.topk_scores(scores[row], 3)
+        assert tk[group]["genes"] == [genes[i] for i in gidx]
+        assert tk[group]["scores"] == [float(s) for s in gsc]
+
+    st = d.status()["inventory"]
+    assert st["bundles_cataloged"] >= 1 and st["bundles_mapped"] >= 1
+    assert st["query_cache"]["hits"] >= 1
+
+    for bad, want in [
+            ({"q": "frobnicate"}, "bad_query"),
+            ({"q": "neighbors"}, "bad_query"),
+            ({"q": "neighbors", "job_id": "inope", "gene": gene},
+             "not_found"),
+            ({"q": "neighbors", "job_id": served["job_id"],
+              "variant": "v9", "gene": gene}, "not_found"),
+            ({"q": "neighbors", "job_id": served["job_id"],
+              "gene": 7}, "bad_query"),
+            ({"q": "neighbors", "job_id": served["job_id"],
+              "gene": gene, "k": True}, "bad_query"),
+            ({"q": "neighbors", "job_id": served["job_id"],
+              "gene": "NOT_A_GENE"}, "bad_query")]:
+        resp = d.handle_query(bad)
+        assert resp["event"] == "error" and resp["error"] == want, bad
+
+
+def test_solo_emit_inventory_bundle_is_byte_identical(served, tsv_paths,
+                                                      tmp_path):
+    """--emit-inventory on a solo run writes the SAME array bytes the
+    daemon published for the equivalent lane — the PR 5 parity contract
+    extended to the query plane's binary format."""
+    from g2vec_tpu.batch.engine import _variant_from_dict, lane_config
+    from g2vec_tpu.config import config_from_job
+    from g2vec_tpu.io.writers import INVENTORY_ARRAYS
+    from g2vec_tpu.pipeline import run as solo_run
+
+    os.makedirs(os.path.join(str(tmp_path), "out"), exist_ok=True)
+    cfg = config_from_job(_job(tsv_paths, tmp_path, "solo1"))
+    cfg = dataclasses.replace(cfg, emit_inventory=True)
+    v = _variant_from_dict(0, {"name": "v0", "train_seed": 1}, cfg)
+    lane = lane_config(cfg, v)
+    solo_run(lane, console=lambda s: None)
+    solo_dir = lane.result_name + "_inventory"
+    assert os.path.isdir(solo_dir)
+    for fn in INVENTORY_ARRAYS:
+        with open(os.path.join(solo_dir, fn), "rb") as a, \
+                open(os.path.join(served["dir"], fn), "rb") as b:
+            assert a.read() == b.read(), \
+                f"{fn}: solo bundle differs from served bundle"
+    # And the solo bundle is addressable as a depth-1 catalog key.
+    cat = inventory.InventoryCatalog([os.path.dirname(solo_dir)],
+                                     budget_bytes=1 << 30)
+    key = os.path.basename(solo_dir)
+    assert inventory.run_query(cat, "meta", key)["n_genes"] > 0
+
+
+# ---------------------------------------------------------------------------
+# Lazy republish, token gating, bounded result op (all jax-free fakes)
+# ---------------------------------------------------------------------------
+
+def test_daemon_lazy_republish_from_durable_record(tmp_path):
+    """A tampered bundle costs latency, never a wrong answer: the query
+    triggers a rebuild from the durable record's _vectors.txt, answers
+    neighbors/meta, and reports topk_biomarkers as scores_unavailable
+    (the [2, G] matrix is not recoverable from text outputs)."""
+    d = _daemon(tmp_path)
+    jid = "i" + "a" * 12
+    rng = np.random.default_rng(3)
+    emb = rng.integers(-5, 6, size=(20, 8)).astype(np.float32)
+    genes = [f"G{i:03d}" for i in range(20)]
+    vec = os.path.join(str(tmp_path), "q_vectors.txt")
+    with open(vec, "w") as f:
+        f.write("GeneSymbol\t" + "\t".join(f"d{i}" for i in range(8))
+                + "\n")
+        for g, row in zip(genes, emb):
+            f.write(g + "\t" + "\t".join(repr(float(x)) for x in row)
+                    + "\n")
+    with open(os.path.join(d.opts.state_dir, "results", f"{jid}.json"),
+              "w") as f:
+        json.dump({"event": "job_done", "job_id": jid, "status": "done",
+                   "variants": {"v0": {"outputs": [vec]}}}, f)
+    dest = os.path.join(d.opts.state_dir, "inventory", jid, "v0")
+    _plant_bundle(dest)
+    path = os.path.join(dest, "embeddings.npy")
+    with open(path, "r+b") as f:
+        f.seek(os.path.getsize(path) - 3)
+        orig = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([orig[0] ^ 0xFF]))
+
+    resp = d.handle_query({"q": "neighbors", "job_id": jid,
+                           "variant": "v0", "gene": "G000", "k": 3})
+    assert resp["event"] == "query_result", resp
+    want, _ = _naive_cosine(emb, emb[0], 3, exclude=0)
+    assert resp["neighbors"] == [genes[i] for i in want]
+    meta = d.handle_query({"q": "meta", "job_id": jid, "variant": "v0"})
+    assert meta["meta"]["source"] == "republish"
+    tk = d.handle_query({"q": "topk_biomarkers", "job_id": jid,
+                         "variant": "v0", "k": 2})
+    assert tk["event"] == "error"
+    assert tk["error"] == "scores_unavailable"
+
+    # No durable record to rebuild from: the corruption surfaces as-is.
+    jid2 = "i" + "b" * 12
+    dest2 = os.path.join(d.opts.state_dir, "inventory", jid2, "v0")
+    _plant_bundle(dest2, seed=9)
+    p2 = os.path.join(dest2, "norms.npy")
+    with open(p2, "r+b") as f:
+        f.truncate(os.path.getsize(p2) - 4)
+    resp = d.handle_query({"q": "neighbors", "job_id": jid2,
+                           "variant": "v0", "gene": "G000", "k": 2})
+    assert resp["event"] == "error" and resp["error"] == "tampered"
+
+
+def test_query_op_is_token_gated(tmp_path):
+    d = _daemon(tmp_path, auth_token="sekret-42")
+    resp = _roundtrip(d, {"op": "query", "q": "list"})
+    assert resp["event"] == "rejected" and resp["error"] == "unauthorized"
+    resp = _roundtrip(d, {"op": "query", "q": "list",
+                          "auth_token": "wrong"})
+    assert resp["event"] == "rejected"
+    resp = _roundtrip(d, {"op": "query", "q": "list",
+                          "auth_token": "sekret-42"})
+    assert resp["event"] == "query_result" and resp["bundles"] == []
+    # Health stays credential-free: the router's probes must not need
+    # the secret.
+    assert _roundtrip(d, {"op": "status"})["event"] == "status"
+
+
+def test_result_op_is_bounded(tmp_path):
+    rec = {"event": "job_done", "job_id": "i" + "c" * 12,
+           "status": "done", "acc_val": 0.9,
+           "outputs": ["x" * 2000], "variants": {"v": {"acc": 1}}}
+    # The shared bounding primitive: selector + cap.
+    out = protocol.bound_record(rec, ["status"], None, 1 << 20)
+    assert out == {"event": "job_done", "job_id": rec["job_id"],
+                   "status": "done"}
+    out = protocol.bound_record(rec, "status", None, 1 << 20)
+    assert out["error"] == "bad_fields"
+    out = protocol.bound_record(rec, None, 256, 1 << 20)
+    assert out["error"] == "oversized_result"
+    assert out["bytes"] > 256 and out["max_bytes"] == 256
+    assert "outputs" in out["fields_available"]
+    # The server cap binds even a greedy client max_bytes.
+    assert protocol.bound_record(rec, None, 1 << 20,
+                                 256)["error"] == "oversized_result"
+
+    # End to end over the connection handler, against a planted record.
+    d = _daemon(tmp_path, max_result_bytes=300)
+    with open(os.path.join(d.opts.state_dir, "results",
+                           f"{rec['job_id']}.json"), "w") as f:
+        json.dump(rec, f)
+    resp = _roundtrip(d, {"op": "result", "job_id": rec["job_id"]})
+    assert resp["error"] == "oversized_result"
+    resp = _roundtrip(d, {"op": "result", "job_id": rec["job_id"],
+                          "fields": ["status", "acc_val"]})
+    assert resp == {"event": "job_done", "job_id": rec["job_id"],
+                    "status": "done", "acc_val": 0.9}
+    resp = _roundtrip(d, {"op": "result", "job_id": "i" + "d" * 12})
+    assert resp["event"] == "pending"
+
+
+# ---------------------------------------------------------------------------
+# Router: failover reads from shared disk when the home replica is dead
+# ---------------------------------------------------------------------------
+
+def test_router_answers_query_for_dead_replica(tmp_path):
+    """No replica process ever boots: every bundle owner is dead, so
+    the router maps the bundle from the shared fleet directory and
+    answers with the same inventory.run_query the daemon uses."""
+    from g2vec_tpu.serve.router import Router, RouterOptions
+
+    fleet_dir = str(tmp_path / "fleet")
+    r = Router(RouterOptions(fleet_dir=fleet_dir, replicas=2),
+               console=lambda s: None)
+    jid = "i" + "e" * 12
+    dest = os.path.join(fleet_dir, "r0", "state", "inventory", jid, "v0")
+    emb, genes, scores = _plant_bundle(dest, g=25, h=8, seed=5)
+    assert r._bundle_owner(jid) == "r0"
+
+    resp = r.handle_query({"q": "neighbors", "job_id": jid,
+                           "gene": "G004", "k": 3})
+    assert resp["event"] == "query_result"
+    assert resp["served_by"] == "router"
+    ridx, rsims = _naive_cosine(emb, emb[4], 3, exclude=4)
+    assert resp["neighbors"] == [genes[i] for i in ridx]
+    assert resp["sims"] == [float(s) for s in rsims]
+
+    tk = r.handle_query({"q": "topk_biomarkers", "job_id": jid, "k": 2})
+    assert tk["event"] == "query_result" and tk["served_by"] == "router"
+    meta = r.handle_query({"q": "meta", "job_id": jid, "variant": "v0"})
+    assert meta["n_genes"] == 25
+
+    lst = r.handle_query({"q": "list"})
+    ent = next(e for e in lst["bundles"] if e["bundle"] == f"{jid}/v0")
+    assert ent["replica"] == "r0" and ent["replica_down"] is True
+
+    resp = r.handle_query({"q": "neighbors", "job_id": "i" + "f" * 12,
+                           "gene": "G000"})
+    assert resp["event"] == "error" and resp["error"] == "not_found"
+    # Ambiguity is the same structured refusal the daemon gives.
+    _plant_bundle(os.path.join(fleet_dir, "r0", "state", "inventory",
+                               jid, "v1"), g=25, h=8, seed=6)
+    resp = r.handle_query({"q": "meta", "job_id": jid})
+    assert resp["error"] == "ambiguous_variant"
+    assert resp["variants"] == ["v0", "v1"]
+
+
+def test_owner_and_resolve_caches_skip_rescans(tmp_path, monkeypatch):
+    """The warm query path never walks directories: the router caches
+    job->owner (placement is sticky, bundles never move), the daemon
+    caches its scan_bundles view (it is the only writer of its root).
+    Misses still rescan, so late-published bundles are found."""
+    from g2vec_tpu.serve.daemon import ServeDaemon
+    from g2vec_tpu.serve.router import Router, RouterOptions
+
+    calls = {"n": 0}
+    real_scan = inventory.scan_bundles
+
+    def counting_scan(roots):
+        calls["n"] += 1
+        return real_scan(roots)
+
+    monkeypatch.setattr(inventory, "scan_bundles", counting_scan)
+
+    fleet_dir = str(tmp_path / "fleet")
+    r = Router(RouterOptions(fleet_dir=fleet_dir, replicas=3),
+               console=lambda s: None)
+    jid = "i" + "a" * 12
+    _plant_bundle(os.path.join(fleet_dir, "r1", "state", "inventory",
+                               jid, "v0"), g=10, h=4, seed=1)
+    assert r._bundle_owner(jid) == "r1"
+    first = calls["n"]
+    assert first >= 2                 # walked r0 then found it on r1
+    for _ in range(5):
+        assert r._bundle_owner(jid) == "r1"
+    assert calls["n"] == first        # every repeat was a dict hit
+    # A genuinely unknown job still rescans (and stays uncached).
+    assert r._bundle_owner("i" + "b" * 12) is None
+    assert calls["n"] == first + 3
+
+    d = _daemon(tmp_path)
+    jid2 = "i" + "c" * 12
+    _plant_bundle(os.path.join(str(tmp_path), "state", "inventory",
+                               jid2, "v0"), g=10, h=4, seed=2)
+    calls["n"] = 0
+    assert d._resolve_bundle(jid2, None) == (f"{jid2}/v0", None)
+    assert calls["n"] == 1            # cold: one rescan populated it
+    for variant in (None, "v0"):
+        assert d._resolve_bundle(jid2, variant) == (f"{jid2}/v0", None)
+    assert calls["n"] == 1            # warm: zero directory walks
+    # A bundle that appears after the cache was built is still found:
+    # the miss rescans before erroring.
+    jid3 = "i" + "d" * 12
+    _plant_bundle(os.path.join(str(tmp_path), "state", "inventory",
+                               jid3, "v0"), g=10, h=4, seed=3)
+    assert d._resolve_bundle(jid3, None) == (f"{jid3}/v0", None)
+    assert calls["n"] == 2
+    # Publish-time reset keeps omitted-variant auto-resolve exact.
+    d._inv_known = {}
+    _plant_bundle(os.path.join(str(tmp_path), "state", "inventory",
+                               jid2, "v1"), g=10, h=4, seed=4)
+    key, err = d._resolve_bundle(jid2, None)
+    assert key is None and err["error"] == "ambiguous_variant"

@@ -41,14 +41,18 @@ while [ "$arms" -lt "$MAX_ARMS" ] && [ "$(date +%s)" -lt "$DEADLINE" ]; do
     # identity, range-reader pins, walk_handoff/halo_build sigkill
     # drills), and the scenario matrix (reducer units, plan/seed-tree
     # determinism, replicate-vs-solo byte parity, permutation walk
-    # accounting, serve-path exactly-once SIGKILL drill).
+    # accounting, serve-path exactly-once SIGKILL drill), and the query
+    # matrix (blocked top-k kernel exactness vs numpy, bundle
+    # tamper/torn integrity drills, mmap LRU byte budget, daemon query
+    # ops + token gating, lazy republish, result bounding, router
+    # failover reads).
     # Non-fatal: a red matrix is reported, the chip battery still runs.
     if ! JAX_PLATFORMS=cpu "$PY" -m pytest tests/test_resilience.py \
             tests/test_fleet.py tests/test_fleet_e2e.py \
             tests/test_overlap_cache.py tests/test_batch_engine.py \
             tests/test_serve.py tests/test_stream.py tests/test_shard.py \
             tests/test_router.py tests/test_edge.py \
-            tests/test_scenario.py \
+            tests/test_scenario.py tests/test_query.py \
             -q -m "not slow" \
             -p no:cacheprovider >/tmp/fault_matrix_arm$arms.log 2>&1; then
         echo "[watch_loop] WARNING: fault/fleet matrix FAILED on arm $arms (log: /tmp/fault_matrix_arm$arms.log)"
