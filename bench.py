@@ -190,6 +190,25 @@ ROUTER_CHAOS_BUDGET = float(os.environ.get("G2VEC_BENCH_ROUTER_BUDGET",
                                            "1200"))
 ROUTER_CHAOS_ARTIFACT = "BENCH_ROUTER_CHAOS.json"
 
+# Elastic autoscaling A/B (serve/router.py scaling controller +
+# serve/daemon.py tenant SLOs): one seeded diurnal+burst schedule of
+# tenant-tagged jobs (gold/silver/bulk, distinct deadlines and compile
+# shapes), one replica SIGKILLed mid-spike, run twice — a static
+# 1-replica fleet vs the elastic fleet (ceiling 2, one pre-warmed
+# spare, shed + quotas). Acceptance: static reproduces the
+# deadline-death failure mode (>= 4 of 50), elastic holds it to <= 1
+# with per-tenant SLO attainment at least as good, and BOTH arms keep
+# exactly-once accounting (0 lost / 0 duplicated) across every scale
+# and kill event.
+AUTOSCALE_JOBS = int(os.environ.get("G2VEC_BENCH_AUTOSCALE_JOBS", "50"))
+AUTOSCALE_SEED = int(os.environ.get("G2VEC_BENCH_AUTOSCALE_SEED", "11"))
+AUTOSCALE_BUDGET = float(os.environ.get("G2VEC_BENCH_AUTOSCALE_BUDGET",
+                                        "420"))
+AUTOSCALE_QUOTAS = os.environ.get(
+    "G2VEC_BENCH_AUTOSCALE_QUOTAS",
+    "gold:6:12:3;silver:3:6:2;bulk:0.8:2:1")
+AUTOSCALE_ARTIFACT = "BENCH_AUTOSCALE.json"
+
 # Interactive query plane (serve/inventory.py + ops/knn.py): seeded
 # Poisson query load against a replicated fleet, concurrent with
 # training jobs, one replica SIGKILLed mid-run. Cold = first touch of a
@@ -1729,6 +1748,125 @@ def _router_chaos() -> None:
                        "written_by": "bench.py --_router_chaos"}, f,
                       indent=1)
         note(f"wrote {ROUTER_CHAOS_ARTIFACT}")
+    if not line["ok"]:
+        sys.exit(1)
+
+
+def _autoscale_arm(note, tag, extra_argv) -> dict:
+    """One arm of the autoscale A/B: tools/chaos_soak.py --autoscale
+    under the shared seeded schedule, static or elastic per
+    extra_argv."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "G2V_CHAOS_JOBS": str(AUTOSCALE_JOBS),
+           "G2V_CHAOS_SEED": str(AUTOSCALE_SEED),
+           "G2V_CHAOS_BUDGET": str(AUTOSCALE_BUDGET),
+           "G2V_CHAOS_STREAM_FRAC": "0",
+           "G2V_CHAOS_VERIFY": "2"}
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "chaos_soak.py"),
+         "--autoscale", "--replicas", "1"] + extra_argv,
+        capture_output=True, text=True, env=env,
+        timeout=AUTOSCALE_BUDGET + 180)
+    for ln in (proc.stderr or "").splitlines():
+        if ln.startswith("# "):
+            note(f"autoscale[{tag}] {ln[2:]}")
+    try:
+        summary = json.loads(proc.stdout)
+    except ValueError:
+        raise RuntimeError(
+            f"autoscale soak ({tag}) emitted no summary "
+            f"(rc={proc.returncode}): "
+            f"{(proc.stderr or proc.stdout)[-400:]}")
+    summary["_rc"] = proc.returncode
+    return summary
+
+
+def _autoscale_arm_digest(summary) -> dict:
+    """The per-arm fields the A/B verdict and the artifact reader
+    care about."""
+    return {
+        "ok": bool(summary.get("ok")) and summary.get("_rc") == 0,
+        "deadline_deaths": summary.get("deadline_deaths"),
+        "attainment": summary.get("attainment"),
+        "attainment_overall": summary.get("attainment_overall"),
+        "goodput_done_per_min": summary.get("goodput_done_per_min"),
+        "terminal_by_status": summary.get("terminal_by_status"),
+        "accepted": summary.get("accepted"),
+        "gave_up": summary.get("gave_up"),
+        "lost": len(summary.get("lost", ())),
+        "duplicated": len(summary.get("duplicated", ())),
+        "replica_kills": summary.get("replica_kills"),
+        "failovers": summary.get("failovers"),
+        "shed_events": summary.get("shed_events"),
+        "quota_events": summary.get("quota_events"),
+        "shed_fraction": summary.get("shed_fraction"),
+        "scale_ups": summary.get("scale_ups"),
+        "scale_downs": summary.get("scale_downs"),
+        "scale_up_reaction_p50_s": summary.get("scale_up_reaction_p50_s"),
+        "scale_up_reaction_max_s": summary.get("scale_up_reaction_max_s"),
+        "max_active_seen": summary.get("max_active_seen"),
+        "warm_pool_events": summary.get("warm_pool_events"),
+        "wall_s": summary.get("wall_s"),
+    }
+
+
+def _autoscale_ab_line(note) -> dict:
+    """Elastic autoscaling A/B: identical seeded diurnal+burst tenant
+    schedule (replica SIGKILLed mid-spike in both arms) against a
+    static 1-replica fleet and the elastic fleet (max 2, one
+    pre-warmed spare, deadline shed + tenant quotas)."""
+    t0 = time.time()
+    static = _autoscale_arm(note, "static", [])
+    elastic = _autoscale_arm(
+        note, "elastic",
+        ["--max-replicas", "2", "--warm-spares", "1", "--shed",
+         "--tenant-quotas", AUTOSCALE_QUOTAS])
+    st, el = _autoscale_arm_digest(static), _autoscale_arm_digest(elastic)
+    st_deaths = st["deadline_deaths"]
+    el_deaths = el["deadline_deaths"]
+    ok = (st["ok"] and el["ok"]
+          and st_deaths is not None and st_deaths >= 4
+          and el_deaths is not None and el_deaths <= 1
+          and st["lost"] == 0 and el["lost"] == 0
+          and st["duplicated"] == 0 and el["duplicated"] == 0
+          and (el["attainment_overall"] or 0.0)
+          >= (st["attainment_overall"] or 1.0))
+    return {
+        "metric": "autoscale_deadline_deaths_averted",
+        "value": (st_deaths - el_deaths
+                  if None not in (st_deaths, el_deaths) else None),
+        "unit": "jobs", "ok": ok,
+        "jobs": AUTOSCALE_JOBS, "seed": AUTOSCALE_SEED,
+        "tenant_quotas": AUTOSCALE_QUOTAS,
+        "static": st, "elastic": el,
+        "wall_s": round(time.time() - t0, 1),
+        "note": "same seeded diurnal+burst schedule (gold/silver/bulk "
+                "tenants, replica SIGKILL mid-spike) twice: static "
+                "1-replica fleet vs elastic (max 2, one pre-warmed "
+                "spare, deadline shed + tenant quotas); acceptance = "
+                "static reproduces >=4/50 deadline deaths, elastic "
+                "<=1 with attainment at least as good, both arms "
+                "0 lost / 0 duplicated across every scale and kill "
+                "event",
+    }
+
+
+def _autoscale_ab() -> None:
+    """Standalone mode: run the autoscale A/B and (with
+    G2VEC_BENCH_AUTOSCALE_WRITE=1) refresh the committed artifact."""
+    def note(msg):
+        print(f"# {msg}", file=sys.stderr, flush=True)
+
+    line = _autoscale_ab_line(note)
+    print(json.dumps(line), flush=True)
+    if os.environ.get("G2VEC_BENCH_AUTOSCALE_WRITE") == "1":
+        repo = os.path.dirname(os.path.abspath(__file__))
+        with open(os.path.join(repo, AUTOSCALE_ARTIFACT), "w") as f:
+            json.dump({"line": line, "code_key": _current_code_key(repo),
+                       "written_by": "bench.py --_autoscale_ab"}, f,
+                      indent=1)
+        note(f"wrote {AUTOSCALE_ARTIFACT}")
     if not line["ok"]:
         sys.exit(1)
 
@@ -3390,6 +3528,8 @@ if __name__ == "__main__":
         _stream_ab()
     elif "--_router_chaos" in sys.argv:
         _router_chaos()
+    elif "--_autoscale_ab" in sys.argv:
+        _autoscale_ab()
     elif "--_query_latency" in sys.argv:
         _query_latency()
     elif "--_chaos_soak" in sys.argv:

@@ -454,7 +454,8 @@ def build_halo_csr(pcsr: PartitionedCSR, *, rank: int, n_ranks: int,
 @dataclasses.dataclass
 class EdgeWalkStats:
     """Handoff accounting across one run's shards (metrics ``handoff``
-    event + BENCH_EDGE_PARTITION.json)."""
+    event; surfaced in BENCH_EDGE_PARTITION.json when
+    ``bench.py --_edge_ab`` regenerates it)."""
 
     shards: int = 0
     rounds: int = 0

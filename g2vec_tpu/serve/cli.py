@@ -99,6 +99,34 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "it is refused with retry_later (default 120); "
                         "never ring-placed elsewhere, which would run "
                         "the job twice.")
+    p.add_argument("--min-replicas", type=int, default=0, metavar="N",
+                   help="Router mode: elastic floor — the scaling "
+                        "controller may drain the active set down to N "
+                        "(default 0 = track --replicas; the fleet stays "
+                        "static unless min < max).")
+    p.add_argument("--max-replicas", type=int, default=0, metavar="N",
+                   help="Router mode: elastic ceiling — sustained queue "
+                        "pressure grows the active set up to N "
+                        "(default 0 = track --replicas).")
+    p.add_argument("--warm-spares", type=int, default=0, metavar="N",
+                   help="Router mode: keep N spare daemons launched "
+                        "(jax initialized, zero jobs) but out of the "
+                        "ring, so a scale-up is a ring add instead of a "
+                        "cold boot; the pool refills in the background "
+                        "after each promotion (default 0).")
+    p.add_argument("--warmup-job", default=None, metavar="FILE",
+                   help="Router mode: canary job payload (JSON) run "
+                        "through every spare right after it parks in "
+                        "the warm pool — out of the ring, result "
+                        "discarded — so jax init, tracing, and the hot "
+                        "shapes' XLA compiles are paid while the spare "
+                        "idles instead of on its first post-promotion "
+                        "batch (default: no pre-warming).")
+    p.add_argument("--scale-interval", type=float, default=1.0,
+                   metavar="S",
+                   help="Router scaling-control cadence: one /status "
+                        "sweep of the active set and one policy tick "
+                        "per interval (default 1.0).")
     p.add_argument("--state-dir", default=None, metavar="DIR",
                    help="Daemon state root: jobs/ (journal of accepted, "
                         "unfinished jobs — re-queued on restart), "
@@ -107,6 +135,23 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--queue-depth", type=int, default=16, metavar="N",
                    help="Max queued jobs before admission rejects with a "
                         "structured queue_full error (default 16).")
+    p.add_argument("--tenant-quotas", type=str, default=None,
+                   metavar="SPEC",
+                   help="Per-tenant admission SLOs: semicolon-separated "
+                        "'name:rate:burst[:weight]' entries — a token "
+                        "bucket (rate jobs/s, burst capacity) plus a "
+                        "weighted-fair queue share; '*' sets the "
+                        "default for unlisted tenants. Over-rate "
+                        "submits reject with tenant_quota + "
+                        "retry_after_s.")
+    p.add_argument("--shed", action="store_true",
+                   help="Deadline-aware load shedding: reject a "
+                        "deadline-carrying submit whose estimated wait "
+                        "(queue depth x observed service time) already "
+                        "exceeds its deadline_s — a structured 'shed' "
+                        "response with retry_after_s, instead of "
+                        "accepting work that will die of "
+                        "deadline_exceeded.")
     p.add_argument("--max-join", type=int, default=4, metavar="K",
                    help="Max shape-compatible jobs merged into one engine "
                         "batch per scheduling cycle (default 4).")
@@ -345,6 +390,10 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
             fwd += ["--max-result-bytes", str(args.max_result_bytes)]
         if args.inventory_dir:
             fwd += ["--inventory-dir", args.inventory_dir]
+        if args.tenant_quotas:
+            fwd += ["--tenant-quotas", args.tenant_quotas]
+        if args.shed:
+            fwd += ["--shed"]
         if args.cache_dir:
             fwd += ["--cache-dir", args.cache_dir]
         if args.platform:
@@ -362,6 +411,11 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
             sticky_deadline_s=args.sticky_deadline,
             inventory_budget_bytes=args.inventory_budget_bytes,
             max_result_bytes=args.max_result_bytes,
+            min_replicas=args.min_replicas,
+            max_replicas=args.max_replicas,
+            warm_spares=args.warm_spares,
+            warmup_job=args.warmup_job,
+            scale_interval=args.scale_interval,
             serve_argv=tuple(fwd))
         return Router(opts).serve_forever()
     if not args.socket:
@@ -402,5 +456,6 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
         inventory_budget_bytes=args.inventory_budget_bytes,
         query_cache_entries=args.query_cache_entries,
         inventory_dir=args.inventory_dir,
-        max_result_bytes=args.max_result_bytes)
+        max_result_bytes=args.max_result_bytes,
+        tenant_quotas=args.tenant_quotas, shed=args.shed)
     return ServeDaemon(opts).serve_forever()

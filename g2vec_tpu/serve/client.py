@@ -41,6 +41,27 @@ class ServeTimeout(TimeoutError):
         self.job_id = job_id
 
 
+class ServeShed(RuntimeError):
+    """The fleet shed this job at admission (deadline-aware load
+    shedding or a tenant rate limit) on every bounded retry.
+    Structured: names the ``tenant`` and ``job_id`` the operator needs,
+    plus the server's last ``retry_after_s`` advice — the caller can
+    honor it on a slower retry loop of its own."""
+
+    def __init__(self, msg: str, tenant: Optional[str] = None,
+                 job_id: Optional[str] = None,
+                 retry_after_s: Optional[float] = None):
+        super().__init__(msg)
+        self.tenant = tenant
+        self.job_id = job_id
+        self.retry_after_s = retry_after_s
+
+
+#: Admission rejections that carry ``retry_after_s`` — transient by
+#: contract (the server is saying "later", not "never").
+_SHED_ERRORS = ("shed", "tenant_quota")
+
+
 def request(socket_path: str, payload: dict,
             timeout: Optional[float] = None) -> Iterator[dict]:
     """Send one request; yield the server's JSONL events until it closes
@@ -195,7 +216,8 @@ def submit_and_wait(socket_path: str, job: dict, tenant: str = "default",
                     jitter: float = 0.25,
                     rng: Optional[random.Random] = None,
                     idem_key: Optional[str] = None,
-                    auth_token: Optional[str] = None) -> dict:
+                    auth_token: Optional[str] = None,
+                    shed_retries: int = 3) -> dict:
     """Submit a job and return its terminal record, surviving daemon
     restarts AND replica failover behind a router.
 
@@ -218,19 +240,48 @@ def submit_and_wait(socket_path: str, job: dict, tenant: str = "default",
       after the job migrated replicas. Never resubmit here — the poll
       is strictly read-only.
 
+    A ``rejected`` answer whose error is ``shed`` or ``tenant_quota``
+    is the fleet's structured "try later": back off for the advised
+    ``retry_after_s`` (plus jitter — an entire shed flash-crowd must not
+    return in lockstep) and resubmit with the SAME idem key, up to
+    ``shed_retries`` extra attempts; past that, raise
+    :class:`ServeShed` naming the tenant and job_id. Shed retries spend
+    their own budget, not the transport-retry one — a load-shedding
+    fleet is healthy, a connection-refusing one is not.
+
     Raises :class:`ServeTimeout` naming the job when all retries or the
     result poll expire."""
     rng = rng if rng is not None else random.Random()
     if idem_key is None:
         idem_key = f"c-{uuid.uuid4().hex}"
     last: Optional[BaseException] = None
-    for attempt in range(retries + 1):
+    sheds = 0
+    attempt = 0
+    while attempt <= retries:
         try:
             events = submit_job(socket_path, job, tenant=tenant,
                                 timeout=timeout, priority=priority,
                                 deadline_s=deadline_s, idem_key=idem_key,
                                 auth_token=auth_token)
-            return events[-1]
+            ev = events[-1]
+            if (ev.get("event") == "rejected"
+                    and ev.get("error") in _SHED_ERRORS
+                    and ev.get("retry_after_s") is not None):
+                if sheds >= shed_retries:
+                    raise ServeShed(
+                        f"job {ev.get('job_id')} (tenant "
+                        f"{ev.get('tenant', tenant)}) shed by admission "
+                        f"({ev.get('error')}) on {sheds + 1} attempt(s); "
+                        f"last advice: retry_after_s="
+                        f"{ev.get('retry_after_s')}",
+                        tenant=ev.get("tenant", tenant),
+                        job_id=ev.get("job_id"),
+                        retry_after_s=float(ev["retry_after_s"]))
+                sheds += 1
+                time.sleep(float(ev["retry_after_s"])
+                           + rng.uniform(0.0, jitter))
+                continue        # same idem key, no transport budget spent
+            return ev
         except ServeConnectionLost as e:
             if e.job_id is not None:
                 if state_dir is not None:
@@ -248,6 +299,7 @@ def submit_and_wait(socket_path: str, job: dict, tenant: str = "default",
             last = e
         if attempt < retries:
             time.sleep(backoff * (2 ** attempt) + rng.uniform(0.0, jitter))
+        attempt += 1
     raise ServeTimeout(
         f"submit failed after {retries + 1} attempt(s): "
         f"{type(last).__name__}: {last}") from last

@@ -73,7 +73,8 @@ from g2vec_tpu.config import (G2VecConfig, config_from_job,
                               serve_join_key)
 from g2vec_tpu.resilience.lifecycle import (DrainRequested, JobCancelled,
                                             JobDeadlineExceeded,
-                                            JobInterrupted)
+                                            JobInterrupted, TokenBucket,
+                                            shed_decision)
 from g2vec_tpu.serve import inventory, protocol
 from g2vec_tpu.utils.integrity import write_json_atomic
 from g2vec_tpu.utils.metrics import MetricsWriter
@@ -155,6 +156,70 @@ class ServeOptions:
     #: becomes a structured ``oversized_result`` error (see
     #: protocol.bound_record). 0 = protocol.MAX_LINE_BYTES.
     max_result_bytes: int = 0
+    #: Per-tenant admission SLOs: ``name:rate:burst[:weight];...`` —
+    #: ``rate`` submissions/second refilling a ``burst``-deep token
+    #: bucket, plus a weighted-fair queue share (see
+    #: :func:`parse_tenant_quotas`). ``*`` names the default applied to
+    #: unlisted tenants; with no ``*`` entry, unlisted tenants are
+    #: unlimited (weight 1). None disables rate limiting entirely.
+    tenant_quotas: Optional[str] = None
+    #: Deadline-aware load shedding: reject a deadlined job at admission
+    #: (structured ``shed`` + ``retry_after_s``) when the estimated
+    #: queue wait already exceeds its whole ``deadline_s`` — refusing
+    #: up-front beats accepting work that dies of deadline_exceeded
+    #: after burning a lane (lifecycle.shed_decision has the boundary
+    #: semantics).
+    shed: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantQuota:
+    """One tenant's admission SLO: token-bucket rate limit + fair share."""
+
+    rate: float                  # submissions/second refill
+    burst: float                 # bucket capacity (max burst size)
+    weight: int = 1              # weighted-fair queue share
+
+
+def parse_tenant_quotas(spec: Optional[str]) -> Dict[str, TenantQuota]:
+    """Parse a ``--tenant-quotas`` spec: semicolon-separated
+    ``name:rate:burst[:weight]`` entries, e.g.
+    ``gold:4:8:3;bulk:0.5:2:1;*:2:4:1``. ``*`` is the default for
+    tenants not named. Raises ValueError naming the bad entry."""
+    quotas: Dict[str, TenantQuota] = {}
+    if not spec:
+        return quotas
+    for entry in spec.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.split(":")
+        if len(parts) not in (3, 4):
+            raise ValueError(
+                f"bad --tenant-quotas entry {entry!r}: expected "
+                f"name:rate:burst[:weight]")
+        name = parts[0].strip()
+        if not name or len(name) > _TENANT_MAX:
+            raise ValueError(f"bad --tenant-quotas tenant name {name!r}")
+        if name in quotas:
+            raise ValueError(f"duplicate --tenant-quotas tenant {name!r}")
+        try:
+            rate, burst = float(parts[1]), float(parts[2])
+            weight = int(parts[3]) if len(parts) == 4 else 1
+        except ValueError:
+            raise ValueError(
+                f"bad --tenant-quotas entry {entry!r}: rate/burst must "
+                f"be numbers, weight an int") from None
+        if rate <= 0 or burst < 1:
+            raise ValueError(
+                f"bad --tenant-quotas entry {entry!r}: need rate > 0 "
+                f"and burst >= 1")
+        if weight < 1:
+            raise ValueError(
+                f"bad --tenant-quotas entry {entry!r}: weight must "
+                f"be >= 1")
+        quotas[name] = TenantQuota(rate=rate, burst=burst, weight=weight)
+    return quotas
 
 
 @dataclasses.dataclass
@@ -196,25 +261,42 @@ class _FairQueue:
     strict-priority with aging: an aged batch job (queued longer than
     ``aging_s``) first, then any interactive job, then any batch job —
     so interactive jobs cut the line but can never starve batch work.
-    Within a tier the first tenant with work is served and rotated to
-    the back, so a tenant submitting N jobs waits behind every other
+    Within a tier tenants are served weighted round-robin: a tenant
+    with ``weight`` w (from ``--tenant-quotas``, default 1) gets up to w
+    consecutive pops before rotating to the back — over a full rotation
+    the tenants' service counts converge to their weight ratio. With no
+    weights configured this degenerates to exactly the old one-pop
+    round-robin, so a tenant submitting N jobs waits behind every other
     tenant once per own job, not zero times.
     ``take_compatible`` pulls additional queued jobs with a matching join
     key (any tenant or priority, FIFO within each) for batch joining —
     those jobs would only have waited longer by staying queued.
     """
 
-    def __init__(self, depth: int, aging_s: float = 30.0):
+    def __init__(self, depth: int, aging_s: float = 30.0,
+                 weights: Optional[Dict[str, int]] = None):
         self._depth = depth
         self._aging_s = aging_s
+        #: tenant -> fair-share weight; ``*`` is the default for
+        #: unlisted tenants. Immutable after construction.
+        self._weights: Dict[str, int] = dict(weights or {})
         # guarded-by: _lock
         self._tiers: Dict[str, "OrderedDict[str, deque]"] = {
             p: OrderedDict() for p in PRIORITIES}
+        #: Per-tier deficit counters for the weighted round-robin:
+        #: remaining consecutive pops before this tenant rotates.
+        # guarded-by: _lock
+        self._credits: Dict[str, Dict[str, int]] = {
+            p: {} for p in PRIORITIES}
         self._n = 0                  # guarded-by: _lock
         self._lock = threading.Lock()
         # Holding _not_empty IS holding _lock (Condition wraps it) —
         # the checker understands the aliasing.
         self._not_empty = threading.Condition(self._lock)
+
+    def _weight(self, tenant: str) -> int:
+        return max(1, self._weights.get(tenant,
+                                        self._weights.get("*", 1)))
 
     def depth(self) -> int:
         with self._lock:
@@ -238,12 +320,20 @@ class _FairQueue:
 
     # analyze: holds[_lock] — pop()'s wait loop already owns the
     # Condition; the checker verifies every call site holds the lock.
-    def _pop_tier(self, tier: "OrderedDict[str, deque]",
+    def _pop_tier(self, pname: str,
                   min_age: float = 0.0) -> Optional[ServeJob]:
+        tier = self._tiers[pname]
+        credits = self._credits[pname]
         now = time.time()
         for name, dq in list(tier.items()):
             if dq and (not min_age or now - dq[0].queued_at >= min_age):
-                tier.move_to_end(name)
+                cr = credits.get(name, self._weight(name)) - 1
+                if cr <= 0:
+                    # Share spent: reset and rotate to the back.
+                    credits[name] = self._weight(name)
+                    tier.move_to_end(name)
+                else:
+                    credits[name] = cr      # keep serving this tenant
                 self._n -= 1
                 return dq.popleft()
         return None
@@ -252,12 +342,12 @@ class _FairQueue:
         with self._not_empty:
             if not self._n:
                 self._not_empty.wait(timeout)
-            job = self._pop_tier(self._tiers["batch"],
+            job = self._pop_tier("batch",
                                  min_age=self._aging_s)     # aged first
             if job is None:
-                job = self._pop_tier(self._tiers["interactive"])
+                job = self._pop_tier("interactive")
             if job is None:
-                job = self._pop_tier(self._tiers["batch"])
+                job = self._pop_tier("batch")
             return job
 
     def take_compatible(self, key: Tuple, limit: int) -> List[ServeJob]:
@@ -337,7 +427,27 @@ class ServeDaemon:
         self.qcache = inventory.QueryCache(opts.query_cache_entries)
         self.metrics = MetricsWriter(opts.metrics_jsonl, append=True)
         self.engine = ResidentEngine(cache_dir=opts.cache_dir)
-        self._queue = _FairQueue(opts.queue_depth, aging_s=opts.aging_s)
+        #: tenant -> TenantQuota, parsed once; immutable after init
+        #: (ValueError on a bad spec surfaces at construction, not on
+        #: the first unlucky tenant's submit).
+        self._quotas = parse_tenant_quotas(opts.tenant_quotas)
+        self._queue = _FairQueue(
+            opts.queue_depth, aging_s=opts.aging_s,
+            weights={t: q.weight for t, q in self._quotas.items()})
+        #: Lazily-built per-tenant token buckets. Admission runs on
+        #: per-connection threads, and a bucket's refill+take must be
+        #: one atomic step or two concurrent submits both spend the
+        #: last token.
+        self._buckets: Dict[str, TokenBucket] = {}  # guarded-by: _tenant_lock
+        self._tenant_lock = threading.Lock()
+        #: Recent per-job service times (batch wall / jobs in batch) —
+        #: the evidence behind the shed estimate. Bounded so the
+        #: estimate tracks the CURRENT workload mix.
+        self._service_times: "deque[float]" = deque(maxlen=32)  # guarded-by: _lock
+        #: Per-tenant SLO ledger (admitted/done/shed/quota_rejected/
+        #: failed/cancelled/deadline_exceeded) for /status and the
+        #: router's fleet aggregation.
+        self._tenant_stats: Dict[str, "Counter[str]"] = {}  # guarded-by: _lock
         self._defaults = G2VecConfig()
         #: In-flight jobs and the lifecycle counters below are touched
         #: from the scheduler thread AND per-connection threads (admit,
@@ -496,6 +606,16 @@ class ServeDaemon:
         # router attaches its own token), so the shared auth_token must
         # not outlive the admission check.
         raw = {k: v for k, v in payload.items() if k != "auth_token"}
+        if submitted_at is None and payload.get("requeue"):
+            # Deadline-clock continuity across failover: the router's
+            # journal migration resubmits with the ORIGINAL admission
+            # time, so deadline_s keeps measuring from when the client
+            # was acked — a replica death must never reset the clock
+            # (honored only with requeue, so ordinary clients cannot
+            # back- or forward-date their own deadlines).
+            sa = payload.get("submitted_at")
+            if isinstance(sa, (int, float)) and not isinstance(sa, bool):
+                submitted_at = float(sa)
         job = ServeJob(job_id=job_id, tenant=tenant,
                        cfg=cfg, variants=variants, raw=raw,
                        submitted_at=(time.time() if submitted_at is None
@@ -505,10 +625,39 @@ class ServeDaemon:
         job.join_key = _join_key(cfg)
         return job
 
+    def _quota_for(self, tenant: str) -> Optional[TenantQuota]:
+        return self._quotas.get(tenant, self._quotas.get("*"))
+
+    def _tenant_count(self, tenant: str, field: str) -> None:
+        with self._lock:
+            self._tenant_stats.setdefault(tenant, Counter())[field] += 1
+
+    def _service_time_s(self) -> Optional[float]:
+        """Mean observed per-job service time, None before the first
+        completed batch (no evidence → shed_decision never sheds)."""
+        with self._lock:
+            times = list(self._service_times)
+        if not times:
+            return None
+        return sum(times) / len(times)
+
     def admit(self, payload: dict,
               subscriber: Optional["queue.Queue"] = None) -> dict:
         """Admission control: validate + enqueue, or reject with a
-        structured error. Returns the ``accepted``/``rejected`` event."""
+        structured error. Returns the ``accepted``/``rejected`` event.
+
+        Beyond validity and queue capacity, two SLO gates (both AFTER
+        the idempotency dedup — a duplicate of an already-accepted job
+        must re-ack, never be shed):
+
+        - **tenant token bucket** (``--tenant-quotas``): an over-rate
+          tenant gets a structured ``tenant_quota`` rejection carrying
+          ``retry_after_s`` — exactly when the next token exists.
+        - **deadline shed** (``--shed``): a deadlined job whose
+          estimated wait (queue depth × observed mean service time)
+          already exceeds ``deadline_s`` gets a structured ``shed``
+          rejection with ``retry_after_s`` instead of an accept that is
+          contractually doomed to ``deadline_exceeded``."""
         try:
             job = self._plan_job(payload)
         except (ValueError, TypeError, ManifestError) as e:
@@ -555,6 +704,57 @@ class ServeDaemon:
                     "error": ("draining" if self._draining
                               else "shutting_down"),
                     "job_id": job.job_id}
+        # A failover/recovery resubmission (requeue=True, set only by
+        # the router's journal migration) already paid the SLO gates
+        # when it was FIRST admitted — the client holds an ack. Shedding
+        # or rate-limiting it now would turn a replica death into a
+        # broken admission contract: the job would sit journaled on the
+        # corpse until its relaunch instead of migrating to a live
+        # survivor. Capacity (queue_full) still applies — a full queue
+        # is a real resource bound, and the router leaves the entry
+        # journaled for the corpse's own recovery in that case.
+        requeue = bool(payload.get("requeue"))
+        quota = self._quota_for(job.tenant) if not requeue else None
+        if quota is not None:
+            now = time.time()
+            with self._tenant_lock:
+                bucket = self._buckets.get(job.tenant)
+                if bucket is None:
+                    bucket = TokenBucket(quota.rate, quota.burst)
+                    self._buckets[job.tenant] = bucket
+                allowed = bucket.take(now)
+                retry_after = 0.0 if allowed else bucket.retry_after(now)
+            if not allowed:
+                _unreserve()
+                self._tenant_count(job.tenant, "quota_rejected")
+                self.metrics.bind_job(job.job_id).emit(
+                    "tenant_quota", tenant=job.tenant,
+                    retry_after_s=round(retry_after, 3))
+                return {"event": "rejected", "error": "tenant_quota",
+                        "tenant": job.tenant, "job_id": job.job_id,
+                        "retry_after_s": round(retry_after, 3),
+                        "detail": f"tenant {job.tenant!r} is over its "
+                                  f"{quota.rate}/s rate limit "
+                                  f"(burst {quota.burst:g})"}
+        if self.opts.shed and not requeue:
+            service = self._service_time_s()
+            queued = self._queue.depth()
+            retry_after = shed_decision(job.deadline_s, queued, service)
+            if retry_after is not None:
+                _unreserve()
+                est_wait = queued * service
+                self._tenant_count(job.tenant, "shed")
+                self.metrics.bind_job(job.job_id).emit(
+                    "shed", tenant=job.tenant,
+                    retry_after_s=round(retry_after, 3),
+                    est_wait_s=round(est_wait, 3))
+                return {"event": "rejected", "error": "shed",
+                        "tenant": job.tenant, "job_id": job.job_id,
+                        "retry_after_s": round(retry_after, 3),
+                        "est_wait_s": round(est_wait, 3),
+                        "detail": f"estimated wait {est_wait:.1f}s "
+                                  f"({queued} queued x {service:.2f}s/job) "
+                                  f"exceeds deadline_s={job.deadline_s:g}"}
         job.subscriber = subscriber
         try:
             self._queue.push(job)
@@ -568,6 +768,7 @@ class ServeDaemon:
                     "queue_depth": self.opts.queue_depth,
                     "job_id": job.job_id}
         self._journal(job)
+        self._tenant_count(job.tenant, "admitted")
         self._job_state(job.job_id, "queued", tenant=job.tenant,
                         priority=job.priority)
         self.metrics.bind_job(job.job_id).emit(
@@ -732,6 +933,7 @@ class ServeDaemon:
         self._cleanup_ckpt(job.job_id)
         with self._lock:
             self.jobs_failed += 1
+        self._tenant_count(job.tenant, status)
         self._job_state(job.job_id, status, detail=detail)
         self._notify(job, record)
         self._notify(job, None)
@@ -891,6 +1093,10 @@ class ServeDaemon:
             return 0
 
         wall = time.time() - t0
+        # The shed estimator's evidence: one completed batch contributes
+        # its per-job share of the wall (joined jobs amortize the batch).
+        with self._lock:
+            self._service_times.append(wall / max(1, len(batch)))
         by_job: Dict[str, Dict] = {}
         for (j, v), lane in zip(lane_owner, res.lanes):
             outs = self._route_outputs(j, v, lane)
@@ -918,6 +1124,7 @@ class ServeDaemon:
             self._cleanup_ckpt(j.job_id)
             with self._lock:
                 self.jobs_done += 1
+            self._tenant_count(j.tenant, "done")
             self._job_state(j.job_id, "done", batch=bid)
             self.metrics.bind_job(j.job_id).emit(
                 "job_done", tenant=j.tenant, batch=bid,
@@ -1102,6 +1309,7 @@ class ServeDaemon:
         self._cleanup_ckpt(job.job_id)
         with self._lock:
             self.jobs_failed += 1
+        self._tenant_count(job.tenant, "failed")
         self._job_state(job.job_id, "failed", classified=classified)
         self.metrics.bind_job(job.job_id).emit("job_failed", error=err,
                                                classified=classified)
@@ -1198,6 +1406,10 @@ class ServeDaemon:
             # connection thread bumps it can RuntimeError mid-iteration.
             job_states = dict(self._state_counts)
             jobs_done, jobs_failed = self.jobs_done, self.jobs_failed
+            service_times = list(self._service_times)
+            tenants = {t: dict(c) for t, c in self._tenant_stats.items()}
+        service = (round(sum(service_times) / len(service_times), 3)
+                   if service_times else None)
         return {"event": "status", "pid": os.getpid(),
                 "uptime_s": round(time.time() - self._t0, 1),
                 "socket": self.opts.socket_path,
@@ -1219,6 +1431,12 @@ class ServeDaemon:
                 "max_join": self.opts.max_join,
                 "jobs_done": jobs_done,
                 "jobs_failed": jobs_failed,
+                #: Admission-SLO plane: the shed estimator's current
+                #: evidence plus the per-tenant ledger the router sums
+                #: into its fleet-wide /status aggregate.
+                "service_time_s": service,
+                "shed_enabled": self.opts.shed,
+                "tenants": tenants,
                 "engine": self.engine.status(),
                 "cache": cache_stats(),
                 "inventory": {**self.catalog.stats(),

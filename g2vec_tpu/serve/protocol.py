@@ -64,8 +64,15 @@ MAX_LINE_BYTES = 8 << 20
 #: typo'd ``payload.get("pirority")`` fails tier-1 instead of silently
 #: returning the default. Job-CONTENT keys (the ``job`` object's
 #: fields) are governed separately by config.SERVE_JOB_KEYS.
+#: ``requeue``/``submitted_at`` are router-internal (set only by the
+#: failover journal migration): requeue skips the tenant-quota and
+#: shed gates — the job already paid admission once and the client
+#: holds an ack — and submitted_at carries the ORIGINAL admission
+#: time so a replica death never resets a deadline clock (honored
+#: only with requeue; an ordinary client cannot back-date).
 SUBMIT_KEYS = ("op", "job", "tenant", "priority", "deadline_s",
-               "idem_key", "job_id", "auth_token")
+               "idem_key", "job_id", "auth_token", "requeue",
+               "submitted_at")
 
 #: The query-request envelope vocabulary (the read plane's twin of
 #: SUBMIT_KEYS). daemon.py/router.py bind a query payload to the

@@ -57,7 +57,7 @@ import uuid
 from typing import Callable, Dict, List, Optional, Tuple
 
 from g2vec_tpu.config import G2VecConfig, config_from_job, serve_join_key
-from g2vec_tpu.resilience.lifecycle import ReplicaHealth
+from g2vec_tpu.resilience.lifecycle import ReplicaHealth, ScalingPolicy
 from g2vec_tpu.resilience.supervisor import ReplicaFleet, ReplicaSpec
 from g2vec_tpu.serve import inventory, protocol
 from g2vec_tpu.utils.metrics import MetricsWriter
@@ -161,6 +161,41 @@ class RouterOptions:
     #: Server-side cap on a relayed ``result`` response (see
     #: protocol.bound_record). 0 = protocol.MAX_LINE_BYTES.
     max_result_bytes: int = 0
+    #: Elastic fleet bounds: the scaling controller may shrink the
+    #: active (in-ring) set to ``min_replicas`` and grow it to
+    #: ``max_replicas``. 0 = track ``replicas`` — with both at 0 the
+    #: fleet is static and the controller never acts (the pre-elastic
+    #: behavior, and the default).
+    min_replicas: int = 0
+    max_replicas: int = 0
+    #: Warm-pool size: spare daemons kept launched (jax initialized,
+    #: zero jobs) but OUT of the ring, so a scale-up is a ring add
+    #: (~instant) instead of a cold daemon boot (tens of seconds). The
+    #: pool refills in the background after each promotion while cold
+    #: names remain.
+    warm_spares: int = 0
+    #: Canary job file (JSON payload) run through every spare right
+    #: after it parks in the warm pool — OUT of the ring, result
+    #: discarded. A daemon process is only process-warm at launch; the
+    #: expensive part of its first real batch is jax init + tracing +
+    #: the hot shapes' XLA compiles, and on a CPU-contended host that
+    #: bill lands exactly when a surge is on. The canary moves it to
+    #: the spare's idle time, so promotion is a ring add in fact, not
+    #: just in mechanism. None disables pre-warming.
+    warmup_job: Optional[str] = None
+    #: Control-loop cadence: one /status sweep of the active set, one
+    #: ScalingPolicy.observe per interval (also how often the /status
+    #: fleet aggregate refreshes).
+    scale_interval: float = 1.0
+    #: ScalingPolicy thresholds (queued jobs per active replica) and
+    #: the estimated-wait trip wire — see lifecycle.ScalingPolicy for
+    #: the hysteresis/cooldown semantics.
+    scale_up_queue: float = 4.0
+    scale_down_queue: float = 0.5
+    scale_up_wait_s: float = 8.0
+    #: Seed for the controller's rng (victim choice on scale-down) —
+    #: a chaos run with a fixed seed drains the same replicas every run.
+    scale_seed: int = 0
 
 
 class Router:
@@ -189,16 +224,73 @@ class Router:
                 fh.write(opts.auth_token)
             os.chmod(tok_file, 0o600)
             serve_argv += ["--auth-token-file", tok_file]
-        self.fleet = ReplicaFleet(opts.fleet_dir, opts.replicas,
+        #: Elastic bounds: 0 means "track --replicas" (static fleet).
+        self._min = opts.min_replicas or opts.replicas
+        self._max = opts.max_replicas or opts.replicas
+        if not (1 <= self._min <= self._max):
+            raise ValueError(f"need 1 <= --min-replicas <= "
+                             f"--max-replicas, got {self._min}.."
+                             f"{self._max}")
+        if opts.warm_spares < 0:
+            raise ValueError(f"--warm-spares must be >= 0, "
+                             f"got {opts.warm_spares}")
+        self._elastic = self._max > self._min
+        n_initial = min(max(opts.replicas, self._min), self._max)
+        # The fleet is SIZED up front (specs are cheap — directories and
+        # names, no processes): active replicas + every name the
+        # controller could ever scale into + the warm pool's headroom.
+        # Which of those names actually run is the router's call.
+        self.fleet = ReplicaFleet(opts.fleet_dir,
+                                  self._max + opts.warm_spares,
                                   serve_argv=serve_argv, console=console)
         self.ring = HashRing(vnodes=opts.vnodes)
         self.health: Dict[str, ReplicaHealth] = {}
+        #: The in-ring replica set — exactly the ring's membership (the
+        #: health machine stays an eligibility OVERLAY on top of it).
+        #: Scale-up adds a name here + to the ring; scale-down drains
+        #: and demotes it to the warm pool.
+        self._active: set = set(self.fleet.names()[:n_initial])  # guarded-by: _hlock
+        #: Launched-but-ringless spares, promotion order = FIFO. A
+        #: demoted replica rejoins this pool after its drain, so the
+        #: pool can temporarily exceed warm_spares — promotions reuse
+        #: warm processes before cold names either way.
+        self._warm: List[str] = []              # guarded-by: _hlock
         for name in self.fleet.names():
-            self.ring.add(name)
+            if name in self._active:
+                self.ring.add(name)
             self.health[name] = ReplicaHealth(
                 name, suspect_after=opts.suspect_after,
                 dead_after=opts.dead_after,
                 rejoin_after=opts.rejoin_after)
+        #: The scale controller (lifecycle.ScalingPolicy): observe/act
+        #: runs ONLY on the scale-loop thread, so the policy object
+        #: itself needs no lock; its decisions mutate _active/_warm/ring
+        #: under _hlock like everyone else.
+        self._policy = ScalingPolicy(
+            self._min, self._max, up_queue=opts.scale_up_queue,
+            down_queue=opts.scale_down_queue,
+            up_wait_s=opts.scale_up_wait_s, seed=opts.scale_seed)
+        #: Fleet-wide admission/SLO aggregate (queued totals, per-tenant
+        #: counters, service times) refreshed by the scale loop each
+        #: interval — /status serves this cache instead of paying N
+        #: replica round-trips per probe.
+        self._fleet_stats: dict = {}            # guarded-by: _hlock
+        #: Last successful per-replica depth sample, carried through a
+        #: replica's death so the controller keeps seeing its journaled
+        #: backlog as pressure. Scale-loop thread only — never shared.
+        self._last_replica_stats: Dict[str, dict] = {}
+        #: Scale-event ledger for /status: the last event plus counters.
+        self._last_scale: Optional[dict] = None  # guarded-by: _hlock
+        self._scale_events: List[dict] = []     # guarded-by: _hlock
+        self.scale_ups = 0                      # guarded-by: _hlock
+        self.scale_downs = 0                    # guarded-by: _hlock
+        #: Serializes warm-pool refills (one background refill thread
+        #: at a time; acquire is non-blocking — a running refill already
+        #: converges the pool).
+        self._refill_lock = threading.Lock()
+        #: Cold names claimed for launch but not yet active/warm — keeps
+        #: a concurrent scale-up and warm refill off the same spec.
+        self._pending_cold: set = set()         # guarded-by: _hlock
         self._defaults = G2VecConfig()     # identical to the daemon's
         self._hlock = threading.RLock()
         #: One lock per replica: fence → migrate → relaunch must be
@@ -310,9 +402,15 @@ class Router:
         with self._hlock:
             return [n for n, h in self.health.items() if h.in_ring]
 
+    def _ring_lookup(self, key: str, eligible) -> Optional[str]:
+        # The ring mutates on scale events now; lookups take the same
+        # lock as add/remove so a bisect never reads a half-built list.
+        with self._hlock:
+            return self.ring.lookup(key, eligible=eligible)
+
     def pick_replica(self, payload: dict) -> Optional[str]:
-        return self.ring.lookup(self._join_key_str(payload),
-                                eligible=self._eligible())
+        return self._ring_lookup(self._join_key_str(payload),
+                                 eligible=self._eligible())
 
     # ---- failover ---------------------------------------------------------
 
@@ -387,10 +485,10 @@ class Router:
                                   from_replica=name, already_on=dup_home)
                 continue
             try:
-                target = self.ring.lookup(self._join_key_str(payload),
-                                          eligible=[n for n in
-                                                    self._eligible()
-                                                    if n != name])
+                target = self._ring_lookup(self._join_key_str(payload),
+                                           eligible=[n for n in
+                                                     self._eligible()
+                                                     if n != name])
             except (ValueError, TypeError):
                 target = None
             if target is None:
@@ -405,7 +503,17 @@ class Router:
                 # Cursor migration: the survivor resumes mid-stream from
                 # the dead replica's last durable checkpoint.
                 shutil.copytree(d, dst, dirs_exist_ok=True)
-            out = dict(payload, op="submit")
+            # requeue=True: this job was ALREADY admitted once (the
+            # client holds an ack) — the survivor must skip its tenant
+            # bucket and shed gate (PR 16: a chaos run showed a spike's
+            # whole migrated journal bouncing off the survivor's
+            # admission SLOs and dying of deadline_exceeded on the
+            # corpse instead). submitted_at keeps the deadline clock
+            # measuring from the ORIGINAL admission.
+            out = dict(payload, op="submit", requeue=True)
+            sa = rec.get("submitted_at")
+            if isinstance(sa, (int, float)) and not isinstance(sa, bool):
+                out["submitted_at"] = sa
             if not payload.get("idem_key"):
                 # Keyless entry (submitted straight to the replica's
                 # socket, no router): there is no key to derive the id
@@ -457,12 +565,21 @@ class Router:
 
     # ---- probe loop -------------------------------------------------------
 
+    def _probe_targets(self) -> List[str]:
+        """Names worth probing: the active set plus the warm pool. Cold
+        names (sized into the fleet but never launched) are skipped —
+        probing them would declare them dead and fire pointless
+        failover/relaunch cycles on processes that should not exist."""
+        with self._hlock:
+            return sorted(self._active) + list(self._warm)
+
     def _probe_loop(self) -> None:
-        due = {n: 0.0 for n in self.fleet.names()}
+        due: Dict[str, float] = {}
         while not self._stop.is_set():
             now = time.monotonic()
-            for name, h in self.health.items():
-                if now < due[name]:
+            for name in self._probe_targets():
+                h = self.health[name]
+                if now < due.get(name, 0.0):
                     continue
                 with self._hlock:
                     if name in self._admin_draining:
@@ -476,6 +593,15 @@ class Router:
                 with self._hlock:
                     trans = h.on_probe(ok, journal_depth=jd,
                                        now=time.time())
+                    # A forward/query thread may have force_dead()ed the
+                    # replica between two probes. Then on_probe sees an
+                    # already-dead state and reports NO transition — but
+                    # the corpse is real and nobody has fenced it. A
+                    # failed probe of a dead, still-unrecovered replica
+                    # must (re)trigger failover, or its journal is
+                    # stranded and every sticky submit waits forever.
+                    dead_unrecovered = (not ok and trans is None
+                                        and h.state == "dead")
                 due[name] = time.monotonic() \
                     + h.probe_interval(self.opts.probe_interval)
                 if trans is not None:
@@ -485,24 +611,396 @@ class Router:
                                       journal_depth=jd)
                     self.console(f"[router] {name}: {trans[0]} -> "
                                  f"{trans[1]} (journal {jd})")
-                    if trans[1] == "dead":
-                        self._failover(name)
+                if (trans is not None and trans[1] == "dead") \
+                        or dead_unrecovered:
+                    self._failover(name)
             self._stop.wait(0.05)
+
+    # ---- scaling ------------------------------------------------------
+
+    def _collect_fleet_stats(self) -> dict:
+        """One ``status`` sweep of the active set: the controller's
+        input signal and the /status fleet aggregate, in one pass.
+        Sums queue depths, averages observed service times, and merges
+        the per-tenant admission ledgers replica-side shedding keeps."""
+        with self._hlock:
+            targets = sorted(self._active)
+        # Prune carryover for names that left the active set, so a
+        # demoted replica's last queue depth can't haunt the signal.
+        for gone in set(self._last_replica_stats) - set(targets):
+            del self._last_replica_stats[gone]
+        queued = running = reachable = 0
+        svc: List[float] = []
+        tenants: Dict[str, Dict[str, int]] = {}
+        per_replica: Dict[str, dict] = {}
+        for name in targets:
+            try:
+                st = self._request(name, {"op": "status"},
+                                   timeout=self.opts.probe_deadline)
+            except (OSError, protocol.ProtocolError, ValueError):
+                st = None
+            if st is None or st.get("event") != "status":
+                # Mid-death blind spot: a SIGKILLed replica answers
+                # nothing while its journaled jobs still exist. Carrying
+                # its last-known depth keeps the controller under
+                # pressure through the outage instead of reading the
+                # dead air as an idle fleet.
+                last = self._last_replica_stats.get(name)
+                if last:
+                    queued += last["queued"]
+                    running += last["running"]
+                    per_replica[name] = {**last, "unreachable": True}
+                continue
+            reachable += 1
+            q = int(st.get("queued") or 0)
+            # "running" is a list of in-flight job ids in the daemon's
+            # status; older builds reported a bare count. Accept both.
+            rv = st.get("running")
+            r = len(rv) if isinstance(rv, (list, tuple)) else int(rv or 0)
+            queued += q
+            running += r
+            s = st.get("service_time_s")
+            if isinstance(s, (int, float)):
+                svc.append(float(s))
+            for t, c in (st.get("tenants") or {}).items():
+                if isinstance(c, dict):
+                    agg = tenants.setdefault(t, {})
+                    for k, v in c.items():
+                        if isinstance(v, int):
+                            agg[k] = agg.get(k, 0) + v
+            per_replica[name] = {"queued": q, "running": r,
+                                 "jobs_done": st.get("jobs_done"),
+                                 "service_time_s": s}
+            self._last_replica_stats[name] = {"queued": q, "running": r}
+        service = sum(svc) / len(svc) if svc else None
+        wait_est = (queued * service / max(1, reachable)) \
+            if service is not None else None
+        return {"sampled_at": round(time.time(), 3),
+                "replicas_reached": reachable,
+                "queued": queued, "running": running,
+                "service_time_s": (round(service, 4)
+                                   if service is not None else None),
+                "est_wait_s": (round(wait_est, 4)
+                               if wait_est is not None else None),
+                "tenants": tenants, "per_replica": per_replica}
+
+    def _scale_loop(self) -> None:
+        """The control loop: one stats sweep + (if elastic) one policy
+        tick per scale_interval. Runs for static fleets too — the
+        sweep is what keeps the /status fleet aggregate fresh."""
+        while not self._stop.is_set():
+            t0 = time.monotonic()
+            stats = self._collect_fleet_stats()
+            with self._hlock:
+                self._fleet_stats = stats
+                active_n = len(self._active)
+            if self._elastic:
+                decision = self._policy.observe(
+                    stats["queued"], active_n,
+                    wait_p99_s=stats.get("est_wait_s"))
+                if decision == "up":
+                    self._scale_up()
+                elif decision == "down":
+                    self._scale_down()
+                else:
+                    # Warm-pool refill waits for CALM (pressure below
+                    # the up thresholds): a daemon boot + canary is
+                    # real CPU, and spending it while the active set
+                    # is fighting a surge slows the exact replicas the
+                    # refill is supposed to back up. The pool refills
+                    # as soon as the surge passes; until then the
+                    # hole only delays the NEXT promotion.
+                    pressure = stats["queued"] / max(1, active_n)
+                    wait = stats.get("est_wait_s")
+                    if (pressure < self.opts.scale_up_queue
+                            and (wait is None
+                                 or wait < self.opts.scale_up_wait_s)):
+                        self._ensure_warm()
+            self._stop.wait(max(0.05, self.opts.scale_interval
+                                - (time.monotonic() - t0)))
+
+    def _next_cold(self) -> Optional[str]:
+        """Claim the first never-launched fleet name (not active, not
+        warm, not mid-launch by another thread). The claim lives in
+        ``_pending_cold`` until the caller moves the name into
+        active/warm or releases it on launch failure."""
+        with self._hlock:
+            busy = self._active | set(self._warm) | self._pending_cold
+            for name in self.fleet.names():
+                if name not in busy and not self.fleet.alive(name):
+                    self._pending_cold.add(name)
+                    return name
+        return None
+
+    def _claim_warm(self) -> Tuple[Optional[str], bool]:
+        """The claim half of a scale-up in ONE critical section:
+        capacity check + warm-pool pop. Returns (spare_or_None,
+        capacity_available). The commit (ring/active add) happens
+        after the launch, which cannot run under _hlock; the split is
+        race-free because the scale-loop thread is the only caller
+        that grows the active set."""
+        with self._hlock:
+            if len(self._active) >= self._max:
+                return None, False
+            return (self._warm.pop(0) if self._warm else None), True
+
+    def _scale_up(self) -> None:
+        """Add one replica to the ring: promote a warm spare (a ring
+        add — near-instant) when the pool has one, else pay a cold
+        daemon boot. The warm-pool refill is NOT kicked here — the
+        scale loop refills once pressure reads calm again."""
+        t0 = time.monotonic()
+        name, capacity = self._claim_warm()
+        if not capacity:
+            return
+        from_warm = name is not None
+        if from_warm:
+            self.metrics.emit("warm_spare", replica=name,
+                              outcome="promoted")
+        else:
+            name = self._next_cold()
+            if name is None:
+                return
+            try:
+                with self._rep_locks[name]:
+                    self.fleet.launch(name)
+            except (RuntimeError, TimeoutError, OSError) as e:
+                with self._hlock:
+                    self._pending_cold.discard(name)
+                self.metrics.emit("replica_relaunch_failed",
+                                  replica=name, error=str(e)[:200])
+                return
+        reaction = time.monotonic() - t0
+        with self._hlock:
+            self._pending_cold.discard(name)
+            self.ring.add(name)
+            self._active.add(name)
+            self.scale_ups += 1
+            active_n = len(self._active)
+            ev = {"kind": "scale_up", "replica": name,
+                  "from_warm": from_warm,
+                  "reaction_s": round(reaction, 4),
+                  "active": active_n, "at": round(time.time(), 3)}
+            self._last_scale = ev
+            self._scale_events.append(ev)
+        self.metrics.emit("scale_up", replica=name, from_warm=from_warm,
+                          reaction_s=round(reaction, 4), active=active_n)
+        self.console(f"[router] scale-up: +{name} "
+                     f"({'warm' if from_warm else 'cold'}, "
+                     f"{reaction:.2f}s, active={active_n})")
+        # No refill here: a scale-up means the fleet is under pressure,
+        # and the refill boot would compete with it — the scale loop
+        # refills the pool once the pressure reading comes back calm.
+
+    def _scale_down(self) -> None:
+        """Remove one replica from the ring and drain it gracefully in
+        the background (the drain can take minutes; the control loop
+        must not stall behind it). The ring removal happens HERE, so
+        no new placements land on the victim from this point on."""
+        with self._hlock:
+            candidates = [n for n in self._active
+                          if n not in self._admin_draining]
+            if len(self._active) <= self._min or not candidates:
+                return
+            victim = self._policy.choose_victim(candidates)
+            self._admin_draining.add(victim)
+            self.health[victim].force_dead(now=time.time())
+            self.ring.remove(victim)
+            self._active.discard(victim)
+            active_n = len(self._active)
+        threading.Thread(target=self._demote, args=(victim, active_n),
+                         name="g2v-router-demote", daemon=True).start()
+
+    def _demote(self, victim: str, active_n: int) -> None:
+        """Graceful scale-down, off the control loop: drain → fence →
+        relaunch. The fresh daemon re-queues its own journal OUT of
+        the ring and finishes those jobs (the PR 9 recovery path), so
+        a scale-down never loses work — then the replica parks in the
+        warm pool, first in line for the next scale-up."""
+        rc = None
+        try:
+            with self._rep_locks[victim]:
+                try:
+                    self._request(victim, {"op": "drain"}, timeout=10.0)
+                except (OSError, protocol.ProtocolError):
+                    pass
+                rc = self.fleet.fence(victim, grace_s=120.0)
+                self.metrics.emit("replica_drained", replica=victim,
+                                  rc=rc)
+                if self._stop.is_set():
+                    return
+                try:
+                    self.fleet.launch(victim)
+                except (RuntimeError, TimeoutError, OSError) as e:
+                    self.metrics.emit("replica_relaunch_failed",
+                                      replica=victim,
+                                      error=str(e)[:200])
+                    return
+        finally:
+            with self._hlock:
+                self._admin_draining.discard(victim)
+        with self._hlock:
+            self._warm.append(victim)
+            self.scale_downs += 1
+            ev = {"kind": "scale_down", "replica": victim, "rc": rc,
+                  "active": active_n, "at": round(time.time(), 3)}
+            self._last_scale = ev
+            self._scale_events.append(ev)
+        self.metrics.emit("scale_down", replica=victim,
+                          active=active_n, rc=rc)
+        self.metrics.emit("warm_spare", replica=victim,
+                          outcome="demoted")
+        self.console(f"[router] scale-down: -{victim} (drained, "
+                     f"rc={rc}, active={active_n})")
+        # The drain relaunched the daemon, so the parked spare is a
+        # fresh (cold) process — re-warm it for the next promotion.
+        self._warm_up(victim)
+
+    def _warm_deficit(self) -> bool:
+        """Does the warm pool need another spare? A stale True only
+        overfills the pool by one (promotions drain it first — the
+        documented, harmless direction)."""
+        with self._hlock:
+            return len(self._warm) < self.opts.warm_spares
+
+    def _add_warm(self, name: str) -> None:
+        with self._hlock:
+            self._pending_cold.discard(name)
+            self._warm.append(name)
+
+    def _warm_up(self, name: str) -> None:
+        """Pre-warm a parked spare with the operator's canary job
+        (``--warmup-job``), submitted straight to the OUT-of-ring
+        spare and run to completion. A freshly launched daemon is
+        only *process*-warm: its first real batch still pays jax
+        init, tracing, and the hot shapes' XLA compiles, and that
+        bill comes due exactly when a surge promotes it (the 1-core
+        chaos rig measured a promoted-but-cold spare stalling its
+        whole queue ~15 s doing this). The canary is an ordinary
+        journaled job against the spare's own state dir with a
+        boot-scoped idem key — every fresh process warms once, an
+        already-warm process dedups to an instant re-ack, and a
+        failure only costs warmth, never the pool slot. Spares are
+        promotable mid-warmup: the canary is just a queued job."""
+        path = self.opts.warmup_job
+        if not path:
+            return
+        t0 = time.monotonic()
+        try:
+            with open(path) as fh:
+                job = json.load(fh)
+            boots = self.fleet.replica(name).boots
+            req = {"op": "submit", "job": job, "tenant": "_warmup",
+                   "idempotency_key": f"warmup-{name}-b{boots}"}
+            if self.opts.auth_token is not None:
+                req["auth_token"] = self.opts.auth_token
+            addr = self._replica_addr(name)
+            if not addr:
+                raise ConnectionError(f"spare {name} has no address")
+            sock = protocol.dial(addr, timeout=10.0)
+            try:
+                sock.settimeout(600.0)
+                f = sock.makefile("rwb")
+                protocol.write_event(f, req)
+                ev = protocol.read_event(f)
+                if ev is None or ev.get("event") != "accepted":
+                    raise RuntimeError(f"canary not accepted: "
+                                       f"{(ev or {}).get('event')!r} "
+                                       f"{(ev or {}).get('error', '')}")
+                # Drain the stream: the daemon closes it after the
+                # terminal event, so EOF == canary finished.
+                while protocol.read_event(f) is not None:
+                    pass
+            finally:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+        except (OSError, ValueError, RuntimeError,
+                protocol.ProtocolError) as e:
+            self.metrics.emit("warm_spare", replica=name,
+                              outcome="warmup_failed",
+                              error=str(e)[:200])
+            self.console(f"[router] warm-up of {name} failed: {e}")
+        else:
+            dt = time.monotonic() - t0
+            self.metrics.emit("warm_spare", replica=name,
+                              outcome="warmed",
+                              warmup_s=round(dt, 3))
+            self.console(f"[router] spare {name} warmed ({dt:.1f}s)")
+
+    def _ensure_warm(self) -> None:
+        """Refill the warm pool in the background while cold names
+        remain. Non-blocking: if a refill thread is already running it
+        will converge the pool on its own."""
+        if self.opts.warm_spares <= 0 or self._stop.is_set():
+            return
+        if not self._refill_lock.acquire(blocking=False):
+            return
+
+        def _refill():
+            try:
+                while not self._stop.is_set():
+                    if not self._warm_deficit():
+                        return
+                    name = self._next_cold()
+                    if name is None:
+                        return
+                    try:
+                        with self._rep_locks[name]:
+                            self.fleet.launch(name)
+                    except (RuntimeError, TimeoutError, OSError) as e:
+                        with self._hlock:
+                            self._pending_cold.discard(name)
+                        self.metrics.emit("replica_relaunch_failed",
+                                          replica=name,
+                                          error=str(e)[:200])
+                        return
+                    self._add_warm(name)
+                    self.metrics.emit("warm_spare", replica=name,
+                                      outcome="launched")
+                    self.console(f"[router] warm spare {name} ready")
+                    # Warm INSIDE the refill loop, deliberately: on a
+                    # CPU-shared host two concurrent daemon boots slow
+                    # each other (and the active set) more than a
+                    # sequential boot→warm→boot chain does.
+                    self._warm_up(name)
+            finally:
+                self._refill_lock.release()
+
+        threading.Thread(target=_refill, name="g2v-router-warm",
+                         daemon=True).start()
 
     # ---- ops --------------------------------------------------------------
 
     def status(self) -> dict:
+        """The one-probe fleet view: per-replica health + role
+        (active / warm / cold), ring membership, the scale-event
+        ledger, and the scale loop's cached admission aggregate
+        (queue totals, per-tenant shed/goodput counters) — answering
+        "is the fleet healthy" without N replica round-trips."""
         with self._hlock:
             reps = {}
             for name, h in self.health.items():
                 spec = self.fleet.replica(name)
+                role = ("active" if name in self._active
+                        else "warm" if name in self._warm else "cold")
                 reps[name] = dict(h.snapshot(), addr=spec.addr,
                                   pid=spec.pid, boots=spec.boots,
+                                  role=role,
                                   assigned=sum(
                                       1 for r in self._assigned.values()
                                       if r == name))
             lats = sorted(self._requeue_latencies)
             jobs_routed, failovers = self.jobs_routed, self.failovers
+            active = sorted(self._active)
+            warm = list(self._warm)
+            ring_members = self.ring.members()
+            draining = sorted(self._admin_draining)
+            last_scale = dict(self._last_scale) \
+                if self._last_scale else None
+            scale_ups, scale_downs = self.scale_ups, self.scale_downs
+            fleet_stats = dict(self._fleet_stats)
         p99 = lats[min(len(lats) - 1,
                        int(0.99 * len(lats)))] if lats else None
         return {"event": "status", "role": "router", "pid": os.getpid(),
@@ -511,6 +1009,23 @@ class Router:
                            if self.tcp_addr else None),
                 "fleet_dir": self.opts.fleet_dir,
                 "replicas": reps,
+                "active": active,
+                "ring": ring_members,
+                "warm_pool": warm,
+                "warm_pool_size": len(warm),
+                "admin_draining": draining,
+                "autoscale": {"elastic": self._elastic,
+                              "min_replicas": self._min,
+                              "max_replicas": self._max,
+                              "warm_spares": self.opts.warm_spares,
+                              "up_queue": self.opts.scale_up_queue,
+                              "down_queue": self.opts.scale_down_queue,
+                              "up_wait_s": self.opts.scale_up_wait_s,
+                              "interval_s": self.opts.scale_interval},
+                "last_scale_event": last_scale,
+                "scale_ups": scale_ups,
+                "scale_downs": scale_downs,
+                "fleet": fleet_stats,
                 "jobs_routed": jobs_routed,
                 "failovers": failovers,
                 "requeue_latency_p99_s": (round(p99, 4)
@@ -584,6 +1099,16 @@ class Router:
             return {"event": "error",
                     "error": f"unknown replica {name!r}"}
         with self._hlock:
+            if name not in self._active:
+                # Warm spares hold zero jobs and cold names hold no
+                # process — "draining" either is at best a no-op and at
+                # worst a fence/relaunch on a spec the scale controller
+                # owns.
+                role = "warm" if name in self._warm else "cold"
+                return {"event": "error",
+                        "error": f"replica {name!r} is not active "
+                                 f"(role: {role}); only in-ring "
+                                 f"replicas can be drained"}
             if name in self._admin_draining:
                 return {"event": "error",
                         "error": f"replica {name!r} is already draining"}
@@ -792,7 +1317,7 @@ class Router:
                 return
         tried: List[str] = []
         for _ in range(max(1, len(self.fleet.names()))):
-            target = self.ring.lookup(
+            target = self._ring_lookup(
                 self._join_key_str(payload),
                 eligible=[n for n in self._eligible() if n not in tried])
             if target is None:
@@ -1021,11 +1546,19 @@ class Router:
     # ---- lifecycle --------------------------------------------------------
 
     def boot_fleet(self) -> None:
-        """Launch or adopt every replica. A dead replica with a journal
-        gets the full failover treatment AFTER the survivors are up, so
-        its jobs migrate instead of waiting for its relaunch."""
+        """Launch or adopt the ACTIVE replicas (the fleet is sized for
+        the elastic maximum plus warm headroom — which names run is
+        decided here and by the scale controller, not by the spec
+        count). A dead active replica with a journal gets the full
+        failover treatment AFTER the survivors are up, so its jobs
+        migrate instead of waiting for its relaunch. Journals stranded
+        on names OUTSIDE the active set (a previous run with wider
+        bounds) migrate to the survivors without a relaunch. Ends by
+        kicking the warm-pool fill."""
+        with self._hlock:
+            targets = sorted(self._active)
         live, dead = [], []
-        for name in self.fleet.names():
+        for name in targets:
             spec = self.fleet.replica(name)
             addr_file = os.path.join(spec.state_dir, "tcp_addr")
             if os.path.exists(addr_file):
@@ -1052,6 +1585,17 @@ class Router:
             else:
                 self.fleet.launch(name)
             live.append(name)
+        for name in self.fleet.names():
+            if name in targets:
+                continue
+            jobs_dir = self._dead_paths(name)[0]
+            depth = len(glob.glob(os.path.join(jobs_dir, "*.json"))) \
+                if os.path.isdir(jobs_dir) else 0
+            if depth and live:
+                with self._hlock:
+                    self.health[name].force_dead(now=time.time())
+                self._failover(name, relaunch=False)
+        self._ensure_warm()
 
     def serve_forever(self) -> int:
         import signal
@@ -1087,12 +1631,19 @@ class Router:
         prober = threading.Thread(target=self._probe_loop,
                                   name="g2v-router-probe", daemon=True)
         prober.start()
+        scaler = threading.Thread(target=self._scale_loop,
+                                  name="g2v-router-scale", daemon=True)
+        scaler.start()
+        with self._hlock:
+            active_n = len(self._active)
         self.metrics.emit("router_start", pid=os.getpid(),
                           listen=f"{self.tcp_addr[0]}:{self.tcp_addr[1]}",
                           replicas=self.fleet.names())
-        self.console(f"[router] fronting {len(self.fleet.names())} "
-                     f"replica(s) on {self.tcp_addr[0]}:"
-                     f"{self.tcp_addr[1]} (fleet {self.opts.fleet_dir})")
+        self.console(f"[router] fronting {active_n} of "
+                     f"{len(self.fleet.names())} replica(s) on "
+                     f"{self.tcp_addr[0]}:{self.tcp_addr[1]} "
+                     f"(fleet {self.opts.fleet_dir}"
+                     f"{', elastic' if self._elastic else ''})")
         try:
             while not self._stop.is_set():
                 try:
@@ -1107,6 +1658,7 @@ class Router:
         finally:
             srv.close()
             prober.join(timeout=5.0)
+            scaler.join(timeout=5.0)
             self.fleet.stop_all(grace_s=60.0)
             self.metrics.emit("router_stop", jobs_routed=self.jobs_routed,
                               failovers=self.failovers)

@@ -167,6 +167,12 @@ EVENT_SCHEMAS = {
     'router_stop': {
         "required": ['failovers', 'jobs_routed'],
         "optional": []},
+    'scale_down': {
+        "required": ['active', 'replica'],
+        "optional": ['rc']},
+    'scale_up': {
+        "required": ['active', 'from_warm', 'reaction_s', 'replica'],
+        "optional": []},
     'scenario': {
         "required": ['n_variants', 'scenario', 'scenario_id', 'scenario_seed', 'via'],
         "optional": ['folds', 'replicates']},
@@ -185,6 +191,9 @@ EVENT_SCHEMAS = {
     'serve_supervised_done': {
         "required": ['attempts'],
         "optional": []},
+    'shed': {
+        "required": ['est_wait_s', 'retry_after_s', 'tenant'],
+        "optional": []},
     'stability': {
         "required": ['n_genes', 'output', 'scenario_id'],
         "optional": ['acc_mean', 'ci_hi', 'ci_lo', 'columns', 'n_replicates']},
@@ -200,10 +209,16 @@ EVENT_SCHEMAS = {
     'supervised_done': {
         "required": ['attempts'],
         "optional": []},
+    'tenant_quota': {
+        "required": ['retry_after_s', 'tenant'],
+        "optional": []},
     'train_done': {
         "required": ['acc_tr', 'acc_val', 'stop_epoch', 'stopped_early'],
         "optional": ['bucket', 'bucket_mode']},
     'walk_cache': {
         "required": ['group', 'outcome'],
         "optional": ['n_rows']},
+    'warm_spare': {
+        "required": ['outcome', 'replica'],
+        "optional": ['error', 'warmup_s']},
 }

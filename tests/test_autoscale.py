@@ -1,0 +1,416 @@
+"""Elastic serve-fleet admission + scaling layer, in isolation.
+
+Every test here is pure (clock-injected token buckets, a fed-by-hand
+scaling policy, an in-process queue) or in-process (a Router object
+with no booted replicas, a fake UNIX-socket server for the client shed
+path) — no subprocesses, so the suite holds tier-1 cost. The full
+elastic fleet under diurnal/burst load with SIGKILLs runs in
+tools/chaos_soak.py --autoscale (bench.py --_autoscale_ab commits the
+A/B evidence).
+"""
+import os
+import socket
+import threading
+
+import pytest
+
+pytestmark = pytest.mark.autoscale
+
+
+# ---------------------------------------------------------------------------
+# Token bucket
+# ---------------------------------------------------------------------------
+
+def test_token_bucket_burst_then_refill():
+    from g2vec_tpu.resilience.lifecycle import TokenBucket
+
+    b = TokenBucket(rate=1.0, burst=2.0)
+    # Full at birth: the whole burst is admissible at t=0...
+    assert b.take(0.0) and b.take(0.0)
+    # ...and the third submission in the same instant is rate-limited.
+    assert not b.take(0.0)
+    # retry_after is the structured answer: one token at rate 1/s.
+    assert b.retry_after(0.0) == pytest.approx(1.0)
+    assert b.retry_after(0.5) == pytest.approx(0.5)
+    # Fractional refill: at t=0.5 there is half a token — still no.
+    assert not b.take(0.5)
+    assert b.take(1.0)
+    # Idle catch-up is capped at burst, not unbounded banking.
+    assert b.take(100.0) and b.take(100.0)
+    assert not b.take(100.0)
+
+
+def test_token_bucket_retry_after_zero_when_available():
+    from g2vec_tpu.resilience.lifecycle import TokenBucket
+
+    b = TokenBucket(rate=2.0, burst=4.0)
+    assert b.retry_after(0.0) == 0.0
+    for _ in range(4):
+        assert b.take(0.0)
+    # rate 2/s -> half a second per token.
+    assert b.retry_after(0.0) == pytest.approx(0.5)
+
+
+def test_token_bucket_validates():
+    from g2vec_tpu.resilience.lifecycle import TokenBucket
+
+    with pytest.raises(ValueError):
+        TokenBucket(rate=0.0, burst=1.0)
+    with pytest.raises(ValueError):
+        TokenBucket(rate=1.0, burst=0.0)
+
+
+# ---------------------------------------------------------------------------
+# Shed decision boundaries
+# ---------------------------------------------------------------------------
+
+def test_shed_decision_boundaries():
+    from g2vec_tpu.resilience.lifecycle import shed_decision
+
+    # Deadline exactly equal to the estimated wait -> ADMIT (shed only
+    # on strict excess; the boundary job still has a chance).
+    assert shed_decision(2.0, queued=4, service_time_s=0.5) is None
+    # One more queued job tips it over: retry_after = the excess wait,
+    # floored at one service time.
+    ra = shed_decision(2.0, queued=5, service_time_s=0.5)
+    assert ra == pytest.approx(0.5)
+    ra = shed_decision(1.0, queued=5, service_time_s=0.5)
+    assert ra == pytest.approx(1.5)
+    # No deadline -> never shed, regardless of queue depth.
+    assert shed_decision(None, queued=10 ** 6,
+                         service_time_s=10.0) is None
+    # No service-time evidence yet -> never shed (accept without proof).
+    assert shed_decision(0.001, queued=10 ** 6,
+                         service_time_s=None) is None
+    # Empty queue admits even a tight deadline.
+    assert shed_decision(0.0, queued=0, service_time_s=5.0) is None
+
+
+# ---------------------------------------------------------------------------
+# Scaling policy hysteresis
+# ---------------------------------------------------------------------------
+
+def test_policy_square_wave_never_flaps():
+    """A queue-depth square wave flipping faster than the streak
+    lengths must produce ZERO decisions — the whole point of streak
+    counting."""
+    from g2vec_tpu.resilience.lifecycle import ScalingPolicy
+
+    p = ScalingPolicy(1, 3, up_ticks=2, down_ticks=6, cooldown_ticks=5)
+    out = [p.observe(10 if t % 2 == 0 else 0, active=1)
+           for t in range(40)]
+    assert out == ["hold"] * 40
+    assert p.decisions == 0
+
+
+def test_policy_sustained_pressure_scales_up_then_cools():
+    from g2vec_tpu.resilience.lifecycle import ScalingPolicy
+
+    p = ScalingPolicy(1, 3, up_queue=4.0, up_ticks=2, cooldown_ticks=5)
+    assert p.observe(10, active=1) == "hold"      # streak 1
+    assert p.observe(10, active=1) == "up"        # streak 2 -> decide
+    # Cooldown: sustained pressure during the hold changes nothing.
+    for _ in range(5):
+        assert p.observe(10, active=2) == "hold"
+    # Pressure that PERSISTED through the whole cooldown has re-earned
+    # its streak — the next tick may decide again immediately.
+    assert p.observe(10, active=2) == "up"
+
+
+def test_policy_scale_down_slow_and_bounded():
+    from g2vec_tpu.resilience.lifecycle import ScalingPolicy
+
+    p = ScalingPolicy(1, 3, down_queue=0.5, down_ticks=6,
+                      cooldown_ticks=0)
+    # At the floor, an idle fleet never scales below min_replicas.
+    for _ in range(20):
+        assert p.observe(0, active=1) == "hold"
+    # Above the floor it takes down_ticks consecutive idle ticks.
+    p2 = ScalingPolicy(1, 3, down_ticks=6, cooldown_ticks=0)
+    out = [p2.observe(0, active=2) for _ in range(6)]
+    assert out == ["hold"] * 5 + ["down"]
+
+
+def test_policy_wait_signal_trips_up_at_modest_depth():
+    from g2vec_tpu.resilience.lifecycle import ScalingPolicy
+
+    p = ScalingPolicy(1, 3, up_queue=4.0, up_wait_s=8.0, up_ticks=2,
+                      cooldown_ticks=0)
+    # Pressure is under threshold (1 job/replica) but the estimated
+    # wait says deadlines are dying: that alone must trip the up path.
+    assert p.observe(1, active=1, wait_p99_s=30.0) == "hold"
+    assert p.observe(1, active=1, wait_p99_s=30.0) == "up"
+
+
+def test_policy_max_guard_and_victim_determinism():
+    from g2vec_tpu.resilience.lifecycle import ScalingPolicy
+
+    p = ScalingPolicy(1, 2, up_ticks=1, cooldown_ticks=0)
+    assert p.observe(100, active=2) == "hold"     # already at max
+    a = ScalingPolicy(1, 3, seed=7)
+    b = ScalingPolicy(1, 3, seed=7)
+    picks_a = [a.choose_victim(["r2", "r0", "r1"]) for _ in range(8)]
+    picks_b = [b.choose_victim(["r0", "r1", "r2"]) for _ in range(8)]
+    assert picks_a == picks_b                     # order-insensitive
+    assert a.choose_victim([]) is None
+
+
+def test_policy_validates():
+    from g2vec_tpu.resilience.lifecycle import ScalingPolicy
+
+    with pytest.raises(ValueError):
+        ScalingPolicy(0, 2)
+    with pytest.raises(ValueError):
+        ScalingPolicy(3, 2)
+    with pytest.raises(ValueError):
+        ScalingPolicy(1, 2, up_queue=1.0, down_queue=1.0)
+
+
+# ---------------------------------------------------------------------------
+# Tenant quota grammar
+# ---------------------------------------------------------------------------
+
+def test_parse_tenant_quotas():
+    from g2vec_tpu.serve.daemon import parse_tenant_quotas
+
+    q = parse_tenant_quotas("gold:4:8:3;bulk:0.5:2;*:2:4:1")
+    assert q["gold"].rate == 4.0 and q["gold"].burst == 8.0 \
+        and q["gold"].weight == 3
+    assert q["bulk"].weight == 1                  # weight defaults to 1
+    assert "*" in q
+    assert parse_tenant_quotas(None) == {}
+    assert parse_tenant_quotas("") == {}
+    for bad in ("gold", "gold:4", "gold:4:8:3:9", "gold:x:8",
+                "gold:4:8:1.5", "gold:0:8", "gold:4:0", "gold:4:8:0",
+                ":4:8", "gold:4:8;gold:2:2"):
+        with pytest.raises(ValueError):
+            parse_tenant_quotas(bad)
+
+
+# ---------------------------------------------------------------------------
+# Weighted-fair queue convergence
+# ---------------------------------------------------------------------------
+
+def _mk_job(job_id, tenant):
+    from g2vec_tpu.serve.daemon import ServeJob
+
+    return ServeJob(job_id=job_id, tenant=tenant, cfg=None, variants=[],
+                    raw={}, submitted_at=0.0)
+
+
+def test_fair_queue_weighted_convergence():
+    """Two tenants in sustained contention: a tenant with weight 3 gets
+    exactly 3 consecutive pops per rotation — over any window the
+    service ratio converges to the weight ratio."""
+    from g2vec_tpu.serve.daemon import _FairQueue
+
+    q = _FairQueue(depth=64, aging_s=3600.0, weights={"a": 3, "b": 1})
+    for i in range(24):
+        q.push(_mk_job(f"a{i}", "a"))
+    for i in range(8):
+        q.push(_mk_job(f"b{i}", "b"))
+    order = [q.pop(timeout=0).tenant for _ in range(32)]
+    assert order[:16] == ["a", "a", "a", "b"] * 4
+    assert order.count("a") == 24 and order.count("b") == 8
+
+
+def test_fair_queue_unweighted_is_plain_round_robin():
+    from g2vec_tpu.serve.daemon import _FairQueue
+
+    q = _FairQueue(depth=64, aging_s=3600.0)
+    for i in range(4):
+        q.push(_mk_job(f"a{i}", "a"))
+        q.push(_mk_job(f"b{i}", "b"))
+    order = [q.pop(timeout=0).tenant for _ in range(8)]
+    assert order == ["a", "b"] * 4
+
+
+def test_fair_queue_star_default_weight():
+    from g2vec_tpu.serve.daemon import _FairQueue
+
+    q = _FairQueue(depth=64, aging_s=3600.0,
+                   weights={"gold": 2, "*": 1})
+    for i in range(6):
+        q.push(_mk_job(f"g{i}", "gold"))
+        q.push(_mk_job(f"u{i}", "unlisted"))
+    order = [q.pop(timeout=0).tenant for _ in range(9)]
+    assert order == ["gold", "gold", "unlisted"] * 3
+
+
+# ---------------------------------------------------------------------------
+# Client shed backoff (fake server — no daemon, no jax)
+# ---------------------------------------------------------------------------
+
+class _FakeServer:
+    """Minimal JSONL server: scripted responses per submission, records
+    every idem_key it sees."""
+
+    def __init__(self, sock_path, script):
+        self.sock_path = sock_path
+        self.script = list(script)    # one entry per expected submit
+        self.idem_keys = []
+        self.tenants = []
+        self._srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._srv.bind(sock_path)
+        self._srv.listen(8)
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        from g2vec_tpu.serve import protocol
+
+        for events in self.script:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            f = conn.makefile("rwb")
+            try:
+                req = protocol.read_event(f)
+                self.idem_keys.append(req.get("idem_key"))
+                self.tenants.append(req.get("tenant"))
+                for ev in events:
+                    protocol.write_event(f, ev)
+            finally:
+                try:
+                    f.close()
+                    conn.close()
+                except OSError:
+                    pass
+
+    def close(self):
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+def test_client_backs_off_shed_and_reuses_idem_key(tmp_path):
+    from g2vec_tpu.serve import client
+
+    shed = [{"event": "rejected", "error": "shed", "job_id": "i1",
+             "tenant": "gold", "retry_after_s": 0.01,
+             "est_wait_s": 9.9}]
+    ok = [{"event": "accepted", "job_id": "i1"},
+          {"event": "job_done", "job_id": "i1", "outputs": []}]
+    path = os.path.join(str(tmp_path), "fake.sock")
+    srv = _FakeServer(path, [shed, shed, ok])
+    try:
+        ev = client.submit_and_wait(path, {"j": 1}, tenant="gold",
+                                    timeout=5.0, jitter=0.0,
+                                    shed_retries=3)
+    finally:
+        srv.close()
+    assert ev["event"] == "job_done"
+    # Three submissions, ONE idempotency key — the shed retry must
+    # never re-key (a re-keyed retry would run the job twice once the
+    # fleet admits it).
+    assert len(srv.idem_keys) == 3
+    assert len(set(srv.idem_keys)) == 1 and srv.idem_keys[0]
+
+
+def test_client_raises_structured_serve_shed(tmp_path):
+    from g2vec_tpu.serve import client
+
+    shed = [{"event": "rejected", "error": "tenant_quota",
+             "job_id": "i2", "tenant": "bulk", "retry_after_s": 0.01}]
+    path = os.path.join(str(tmp_path), "fake.sock")
+    srv = _FakeServer(path, [shed] * 3)
+    try:
+        with pytest.raises(client.ServeShed) as ei:
+            client.submit_and_wait(path, {"j": 1}, tenant="bulk",
+                                   timeout=5.0, jitter=0.0,
+                                   shed_retries=2)
+    finally:
+        srv.close()
+    assert ei.value.tenant == "bulk"
+    assert ei.value.job_id == "i2"
+    assert ei.value.retry_after_s == pytest.approx(0.01)
+    # All three attempts (1 + shed_retries) carried the same key.
+    assert len(set(srv.idem_keys)) == 1
+
+
+# ---------------------------------------------------------------------------
+# Router aggregate status + elastic construction (no processes)
+# ---------------------------------------------------------------------------
+
+def test_router_elastic_state_and_aggregate_status(tmp_path):
+    from g2vec_tpu.serve.router import Router, RouterOptions
+
+    r = Router(RouterOptions(fleet_dir=str(tmp_path / "fleet"),
+                             replicas=1, min_replicas=1,
+                             max_replicas=3, warm_spares=1),
+               console=lambda s: None)
+    # Fleet sized for the ceiling + warm headroom; only r0 active.
+    assert r.fleet.names() == ["r0", "r1", "r2", "r3"]
+    st = r.status()
+    assert st["active"] == ["r0"]
+    assert st["ring"] == ["r0"]
+    assert st["warm_pool"] == [] and st["warm_pool_size"] == 0
+    assert st["autoscale"]["elastic"] is True
+    assert st["autoscale"]["min_replicas"] == 1
+    assert st["autoscale"]["max_replicas"] == 3
+    assert st["autoscale"]["warm_spares"] == 1
+    assert st["last_scale_event"] is None
+    assert st["scale_ups"] == 0 and st["scale_downs"] == 0
+    assert st["fleet"] == {}          # no sweep has run yet
+    roles = {n: rep["role"] for n, rep in st["replicas"].items()}
+    assert roles == {"r0": "active", "r1": "cold", "r2": "cold",
+                     "r3": "cold"}
+    # Admin drain refuses non-active names instead of fencing a spec
+    # the scale controller owns.
+    resp = r.handle_drain_replica("r2")
+    assert resp["event"] == "error" and "not active" in resp["error"]
+    assert r.handle_drain_replica("nope")["event"] == "error"
+
+
+def test_router_static_default_unchanged(tmp_path):
+    from g2vec_tpu.serve.router import Router, RouterOptions
+
+    r = Router(RouterOptions(fleet_dir=str(tmp_path / "fleet"),
+                             replicas=2), console=lambda s: None)
+    st = r.status()
+    assert r.fleet.names() == ["r0", "r1"]
+    assert st["active"] == ["r0", "r1"]
+    assert st["autoscale"]["elastic"] is False
+
+
+def test_router_rejects_bad_elastic_bounds(tmp_path):
+    from g2vec_tpu.serve.router import Router, RouterOptions
+
+    with pytest.raises(ValueError):
+        Router(RouterOptions(fleet_dir=str(tmp_path / "f1"),
+                             replicas=2, min_replicas=3,
+                             max_replicas=2), console=lambda s: None)
+    with pytest.raises(ValueError):
+        Router(RouterOptions(fleet_dir=str(tmp_path / "f2"),
+                             replicas=1, warm_spares=-1),
+               console=lambda s: None)
+
+
+def test_router_scale_claim_and_probe_targets(tmp_path):
+    """The pure halves of the scale machinery: capacity claims and the
+    probe target set, driven without any processes."""
+    from g2vec_tpu.serve.router import Router, RouterOptions
+
+    r = Router(RouterOptions(fleet_dir=str(tmp_path / "fleet"),
+                             replicas=1, min_replicas=1,
+                             max_replicas=2, warm_spares=1),
+               console=lambda s: None)
+    # Cold names are never probed (probing them would declare them
+    # dead and launch processes that should not exist).
+    assert r._probe_targets() == ["r0"]
+    with r._hlock:
+        r._warm.append("r1")
+    assert r._probe_targets() == ["r0", "r1"]
+    # A claim prefers the warm pool and empties it...
+    name, capacity = r._claim_warm()
+    assert (name, capacity) == ("r1", True)
+    with r._hlock:
+        r.ring.add(name)
+        r._active.add(name)
+    # ...and at the ceiling there is no capacity left to claim.
+    assert r._claim_warm() == (None, False)
+    # _next_cold skips active/warm/pending and claims the first cold.
+    assert r._next_cold() == "r2"
+    assert r._next_cold() is None     # r2 now pending, nothing cold left

@@ -19,8 +19,9 @@ Contracts pinned here:
    ``embed_allreduce`` seams is NAMED by the survivor's
    PeerTimeoutError instead of wedging the fleet.
 6. **Bounded per-rank RSS**: every sharded rank peaks well below the
-   MEASURED unsharded run at the same scale (slow — the committed
-   BENCH_SHARD_SCALE.json carries the full scaling curve).
+   MEASURED unsharded run at the same scale (slow — the full scaling
+   curve is BENCH_SHARD_SCALE.json, written on demand by
+   `bench.py --_shard_scale`).
 """
 import json
 import os
@@ -388,7 +389,8 @@ def test_sharded_rss_below_measured_unsharded_run(tmp_path):
     trainer-state bytes (4 x [G, H] f32) are NOT the bound: real peaks
     carry ~1 GB process overhead plus unpack/exchange transients, so
     the honest comparison is run-vs-run at the same scale (same framing
-    as bench.py --_shard_scale / BENCH_SHARD_SCALE.json)."""
+    as bench.py --_shard_scale, which writes BENCH_SHARD_SCALE.json
+    on demand)."""
     from g2vec_tpu.data.synth import SynthGraphSpec, write_synth_graph_streamed
 
     n_genes, hidden = 262_144, 256

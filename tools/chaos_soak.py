@@ -36,6 +36,20 @@ across ALL replicas' metrics streams and exactly one result record
 across all results dirs — plus byte parity and the death-to-requeue
 latency distribution from the router's ``failover`` events.
 
+``--autoscale`` switches to **autoscale mode**: a seeded diurnal load
+model (sinusoid base rate with flash-crowd spike windows, mixed tenants
+with distinct SLO classes) runs against the router — elastic
+(``--max-replicas`` > min, warm spares, ``--shed``,
+``--tenant-quotas``) or static (``--max-replicas 0``) — with one
+replica SIGKILL scheduled mid-spike. The submit loop honors structured
+``shed``/``tenant_quota`` rejections (same idempotency key, advised
+backoff, bounded attempts), the router's aggregate ``/status`` is
+asserted on throughout, and the summary adds per-tenant SLO
+attainment, deadline-death and shed counts, goodput, and the scale-up
+reaction-time distribution — the evidence behind
+``bench.py --_autoscale_ab`` (BENCH_AUTOSCALE.json), which runs the
+identical seeded schedule against both fleet shapes.
+
 Scale knobs are flags with G2V_CHAOS_* env fallbacks so CI can shrink
 the soak (``G2V_CHAOS_JOBS=6 python tools/chaos_soak.py``). The
 committed artifacts (BENCH_CHAOS_SOAK.json, BENCH_ROUTER_CHAOS.json) are
@@ -46,6 +60,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import random
 import shutil
@@ -55,7 +70,7 @@ import sys
 import tempfile
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
@@ -120,6 +135,36 @@ def build_parser() -> argparse.ArgumentParser:
                         "drain / router SIGKILL+restart / cancel; "
                         "accounting spans every replica's results dir and "
                         "metrics stream (0 = classic single-daemon mode).")
+    p.add_argument("--autoscale", action="store_true",
+                   default=_env_int("G2V_CHAOS_AUTOSCALE", 0) > 0,
+                   help="Autoscale mode: seeded diurnal/burst load with "
+                        "tenant SLO classes against the router (elastic "
+                        "when --max-replicas > min, static otherwise), "
+                        "one replica SIGKILL mid-spike, aggregate-status "
+                        "assertions, per-tenant attainment accounting.")
+    p.add_argument("--min-replicas", type=int,
+                   default=_env_int("G2V_CHAOS_MIN_REPLICAS", 0),
+                   help="Elastic floor forwarded to the router "
+                        "(0 = --replicas).")
+    p.add_argument("--max-replicas", type=int,
+                   default=_env_int("G2V_CHAOS_MAX_REPLICAS", 0),
+                   help="Elastic ceiling forwarded to the router "
+                        "(0 = static fleet of --replicas).")
+    p.add_argument("--warm-spares", type=int,
+                   default=_env_int("G2V_CHAOS_WARM", 0),
+                   help="Pre-launched ringless spares kept warm by the "
+                        "router for instant scale-up.")
+    p.add_argument("--scale-interval", type=float,
+                   default=_env_float("G2V_CHAOS_SCALE_INTERVAL", 0.5),
+                   help="Router scaling-controller tick seconds.")
+    p.add_argument("--shed", action="store_true",
+                   default=_env_int("G2V_CHAOS_SHED", 0) > 0,
+                   help="Forward --shed to the replicas: deadline-aware "
+                        "admission shedding with structured retry_after_s.")
+    p.add_argument("--tenant-quotas", type=str,
+                   default=os.environ.get("G2V_CHAOS_QUOTAS"),
+                   help="Forward --tenant-quotas SPEC to the replicas "
+                        "(token-bucket rates + weighted-fair shares).")
     return p
 
 
@@ -375,8 +420,8 @@ class RouterSoak(Soak):
 
     # ---- router lifecycle -------------------------------------------
 
-    def launch_router(self) -> None:
-        argv = [sys.executable, "-m", "g2vec_tpu", "serve",
+    def _router_argv(self) -> List[str]:
+        return [sys.executable, "-m", "g2vec_tpu", "serve",
                 "--replicas", str(self.opts.replicas),
                 "--listen", "127.0.0.1:0",
                 "--state-dir", self.fleet,
@@ -385,6 +430,9 @@ class RouterSoak(Soak):
                 "--queue-depth", "64", "--max-join", "6",
                 "--probe-interval", "0.4", "--probe-deadline", "3.0",
                 "--metrics-jsonl", self.router_metrics]
+
+    def launch_router(self) -> None:
+        argv = self._router_argv()
         addr_file = os.path.join(self.fleet, "router_addr")
         try:
             os.unlink(addr_file)
@@ -639,6 +687,334 @@ class RouterSoak(Soak):
             self.unsubmitted.append(k)
 
 
+#: SLO classes for autoscale mode: arrival share, probability a job
+#: carries a deadline, the deadline range (queue-wait budget, seconds),
+#: and how often the tenant submits at interactive priority. Gold is
+#: latency-critical (every job deadlined), bulk is throughput traffic
+#: that can wait.
+TENANT_CLASSES = {
+    "gold":   {"share": 0.30, "deadline_p": 1.0, "deadline": (5.0, 8.0),
+               "interactive_p": 0.8},
+    "silver": {"share": 0.30, "deadline_p": 0.5, "deadline": (7.0, 11.0),
+               "interactive_p": 0.3},
+    "bulk":   {"share": 0.40, "deadline_p": 0.1, "deadline": (15.0, 25.0),
+               "interactive_p": 0.0},
+}
+
+#: Default per-tenant token buckets + weighted-fair shares for the
+#: elastic arm: gold paid for headroom and 3x queue weight, bulk gets a
+#: tight bucket so a bulk flash-crowd defers to gold instead of
+#: starving it.
+DEFAULT_QUOTAS = "gold:6:12:3;silver:3:6:2;bulk:0.8:2:1"
+
+
+def diurnal_arrivals(n: int, rng: random.Random, base_rate: float,
+                     period_s: float,
+                     spikes: List[Tuple[float, float, float]]) -> List[float]:
+    """Seeded non-homogeneous arrival times: a sinusoid over
+    ``base_rate`` (the diurnal curve, compressed to ``period_s``) with
+    multiplicative flash-crowd windows ``(start_s, dur_s, mult)``. The
+    same (seed, knobs) always yields the same schedule — that is what
+    makes the static/elastic A/B a controlled experiment."""
+    arrivals, t = [], 0.0
+    for _ in range(n):
+        rate = base_rate * (1.0 + 0.5 * math.sin(2 * math.pi * t / period_s))
+        for (s0, dur, mult) in spikes:
+            if s0 <= t < s0 + dur:
+                rate *= mult
+        arrivals.append(t)
+        t += rng.expovariate(max(0.05, rate))
+    return arrivals
+
+
+class AutoscaleSoak(RouterSoak):
+    """Soak state for autoscale mode: the router fronts a fleet that is
+    either elastic (min..max active replicas, warm spares, deadline
+    shedding, tenant quotas) or static (the baseline arm), and the load
+    is the seeded diurnal/burst model with tenant SLO classes. The
+    submit loop is SLO-aware: structured ``shed`` / ``tenant_quota``
+    rejections are retried with the SAME idempotency key after the
+    advised ``retry_after_s`` (plus jitter), for a bounded number of
+    attempts; exhaustion is recorded per tenant as a final shed — never
+    as a lost job, because a shed job was refused BEFORE journaling."""
+
+    MAX_SHED_RETRIES = 8
+
+    def __init__(self, opts, workdir: str):
+        super().__init__(opts, workdir)
+        self.gave_up: List[dict] = []        # exhausted shed/quota retries
+        self.shed_retries = 0                # shed rejections retried
+        self.quota_retries = 0               # quota rejections retried
+        self.status_checks = 0
+        self.status_violations: List[str] = []
+        self.max_active_seen = 0
+        self.arrival_t0: Optional[float] = None
+        self.warmup_job: Optional[str] = None  # canary file for spares
+
+    # ---- fleet shape -------------------------------------------------
+
+    def _elastic(self) -> bool:
+        mn = self.opts.min_replicas or self.opts.replicas
+        mx = self.opts.max_replicas or self.opts.replicas
+        return mx > mn
+
+    def _fleet_width(self) -> int:
+        return (max(self.opts.replicas, self.opts.max_replicas)
+                + max(0, self.opts.warm_spares))
+
+    def _replica_dirs(self) -> List[str]:
+        return [os.path.join(self.fleet, f"r{i}")
+                for i in range(self._fleet_width())]
+
+    def journal_ids(self) -> List[str]:
+        """Leftover journal entries, excluding warm-pool canaries: the
+        shutdown can land while a spare's ``--warmup-job`` is queued,
+        and an abandoned canary is not lost work — its result is
+        discarded by design (the warmth was the product), and it never
+        appears in the ack ledger this accounting audits."""
+        out = []
+        for rdir in self._replica_dirs():
+            jdir = os.path.join(rdir, "state", "jobs")
+            if not os.path.isdir(jdir):
+                continue
+            for fn in os.listdir(jdir):
+                if not fn.endswith(".json"):
+                    continue
+                try:
+                    with open(os.path.join(jdir, fn)) as f:
+                        if json.load(f).get("tenant") == "_warmup":
+                            continue
+                except (OSError, ValueError):
+                    pass
+                out.append(fn[:-5])
+        return out
+
+    def _router_argv(self) -> List[str]:
+        argv = super()._router_argv()
+        if self.opts.max_replicas:
+            argv += ["--min-replicas", str(self.opts.min_replicas),
+                     "--max-replicas", str(self.opts.max_replicas),
+                     "--warm-spares", str(self.opts.warm_spares),
+                     "--scale-interval", str(self.opts.scale_interval)]
+            if self.warmup_job:
+                argv += ["--warmup-job", self.warmup_job]
+        if self.opts.shed:
+            argv += ["--shed"]
+        if self.opts.tenant_quotas:
+            argv += ["--tenant-quotas", self.opts.tenant_quotas]
+        return argv
+
+    # ---- SLO assignment ----------------------------------------------
+
+    def slo_of(self, k: int) -> Tuple[str, Optional[float], str]:
+        """Deterministic (seed, k) -> (tenant, deadline_s, priority).
+        Independent of arm shape, so the static and elastic runs submit
+        byte-identical SLO mixes."""
+        rng = random.Random((self.opts.seed << 24) ^ k)
+        r, acc = rng.random(), 0.0
+        tenant = "bulk"
+        for name, cls in TENANT_CLASSES.items():
+            acc += cls["share"]
+            if r < acc:
+                tenant = name
+                break
+        cls = TENANT_CLASSES[tenant]
+        deadline_s = (round(rng.uniform(*cls["deadline"]), 2)
+                      if rng.random() < cls["deadline_p"] else None)
+        priority = ("interactive"
+                    if rng.random() < cls["interactive_p"] else "batch")
+        return tenant, deadline_s, priority
+
+    def make_job(self, k: int, paths: dict, native_ok: bool) -> dict:
+        """Tenant-shaped job mix with DISTINCT batch-join keys. The
+        base soak submits config-identical jobs, which the daemon joins
+        into one amortized batch — a load so compressible that a single
+        replica absorbs any spike, and the ring (which places by join
+        key) sends every job to ONE owner. Real multi-tenant traffic is
+        the opposite. Gold/silver are interactive: small jobs on cached
+        engine shapes (cheap after the first compile). Bulk is batch
+        analytics: each job wants its own walk length and model width,
+        so nearly every bulk job pays a fresh XLA compile — seconds of
+        head-of-line blocking on the daemon's single scheduler. That
+        cost asymmetry is what the flash crowd weaponizes: a wall of
+        bulk compiles lands in front of deadlined gold traffic."""
+        job = super().make_job(k, paths, native_ok)
+        job["numBiomarker"] = 2 + (k % 25)
+        tenant, _, _ = self.slo_of(k)
+        if tenant == "bulk":
+            job["lenPath"] = 10 + 2 * (k % 16)
+            job["sizeHiddenlayer"] = 24
+        else:
+            job["lenPath"] = 8
+        job["numRepetition"] = 3
+        return job
+
+    # ---- chaos: kill an ACTIVE replica only --------------------------
+
+    def _pick_replica(self) -> Optional[str]:
+        st = self.router_status()
+        if not st:
+            return None
+        reps = st.get("replicas") or {}
+        live = [n for n, r in reps.items()
+                if r.get("state") in ("healthy", "suspect")
+                and r.get("pid") and r.get("role") == "active"]
+        if not live:
+            return None
+        name = self.rng.choice(sorted(live))
+        self._victim_pid = reps[name].get("pid")
+        return name
+
+    # ---- aggregate-status assertions ---------------------------------
+
+    def check_router_status(self) -> None:
+        """One assertion pass over the router's fleet-wide /status: the
+        keys the dashboard (and this accounting) depend on must exist
+        and the scale state must respect the configured bounds. Any
+        violation fails the soak."""
+        st = self.router_status()
+        if not st:
+            return                 # router mid-restart: not a violation
+        self.status_checks += 1
+        probs: List[str] = []
+        for key in ("replicas", "active", "warm_pool", "warm_pool_size",
+                    "autoscale", "last_scale_event", "scale_ups",
+                    "scale_downs", "fleet"):
+            if key not in st:
+                probs.append(f"missing key {key!r}")
+        auto = st.get("autoscale") or {}
+        active = st.get("active") or []
+        self.max_active_seen = max(self.max_active_seen, len(active))
+        mn = self.opts.min_replicas or self.opts.replicas
+        mx = self.opts.max_replicas or self.opts.replicas
+        if bool(auto.get("elastic")) != self._elastic():
+            probs.append(f"autoscale.elastic={auto.get('elastic')!r}, "
+                         f"expected {self._elastic()}")
+        if active and not (1 <= len(active) <= mx):
+            probs.append(f"active={len(active)} outside [1, {mx}]")
+        # Transient overfill is legal (a demote parks its replica even
+        # when the pool is full) but bounded by the fleet width.
+        warm_cap = mx + max(0, self.opts.warm_spares) - mn
+        if st.get("warm_pool_size", 0) > warm_cap:
+            probs.append(f"warm_pool_size={st.get('warm_pool_size')} "
+                         f"exceeds bound {warm_cap}")
+        if st.get("scale_ups", 0) > 0:
+            ev = st.get("last_scale_event") or {}
+            for field in ("kind", "replica", "at"):
+                if field not in ev:
+                    probs.append(f"last_scale_event missing {field!r}")
+        fleet = st.get("fleet") or {}
+        if fleet:
+            for key in ("queued", "running", "est_wait_s", "tenants"):
+                if key not in fleet:
+                    probs.append(f"fleet aggregate missing {key!r}")
+        for p in probs:
+            if p not in self.status_violations:
+                self.status_violations.append(p)
+                self.note(f"STATUS VIOLATION: {p}")
+
+    # ---- router metrics ----------------------------------------------
+
+    def router_events(self, kinds: Tuple[str, ...]) -> List[dict]:
+        out = []
+        try:
+            with open(self.router_metrics) as f:
+                for line in f:
+                    try:
+                        ev = json.loads(line)
+                    except ValueError:
+                        continue
+                    if ev.get("event") in kinds:
+                        out.append(ev)
+        except OSError:
+            pass
+        return out
+
+    def slo_events(self) -> Dict[str, int]:
+        """Fleet-wide admission-SLO event counts from every replica's
+        durable metrics stream (the in-memory per-tenant ledgers die
+        with a SIGKILLed replica; the JSONL does not)."""
+        counts = {"shed": 0, "tenant_quota": 0}
+        for rdir in self._replica_dirs():
+            path = os.path.join(rdir, "metrics.jsonl")
+            try:
+                with open(path) as f:
+                    for line in f:
+                        try:
+                            ev = json.loads(line)
+                        except ValueError:
+                            continue
+                        if ev.get("event") in counts:
+                            counts[ev.get("event")] += 1
+            except OSError:
+                pass
+        return counts
+
+    # ---- SLO-aware submission ----------------------------------------
+
+    def submit_one(self, k: int, job: dict) -> None:
+        from g2vec_tpu.serve import client
+
+        rng = random.Random((self.opts.seed << 20) ^ k)
+        tenant, deadline_s, priority = self.slo_of(k)
+        idem = f"soak-{self.opts.seed}-{k}"
+        sheds = 0
+        for attempt in range(16):
+            try:
+                evs = client.submit_job(
+                    self.addr, job, tenant=tenant, timeout=600,
+                    priority=priority, deadline_s=deadline_s,
+                    idem_key=idem)
+                if evs and evs[-1].get("event") == "rejected":
+                    err = evs[-1].get("error")
+                    if err in ("no_replicas", "draining", "queue_full"):
+                        raise OSError(f"fleet busy: {err}")
+                    if err in ("shed", "tenant_quota"):
+                        sheds += 1
+                        with self.lock:
+                            if err == "shed":
+                                self.shed_retries += 1
+                            else:
+                                self.quota_retries += 1
+                        if sheds > self.MAX_SHED_RETRIES:
+                            with self.lock:
+                                self.gave_up.append(
+                                    {"k": k, "tenant": tenant,
+                                     "deadline_s": deadline_s,
+                                     "error": err})
+                            return
+                        ra = evs[-1].get("retry_after_s")
+                        ra = float(ra) if isinstance(ra, (int, float)) \
+                            else 0.5
+                        time.sleep(min(8.0, max(0.05, ra))
+                                   + rng.uniform(0.0, 0.3))
+                        continue
+                    with self.lock:
+                        self.rejected.append(k)
+                    return
+                jid = evs[0].get("job_id") if evs else None
+                if jid:
+                    with self.lock:
+                        self.acks[jid] = {"k": k, "job": job,
+                                          "deadline_s": deadline_s,
+                                          "tenant": tenant}
+                    return
+                break
+            except client.ServeConnectionLost as e:
+                if e.job_id:
+                    with self.lock:
+                        self.acks[e.job_id] = {"k": k, "job": job,
+                                               "deadline_s": deadline_s,
+                                               "tenant": tenant}
+                    return
+            except (client.ServeTimeout, OSError):
+                pass
+            time.sleep(min(5.0, 0.2 * (2 ** attempt))
+                       + rng.uniform(0.0, 0.25))
+        with self.lock:
+            self.unsubmitted.append(k)
+
+
 def run_router_soak(opts, workdir: str) -> dict:
     """The replicated-fleet storm: N replicas behind the router, seeded
     replica-SIGKILL / replica-drain / router-restart rotation, fleet-wide
@@ -823,6 +1199,321 @@ def _percentile(vals: List[float], q: float) -> float:
     return round(s[min(len(s) - 1, int(round(q * (len(s) - 1))))], 3)
 
 
+def _byte_parity(soak, acks: Dict[str, dict], results: Dict[str, dict],
+                 workdir: str, n_verify: int):
+    """Re-run a sample of completed jobs solo and uninterrupted in THIS
+    process; their outputs must be byte-identical to what the stormed
+    fleet recorded. Returns (checked, identical, mismatches)."""
+    done_ids = [jid for jid in acks
+                if results.get(jid, {}).get("status") == "done"]
+    sample = sorted(done_ids)[:max(0, n_verify)]
+    byte_checked, byte_identical, mismatches = 0, 0, []
+    if not sample:
+        return byte_checked, byte_identical, mismatches
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from g2vec_tpu.batch.engine import _variant_from_dict, lane_config
+    from g2vec_tpu.config import config_from_job
+    from g2vec_tpu.pipeline import run as solo_run
+
+    for jid in sample:
+        k = acks[jid]["k"]
+        job = acks[jid]["job"]
+        cfg = config_from_job(
+            {**job, "result_name": os.path.join(workdir, "out",
+                                                f"solo{k}")})
+        v = _variant_from_dict(0, {"name": "v"}, cfg)
+        sres = solo_run(lane_config(cfg, v), console=lambda s: None)
+        outs = results[jid]["variants"]["v"]["outputs"]
+        byte_checked += 1
+        same = True
+        for fa, fb in zip(sorted(outs), sorted(sres.output_files)):
+            with open(fa, "rb") as a, open(fb, "rb") as b:
+                if a.read() != b.read():
+                    same = False
+                    mismatches.append(f"{jid}: {fa} != {fb}")
+        byte_identical += int(same)
+        soak.note(f"parity {jid} (job{k}): "
+                  f"{'identical' if same else 'MISMATCH'}")
+    return byte_checked, byte_identical, mismatches
+
+
+def run_autoscale_soak(opts, workdir: str) -> dict:
+    """The elastic-vs-static proof harness: the seeded diurnal/burst
+    storm with tenant SLO classes against ONE fleet shape (the caller —
+    bench.py --_autoscale_ab — runs it twice, static then elastic, under
+    the identical schedule). One active replica is SIGKILLed mid-spike;
+    every heal and every scale event must come from the router. The
+    summary carries deadline deaths, per-tenant attainment, shed/quota
+    traffic, goodput, and the scale-up reaction distribution on top of
+    the fleet-wide exactly-once predicate."""
+    from g2vec_tpu.data.synthetic import SyntheticSpec, write_synthetic_tsv
+    from g2vec_tpu.serve import client
+
+    soak = AutoscaleSoak(opts, workdir)
+    native_ok = bool(shutil.which("g++")) and opts.stream_frac > 0
+    # Heavier cohort than the base soak: per-job cost must be real for
+    # a flash crowd to build an actual queue (the tiny spec services in
+    # ~0.3 s/job and no arrival rate this side of silly saturates it).
+    spec = SyntheticSpec(n_good=44, n_poor=40, module_size=12,
+                         n_background=44, n_expr_only=6, n_net_only=6,
+                         module_chords=2, background_edges=80, seed=7)
+    paths = write_synthetic_tsv(spec, os.path.join(workdir, "data"))
+    os.makedirs(os.path.join(workdir, "out"), exist_ok=True)
+
+    # The warm-pool canary: a gold/silver-shaped job. XLA programs are
+    # keyed by walk length and model width (biomarker count, epochs,
+    # seeds don't change shapes), so one canary at the interactive
+    # tier's lenPath/sizeHiddenlayer pre-compiles EVERY gold and silver
+    # job's programs on a spare before it is ever promoted — the
+    # deadlined traffic lands on a hot process. Bulk's unique shapes
+    # stay cold by design; bulk carries (almost) no deadlines to miss.
+    if soak._elastic() and opts.warm_spares > 0:
+        canary = soak.make_job(0, paths, native_ok)
+        canary.update(lenPath=8, sizeHiddenlayer=16, numRepetition=3,
+                      numBiomarker=2, epoch=opts.epochs,
+                      result_name=os.path.join(workdir, "out", "warmup"))
+        soak.warmup_job = os.path.join(workdir, "warmup_job.json")
+        with open(soak.warmup_job, "w") as fh:
+            json.dump(canary, fh)
+
+    n = opts.jobs
+    rng = soak.rng
+    # The load model: one compressed "day" with two flash crowds. The
+    # spike times are seed-jittered, then shared verbatim by both arms.
+    spikes = [(rng.uniform(14.0, 17.0), 6.0, 12.0),
+              (rng.uniform(52.0, 58.0), 8.0, 4.0)]
+    arrivals = diurnal_arrivals(n, rng, base_rate=0.6, period_s=70.0,
+                                spikes=spikes)
+    # The acceptance kill: one ACTIVE replica dies 2.5 s into the first
+    # flash crowd, when the queue is deepest and a lost journal would
+    # hurt the most. By then the elastic arm has already scaled up
+    # (the crowd trips the queue threshold within a tick or two), so a
+    # survivor is in the ring to inherit the dead journal; the static
+    # arm's queued jobs instead wait out the full fence+relaunch window
+    # with their deadline clocks running.
+    kill_at = spikes[0][0] + 2.5
+
+    soak.note(f"autoscale soak ({'elastic' if soak._elastic() else 'static'}"
+              f"): {n} jobs over base {opts.replicas} replica(s), "
+              f"max={opts.max_replicas or opts.replicas} "
+              f"warm={opts.warm_spares} shed={opts.shed} "
+              f"quotas={'yes' if opts.tenant_quotas else 'no'}, "
+              f"spikes={[(round(s, 1), d, m) for s, d, m in spikes]}, "
+              f"kill_at={kill_at:.1f}s, seed {opts.seed}")
+    soak.launch_router()
+
+    if soak.warmup_job:
+        # Bring-up discipline: the storm opens only after the initial
+        # warm pool is WARM (canary complete). Operators finish
+        # provisioning before opening the doors — and on a shared-CPU
+        # host, a mid-storm canary compile steals exactly the cycles
+        # the active set needs to hold its deadlines. Bounded wait: a
+        # failed warmup degrades to the old cold-spare behavior.
+        warm_wait_t0 = time.time()
+        while time.time() - warm_wait_t0 < 120.0:
+            warmed = sum(1 for ev in soak.router_events(("warm_spare",))
+                         if ev.get("outcome") == "warmed")
+            if warmed >= opts.warm_spares:
+                soak.note(f"warm pool warmed ({warmed} spare(s), "
+                          f"{time.time() - warm_wait_t0:.1f}s) — "
+                          f"opening the storm")
+                break
+            time.sleep(0.5)
+        else:
+            soak.note("warm pool never finished warming (120s) — "
+                      "storm opens against cold spares")
+
+    threads: List[threading.Thread] = []
+    soak.arrival_t0 = time.time()
+
+    def arrival_loop():
+        t0 = soak.arrival_t0
+        jobs = [soak.make_job(k, paths, native_ok) for k in range(n)]
+        for k in range(n):
+            now = time.time() - t0
+            if now < arrivals[k]:
+                time.sleep(arrivals[k] - now)
+            th = threading.Thread(target=soak.submit_one,
+                                  args=(k, jobs[k]), daemon=True)
+            th.start()
+            threads.append(th)
+
+    arr = threading.Thread(target=arrival_loop, daemon=True)
+    arr.start()
+
+    deadline = soak.t0 + opts.budget_s
+    kill_wall = soak.arrival_t0 + kill_at
+    killed = False
+    next_status = time.time() + 1.0
+    budget_blown = False
+    while True:
+        if time.time() > deadline:
+            budget_blown = True
+            soak.note("BUDGET BLOWN — abandoning the storm")
+            break
+        if soak.proc.poll() is not None:
+            soak.note(f"router self-death rc={soak.proc.returncode} — "
+                      f"restarting (counts against it)")
+            soak.launch_router()
+        if not killed and time.time() >= kill_wall:
+            killed = True
+            soak.op_replica_sigkill()
+        if time.time() >= next_status:
+            soak.check_router_status()
+            next_status = time.time() + 1.0
+        if killed and not arr.is_alive() \
+                and all(not th.is_alive() for th in threads):
+            with soak.lock:
+                acked = set(soak.acks)
+            if acked and acked <= set(soak.results()) \
+                    and not soak.journal_ids():
+                break
+        time.sleep(0.25)
+
+    arr.join(timeout=60)
+    for th in threads:
+        th.join(timeout=120)
+    while not budget_blown and time.time() < deadline:
+        if soak.proc.poll() is not None:
+            soak.launch_router()
+        with soak.lock:
+            acked = set(soak.acks)
+        if acked <= set(soak.results()) and not soak.journal_ids():
+            break
+        time.sleep(0.5)
+    soak.check_router_status()
+    try:
+        client.shutdown(soak.addr)
+        soak.proc.wait(timeout=180)
+    except (OSError, client.ServeConnectionLost,
+            subprocess.TimeoutExpired):
+        soak.proc.kill()
+        soak.proc.wait()
+
+    # ---- accounting --------------------------------------------------
+    results = soak.results()
+    locations = soak.result_locations()
+    with soak.lock:
+        acks = dict(soak.acks)
+        gave_up = list(soak.gave_up)
+    lost = sorted(jid for jid in acks if jid not in results)
+    term_counts = soak.terminal_event_counts()
+    duplicated = sorted(set(
+        [jid for jid, c in term_counts.items() if c > 1]
+        + [jid for jid, where in locations.items() if len(where) > 1]))
+    by_status: Dict[str, int] = {}
+    for jid in acks:
+        st = results.get(jid, {}).get("status", "LOST")
+        by_status[st] = by_status.get(st, 0) + 1
+    deadline_deaths = by_status.get("deadline_exceeded", 0)
+
+    # Per-tenant SLO attainment over DEADLINED traffic: done /
+    # (deadlined acked + deadlined given-up-after-sheds). A finally-shed
+    # job counts against the tenant — refusing it is still a miss, just
+    # an honest, early, cheap one.
+    attainment: Dict[str, Optional[float]] = {}
+    att_num_total, att_den_total = 0, 0
+    gave_up_by_tenant: Dict[str, int] = {}
+    for g in gave_up:
+        gave_up_by_tenant[g["tenant"]] = \
+            gave_up_by_tenant.get(g["tenant"], 0) + 1
+    for tenant in TENANT_CLASSES:
+        acked_dl = [jid for jid, a in acks.items()
+                    if a.get("tenant") == tenant
+                    and a.get("deadline_s") is not None]
+        num = sum(1 for jid in acked_dl
+                  if results.get(jid, {}).get("status") == "done")
+        den = len(acked_dl) + sum(1 for g in gave_up
+                                  if g["tenant"] == tenant
+                                  and g["deadline_s"] is not None)
+        attainment[tenant] = round(num / den, 3) if den else None
+        att_num_total += num
+        att_den_total += den
+    attainment_overall = (round(att_num_total / att_den_total, 3)
+                          if att_den_total else None)
+
+    # Scale evidence from the router's durable metrics stream.
+    ups = soak.router_events(("scale_up",))
+    downs = soak.router_events(("scale_down",))
+    warm_evs = soak.router_events(("warm_spare",))
+    warm_outcomes: Dict[str, int] = {}
+    for ev in warm_evs:
+        o = ev.get("outcome", "?")
+        warm_outcomes[o] = warm_outcomes.get(o, 0) + 1
+    reactions = [float(ev.get("reaction_s", 0.0)) for ev in ups]
+    spike1_wall = soak.arrival_t0 + spikes[0][0]
+    spike_to_scale = None
+    for ev in ups:
+        ts = ev.get("ts")
+        if isinstance(ts, (int, float)) and ts >= spike1_wall:
+            spike_to_scale = round(ts - spike1_wall, 2)
+            break
+    slo_evs = soak.slo_events()
+
+    # Goodput over the STORM window (arrivals open -> now), not process
+    # lifetime: the elastic arm's pre-storm warm bring-up is
+    # provisioning time, not serving time, and must not dilute its
+    # throughput against the static arm's.
+    wall_s = time.time() - (soak.arrival_t0 or soak.t0)
+    done_n = by_status.get("done", 0)
+
+    byte_checked, byte_identical, mismatches = _byte_parity(
+        soak, acks, results, workdir, opts.verify)
+
+    ok = (not budget_blown and not lost and not duplicated
+          and not soak.unsubmitted and not soak.rejected
+          and not soak.journal_ids()
+          and by_status.get("failed", 0) == 0
+          and byte_identical == byte_checked
+          and not soak.status_violations
+          and soak.replica_kills >= 1)
+    if soak._elastic():
+        # The elastic arm must actually have scaled — a run that never
+        # left min_replicas proved nothing about the controller.
+        ok = ok and len(ups) >= 1 and soak.max_active_seen \
+            > (opts.min_replicas or opts.replicas)
+    return {
+        "ok": ok, "mode": "autoscale",
+        "elastic": soak._elastic(), "seed": opts.seed, "jobs": n,
+        "min_replicas": opts.min_replicas or opts.replicas,
+        "max_replicas": opts.max_replicas or opts.replicas,
+        "warm_spares": opts.warm_spares, "shed": bool(opts.shed),
+        "tenant_quotas": opts.tenant_quotas,
+        "spikes": [[round(s, 2), d, m] for s, d, m in spikes],
+        "kill_at_s": round(kill_at, 2),
+        "accepted": len(acks), "rejected": len(soak.rejected),
+        "unsubmitted": len(soak.unsubmitted),
+        "gave_up": len(gave_up),
+        "gave_up_by_tenant": gave_up_by_tenant,
+        "terminal_by_status": by_status,
+        "deadline_deaths": deadline_deaths,
+        "lost": lost, "duplicated": duplicated,
+        "journal_leftover": soak.journal_ids(),
+        "replica_kills": soak.replica_kills,
+        "shed_events": slo_evs["shed"],
+        "quota_events": slo_evs["tenant_quota"],
+        "shed_retries": soak.shed_retries,
+        "quota_retries": soak.quota_retries,
+        "shed_fraction": round(len(gave_up) / n, 3),
+        "attainment": attainment,
+        "attainment_overall": attainment_overall,
+        "goodput_done_per_min": round(60.0 * done_n / wall_s, 2),
+        "scale_ups": len(ups), "scale_downs": len(downs),
+        "scale_up_reaction_p50_s": _percentile(reactions, 0.5),
+        "scale_up_reaction_max_s": _percentile(reactions, 1.0),
+        "spike_to_scale_s": spike_to_scale,
+        "max_active_seen": soak.max_active_seen,
+        "warm_pool_events": warm_outcomes,
+        "failovers": len(soak.failover_events()),
+        "status_checks": soak.status_checks,
+        "status_violations": soak.status_violations,
+        "byte_checked": byte_checked, "byte_identical": byte_identical,
+        "mismatches": mismatches,
+        "budget_blown": budget_blown,
+        "wall_s": round(wall_s, 1),
+    }
+
+
 def run_soak(opts, workdir: str) -> dict:
     from g2vec_tpu.data.synthetic import SyntheticSpec, write_synthetic_tsv
     from g2vec_tpu.serve import client
@@ -990,8 +1681,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     workdir = opts.workdir or tempfile.mkdtemp(prefix="g2vec-chaos-")
     os.makedirs(workdir, exist_ok=True)
     try:
-        summary = (run_router_soak(opts, workdir) if opts.replicas
-                   else run_soak(opts, workdir))
+        if opts.autoscale:
+            if opts.replicas < 1:
+                opts.replicas = 1
+            summary = run_autoscale_soak(opts, workdir)
+        elif opts.replicas:
+            summary = run_router_soak(opts, workdir)
+        else:
+            summary = run_soak(opts, workdir)
     finally:
         if not opts.keep and not opts.workdir:
             shutil.rmtree(workdir, ignore_errors=True)

@@ -45,7 +45,9 @@ while [ "$arms" -lt "$MAX_ARMS" ] && [ "$(date +%s)" -lt "$DEADLINE" ]; do
     # matrix (blocked top-k kernel exactness vs numpy, bundle
     # tamper/torn integrity drills, mmap LRU byte budget, daemon query
     # ops + token gating, lazy republish, result bounding, router
-    # failover reads).
+    # failover reads), and the autoscale matrix (token-bucket/shed/
+    # scaling-policy units, weighted-fair convergence, controller
+    # hysteresis, client shed backoff, router aggregate status).
     # Non-fatal: a red matrix is reported, the chip battery still runs.
     if ! JAX_PLATFORMS=cpu "$PY" -m pytest tests/test_resilience.py \
             tests/test_fleet.py tests/test_fleet_e2e.py \
@@ -53,6 +55,7 @@ while [ "$arms" -lt "$MAX_ARMS" ] && [ "$(date +%s)" -lt "$DEADLINE" ]; do
             tests/test_serve.py tests/test_stream.py tests/test_shard.py \
             tests/test_router.py tests/test_edge.py \
             tests/test_scenario.py tests/test_query.py \
+            tests/test_autoscale.py \
             -q -m "not slow" \
             -p no:cacheprovider >/tmp/fault_matrix_arm$arms.log 2>&1; then
         echo "[watch_loop] WARNING: fault/fleet matrix FAILED on arm $arms (log: /tmp/fault_matrix_arm$arms.log)"
