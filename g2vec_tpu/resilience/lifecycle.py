@@ -253,7 +253,7 @@ def shed_decision(deadline_s, queued: int, service_time_s):
 
 class ScalingPolicy:
     """Seeded, hysteretic scale controller. Pure: the router feeds it one
-    ``observe(queued_total, active, wait_p99_s)`` per control tick and
+    ``observe(queued_total, active, est_wait_s)`` per control tick and
     acts on the returned decision (``"up"`` / ``"down"`` / ``"hold"``).
 
     Two signals, asymmetric thresholds, streak counting, and a cooldown
@@ -262,9 +262,12 @@ class ScalingPolicy:
     - **pressure** = queued jobs per active replica. ``up_queue`` and
       ``down_queue`` are deliberately far apart (default 4.0 vs 0.5) so
       the region between them is a dead band.
-    - **wait** — the fleet's estimated p99 queue wait. Scale-up also
-      triggers when it crosses ``up_wait_s`` even at modest depth (a few
-      slow jobs hurt deadlines as much as many fast ones).
+    - **wait** — the fleet-MEAN queue-drain estimate (total queued ×
+      mean observed service time / reachable replicas), not a tail
+      percentile: tune ``up_wait_s`` as "a typical queued job waits
+      this long", not as a p99. Scale-up also triggers when it crosses
+      ``up_wait_s`` even at modest depth (a few slow jobs hurt
+      deadlines as much as many fast ones).
     - A decision needs ``up_ticks`` (or ``down_ticks``) *consecutive*
       ticks beyond threshold; any tick back inside the band resets the
       streak, so a square-wave load (spike, quiet, spike …) that flips
@@ -303,17 +306,17 @@ class ScalingPolicy:
         self.decisions = 0
 
     def observe(self, queued_total: int, active: int,
-                wait_p99_s=None) -> str:
+                est_wait_s=None) -> str:
         """Feed one control tick; returns ``"up"``, ``"down"`` or
         ``"hold"``. The caller is responsible for actually changing the
         fleet — the policy only counts and decides."""
         self.ticks += 1
         pressure = queued_total / max(1, active)
         hot = (pressure >= self.up_queue
-               or (wait_p99_s is not None
-                   and wait_p99_s >= self.up_wait_s))
+               or (est_wait_s is not None
+                   and est_wait_s >= self.up_wait_s))
         cold = (pressure <= self.down_queue
-                and (wait_p99_s is None or wait_p99_s < self.up_wait_s))
+                and (est_wait_s is None or est_wait_s < self.up_wait_s))
         if hot:
             self.up_streak += 1
             self.down_streak = 0

@@ -54,9 +54,11 @@ from __future__ import annotations
 
 import dataclasses
 import glob
+import hmac
 import os
 import queue
 import re
+import secrets
 import shutil
 import socket
 import sys
@@ -406,6 +408,15 @@ class ServeDaemon:
         for d in (self._jobs_dir, self._results_dir, self._spool_dir):
             os.makedirs(d, exist_ok=True)
         self._ckpt_dir = os.path.join(opts.state_dir, "ckpt")
+        #: Per-replica migration secret: ``requeue``/``submitted_at`` on
+        #: a submit are honored only when the payload carries this token
+        #: (see _trusted_requeue). It lives as a 0600 file in the state
+        #: dir, so possession proves filesystem access to THIS replica's
+        #: durable state — the router qualifies (it co-hosts the state
+        #: dirs and fences/migrates their journals), a network client
+        #: holding the shared fleet auth_token does not. Kept across
+        #: relaunches so a failover racing a relaunch stays consistent.
+        self._relay_token = self._load_relay_token()
         #: The query plane's read substrate: bundles published under
         #: <state>/inventory/<job_id>/<variant>/ (plus an optional
         #: --inventory-dir of solo bundles), memory-mapped behind a
@@ -504,6 +515,36 @@ class ServeDaemon:
                     # runs from __init__ before any connection thread
                     self._idem[key] = rec.get("job_id", fn[:-5])
 
+    def _load_relay_token(self) -> str:
+        """Load (or mint, 0600) ``<state-dir>/relay_token``."""
+        path = os.path.join(self.opts.state_dir, "relay_token")
+        try:
+            with open(path) as f:
+                tok = f.read().strip()
+            if tok:
+                return tok
+        except OSError:
+            pass
+        tok = secrets.token_hex(16)
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
+            f.write(tok)
+        return tok
+
+    def _trusted_requeue(self, payload: dict) -> bool:
+        """Is this submit the router's own journal-migration resubmit?
+        Only then are ``requeue`` (skip the tenant-quota and shed gates)
+        and ``submitted_at`` (deadline-clock continuity) honored. Trust
+        is possession of this replica's ``relay_token``; the shared
+        fleet ``auth_token`` proves nothing here — every client has it,
+        and a client that could forge requeue would bypass the very SLO
+        gates tenancy exists for (and forward-date its own deadline)."""
+        if not payload.get("requeue"):
+            return False
+        tok = payload.get("relay_token")
+        return isinstance(tok, str) \
+            and hmac.compare_digest(tok, self._relay_token)
+
     # ---- admission --------------------------------------------------------
 
     def _new_job_id(self) -> str:
@@ -601,18 +642,22 @@ class ServeDaemon:
                 job_id = explicit
             else:
                 job_id = self._new_job_id()
-        # Never journal the admission secret: raw is persisted verbatim
-        # to <state>/jobs/*.json (and re-sent on failover, where the
-        # router attaches its own token), so the shared auth_token must
-        # not outlive the admission check.
-        raw = {k: v for k, v in payload.items() if k != "auth_token"}
-        if submitted_at is None and payload.get("requeue"):
+        # Never journal the admission secrets or relay metadata: raw is
+        # persisted verbatim to <state>/jobs/*.json (and re-sent on
+        # failover, where the router attaches fresh auth/relay tokens
+        # and the journal record's own submitted_at), so none of these
+        # may outlive the admission check.
+        raw = {k: v for k, v in payload.items()
+               if k not in ("auth_token", "relay_token", "requeue",
+                            "submitted_at")}
+        if submitted_at is None and self._trusted_requeue(payload):
             # Deadline-clock continuity across failover: the router's
             # journal migration resubmits with the ORIGINAL admission
             # time, so deadline_s keeps measuring from when the client
             # was acked — a replica death must never reset the clock
-            # (honored only with requeue, so ordinary clients cannot
-            # back- or forward-date their own deadlines).
+            # (honored only with a relay-token-proven requeue, so
+            # ordinary clients cannot back- or forward-date their own
+            # deadlines).
             sa = payload.get("submitted_at")
             if isinstance(sa, (int, float)) and not isinstance(sa, bool):
                 submitted_at = float(sa)
@@ -704,16 +749,24 @@ class ServeDaemon:
                     "error": ("draining" if self._draining
                               else "shutting_down"),
                     "job_id": job.job_id}
-        # A failover/recovery resubmission (requeue=True, set only by
-        # the router's journal migration) already paid the SLO gates
-        # when it was FIRST admitted — the client holds an ack. Shedding
-        # or rate-limiting it now would turn a replica death into a
-        # broken admission contract: the job would sit journaled on the
-        # corpse until its relaunch instead of migrating to a live
-        # survivor. Capacity (queue_full) still applies — a full queue
-        # is a real resource bound, and the router leaves the entry
-        # journaled for the corpse's own recovery in that case.
-        requeue = bool(payload.get("requeue"))
+        # A failover/recovery resubmission (requeue=True + this
+        # replica's relay_token, set only by the router's journal
+        # migration) already paid the SLO gates when it was FIRST
+        # admitted — the client holds an ack. Shedding or rate-limiting
+        # it now would turn a replica death into a broken admission
+        # contract: the job would sit journaled on the corpse until its
+        # relaunch instead of migrating to a live survivor. Capacity
+        # (queue_full) still applies — a full queue is a real resource
+        # bound, and the router leaves the entry journaled for the
+        # corpse's own recovery in that case. A requeue flag WITHOUT
+        # the token degrades to a normal submit (all gates apply) —
+        # that direction is safe, and loud so a router whose token read
+        # failed shows up in the log instead of silently re-gating
+        # already-acked migrations.
+        requeue = self._trusted_requeue(payload)
+        if payload.get("requeue") and not requeue:
+            self.console(f"[serve] untrusted requeue flag on "
+                         f"{job.job_id} ignored (no/bad relay_token)")
         quota = self._quota_for(job.tenant) if not requeue else None
         if quota is not None:
             now = time.time()

@@ -68,11 +68,16 @@ MAX_LINE_BYTES = 8 << 20
 #: failover journal migration): requeue skips the tenant-quota and
 #: shed gates — the job already paid admission once and the client
 #: holds an ack — and submitted_at carries the ORIGINAL admission
-#: time so a replica death never resets a deadline clock (honored
-#: only with requeue; an ordinary client cannot back-date).
+#: time so a replica death never resets a deadline clock. Because
+#: every client shares the fleet ``auth_token``, that token cannot
+#: prove router-ness: both fields are honored only when the payload
+#: carries the target replica's ``relay_token`` (a per-state-dir
+#: secret readable only via the replica's filesystem — the router
+#: co-hosts the state dirs, network tenants do not), and the router
+#: strips all three from externally received submits before relaying.
 SUBMIT_KEYS = ("op", "job", "tenant", "priority", "deadline_s",
                "idem_key", "job_id", "auth_token", "requeue",
-               "submitted_at")
+               "submitted_at", "relay_token")
 
 #: The query-request envelope vocabulary (the read plane's twin of
 #: SUBMIT_KEYS). daemon.py/router.py bind a query payload to the

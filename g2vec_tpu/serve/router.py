@@ -67,6 +67,21 @@ from g2vec_tpu.utils.metrics import MetricsWriter
 _AUTH_OPS = ("submit", "cancel", "drain_replica", "shutdown", "query")
 
 
+def sanitize_client_submit(req: dict) -> dict:
+    """Strip the fields a client must never control from a submit
+    before relaying it: ``auth_token`` (the admission secret must not
+    be journaled downstream), and the router-internal migration fields
+    ``requeue``/``submitted_at``/``relay_token`` — forwarded untouched,
+    any tenant holding the shared fleet token could bypass the
+    per-tenant quota and deadline-shed gates and forward-date its own
+    deadline clock. The daemon additionally refuses those fields
+    without the replica's relay_token (defense in depth); stripping
+    here keeps an honest client's stale field from degrading too."""
+    return {k: v for k, v in req.items()
+            if k not in ("auth_token", "requeue", "submitted_at",
+                         "relay_token")}
+
+
 # ---------------------------------------------------------------------------
 # Consistent hashing
 # ---------------------------------------------------------------------------
@@ -420,6 +435,21 @@ class Router:
                 os.path.join(spec.state_dir, "results"),
                 os.path.join(spec.state_dir, "ckpt"))
 
+    def _relay_token_of(self, name: str) -> Optional[str]:
+        """A replica's migration secret (``<state>/relay_token``,
+        minted by the daemon at boot): attached to journal-migration
+        resubmits so the survivor honors ``requeue``/``submitted_at``.
+        The router can read it because it co-hosts the fleet's state
+        dirs — which is exactly the trust being proven. None (file not
+        there yet / unreadable) degrades the resubmit to a normal
+        gated submit, never blocks it."""
+        try:
+            with open(os.path.join(self.fleet.replica(name).state_dir,
+                                   "relay_token")) as fh:
+                return fh.read().strip() or None
+        except OSError:
+            return None
+
     def _failover(self, name: str, relaunch: bool = True) -> int:
         """Fence a dead replica, migrate its journal to survivors, then
         relaunch it. Returns the number of jobs re-queued. Serialized
@@ -509,8 +539,14 @@ class Router:
             # whole migrated journal bouncing off the survivor's
             # admission SLOs and dying of deadline_exceeded on the
             # corpse instead). submitted_at keeps the deadline clock
-            # measuring from the ORIGINAL admission.
+            # measuring from the ORIGINAL admission. The target's
+            # relay_token is what makes the survivor believe either
+            # field — clients can't set them (sanitize_client_submit
+            # strips, the daemon verifies).
             out = dict(payload, op="submit", requeue=True)
+            tok = self._relay_token_of(target)
+            if tok:
+                out["relay_token"] = tok
             sa = rec.get("submitted_at")
             if isinstance(sa, (int, float)) and not isinstance(sa, bool):
                 out["submitted_at"] = sa
@@ -697,7 +733,7 @@ class Router:
             if self._elastic:
                 decision = self._policy.observe(
                     stats["queued"], active_n,
-                    wait_p99_s=stats.get("est_wait_s"))
+                    est_wait_s=stats.get("est_wait_s"))
                 if decision == "up":
                     self._scale_up()
                 elif decision == "down":
@@ -868,6 +904,19 @@ class Router:
             self._pending_cold.discard(name)
             self._warm.append(name)
 
+    def _warmup_req(self, name: str, job: dict) -> dict:
+        """The canary submit for one spare. ``idem_key`` (the protocol
+        field — see protocol.SUBMIT_KEYS) is boot-scoped: stable within
+        one daemon boot, so a re-warm of an already-warm process dedups
+        to an instant re-ack instead of re-running the canary; a fresh
+        boot gets a fresh key and warms once."""
+        boots = self.fleet.replica(name).boots
+        req = {"op": "submit", "job": job, "tenant": "_warmup",
+               "idem_key": f"warmup-{name}-b{boots}"}
+        if self.opts.auth_token is not None:
+            req["auth_token"] = self.opts.auth_token
+        return req
+
     def _warm_up(self, name: str) -> None:
         """Pre-warm a parked spare with the operator's canary job
         (``--warmup-job``), submitted straight to the OUT-of-ring
@@ -889,11 +938,7 @@ class Router:
         try:
             with open(path) as fh:
                 job = json.load(fh)
-            boots = self.fleet.replica(name).boots
-            req = {"op": "submit", "job": job, "tenant": "_warmup",
-                   "idempotency_key": f"warmup-{name}-b{boots}"}
-            if self.opts.auth_token is not None:
-                req["auth_token"] = self.opts.auth_token
+            req = self._warmup_req(name, job)
             addr = self._replica_addr(name)
             if not addr:
                 raise ConnectionError(f"spare {name} has no address")
@@ -1243,7 +1288,7 @@ class Router:
     # ---- submit relay -----------------------------------------------------
 
     def _relay_submit(self, f, req: dict) -> None:
-        payload = {k: v for k, v in req.items() if k != "auth_token"}
+        payload = sanitize_client_submit(req)
         if not payload.get("idem_key"):
             # Router-minted key: even a client that never heard of idem
             # keys gets exactly-once failover semantics.

@@ -138,8 +138,8 @@ def test_policy_wait_signal_trips_up_at_modest_depth():
                       cooldown_ticks=0)
     # Pressure is under threshold (1 job/replica) but the estimated
     # wait says deadlines are dying: that alone must trip the up path.
-    assert p.observe(1, active=1, wait_p99_s=30.0) == "hold"
-    assert p.observe(1, active=1, wait_p99_s=30.0) == "up"
+    assert p.observe(1, active=1, est_wait_s=30.0) == "hold"
+    assert p.observe(1, active=1, est_wait_s=30.0) == "up"
 
 
 def test_policy_max_guard_and_victim_determinism():
@@ -386,6 +386,49 @@ def test_router_rejects_bad_elastic_bounds(tmp_path):
         Router(RouterOptions(fleet_dir=str(tmp_path / "f2"),
                              replicas=1, warm_spares=-1),
                console=lambda s: None)
+
+
+def test_sanitize_client_submit_strips_internal_fields():
+    """The relay must never forward the fields that bypass admission:
+    requeue/submitted_at (quota + shed + deadline-clock bypass) and the
+    secrets. Everything a client legitimately controls passes through."""
+    from g2vec_tpu.serve.router import sanitize_client_submit
+
+    req = {"op": "submit", "job": {"epoch": 5}, "tenant": "gold",
+           "priority": "batch", "deadline_s": 10.0, "idem_key": "k1",
+           "auth_token": "fleet-secret", "requeue": True,
+           "submitted_at": 1.0, "relay_token": "forged"}
+    out = sanitize_client_submit(req)
+    assert set(out) == {"op", "job", "tenant", "priority",
+                        "deadline_s", "idem_key"}
+    assert req["requeue"] is True         # input left untouched
+
+
+def test_warmup_canary_uses_boot_scoped_idem_key(tmp_path):
+    """The canary must carry the PROTOCOL idempotency field
+    (``idem_key`` — a typo'd key is silently ignored and every re-warm
+    re-runs the whole canary), stable within a boot so a re-warm of an
+    already-warm process dedups to a re-ack, fresh across boots."""
+    from g2vec_tpu.serve import protocol
+    from g2vec_tpu.serve.router import Router, RouterOptions
+
+    r = Router(RouterOptions(fleet_dir=str(tmp_path / "fleet"),
+                             replicas=1, min_replicas=1,
+                             max_replicas=2, warm_spares=1,
+                             auth_token="tok"),
+               console=lambda s: None)
+    r.fleet.replica("r1").boots = 3
+    a = r._warmup_req("r1", {"epoch": 1})
+    b = r._warmup_req("r1", {"epoch": 1})
+    assert "idem_key" in a and "idempotency_key" not in a
+    assert a["idem_key"] == b["idem_key"] == "warmup-r1-b3"
+    assert a["auth_token"] == "tok" and a["tenant"] == "_warmup"
+    # Every envelope key is protocol vocabulary — an off-vocabulary
+    # key is exactly the silent-drop bug this test pins against.
+    assert set(a) - {"job"} <= set(protocol.SUBMIT_KEYS)
+    r.fleet.replica("r1").boots = 4
+    assert r._warmup_req("r1", {"epoch": 1})["idem_key"] \
+        == "warmup-r1-b4"
 
 
 def test_router_scale_claim_and_probe_targets(tmp_path):

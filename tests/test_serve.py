@@ -132,14 +132,16 @@ def test_bad_jobs_reject_at_admission_naming_the_problem(
 
 def test_requeue_bypasses_quota_and_shed_but_not_queue_full(
         tsv_paths, tmp_path):
-    # A failover resubmission (requeue=True, set only by the router's
-    # journal migration) already paid the SLO gates at first admission
-    # — the client holds an ack, so shedding or rate-limiting it now
-    # would turn a replica death into a lost job. Capacity is a real
-    # resource bound though: queue_full must still apply.
+    # A failover resubmission (requeue=True + the replica's relay
+    # token, set only by the router's journal migration) already paid
+    # the SLO gates at first admission — the client holds an ack, so
+    # shedding or rate-limiting it now would turn a replica death into
+    # a lost job. Capacity is a real resource bound though: queue_full
+    # must still apply.
     d = _daemon(tmp_path, tenant_quotas="gold:0.001:1", shed=True,
                 queue_depth=3)
     try:
+        tok = d._relay_token
         ok = d.admit({"tenant": "gold",
                       "job": _job(tsv_paths, tmp_path, "q1")})
         assert ok["event"] == "accepted"
@@ -147,24 +149,37 @@ def test_requeue_bypasses_quota_and_shed_but_not_queue_full(
         rej = d.admit({"tenant": "gold",
                        "job": _job(tsv_paths, tmp_path, "q2")})
         assert rej["error"] == "tenant_quota"
+        # …and so is a FORGED requeue — the flag alone (which any
+        # client holding the shared fleet auth_token can send) must not
+        # open the gate; only the state-dir relay token does.
+        forged = d.admit({"tenant": "gold", "requeue": True,
+                          "relay_token": "not-the-token",
+                          "job": _job(tsv_paths, tmp_path, "q2")})
+        assert forged["error"] == "tenant_quota"
         # …but the migration requeue of already-acked work is not.
         re1 = d.admit({"tenant": "gold", "requeue": True,
+                       "relay_token": tok,
                        "job": _job(tsv_paths, tmp_path, "q2")})
         assert re1["event"] == "accepted"
         # Shed gate: with 10 s/job evidence and a non-empty queue, a
-        # 1 s-deadline submit is shed — unless it is a requeue.
+        # 1 s-deadline submit is shed — unless it is a (proven) requeue.
         with d._lock:
             d._service_times.append(10.0)
         rej2 = d.admit({"tenant": "silver", "deadline_s": 1.0,
                         "job": _job(tsv_paths, tmp_path, "s1")})
         assert rej2["error"] == "shed"
+        forged2 = d.admit({"tenant": "silver", "deadline_s": 1.0,
+                           "requeue": True,
+                           "job": _job(tsv_paths, tmp_path, "s1")})
+        assert forged2["error"] == "shed"
         re2 = d.admit({"tenant": "silver", "deadline_s": 1.0,
-                       "requeue": True,
+                       "requeue": True, "relay_token": tok,
                        "job": _job(tsv_paths, tmp_path, "s1b")})
         assert re2["event"] == "accepted"
         # Queue now holds 3 of 3: even a requeue is refused on capacity
         # (the router leaves the entry journaled for corpse recovery).
         full = d.admit({"tenant": "gold", "requeue": True,
+                        "relay_token": tok,
                         "job": _job(tsv_paths, tmp_path, "q3")})
         assert full["error"] == "queue_full"
     finally:
@@ -172,21 +187,37 @@ def test_requeue_bypasses_quota_and_shed_but_not_queue_full(
 
 
 def test_requeue_preserves_deadline_clock(tsv_paths, tmp_path):
-    # submitted_at pass-through is honored ONLY with requeue: migration
-    # must not reset a deadline clock, but an ordinary client must not
-    # be able to back- or forward-date its own deadline.
+    # submitted_at pass-through is honored ONLY with a relay-token-
+    # proven requeue: migration must not reset a deadline clock, but an
+    # ordinary client — including one waving the requeue flag, which
+    # the shared fleet auth_token cannot distinguish from the router —
+    # must not be able to back- or forward-date its own deadline.
     d = _daemon(tmp_path)
     try:
         t0 = time.time()
-        ok = d.admit({"tenant": "a", "submitted_at": 123.0,
-                      "job": _job(tsv_paths, tmp_path, "n1")})
-        assert ok["event"] == "accepted"
         jobs_dir = os.path.join(d.opts.state_dir, "jobs")
-        with open(os.path.join(jobs_dir, ok["job_id"] + ".json")) as f:
-            rec = json.load(f)
-        assert rec["submitted_at"] >= t0
+        for payload in (
+            {"tenant": "a", "submitted_at": 123.0,
+             "job": _job(tsv_paths, tmp_path, "n1")},
+            {"tenant": "a", "submitted_at": 123.0, "requeue": True,
+             "job": _job(tsv_paths, tmp_path, "n1b")},
+            {"tenant": "a", "submitted_at": 123.0, "requeue": True,
+             "relay_token": "forged",
+             "job": _job(tsv_paths, tmp_path, "n1c")},
+        ):
+            ok = d.admit(payload)
+            assert ok["event"] == "accepted"
+            with open(os.path.join(jobs_dir,
+                                   ok["job_id"] + ".json")) as f:
+                rec = json.load(f)
+            assert rec["submitted_at"] >= t0
+            # Relay metadata never reaches the journal — a later
+            # failover resubmit of this record must not replay a
+            # client-chosen clock.
+            for k in ("requeue", "submitted_at", "relay_token"):
+                assert k not in rec["payload"]
         re1 = d.admit({"tenant": "a", "submitted_at": 123.0,
-                       "requeue": True,
+                       "requeue": True, "relay_token": d._relay_token,
                        "job": _job(tsv_paths, tmp_path, "n2")})
         assert re1["event"] == "accepted"
         with open(os.path.join(jobs_dir, re1["job_id"] + ".json")) as f:
