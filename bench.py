@@ -209,6 +209,26 @@ AUTOSCALE_QUOTAS = os.environ.get(
     "gold:6:12:3;silver:3:6:2;bulk:0.8:2:1")
 AUTOSCALE_ARTIFACT = "BENCH_AUTOSCALE.json"
 
+# Partition-tolerant control plane (serve/leader.py + router fencing +
+# daemon self-quarantine + degraded-mode clients): the relay-blackhole
+# drill from tools/chaos_soak.py --partition. One replica is
+# partitioned-while-alive (the router must fence + migrate, the replica
+# must self-quarantine off the shared-disk marker and stay out of the
+# ring after the heal), the active router is SIGSTOPped past its lease
+# ttl (every mutating command the zombie then emits must die with the
+# structured stale_epoch rejection), and a chain of router SIGKILLs is
+# ridden out by standbys with degraded-mode client drills in each gap.
+# Acceptance: fleet-wide exactly-once, zero post-fence output from the
+# quarantined replica, all stale epochs rejected, every takeover
+# completed. Env-shrinkable.
+PARTITION_JOBS = int(os.environ.get("G2VEC_BENCH_PARTITION_JOBS", "18"))
+PARTITION_SEED = int(os.environ.get("G2VEC_BENCH_PARTITION_SEED", "5"))
+PARTITION_TAKEOVERS = int(os.environ.get(
+    "G2VEC_BENCH_PARTITION_TAKEOVERS", "3"))
+PARTITION_BUDGET = float(os.environ.get("G2VEC_BENCH_PARTITION_BUDGET",
+                                        "900"))
+PARTITION_ARTIFACT = "BENCH_PARTITION.json"
+
 # Interactive query plane (serve/inventory.py + ops/knn.py): seeded
 # Poisson query load against a replicated fleet, concurrent with
 # training jobs, one replica SIGKILLed mid-run. Cold = first touch of a
@@ -1748,6 +1768,97 @@ def _router_chaos() -> None:
                        "written_by": "bench.py --_router_chaos"}, f,
                       indent=1)
         note(f"wrote {ROUTER_CHAOS_ARTIFACT}")
+    if not line["ok"]:
+        sys.exit(1)
+
+
+def _partition_chaos_line(note) -> dict:
+    """Partition drill: tools/chaos_soak.py --partition as a
+    subprocess. Acceptance = exactly-once under false-dead fencing,
+    zombie-leader epoch rejection, the standby takeover chain, and
+    degraded-mode client drills in every routerless gap."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "G2V_CHAOS_JOBS": str(PARTITION_JOBS),
+           "G2V_CHAOS_SEED": str(PARTITION_SEED),
+           "G2V_CHAOS_TAKEOVERS": str(PARTITION_TAKEOVERS),
+           "G2V_CHAOS_BUDGET": str(PARTITION_BUDGET),
+           "G2V_CHAOS_STREAM_FRAC": "0",
+           "G2V_CHAOS_VERIFY": "2"}
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "chaos_soak.py"),
+         "--partition"],
+        capture_output=True, text=True, env=env,
+        timeout=PARTITION_BUDGET + 180)
+    for ln in (proc.stderr or "").splitlines():
+        if ln.startswith("# "):
+            note(f"partition {ln[2:]}")
+    try:
+        summary = json.loads(proc.stdout)
+    except ValueError:
+        raise RuntimeError(
+            f"partition drill emitted no summary "
+            f"(rc={proc.returncode}): "
+            f"{(proc.stderr or proc.stdout)[-400:]}")
+    accepted = summary.get("accepted", 0) or 1
+    accounted = accepted - len(summary.get("lost", ()))
+    return {
+        "metric": "partition_accounted_fraction",
+        "value": round(accounted / accepted, 4), "unit": "fraction",
+        "ok": bool(summary.get("ok")) and proc.returncode == 0,
+        "jobs": summary.get("jobs"),
+        "replicas": summary.get("replicas"),
+        "lease_ttl_s": summary.get("lease_ttl_s"),
+        "accepted": accepted,
+        "terminal_by_status": summary.get("terminal_by_status"),
+        "lost": len(summary.get("lost", ())),
+        "duplicated": len(summary.get("duplicated", ())),
+        "fence_epoch": summary.get("fence_epoch"),
+        "quarantine_to_park_s": summary.get("quarantine_to_park_s"),
+        "quarantine_parked": summary.get("quarantine_parked"),
+        "fenced_replica_violations":
+            summary.get("fenced_replica_violations"),
+        "fenced_stays_out": summary.get("fenced_stays_out"),
+        "stale_probe_rejects": summary.get("stale_probe_rejects"),
+        "stale_probe_targets": summary.get("stale_probe_targets"),
+        "zombie_rejects": summary.get("zombie_rejects"),
+        "takeovers": summary.get("takeovers"),
+        "takeover_p50_s": summary.get("takeover_p50_s"),
+        "takeover_p99_s": summary.get("takeover_p99_s"),
+        "degraded_submits": summary.get("degraded_submits"),
+        "degraded_status_ok": summary.get("degraded_status_ok"),
+        "failovers": summary.get("failovers"),
+        "requeue_p50_s": summary.get("requeue_p50_s"),
+        "requeue_p99_s": summary.get("requeue_p99_s"),
+        "byte_checked": summary.get("byte_checked"),
+        "byte_identical": summary.get("byte_identical"),
+        "seed": summary.get("seed"),
+        "wall_s": round(time.time() - t0, 1),
+        "note": "relay-blackhole control-plane drill (false-dead fence "
+                "+ replica self-quarantine, SIGSTOP zombie leader with "
+                "stale_epoch rejection matrix, SIGKILL takeover chain "
+                "with degraded-mode client drills in the gaps); "
+                "takeover_p50/p99_s = fault-to-new-router-answering as "
+                "a client measures it",
+    }
+
+
+def _partition_chaos() -> None:
+    """Standalone mode: run the partition drill and (with
+    G2VEC_BENCH_PARTITION_WRITE=1) refresh the committed artifact."""
+    def note(msg):
+        print(f"# {msg}", file=sys.stderr, flush=True)
+
+    line = _partition_chaos_line(note)
+    print(json.dumps(line), flush=True)
+    if os.environ.get("G2VEC_BENCH_PARTITION_WRITE") == "1":
+        repo = os.path.dirname(os.path.abspath(__file__))
+        with open(os.path.join(repo, PARTITION_ARTIFACT), "w") as f:
+            json.dump({"line": line, "code_key": _current_code_key(repo),
+                       "written_by": "bench.py --_partition_chaos"}, f,
+                      indent=1)
+        note(f"wrote {PARTITION_ARTIFACT}")
     if not line["ok"]:
         sys.exit(1)
 
@@ -3528,6 +3639,8 @@ if __name__ == "__main__":
         _stream_ab()
     elif "--_router_chaos" in sys.argv:
         _router_chaos()
+    elif "--_partition_chaos" in sys.argv:
+        _partition_chaos()
     elif "--_autoscale_ab" in sys.argv:
         _autoscale_ab()
     elif "--_query_latency" in sys.argv:

@@ -202,13 +202,14 @@ def all_checkers() -> List[Checker]:
     # Imported here, not at module top: core must stay importable from
     # a checker module without a cycle.
     from g2vec_tpu.analyze.configdoc import ConfigDocChecker
+    from g2vec_tpu.analyze.epochs import EpochStampChecker
     from g2vec_tpu.analyze.events import MetricsSchemaChecker
     from g2vec_tpu.analyze.locks import LockDisciplineChecker
     from g2vec_tpu.analyze.purity import JaxPurityChecker
     from g2vec_tpu.analyze.seams import FaultSeamChecker
     return [LockDisciplineChecker(), JaxPurityChecker(),
             FaultSeamChecker(), MetricsSchemaChecker(),
-            ConfigDocChecker()]
+            ConfigDocChecker(), EpochStampChecker()]
 
 
 def load_baseline(path: str) -> Dict[str, str]:

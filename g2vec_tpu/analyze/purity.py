@@ -40,6 +40,9 @@ JAX_FREE = {
     "g2vec_tpu/serve/router.py":
         "the front door must boot in milliseconds on accelerator-free "
         "hosts",
+    "g2vec_tpu/serve/leader.py":
+        "the leadership lease is watched by standby routers on "
+        "accelerator-free hosts",
     "g2vec_tpu/resilience/lifecycle.py":
         "imported by router and daemon alike; pure state machines",
     "tools/chaos_soak.py":

@@ -310,6 +310,11 @@ class ReplicaSpec:
     proc: Optional[object] = None       # guarded-by: Router._rep_locks
     boots: int = 0                      # guarded-by: Router._rep_locks
     exits: int = 0                      # guarded-by: Router._rep_locks
+    #: False for a replica on another host (or behind a relay): its
+    #: death can never be verified from here — fence() must not kill a
+    #: recycled local pid, and the router must quarantine via a fence
+    #: marker instead of trusting SIGKILL.
+    local: bool = True                  # guarded-by: Router._rep_locks
 
 
 class ReplicaFleet:
@@ -377,6 +382,13 @@ class ReplicaFleet:
             os.unlink(self._addr_file(spec))    # never read a stale addr
         except OSError:
             pass
+        # A successor on this state dir boots UNFENCED: the quarantine
+        # marker that parked the predecessor (serve/leader.py) must not
+        # instantly park the fresh daemon. The router clears it on its
+        # own relaunch path too; this covers manual/boot launches.
+        from g2vec_tpu.serve.leader import clear_fence_marker
+
+        clear_fence_marker(spec.state_dir)
         cmd = [sys.executable, "-m", "g2vec_tpu", "serve",
                "--socket", spec.socket_path,
                "--state-dir", spec.state_dir,
@@ -410,13 +422,17 @@ class ReplicaFleet:
         raise TimeoutError(f"replica {name} TCP listener not up within "
                            f"{wait_ready_s:.0f}s; see {spec.log_path}")
 
-    def adopt(self, name: str, pid: int, addr: Optional[str]) -> ReplicaSpec:
+    def adopt(self, name: str, pid: int, addr: Optional[str],
+              local: bool = True) -> ReplicaSpec:
         """Record an already-running replica (router restart: the daemons
         survived, only the router died). Fencing falls back to
-        ``os.kill`` since the process is not our child."""
+        ``os.kill`` since the process is not our child; ``local=False``
+        marks a replica whose process lives beyond this host's reach
+        (remote or relayed), so fencing can only ever be advisory."""
         spec = self.replicas[name]
         spec.proc = None
         spec.pid = pid
+        spec.local = local
         if addr:
             spec.addr = addr
         elif os.path.exists(self._addr_file(spec)):
@@ -430,6 +446,11 @@ class ReplicaFleet:
             return spec.proc.poll() is None
         if spec.pid is None:
             return False
+        if not spec.local:
+            # A remote pid means nothing to this host's process table;
+            # only the router's network probes can judge it. Having a
+            # pid at all means it was adopted alive.
+            return True
         try:
             os.kill(spec.pid, 0)
             return True
@@ -460,11 +481,20 @@ class ReplicaFleet:
         migrated — a slow-but-alive replica must never race a survivor
         on the same job. Waits up to ``grace_s`` for a natural exit,
         then SIGKILLs. Returns the exit code when known (negative =
-        killed by that signal), None for a non-child."""
+        killed by that signal), None for a non-child — None means the
+        caller has NO local proof of death, which is what separates a
+        verified-dead failover from a false-dead quarantine."""
         import signal as _signal
 
         spec = self.replicas[name]
         rc: Optional[int] = None
+        if not spec.local:
+            # The process lives on another host: os.kill here would hit
+            # a recycled local pid at best. Death is unverifiable.
+            spec.proc = None
+            spec.pid = None
+            spec.exits += 1
+            return None
         if spec.proc is None and spec.pid is None:
             spec.pid = self._pidfile_pid(spec)
         if spec.proc is not None:
@@ -509,6 +539,8 @@ class ReplicaFleet:
         import signal as _signal
 
         for spec in self.replicas.values():
+            if not spec.local:
+                continue        # not ours to signal
             if spec.proc is not None and spec.proc.poll() is None:
                 spec.proc.send_signal(_signal.SIGTERM)
             else:

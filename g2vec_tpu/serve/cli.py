@@ -10,7 +10,9 @@ Router (fleet front door; --state-dir becomes the fleet root)::
 
     g2vec serve --replicas 3 --listen 0.0.0.0:7433 --state-dir /srv/g2vec \\
         [--auth-token-file F] [--probe-interval 0.5] [--probe-deadline 2] \\
-        [--cache-dir DIR] [--queue-depth 16] [--max-join 4]
+        [--cache-dir DIR] [--queue-depth 16] [--max-join 4] \\
+        [--lease-ttl-s 5] [--standby] [--join-spread K] \\
+        [--remote-replicas]
 
 Client (same flag, a client op instead of --state-dir; --socket accepts a
 UNIX path or a TCP host:port — a daemon or the router)::
@@ -127,6 +129,32 @@ def build_serve_parser() -> argparse.ArgumentParser:
                    help="Router scaling-control cadence: one /status "
                         "sweep of the active set and one policy tick "
                         "per interval (default 1.0).")
+    p.add_argument("--standby", action="store_true",
+                   help="Router mode: start as a STANDBY — watch the "
+                        "fleet's leadership lease and take over (epoch "
+                        "+1, adopting the running replicas) only when "
+                        "the active router's lease expires or is "
+                        "released. Implies leased leadership.")
+    p.add_argument("--lease-ttl-s", type=float, default=0.0, metavar="S",
+                   help="Router mode: enable leased leadership with "
+                        "this ttl (default 0 = no lease machinery; "
+                        "--standby without it uses 5s). The leader "
+                        "renews at ttl/3; on loss it keeps serving "
+                        "reads while daemons fence its mutations as "
+                        "stale_epoch.")
+    p.add_argument("--join-spread", type=int, default=1, metavar="K",
+                   help="Router mode: bounded per-join-key placement "
+                        "spread — a hot key may land on any of K salted "
+                        "ring candidates, least-loaded first (default 1 "
+                        "= classic single-home placement). Keyed "
+                        "(idem_key) submits stay sticky regardless.")
+    p.add_argument("--remote-replicas", action="store_true",
+                   help="Router mode: the fleet's daemons are launched "
+                        "and supervised elsewhere — adopt them via "
+                        "their published tcp_addr files, never spawn, "
+                        "SIGKILL-verify, or relaunch locally. An "
+                        "unreachable remote replica is fenced (marker + "
+                        "epoch) before its journal migrates.")
     p.add_argument("--state-dir", default=None, metavar="DIR",
                    help="Daemon state root: jobs/ (journal of accepted, "
                         "unfinished jobs — re-queued on restart), "
@@ -416,6 +444,10 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
             warm_spares=args.warm_spares,
             warmup_job=args.warmup_job,
             scale_interval=args.scale_interval,
+            standby=args.standby,
+            lease_ttl_s=args.lease_ttl_s,
+            join_spread=args.join_spread,
+            remote_replicas=args.remote_replicas,
             serve_argv=tuple(fwd))
         return Router(opts).serve_forever()
     if not args.socket:

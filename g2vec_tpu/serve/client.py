@@ -10,18 +10,47 @@ the caller can fall back to :func:`poll_result` (filesystem) or
 :func:`poll_result_net` (the ``result`` op, re-resolved through the
 router on every attempt), which read the durable record that survives
 any replica's death.
+
+HA fleets add two layers on top:
+
+- :func:`submit_and_wait` and :func:`poll_result_net` accept a LIST of
+  router addresses (active + standbys); attempts rotate through the
+  list under the existing jittered backoff, so a client survives a
+  router takeover without reconfiguration.
+- When NO router answers at all (both routers partitioned away), the
+  ``degraded_*`` helpers fall back to the fleet's published per-replica
+  ``tcp_addr`` files: read-only ops (status / result / query) fan out
+  to the replicas directly, and keyed submits go to a deterministically
+  chosen replica — the idempotency key derives the job_id, so once a
+  router heals its sticky scan reconciles the degraded-mode submit with
+  the journal/result exactly once.
 """
 from __future__ import annotations
 
+import glob
 import json
 import os
 import random
 import socket
 import time
 import uuid
-from typing import Iterator, List, Optional
+import zlib
+from typing import Iterator, List, Optional, Sequence, Union
 
 from g2vec_tpu.serve import protocol
+
+#: A serve endpoint: one address, or a rotation list (router + standbys).
+Addr = Union[str, Sequence[str]]
+
+
+def _rotation(socket_path: Addr) -> List[str]:
+    """Normalize an address-or-list into a non-empty rotation list."""
+    if isinstance(socket_path, (list, tuple)):
+        addrs = [a for a in socket_path if a]
+        if not addrs:
+            raise ValueError("empty address list")
+        return list(addrs)
+    return [socket_path]
 
 
 class ServeConnectionLost(RuntimeError):
@@ -206,7 +235,7 @@ def result(socket_path: str, job_id: str,
                 job_id=job_id, **extra)
 
 
-def submit_and_wait(socket_path: str, job: dict, tenant: str = "default",
+def submit_and_wait(socket_path: Addr, job: dict, tenant: str = "default",
                     state_dir: Optional[str] = None,
                     timeout: Optional[float] = None,
                     poll_deadline_s: float = 300.0,
@@ -249,17 +278,26 @@ def submit_and_wait(socket_path: str, job: dict, tenant: str = "default",
     their own budget, not the transport-retry one — a load-shedding
     fleet is healthy, a connection-refusing one is not.
 
+    ``socket_path`` may be a LIST of router addresses (active router
+    first, standbys after): each transport retry rotates to the next
+    address under the same jittered backoff, so a standby takeover is
+    one rotation away instead of a reconfiguration. The idem key makes
+    the rotation safe — whichever router finally accepts dedups against
+    everything its predecessors journaled.
+
     Raises :class:`ServeTimeout` naming the job when all retries or the
     result poll expire."""
     rng = rng if rng is not None else random.Random()
+    addrs = _rotation(socket_path)
     if idem_key is None:
         idem_key = f"c-{uuid.uuid4().hex}"
     last: Optional[BaseException] = None
     sheds = 0
     attempt = 0
     while attempt <= retries:
+        addr = addrs[attempt % len(addrs)]
         try:
-            events = submit_job(socket_path, job, tenant=tenant,
+            events = submit_job(addr, job, tenant=tenant,
                                 timeout=timeout, priority=priority,
                                 deadline_s=deadline_s, idem_key=idem_key,
                                 auth_token=auth_token)
@@ -287,7 +325,7 @@ def submit_and_wait(socket_path: str, job: dict, tenant: str = "default",
                 if state_dir is not None:
                     return poll_result(state_dir, e.job_id,
                                        deadline_s=poll_deadline_s)
-                return poll_result_net(socket_path, e.job_id,
+                return poll_result_net(addrs, e.job_id,
                                        deadline_s=poll_deadline_s,
                                        rng=rng)
             last = e          # unacknowledged — the idem key makes the
@@ -339,7 +377,7 @@ def poll_result(state_dir: str, job_id: str, deadline_s: float = 300.0,
                        f"{deadline_s:.0f}s ({path})", job_id=job_id)
 
 
-def poll_result_net(socket_path: str, job_id: str,
+def poll_result_net(socket_path: Addr, job_id: str,
                     deadline_s: float = 300.0, interval: float = 0.5,
                     jitter: float = 0.5,
                     rng: Optional[random.Random] = None) -> dict:
@@ -354,15 +392,19 @@ def poll_result_net(socket_path: str, job_id: str,
     duplicate work, only observe it. Transport errors (the router itself
     restarting, a replica relaunching) back off with jitter so a fleet
     of waiting clients doesn't re-dial in lockstep; ``pending`` answers
-    poll at the flat ``interval``.
+    poll at the flat ``interval``. A LIST of addresses (router +
+    standbys) rotates to the next entry on each transport failure —
+    strictly read-only, so asking every router is always safe.
 
     Raises :class:`ServeTimeout` naming ``job_id`` at the deadline."""
     rng = rng if rng is not None else random.Random()
+    addrs = _rotation(socket_path)
     deadline = time.time() + deadline_s
     fails = 0
+    idx = 0
     while time.time() < deadline:
         try:
-            for ev in request(socket_path,
+            for ev in request(addrs[idx % len(addrs)],
                               {"op": "result", "job_id": job_id},
                               timeout=min(30.0, deadline_s)):
                 if ev.get("event") not in ("pending", "error"):
@@ -372,8 +414,148 @@ def poll_result_net(socket_path: str, job_id: str,
             time.sleep(interval)
         except (OSError, ServeConnectionLost, protocol.ProtocolError):
             fails += 1
+            idx += 1            # rotate: maybe a standby answers
             time.sleep(min(8.0, interval * (2 ** min(fails, 4)))
                        + rng.uniform(0.0, jitter))
     raise ServeTimeout(f"no result record for job {job_id} within "
-                       f"{deadline_s:.0f}s (via {socket_path})",
+                       f"{deadline_s:.0f}s (via {addrs})",
                        job_id=job_id)
+
+
+# ---- degraded mode (no router answers) ----------------------------------
+#
+# The fleet's replicas publish their own ``tcp_addr`` files on the shared
+# fleet disk; a client that can read that disk can keep working when
+# every router is partitioned away or dead. Reads (status / result /
+# query) fan out to the replicas directly — they can never duplicate
+# work. Submits are allowed ONLY with an idempotency key: the key
+# derives the job_id, the chosen replica's dedup table absorbs retries,
+# and the first healed router's sticky scan finds the journal entry or
+# result record wherever it landed — reconciliation IS the idem key.
+
+
+def fleet_addrs(fleet_dir: str) -> List[str]:
+    """Replica addresses published under ``<fleet_dir>/<name>/state/
+    tcp_addr``, sorted by replica name. Replicas that never bound (no
+    file) or are mid-relaunch (empty file) are skipped."""
+    out: List[str] = []
+    for path in sorted(glob.glob(os.path.join(
+            fleet_dir, "*", "state", "tcp_addr"))):
+        try:
+            with open(path) as fh:
+                addr = fh.read().strip()
+        except OSError:
+            continue
+        if addr:
+            out.append(addr)
+    return out
+
+
+def router_addrs(fleet_dir: str) -> List[str]:
+    """The active router's published address (``<fleet_dir>/
+    router_addr``), as a rotation list — [] when no router ever bound."""
+    try:
+        with open(os.path.join(fleet_dir, "router_addr")) as fh:
+            addr = fh.read().strip()
+    except OSError:
+        return []
+    return [addr] if addr else []
+
+
+def degraded_result(fleet_dir: str, job_id: str,
+                    timeout: Optional[float] = 10.0,
+                    auth_token: Optional[str] = None) -> dict:
+    """``result`` fan-out across the replicas: the first durable record
+    wins; otherwise ``pending`` (some replica reachable, none finished)
+    or a structured ``no_replicas`` error."""
+    reached = False
+    for addr in fleet_addrs(fleet_dir):
+        try:
+            ev = result(addr, job_id, timeout=timeout,
+                        auth_token=auth_token)
+        except (OSError, ServeConnectionLost, protocol.ProtocolError):
+            continue
+        reached = True
+        if ev.get("event") not in ("pending", "error"):
+            return dict(ev, degraded=True)
+    if reached:
+        return {"event": "pending", "job_id": job_id, "degraded": True}
+    return {"event": "error", "error": "no_replicas", "degraded": True,
+            "detail": f"no replica reachable via {fleet_dir}"}
+
+
+def degraded_query(fleet_dir: str, q: str, job_id: Optional[str] = None,
+                   variant: Optional[str] = None,
+                   gene: Optional[str] = None, k: Optional[int] = None,
+                   timeout: Optional[float] = 10.0,
+                   auth_token: Optional[str] = None) -> dict:
+    """Read-plane query fan-out: first replica that answers without an
+    error serves it (only the bundle's home replica has the inventory,
+    the rest answer ``not_found``)."""
+    last: Optional[dict] = None
+    for addr in fleet_addrs(fleet_dir):
+        try:
+            ev = query(addr, q, job_id=job_id, variant=variant,
+                       gene=gene, k=k, timeout=timeout,
+                       auth_token=auth_token)
+        except (OSError, ServeConnectionLost, protocol.ProtocolError):
+            continue
+        if not ev.get("error"):
+            return dict(ev, degraded=True)
+        last = ev
+    if last is not None:
+        return dict(last, degraded=True)
+    return {"event": "error", "error": "no_replicas", "degraded": True,
+            "detail": f"no replica reachable via {fleet_dir}"}
+
+
+def degraded_status(fleet_dir: str,
+                    timeout: Optional[float] = 5.0) -> dict:
+    """Per-replica status roll-up assembled client-side — the degraded
+    twin of the router's ``status`` aggregate."""
+    reps = {}
+    for addr in fleet_addrs(fleet_dir):
+        try:
+            reps[addr] = status(addr, timeout=timeout)
+        except (OSError, ServeConnectionLost, protocol.ProtocolError):
+            reps[addr] = {"event": "error", "error": "unreachable"}
+    return {"event": "status", "role": "degraded_client",
+            "degraded": True, "fleet_dir": fleet_dir, "replicas": reps}
+
+
+def degraded_submit(fleet_dir: str, job: dict, tenant: str = "default",
+                    idem_key: Optional[str] = None,
+                    timeout: Optional[float] = None,
+                    priority: Optional[str] = None,
+                    deadline_s: Optional[float] = None,
+                    auth_token: Optional[str] = None) -> List[dict]:
+    """Keyed submit straight to a replica while no router answers.
+
+    Requires an ``idem_key`` (minted when absent — the caller should
+    keep it for retries): the key derives the job_id, so this submit is
+    reconcilable no matter where it lands. Before submitting, every
+    reachable replica is asked for the durable record — a job that
+    already ran anywhere dedups client-side. The target replica is
+    chosen deterministically from the key over the reachable set, so a
+    degraded retry of the same key lands on the same replica and its
+    dedup table absorbs it. Raises :class:`ServeConnectionLost` when no
+    replica is reachable at all."""
+    if idem_key is None:
+        idem_key = f"d-{uuid.uuid4().hex}"
+    jid = protocol.idem_job_id(idem_key)
+    rec = degraded_result(fleet_dir, jid, auth_token=auth_token)
+    if rec.get("event") not in ("pending", "error"):
+        return [{"event": "accepted", "job_id": jid, "deduped": True,
+                 "degraded": True}, rec]
+    addrs = fleet_addrs(fleet_dir)
+    if not addrs:
+        raise ServeConnectionLost(
+            f"degraded submit: no replica published an address under "
+            f"{fleet_dir}", job_id=jid)
+    # Deterministic placement over the *reachable* set: stable for
+    # retries of the same key, no coordination required.
+    target = addrs[zlib.crc32(idem_key.encode()) % len(addrs)]
+    events = submit_job(target, job, tenant=tenant, timeout=timeout,
+                        priority=priority, deadline_s=deadline_s,
+                        idem_key=idem_key, auth_token=auth_token)
+    return [dict(ev, degraded=True) for ev in events]

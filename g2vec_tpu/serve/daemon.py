@@ -77,7 +77,7 @@ from g2vec_tpu.resilience.lifecycle import (DrainRequested, JobCancelled,
                                             JobDeadlineExceeded,
                                             JobInterrupted, TokenBucket,
                                             shed_decision)
-from g2vec_tpu.serve import inventory, protocol
+from g2vec_tpu.serve import inventory, leader, protocol
 from g2vec_tpu.utils.integrity import write_json_atomic
 from g2vec_tpu.utils.metrics import MetricsWriter
 
@@ -488,6 +488,22 @@ class ServeDaemon:
         self._idem: Dict[str, str] = {}
         self._idem_lock = threading.Lock()
         self._load_idem_table()
+        #: Leadership-fencing state (serve/leader.py). The highest
+        #: router epoch this state dir has EVER witnessed, persisted so
+        #: a relaunch keeps rejecting a zombie ex-leader's commands;
+        #: a mutating op carrying a lower epoch gets a structured
+        #: ``stale_epoch`` reject in _handle_conn. Epoch-less payloads
+        #: (single-router fleets, degraded-mode clients) always pass.
+        self._epoch_path = os.path.join(opts.state_dir,
+                                        leader.ROUTER_EPOCH_FILE)
+        # guarded-by: _epoch_lock
+        self._router_epoch = leader.read_epoch_file(self._epoch_path)
+        self._epoch_lock = threading.Lock()
+        #: Fence-marker latch: flips True once, on first sighting of
+        #: <state>/fenced (racy-read bool like _draining — writers
+        #: converge, readers only see it late, and "late" here means
+        #: one extra marker stat()).
+        self._quarantined = False
         if opts.fault_plan:
             from g2vec_tpu.resilience.faults import install_plan
 
@@ -544,6 +560,65 @@ class ServeDaemon:
         tok = payload.get("relay_token")
         return isinstance(tok, str) \
             and hmac.compare_digest(tok, self._relay_token)
+
+    # ---- leadership fencing ----------------------------------------------
+
+    def _observe_epoch(self, payload: dict) -> Optional[dict]:
+        """The fencing-epoch gate for mutating ops.
+
+        A payload carrying ``router_epoch`` >= the highest epoch this
+        state dir has witnessed advances (and persists) the watermark
+        and passes; a LOWER epoch means the sender lost the leadership
+        lease to a successor — return the structured ``stale_epoch``
+        reject so the zombie learns it must stop trusting its own
+        failure detector. Absent/0 epochs always pass: single-router
+        fleets and degraded-mode clients carry none, and this gate must
+        be inert for them (the PR 16 contract)."""
+        e = payload.get("router_epoch")
+        if not isinstance(e, int) or isinstance(e, bool) or e <= 0:
+            return None
+        with self._epoch_lock:
+            cur = self._router_epoch
+            if e >= cur:
+                if e > cur:
+                    self._router_epoch = e
+                    leader.write_epoch_file(self._epoch_path, e)
+                return None
+        self.metrics.emit("stale_epoch", op=payload.get("op"),
+                          got_epoch=e, seen_epoch=cur, side="daemon")
+        return {"event": "rejected", "error": "stale_epoch",
+                "got_epoch": e, "seen_epoch": cur,
+                "detail": f"router epoch {e} is stale (this replica has "
+                          f"seen {cur}); the leadership lease moved on"}
+
+    def _fenced(self) -> bool:
+        """Has the leader fenced this replica (``<state>/fenced``)?
+
+        Checked at every admission and at the trainers' shard/superstep
+        boundaries. Marker presence alone quarantines — a torn marker
+        reads as epoch 0, still fenced — because the marker only exists
+        when a journal migration is underway and running on means
+        double execution. First sighting emits ``quarantine`` and
+        latches; the marker's epoch also advances the persisted
+        watermark so the fencing leader's successor is never stale."""
+        ep = leader.read_fence_marker(self.opts.state_dir)
+        if ep is None:
+            return False
+        if not self._quarantined:
+            self._quarantined = True
+            with self._lock:
+                parked = len(self._running)
+            parked += self._queue.depth()
+            with self._epoch_lock:
+                if ep > self._router_epoch:
+                    self._router_epoch = ep
+                    leader.write_epoch_file(self._epoch_path, ep)
+            self.metrics.emit("quarantine", epoch=ep, parked=parked)
+            self.console(f"[serve] fenced at epoch {ep}: admission "
+                         f"closed, in-flight work parks at the next "
+                         f"boundary, no further results/inventory "
+                         f"publish ({parked} job(s) stay journaled)")
+        return True
 
     # ---- admission --------------------------------------------------------
 
@@ -649,7 +724,7 @@ class ServeDaemon:
         # may outlive the admission check.
         raw = {k: v for k, v in payload.items()
                if k not in ("auth_token", "relay_token", "requeue",
-                            "submitted_at")}
+                            "submitted_at", "router_epoch")}
         if submitted_at is None and self._trusted_requeue(payload):
             # Deadline-clock continuity across failover: the router's
             # journal migration resubmits with the ORIGINAL admission
@@ -749,6 +824,16 @@ class ServeDaemon:
                     "error": ("draining" if self._draining
                               else "shutting_down"),
                     "job_id": job.job_id}
+        if self._fenced():
+            # Quarantined: the leader is migrating this state dir's
+            # journal. Admitting now would journal a job the migration
+            # can miss — the client must go to the survivor (dedup by
+            # idem key makes the retry safe).
+            _unreserve()
+            return {"event": "rejected", "error": "fenced",
+                    "job_id": job.job_id,
+                    "detail": "replica is quarantined by the router's "
+                              "fence marker; resubmit to the fleet"}
         # A failover/recovery resubmission (requeue=True + this
         # replica's relay_token, set only by the router's journal
         # migration) already paid the SLO gates when it was FIRST
@@ -975,6 +1060,16 @@ class ServeDaemon:
                          detail: str) -> None:
         """Record a cancelled / deadline_exceeded terminal state: result
         record, journal removal, cursor cleanup, subscriber notice."""
+        if self._fenced():
+            # A fenced replica must not mint terminal records — the
+            # survivor owns this job's fate now, and two terminal
+            # states for one ack breaks exactly-once. Stay journaled.
+            self._notify(job, {"event": "job_drained",
+                               "job_id": job.job_id,
+                               "note": "replica fenced; job stays "
+                                       "journaled for migration"})
+            self._notify(job, None)
+            return
         record = {"event": f"job_{status}", "job_id": job.job_id,
                   "tenant": job.tenant, "status": status, "detail": detail,
                   "idem_key": job.idem_key,
@@ -1038,6 +1133,11 @@ class ServeDaemon:
         """One scheduling cycle: pop the next job (tenant-fair), join every
         shape-compatible queued job into the same engine batch, execute,
         route results. Returns the number of jobs completed (0 = idle)."""
+        if self._fenced():
+            # Quarantined: leave the queue journaled and untouched for
+            # the migration; starting a batch now is double execution.
+            time.sleep(min(timeout, 0.2))
+            return 0
         job = self._queue.pop(timeout=timeout)
         if job is None:
             return 0
@@ -1106,6 +1206,12 @@ class ServeDaemon:
             state."""
             if self._draining:
                 raise DrainRequested(detail="daemon drain")
+            if self._fenced():
+                # Self-quarantine park: the batch checkpoints at this
+                # boundary and every job stays journaled — the fenced
+                # replica must never finish work whose journal the
+                # leader is migrating to a survivor.
+                raise DrainRequested(detail="fenced by router")
             now = time.time()
             for j in batch:
                 if j.cancel_ev.is_set():
@@ -1146,6 +1252,25 @@ class ServeDaemon:
             return 0
 
         wall = time.time() - t0
+        if self._fenced():
+            # The marker landed after the last boundary check but
+            # before the terminal write: publishing now would hand the
+            # client a result the survivor may also produce. Park the
+            # whole batch exactly as a drain would — journaled, no
+            # record, no inventory — and let idem dedup on the survivor
+            # keep the accounting exactly-once.
+            shutil.rmtree(spool, ignore_errors=True)
+            for j in batch:
+                self._job_state(j.job_id, "drained", batch=bid)
+                self._notify(j, {"event": "job_drained",
+                                 "job_id": j.job_id,
+                                 "note": "replica fenced; job stays "
+                                         "journaled for migration"})
+                self._notify(j, None)
+            with self._lock:
+                for j in batch:
+                    self._running.pop(j.job_id, None)
+            return 0
         # The shed estimator's evidence: one completed batch contributes
         # its per-job share of the wall (joined jobs amortize the batch).
         with self._lock:
@@ -1258,6 +1383,12 @@ class ServeDaemon:
         from g2vec_tpu.io.writers import write_inventory_bundle
 
         key = f"{job.job_id}/{v.name}"
+        if self._fenced():
+            # Belt over the _run_jobs park: a fenced replica must not
+            # publish query-plane bytes the survivor will re-derive.
+            self.metrics.emit("inventory", bundle=key, bytes=0,
+                              outcome="skipped", error="replica fenced")
+            return
         dest = os.path.join(self._inventory_dir, job.job_id, v.name)
         if lane.embeddings is None:
             self.metrics.emit("inventory", bundle=key, bytes=0,
@@ -1351,6 +1482,15 @@ class ServeDaemon:
 
     def _finish_failed(self, job: ServeJob, err: str,
                        classified: str) -> None:
+        if self._fenced():
+            # Same contract as _finish_terminal: no terminal records
+            # after fencing — the job stays journaled for the survivor.
+            self._notify(job, {"event": "job_drained",
+                               "job_id": job.job_id,
+                               "note": "replica fenced; job stays "
+                                       "journaled for migration"})
+            self._notify(job, None)
+            return
         record = {"event": "job_failed", "job_id": job.job_id,
                   "tenant": job.tenant, "status": "failed", "error": err,
                   "idem_key": job.idem_key, "classified": classified,
@@ -1461,6 +1601,8 @@ class ServeDaemon:
             jobs_done, jobs_failed = self.jobs_done, self.jobs_failed
             service_times = list(self._service_times)
             tenants = {t: dict(c) for t, c in self._tenant_stats.items()}
+        with self._epoch_lock:
+            router_epoch = self._router_epoch
         service = (round(sum(service_times) / len(service_times), 3)
                    if service_times else None)
         return {"event": "status", "pid": os.getpid(),
@@ -1479,6 +1621,11 @@ class ServeDaemon:
                 "queued": self._queue.depth(), "running": running,
                 "queued_by_priority": self._queue.depths(),
                 "draining": self._draining,
+                #: Leadership-fencing plane: the highest router epoch
+                #: witnessed and whether the leader has quarantined
+                #: this state dir (serve/leader.py fence marker).
+                "router_epoch": router_epoch,
+                "fenced": self._fenced(),
                 "job_states": job_states,
                 "queue_depth_limit": self.opts.queue_depth,
                 "max_join": self.opts.max_join,
@@ -1552,6 +1699,17 @@ class ServeDaemon:
                         "detail": f"op {op!r} requires a valid "
                                   f"'auth_token' on this listener"})
                 return
+            if op in ("submit", "cancel", "drain", "shutdown"):
+                # Fencing gate, mutating ops only: a command stamped
+                # with a superseded leadership epoch comes from a
+                # zombie ex-leader — reject it structurally so the
+                # zombie stops fencing/migrating. Reads (status, ping,
+                # result, query) stay open to everyone: a stale router
+                # observing the fleet is harmless and useful.
+                stale = self._observe_epoch(req)
+                if stale is not None:
+                    protocol.write_event(f, stale)
+                    return
             if op == "submit":
                 sub: "queue.Queue" = queue.Queue()
                 resp = self.admit(req, subscriber=sub)

@@ -75,9 +75,17 @@ MAX_LINE_BYTES = 8 << 20
 #: secret readable only via the replica's filesystem — the router
 #: co-hosts the state dirs, network tenants do not), and the router
 #: strips all three from externally received submits before relaying.
+#: ``router_epoch`` is the leadership fencing epoch (serve/leader.py):
+#: a router holding the lease stamps every mutating command with its
+#: epoch, daemons persist the highest epoch they have witnessed, and a
+#: mutating command carrying a LOWER epoch gets a structured
+#: ``stale_epoch`` reject — a zombie ex-leader that lost the lease
+#: mid-partition can no longer fence replicas or migrate journals.
+#: Absent/0 means "no leadership machinery" (single-router fleets and
+#: degraded-mode clients) and is always accepted.
 SUBMIT_KEYS = ("op", "job", "tenant", "priority", "deadline_s",
                "idem_key", "job_id", "auth_token", "requeue",
-               "submitted_at", "relay_token")
+               "submitted_at", "relay_token", "router_epoch")
 
 #: The query-request envelope vocabulary (the read plane's twin of
 #: SUBMIT_KEYS). daemon.py/router.py bind a query payload to the

@@ -59,7 +59,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from g2vec_tpu.config import G2VecConfig, config_from_job, serve_join_key
 from g2vec_tpu.resilience.lifecycle import ReplicaHealth, ScalingPolicy
 from g2vec_tpu.resilience.supervisor import ReplicaFleet, ReplicaSpec
-from g2vec_tpu.serve import inventory, protocol
+from g2vec_tpu.serve import inventory, leader, protocol
 from g2vec_tpu.utils.metrics import MetricsWriter
 
 #: Token-gated ops: the mutators, plus ``query`` — a read, but one that
@@ -76,10 +76,14 @@ def sanitize_client_submit(req: dict) -> dict:
     per-tenant quota and deadline-shed gates and forward-date its own
     deadline clock. The daemon additionally refuses those fields
     without the replica's relay_token (defense in depth); stripping
-    here keeps an honest client's stale field from degrading too."""
+    here keeps an honest client's stale field from degrading too.
+    ``router_epoch`` is stripped for the same reason: the fencing
+    epoch is the ROUTER's claim of leadership — a client-supplied one
+    could advance a daemon's persisted watermark and lock the real
+    leader out."""
     return {k: v for k, v in req.items()
             if k not in ("auth_token", "requeue", "submitted_at",
-                         "relay_token")}
+                         "relay_token", "router_epoch")}
 
 
 # ---------------------------------------------------------------------------
@@ -211,6 +215,26 @@ class RouterOptions:
     #: Seed for the controller's rng (victim choice on scale-down) —
     #: a chaos run with a fixed seed drains the same replicas every run.
     scale_seed: int = 0
+    #: HA control plane (serve/leader.py). ``standby=True``: do not
+    #: serve; watch ``<fleet_dir>/leader.json`` and take over with
+    #: epoch+1 (adopting the live fleet) once the lease expires.
+    #: ``lease_ttl_s > 0`` on a primary: acquire + renew the lease and
+    #: stamp every mutating replica command with the fencing epoch.
+    #: Both default OFF — a 1-router fleet never writes a lease and
+    #: every command goes out epoch-less (byte-identical to PR 16).
+    standby: bool = False
+    lease_ttl_s: float = 0.0
+    #: Replicas reached through a relay or on another host: their
+    #: death can never be verified from here (SIGKILL proves nothing),
+    #: so failover quarantines them with a fence marker + epoch bump
+    #: instead, and probe adoption keeps the externally published
+    #: ``tcp_addr`` instead of the daemon's self-reported listen addr.
+    remote_replicas: bool = False
+    #: Join-key salting: a keyed submit may land on the least-loaded
+    #: of this many ring candidates for its join key, so a single-hot-
+    #: shape flash crowd spreads to a promoted spare instead of
+    #: pinning one replica. 1 = the pre-salting single-owner placement.
+    join_spread: int = 1
 
 
 class Router:
@@ -249,6 +273,9 @@ class Router:
         if opts.warm_spares < 0:
             raise ValueError(f"--warm-spares must be >= 0, "
                              f"got {opts.warm_spares}")
+        if opts.join_spread < 1:
+            raise ValueError(f"--join-spread must be >= 1, "
+                             f"got {opts.join_spread}")
         self._elastic = self._max > self._min
         n_initial = min(max(opts.replicas, self._min), self._max)
         # The fleet is SIZED up front (specs are cheap — directories and
@@ -350,8 +377,34 @@ class Router:
         #: disk scan. Plain dict: entry writes are idempotent, so
         #: GIL-atomic get/setdefault need no extra lock.
         self._owner_cache: Dict[str, str] = {}
+        #: The leadership lease — None when HA is off (the default):
+        #: a 1-router fleet never writes leader.json, router_epoch
+        #: stays 0, and every mutating command goes out epoch-less.
+        #: The LeaderLease carries its own lock; held/epoch reads are
+        #: GIL-atomic snapshots.
+        self._lease: Optional[leader.LeaderLease] = None
+        if opts.standby or opts.lease_ttl_s > 0:
+            self._lease = leader.LeaderLease(
+                opts.fleet_dir,
+                ttl_s=opts.lease_ttl_s or leader.DEFAULT_TTL_S)
+        #: Mutating commands a replica refused because our epoch was
+        #: superseded — the router-side zombie tripwire for /status.
+        self.stale_rejects = 0                  # guarded-by: _hlock
+        if opts.remote_replicas:
+            # No spec may ever be fenced by pid: the processes live
+            # behind a relay / on another host, so local kill(2) proof
+            # is unobtainable and quarantine is the only fence.
+            for n in self.fleet.names():
+                self.fleet.replica(n).local = False
         self.tcp_addr: Optional[Tuple[str, int]] = None
         self._t0 = time.time()
+
+    @property
+    def router_epoch(self) -> int:
+        """The fencing epoch stamped on mutating replica commands;
+        0 = no leadership machinery (every _request/_relay_to drops
+        the field so the wire payload is byte-identical to PR 16)."""
+        return self._lease.epoch if self._lease is not None else 0
 
     # ---- replica I/O ------------------------------------------------------
 
@@ -368,6 +421,10 @@ class Router:
         out = dict(req)
         if self.opts.auth_token is not None:
             out.setdefault("auth_token", self.opts.auth_token)
+        if not out.get("router_epoch"):
+            # Epoch 0 / absent = no leadership machinery: drop the
+            # field so HA-off wire payloads stay byte-identical.
+            out.pop("router_epoch", None)
         sock = protocol.dial(addr, timeout=timeout
                              if timeout is not None else 10.0)
         try:
@@ -376,12 +433,28 @@ class Router:
             ev = protocol.read_event(f)
             if ev is None:
                 raise ConnectionError(f"replica {name} closed the stream")
+            if ev.get("error") == "stale_epoch":
+                self._on_stale_epoch(name, out.get("op"), ev)
             return ev
         finally:
             try:
                 sock.close()
             except OSError:
                 pass
+
+    def _on_stale_epoch(self, name: str, op, ev: dict) -> None:
+        """A replica refused our mutating command because our fencing
+        epoch was superseded: this router lost the lease and is a
+        zombie. Count + emit; the lease loop handles re-election."""
+        with self._hlock:
+            self.stale_rejects += 1
+        self.metrics.emit("stale_epoch", op=op, replica=name,
+                          side="router",
+                          got_epoch=ev.get("got_epoch"),
+                          seen_epoch=ev.get("seen_epoch"))
+        self.console(f"[router] {name} rejected {op!r}: our epoch "
+                     f"{ev.get('got_epoch')} is stale (replica has "
+                     f"seen {ev.get('seen_epoch')}) — leadership moved")
 
     def probe(self, name: str) -> Tuple[bool, int]:
         """One health probe: (reachable, journal_depth)."""
@@ -390,10 +463,26 @@ class Router:
                                timeout=self.opts.probe_deadline)
             if st.get("event") != "status":
                 return False, 0
+            if st.get("fenced"):
+                # Reachable but quarantined (its fence marker is still
+                # down): a fenced replica rejects every admission, so
+                # letting it rejoin the ring would bounce its whole key
+                # range. It stays "dead" to the health machine until a
+                # verified restart clears the marker.
+                return False, int(st.get("journal_depth") or 0)
             pid = st.get("pid")
             spec = self.fleet.replica(name)
             if spec.pid is None and isinstance(pid, int):
-                self.fleet.adopt(name, pid, st.get("listen"))
+                # Remote/relayed replicas keep the externally
+                # published tcp_addr file: the daemon's self-reported
+                # listen addr is its DIRECT socket, and adopting it
+                # would silently route around the relay (and around
+                # any partition injector sitting on it).
+                self.fleet.adopt(
+                    name, pid,
+                    None if self.opts.remote_replicas
+                    else st.get("listen"),
+                    local=not self.opts.remote_replicas)
             return True, int(st.get("journal_depth") or 0)
         except (OSError, protocol.ProtocolError, ValueError):
             return False, 0
@@ -423,8 +512,43 @@ class Router:
         with self._hlock:
             return self.ring.lookup(key, eligible=eligible)
 
+    def _pick_salted(self, key: str, eligible) -> Optional[str]:
+        """Salted placement: the ring owner of ``key`` plus up to
+        ``join_spread - 1`` salted alternates, least-loaded wins (ties
+        go to the primary, so spread 1 and a calm fleet reproduce the
+        pre-salting placement exactly). Load = the scale loop's last
+        queued+running sample plus our own in-flight assignments, so
+        a flash crowd spreads within one scale interval instead of
+        pinning the primary until its queue sample catches up."""
+        with self._hlock:
+            primary = self.ring.lookup(key, eligible=eligible)
+            if primary is None or self.opts.join_spread <= 1:
+                return primary
+            cands = [primary]
+            for i in range(1, self.opts.join_spread):
+                alt = self.ring.lookup(f"{key}#salt{i}",
+                                       eligible=eligible)
+                if alt is not None and alt not in cands:
+                    cands.append(alt)
+            if len(cands) == 1:
+                return primary
+            per = self._fleet_stats.get("per_replica") or {}
+            assigned: Dict[str, int] = {}
+            for rep in self._assigned.values():
+                assigned[rep] = assigned.get(rep, 0) + 1
+
+            def load(n: str) -> Tuple[int, int]:
+                st = per.get(n) or {}
+                q = st.get("queued")
+                r = st.get("running")
+                sampled = (q if isinstance(q, int) else 0) \
+                    + (r if isinstance(r, int) else 0)
+                return (sampled + assigned.get(n, 0), cands.index(n))
+
+            return min(cands, key=load)
+
     def pick_replica(self, payload: dict) -> Optional[str]:
-        return self._ring_lookup(self._join_key_str(payload),
+        return self._pick_salted(self._join_key_str(payload),
                                  eligible=self._eligible())
 
     # ---- failover ---------------------------------------------------------
@@ -465,7 +589,32 @@ class Router:
 
     def _failover_locked(self, name: str, relaunch: bool) -> int:
         died_at = time.monotonic()
-        self.fleet.fence(name, grace_s=self.opts.fence_grace_s)
+        spec = self.fleet.replica(name)
+        rc = self.fleet.fence(name, grace_s=self.opts.fence_grace_s)
+        if rc is None and not spec.local:
+            # UNVERIFIED death: the replica is merely unreachable — it
+            # may be alive across an asymmetric partition, mid-batch on
+            # the very journal we are about to migrate. Split-brain
+            # guard: bump the fencing epoch (so the corpse's view of
+            # the world is provably stale) and drop a quarantine
+            # marker in its state dir BEFORE reading the journal; the
+            # partitioned daemon sees the marker at its next shard
+            # boundary, parks everything journaled, and stops
+            # publishing. If we ourselves lost the lease (bump() == 0
+            # while HA is on), we are the zombie — no fencing rights,
+            # no migration; the real leader owns this corpse.
+            if self._lease is not None:
+                fence_epoch = self._lease.bump()
+                if fence_epoch == 0:
+                    self.console(f"[router] NOT migrating {name}: "
+                                 f"lease lost (we are the zombie)")
+                    return 0
+            else:
+                fence_epoch = 0     # marker presence alone quarantines
+            leader.write_fence_marker(spec.state_dir, fence_epoch)
+            self.metrics.emit("fenced", replica=name, epoch=fence_epoch)
+            self.console(f"[router] quarantined {name} (unverified "
+                         f"death, fence epoch {fence_epoch})")
         jobs_dir, results_dir, ckpt_dir = self._dead_paths(name)
         entries = []
         if os.path.isdir(jobs_dir):
@@ -543,7 +692,8 @@ class Router:
             # relay_token is what makes the survivor believe either
             # field — clients can't set them (sanitize_client_submit
             # strips, the daemon verifies).
-            out = dict(payload, op="submit", requeue=True)
+            out = dict(payload, op="submit", requeue=True,
+                       router_epoch=self.router_epoch)
             tok = self._relay_token_of(target)
             if tok:
                 out["relay_token"] = tok
@@ -589,14 +739,19 @@ class Router:
                               latency_s=round(latency, 4))
             self.console(f"[router] failover {job_id}: {name} -> "
                          f"{target} ({latency:.2f}s after death)")
-        if relaunch and not self._stop.is_set():
+        if relaunch and spec.local and not self._stop.is_set():
             try:
+                # launch() clears any fence marker on this state dir —
+                # a fresh local daemon starts unquarantined.
                 self.fleet.launch(name)
                 self.metrics.emit("replica_relaunched", replica=name)
             except (RuntimeError, TimeoutError, OSError) as e:
                 self.metrics.emit("replica_relaunch_failed", replica=name,
                                   error=str(e)[:200])
                 self.console(f"[router] relaunch of {name} failed: {e}")
+        # Non-local replicas are NOT relaunched (their supervisor owns
+        # the process) and their fence marker stays: only a verified
+        # restart on that state dir may lift the quarantine.
         return requeued
 
     # ---- probe loop -------------------------------------------------------
@@ -857,7 +1012,10 @@ class Router:
         try:
             with self._rep_locks[victim]:
                 try:
-                    self._request(victim, {"op": "drain"}, timeout=10.0)
+                    self._request(victim,
+                                  {"op": "drain",
+                                   "router_epoch": self.router_epoch},
+                                  timeout=10.0)
                 except (OSError, protocol.ProtocolError):
                     pass
                 rc = self.fleet.fence(victim, grace_s=120.0)
@@ -912,7 +1070,10 @@ class Router:
         boot gets a fresh key and warms once."""
         boots = self.fleet.replica(name).boots
         req = {"op": "submit", "job": job, "tenant": "_warmup",
-               "idem_key": f"warmup-{name}-b{boots}"}
+               "idem_key": f"warmup-{name}-b{boots}",
+               "router_epoch": self.router_epoch}
+        if not req.get("router_epoch"):
+            req.pop("router_epoch", None)     # HA off: byte-compat
         if self.opts.auth_token is not None:
             req["auth_token"] = self.opts.auth_token
         return req
@@ -1046,9 +1207,19 @@ class Router:
                 if self._last_scale else None
             scale_ups, scale_downs = self.scale_ups, self.scale_downs
             fleet_stats = dict(self._fleet_stats)
+            stale_rejects = self.stale_rejects
         p99 = lats[min(len(lats) - 1,
                        int(0.99 * len(lats)))] if lats else None
+        if self._lease is not None:
+            leader_view = {"enabled": True, "held": self._lease.held,
+                           "epoch": self._lease.epoch,
+                           "holder": self._lease.holder,
+                           "standby": self.opts.standby}
+        else:
+            leader_view = {"enabled": False}
         return {"event": "status", "role": "router", "pid": os.getpid(),
+                "leader": leader_view,
+                "stale_rejects": stale_rejects,
                 "uptime_s": round(time.time() - self._t0, 1),
                 "listen": (f"{self.tcp_addr[0]}:{self.tcp_addr[1]}"
                            if self.tcp_addr else None),
@@ -1118,9 +1289,10 @@ class Router:
         answers = []
         for name in self.fleet.names():
             try:
-                resp = self._request(name, {"op": "cancel",
-                                            "job_id": job_id},
-                                     timeout=5.0)
+                resp = self._request(
+                    name, {"op": "cancel", "job_id": job_id,
+                           "router_epoch": self.router_epoch},
+                    timeout=5.0)
             except (OSError, protocol.ProtocolError):
                 continue
             answers.append(dict(resp, replica=name))
@@ -1163,8 +1335,10 @@ class Router:
         try:
             with self._rep_locks[name]:
                 try:
-                    resp = self._request(name, {"op": "drain"},
-                                         timeout=10.0)
+                    resp = self._request(
+                        name, {"op": "drain",
+                               "router_epoch": self.router_epoch},
+                        timeout=10.0)
                 except (OSError, protocol.ProtocolError) as e:
                     resp = {"event": "error", "error": str(e)[:200]}
                 rc = self.fleet.fence(name, grace_s=120.0)  # graceful
@@ -1362,7 +1536,10 @@ class Router:
                 return
         tried: List[str] = []
         for _ in range(max(1, len(self.fleet.names()))):
-            target = self._ring_lookup(
+            # Salted placement (join_spread > 1): a hot join-key flash
+            # crowd spreads across a bounded candidate set instead of
+            # pinning one replica while a promoted spare idles.
+            target = self._pick_salted(
                 self._join_key_str(payload),
                 eligible=[n for n in self._eligible() if n not in tried])
             if target is None:
@@ -1379,7 +1556,10 @@ class Router:
         """Forward one submit to ``target`` and relay its event stream.
         Returns False if the replica was unreachable BEFORE acking (safe
         to try the next ring successor — nothing was accepted)."""
-        out = dict(payload, op="submit")
+        out = dict(payload, op="submit",
+                   router_epoch=self.router_epoch)
+        if not out.get("router_epoch"):
+            out.pop("router_epoch", None)     # HA off: byte-compat
         if self.opts.auth_token is not None:
             out["auth_token"] = self.opts.auth_token
         addr = self._replica_addr(target)
@@ -1400,6 +1580,13 @@ class Router:
                 first = None
             if first is None:
                 return False               # died pre-ack: retry elsewhere
+            if first.get("error") == "stale_epoch":
+                # WE are the zombie: a newer leader exists. Surface the
+                # reject to the client rather than spraying the stale
+                # submit at ring successors (each would reject it too).
+                self._on_stale_epoch(target, "submit", first)
+                protocol.write_event(f, first)
+                return True
             job_id = first.get("job_id")
             if first.get("event") == "accepted" and job_id:
                 # Relay threads run concurrently: the count and the
@@ -1627,7 +1814,10 @@ class Router:
                 with self._hlock:
                     self.health[name].force_dead(now=time.time())
                 self._failover(name)
-            else:
+            elif not self.opts.remote_replicas:
+                # Remote fleets are adopted, never launched: the daemons
+                # live on other hosts and a local Popen would just fork
+                # a replica nobody asked for.
                 self.fleet.launch(name)
             live.append(name)
         for name in self.fleet.names():
@@ -1642,6 +1832,31 @@ class Router:
                 self._failover(name, relaunch=False)
         self._ensure_warm()
 
+    def _lease_loop(self) -> None:
+        """Renew the leadership lease at ttl/3.  On loss the router
+        KEEPS serving — reads stay correct, and every mutating command
+        it still emits carries its now-stale epoch, which daemons
+        reject (``stale_epoch``).  The loop keeps trying to re-acquire:
+        if the usurper dies in turn, this router resumes leadership
+        with a fresh, higher epoch."""
+        assert self._lease is not None
+        interval = max(0.2, self._lease.ttl_s / 3.0)
+        while not self._stop.wait(interval):
+            if self._lease.held:
+                if not self._lease.renew():
+                    self.console(
+                        f"[router] LOST leadership lease (epoch "
+                        f"{self._lease.epoch} superseded) — serving "
+                        f"reads only; mutations will be fenced")
+            elif self._lease.acquire():
+                # Re-elected (the usurper died or released).
+                self.metrics.emit("leader_elected",
+                                  epoch=self._lease.epoch,
+                                  holder=self._lease.holder,
+                                  standby=self.opts.standby)
+                self.console(f"[router] re-acquired leadership lease "
+                             f"(epoch {self._lease.epoch})")
+
     def serve_forever(self) -> int:
         import signal
 
@@ -1650,6 +1865,55 @@ class Router:
         # share this interpreter, and a forwarded query's wall includes
         # every GIL hold on the relay path.
         sys.setswitchinterval(1e-3)
+
+        def _on_sigterm(*_):
+            self._stop.set()
+
+        try:
+            signal.signal(signal.SIGTERM, _on_sigterm)
+        except ValueError:
+            pass
+        if self._lease is not None:
+            # Leadership gates EVERYTHING below: a standby must not
+            # boot replicas, bind, or publish router_addr/router.pid
+            # until it actually holds the lease — the active router's
+            # clients are still reading those files.
+            t_wait = time.time()
+            if self.opts.standby:
+                self.console(f"[router] standby: watching lease in "
+                             f"{self.opts.fleet_dir} as "
+                             f"{self._lease.holder}")
+                if not leader.wait_for_leadership(
+                        self._lease, stop=self._stop):
+                    self.console("[router] standby stopped before "
+                                 "taking over")
+                    self.metrics.close()
+                    return 0
+                takeover_s = round(time.time() - t_wait, 3)
+                self.metrics.emit("leader_elected",
+                                  epoch=self._lease.epoch,
+                                  holder=self._lease.holder,
+                                  standby=True, takeover_s=takeover_s)
+                self.console(f"[router] standby took over: epoch "
+                             f"{self._lease.epoch} after {takeover_s}s")
+            else:
+                if not self._lease.acquire():
+                    st, _ = self._lease.peek()
+                    self.console(
+                        f"[router] lease in {self.opts.fleet_dir} is "
+                        f"held by "
+                        f"{st.holder if st else 'unknown'} — start "
+                        f"with --standby to wait for it")
+                    self.metrics.close()
+                    return 1
+                self.metrics.emit("leader_elected",
+                                  epoch=self._lease.epoch,
+                                  holder=self._lease.holder,
+                                  standby=False)
+            renewer = threading.Thread(target=self._lease_loop,
+                                       name="g2v-router-lease",
+                                       daemon=True)
+            renewer.start()
         self.boot_fleet()
         host, port = protocol.parse_addr(self.opts.listen)
         srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -1665,14 +1929,6 @@ class Router:
         with open(os.path.join(self.opts.fleet_dir, "router.pid"),
                   "w") as fh:
             fh.write(str(os.getpid()))
-
-        def _on_sigterm(*_):
-            self._stop.set()
-
-        try:
-            signal.signal(signal.SIGTERM, _on_sigterm)
-        except ValueError:
-            pass
         prober = threading.Thread(target=self._probe_loop,
                                   name="g2v-router-probe", daemon=True)
         prober.start()
@@ -1705,6 +1961,10 @@ class Router:
             prober.join(timeout=5.0)
             scaler.join(timeout=5.0)
             self.fleet.stop_all(grace_s=60.0)
+            if self._lease is not None:
+                # Clean exit: drop the lease so a standby takes over
+                # immediately instead of waiting out the ttl.
+                self._lease.release()
             self.metrics.emit("router_stop", jobs_routed=self.jobs_routed,
                               failovers=self.failovers)
             self.metrics.close()

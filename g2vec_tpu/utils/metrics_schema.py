@@ -65,6 +65,9 @@ EVENT_SCHEMAS = {
     'failover_reconciled': {
         "required": ['from_replica', 'job_id'],
         "optional": ['already_on']},
+    'fenced': {
+        "required": ['epoch', 'replica'],
+        "optional": []},
     'fleet_done': {
         "required": ['attempts', 'mesh', 'ranks'],
         "optional": []},
@@ -128,12 +131,18 @@ EVENT_SCHEMAS = {
     'lane_variant': {
         "required": [],
         "optional": []},
+    'leader_elected': {
+        "required": ['epoch', 'holder'],
+        "optional": ['standby', 'takeover_s']},
     'paths': {
         "required": ['n_path_genes', 'n_paths', 'sampler_threads', 'walker_backend'],
         "optional": ['walk_cache_hits']},
     'preprocess': {
         "required": ['n_edges', 'n_genes', 'n_samples'],
         "optional": []},
+    'quarantine': {
+        "required": ['epoch'],
+        "optional": ['parked']},
     'query': {
         "required": ['cache', 'ms', 'q'],
         "optional": ['bundle', 'error', 'served_by']},
@@ -197,6 +206,9 @@ EVENT_SCHEMAS = {
     'stability': {
         "required": ['n_genes', 'output', 'scenario_id'],
         "optional": ['acc_mean', 'ci_hi', 'ci_lo', 'columns', 'n_replicates']},
+    'stale_epoch': {
+        "required": ['got_epoch', 'op', 'seen_epoch'],
+        "optional": ['replica', 'side']},
     'straggler_warning': {
         "required": ['factor', 'median_seconds', 'rank', 'seconds', 'stage'],
         "optional": []},

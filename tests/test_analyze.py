@@ -369,7 +369,7 @@ def test_repo_is_clean():
     elapsed = time.perf_counter() - t0
     assert rep.checkers_run == ["lock-discipline", "jax-purity",
                                 "fault-seams", "metrics-schema",
-                                "config-doc-drift"]
+                                "config-doc-drift", "epoch-stamp"]
     assert not rep.findings, \
         "\n".join(f"{f.location()}: [{f.checker}] {f.message}"
                   for f in rep.findings)

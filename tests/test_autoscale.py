@@ -457,3 +457,64 @@ def test_router_scale_claim_and_probe_targets(tmp_path):
     # _next_cold skips active/warm/pending and claims the first cold.
     assert r._next_cold() == "r2"
     assert r._next_cold() is None     # r2 now pending, nothing cold left
+
+
+# ---------------------------------------------------------------------------
+# Join-key salting (flash-crowd spread onto the promoted spare)
+# ---------------------------------------------------------------------------
+
+def test_join_key_salting_bounded_spread(tmp_path):
+    """A hot join key may spread to at most ``join_spread`` ring-chosen
+    replicas, least-loaded first: a flash crowd on one key lands on the
+    just-promoted spare instead of pinning the primary, while cold keys
+    (and spread=1 fleets) reproduce the pre-salting placement exactly —
+    that bound is what keeps the walk-cache affinity story alive."""
+    from g2vec_tpu.serve.router import Router, RouterOptions
+
+    eligible = ["r0", "r1", "r2"]
+    r = Router(RouterOptions(fleet_dir=str(tmp_path / "fleet"),
+                             replicas=3, join_spread=2),
+               console=lambda s: None)
+
+    def owners(key):
+        with r._hlock:
+            return (r.ring.lookup(key, eligible=eligible),
+                    r.ring.lookup(f"{key}#salt1", eligible=eligible))
+
+    # A key whose salted alternate differs from its primary (most do;
+    # the search keeps the test deterministic across ring tweaks).
+    key = next(k for k in (f"hot{i}" for i in range(200))
+               if owners(k)[0] != owners(k)[1])
+    primary, alt = owners(key)
+
+    # Calm fleet: the tie goes to the primary — byte-identical routing.
+    assert r._pick_salted(key, eligible) == primary
+    # Storm on the primary: the alternate absorbs the crowd, so the
+    # pinning storm reaches 2 replicas.
+    with r._hlock:
+        r._fleet_stats = {"per_replica":
+                          {primary: {"queued": 10, "running": 2}}}
+    assert r._pick_salted(key, eligible) == alt
+    # BOUNDED spread: with both candidates loaded, the idle third
+    # replica must never win — it is not in the key's candidate set.
+    third = next(n for n in eligible if n not in (primary, alt))
+    with r._hlock:
+        r._fleet_stats = {"per_replica": {
+            primary: {"queued": 10, "running": 2},
+            alt: {"queued": 10, "running": 2},
+            third: {"queued": 0, "running": 0}}}
+    assert r._pick_salted(key, eligible) == primary   # tie -> primary
+    # In-flight assignments count BEFORE the next stats sweep lands:
+    # the crowd spreads within one scale interval.
+    with r._hlock:
+        r._fleet_stats = {}
+        r._assigned.update({f"j{i}": primary for i in range(3)})
+    assert r._pick_salted(key, eligible) == alt
+    # spread=1 routers ignore load entirely (legacy placement).
+    r1 = Router(RouterOptions(fleet_dir=str(tmp_path / "fleet1"),
+                              replicas=3, join_spread=1),
+                console=lambda s: None)
+    with r1._hlock:
+        r1._fleet_stats = {"per_replica":
+                           {primary: {"queued": 100, "running": 9}}}
+    assert r1._pick_salted(key, eligible) == primary

@@ -61,3 +61,124 @@ def test_chaos_soak_small_storm_accounts_every_job(tmp_path):
         "done", "cancelled", "deadline_exceeded"}
     assert all(rc == 0 for rc in summary["drain_exit_codes"])
     assert summary["byte_identical"] == summary["byte_checked"]
+
+
+def test_partition_flags_env_fallbacks(monkeypatch):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import chaos_soak
+    finally:
+        sys.path.pop(0)
+    monkeypatch.setenv("G2V_CHAOS_PARTITION", "1")
+    monkeypatch.setenv("G2V_CHAOS_TAKEOVERS", "2")
+    monkeypatch.setenv("G2V_CHAOS_LEASE_TTL", "0.8")
+    opts = chaos_soak.build_parser().parse_args([])
+    assert opts.partition is True
+    assert (opts.takeovers, opts.lease_ttl) == (2, 0.8)
+    monkeypatch.delenv("G2V_CHAOS_PARTITION")
+    opts = chaos_soak.build_parser().parse_args(["--partition"])
+    assert opts.partition is True and opts.takeovers == 2
+
+
+def test_relay_blackholes_each_direction_independently():
+    """The partition injector itself: bytes flow both ways when healed,
+    die in exactly the direction that was dropped, and connections
+    still ACCEPT while partitioned (a partition is silence, not a
+    refused dial)."""
+    import socket
+    import threading
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        from chaos_soak import _Relay
+    finally:
+        sys.path.pop(0)
+
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+
+    def echo_loop():
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            def pump(c):
+                try:
+                    while True:
+                        d = c.recv(4096)
+                        if not d:
+                            return
+                        c.sendall(d)
+                except OSError:
+                    pass
+                finally:
+                    c.close()
+            threading.Thread(target=pump, args=(conn,),
+                             daemon=True).start()
+
+    threading.Thread(target=echo_loop, daemon=True).start()
+    relay = _Relay("127.0.0.1:%d" % srv.getsockname()[1])
+    try:
+        host, port = relay.addr.rsplit(":", 1)
+
+        def rt(payload: bytes, timeout: float):
+            c = socket.create_connection((host, int(port)), timeout=5)
+            c.settimeout(timeout)
+            try:
+                c.sendall(payload)
+                return c.recv(4096)
+            finally:
+                c.close()
+
+        assert rt(b"ping", 5.0) == b"ping"           # healed: echo
+        relay.partition(to_replica=False, to_client=True)
+        with pytest.raises(OSError):                  # replies die
+            rt(b"lost", 2.0)
+        relay.heal()
+        assert rt(b"again", 5.0) == b"again"
+        relay.partition()                             # both directions
+        with pytest.raises(OSError):
+            rt(b"void", 2.0)
+        # Still ACCEPTS while partitioned — the SYN is the kernel's.
+        c = socket.create_connection((host, int(port)), timeout=5)
+        c.close()
+        relay.heal()
+        assert rt(b"healed", 5.0) == b"healed"
+    finally:
+        relay.close()
+        srv.close()
+
+
+@pytest.mark.slow
+@pytest.mark.partition
+def test_partition_drill_small_storm(tmp_path):
+    """A shrunk control-plane drill must pass the full partition
+    acceptance: false-dead fence + quarantine, zombie epoch rejection,
+    takeover chain, degraded-mode drills, exactly-once fleet-wide."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "G2V_CHAOS_JOBS": "6", "G2V_CHAOS_STREAM_FRAC": "0",
+           "G2V_CHAOS_VERIFY": "1", "G2V_CHAOS_TAKEOVERS": "1",
+           "G2V_CHAOS_BUDGET": "420"}
+    out = os.path.join(str(tmp_path), "summary.json")
+    proc = subprocess.run(
+        [sys.executable, SOAK, "--partition", "--seed", "2",
+         "--json", out],
+        capture_output=True, text=True, env=env, timeout=540)
+    assert proc.returncode == 0, (proc.stdout + proc.stderr)[-1500:]
+    with open(out) as f:
+        summary = json.load(f)
+    assert summary["ok"] is True
+    assert summary["mode"] == "partition"
+    assert summary["fence_epoch"] >= 1
+    assert summary["quarantine_to_park_s"] is not None
+    assert summary["fenced_replica_violations"] == []
+    assert summary["fenced_stays_out"] is True
+    assert summary["stale_probe_rejects"] \
+        == summary["stale_probe_targets"] > 0
+    assert summary["zombie_rejects"] >= 1
+    assert summary["takeovers"] >= 2      # SIGSTOP + 1 SIGKILL round
+    assert summary["degraded_submits"] >= 1
+    assert summary["lost"] == [] and summary["duplicated"] == []
+    assert summary["journal_leftover"] == []

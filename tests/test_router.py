@@ -63,9 +63,11 @@ def _job(tsv_paths, tmp_path, name, **overrides):
 def _daemon(tmp_path, **opt_overrides):
     from g2vec_tpu.serve.daemon import ServeDaemon, ServeOptions
 
-    opts = ServeOptions(
-        socket_path=os.path.join(str(tmp_path), "serve.sock"),
-        state_dir=os.path.join(str(tmp_path), "state"), **opt_overrides)
+    opt_overrides.setdefault(
+        "socket_path", os.path.join(str(tmp_path), "serve.sock"))
+    opt_overrides.setdefault(
+        "state_dir", os.path.join(str(tmp_path), "state"))
+    opts = ServeOptions(**opt_overrides)
     return ServeDaemon(opts, console=lambda s: None)
 
 
@@ -663,3 +665,465 @@ def test_router_failover_mid_streaming_job_byte_identical(
     finally:
         r._stop.set()
         th.join(timeout=120)
+
+# ---------------------------------------------------------------------------
+# Leadership lease + fencing epochs (partition-tolerant control plane)
+# ---------------------------------------------------------------------------
+
+def test_leader_lease_acquire_renew_release_handoff(tmp_path):
+    from g2vec_tpu.serve import leader
+
+    fleet = str(tmp_path)
+    a = leader.LeaderLease(fleet, ttl_s=5.0, holder="A", settle_s=0.01)
+    b = leader.LeaderLease(fleet, ttl_s=5.0, holder="B", settle_s=0.01)
+    assert a.acquire() and a.held and a.epoch == 1
+    # A fresh foreign lease refuses a second claimant outright.
+    assert not b.acquire() and not b.held
+    assert a.renew()
+    # Re-acquire while holding is idempotent (same epoch, no bump).
+    assert a.acquire() and a.epoch == 1
+    # Clean release hands over WITHOUT waiting out the ttl, epoch +1.
+    a.release()
+    assert not a.held
+    assert b.acquire() and b.held and b.epoch == 2
+
+
+def test_leader_lease_expiry_takeover_keeps_zombie_epoch(tmp_path):
+    """After a ttl takeover the old holder must become a ZOMBIE that
+    keeps its stale epoch: renew/bump fail, held drops, but .epoch
+    stays — its stamped commands are what daemons reject."""
+    from g2vec_tpu.serve import leader
+
+    fleet = str(tmp_path)
+    a = leader.LeaderLease(fleet, ttl_s=0.2, holder="A", settle_s=0.01)
+    b = leader.LeaderLease(fleet, ttl_s=0.2, holder="B", settle_s=0.01)
+    assert a.acquire() and a.epoch == 1
+    time.sleep(0.35)                         # let A's lease expire
+    assert b.acquire() and b.epoch == 2      # takeover bumps the epoch
+    assert a.renew() is False
+    assert not a.held
+    assert a.epoch == 1                      # KEPT, not zeroed
+    assert a.bump() == 0                     # no fencing rights
+    assert b.bump() == 3                     # the real leader fences on
+
+
+def test_leader_lease_torn_write_keeps_epochs_monotone(tmp_path):
+    """A half-written lease file must not grant leadership OR reset the
+    epoch sequence: the epoch-hint sidecar keeps claims monotone."""
+    from g2vec_tpu.serve import leader
+
+    fleet = str(tmp_path)
+    a = leader.LeaderLease(fleet, ttl_s=5.0, holder="A", settle_s=0.01)
+    assert a.acquire() and a.bump() == 2
+    # Tear the lease file mid-write (no atomic rename).
+    with open(os.path.join(fleet, leader.LEASE_FILE), "w") as fh:
+        fh.write('{"epoch": 99, "hol')
+    st, expired = a.peek()
+    assert st is None and expired            # torn = absent = expired
+    b = leader.LeaderLease(fleet, ttl_s=5.0, holder="B", settle_s=0.01)
+    assert b.acquire()
+    assert b.epoch == 3                      # hint (2) + 1, monotone
+
+
+def test_leader_lease_stale_mtime_backstop(tmp_path):
+    """A writer with a future-skewed clock cannot publish an
+    unexpirable lease: either stale clock (recorded renewed_at OR the
+    file mtime) expires it."""
+    import json as _json
+
+    from g2vec_tpu.serve import leader
+
+    fleet = str(tmp_path)
+    path = os.path.join(fleet, leader.LEASE_FILE)
+    # Future renewed_at (skewed writer) but an honest, old mtime.
+    with open(path, "w") as fh:
+        _json.dump({"epoch": 7, "holder": "skewed",
+                    "renewed_at": time.time() + 1e6, "ttl_s": 0.2}, fh)
+    old = time.time() - 60
+    os.utime(path, (old, old))
+    st, expired = leader.LeaderLease(fleet, ttl_s=0.2,
+                                     holder="B").peek()
+    assert st is not None and st.epoch == 7
+    assert expired                           # mtime backstop fired
+    b = leader.LeaderLease(fleet, ttl_s=5.0, holder="B",
+                           settle_s=0.01)
+    assert b.acquire() and b.epoch == 8      # monotone over the corpse
+    # The inverse skew (ancient renewed_at, fresh mtime) expires too.
+    with open(path, "w") as fh:
+        _json.dump({"epoch": 8, "holder": "B",
+                    "renewed_at": time.time() - 60, "ttl_s": 0.2}, fh)
+    st2, expired2 = b.peek()
+    assert st2 is not None and expired2
+    # And a genuinely fresh lease does NOT expire.
+    with open(path, "w") as fh:
+        _json.dump({"epoch": 8, "holder": "B",
+                    "renewed_at": time.time(), "ttl_s": 60.0}, fh)
+    _, expired3 = b.peek()
+    assert not expired3
+
+
+def test_leader_lease_concurrent_acquire_single_winner(tmp_path):
+    """N routers racing one expired lease: claim-then-confirm leaves at
+    most one confirmed holder per settle window, and one renew() round
+    collapses any window straggler to EXACTLY one leader."""
+    from g2vec_tpu.serve import leader
+
+    fleet = str(tmp_path)
+    leases = [leader.LeaderLease(fleet, ttl_s=5.0, holder=f"h{i}",
+                                 settle_s=0.05) for i in range(4)]
+    barrier = threading.Barrier(len(leases))
+    got = [False] * len(leases)
+
+    def race(i):
+        barrier.wait()
+        got[i] = leases[i].acquire()
+
+    threads = [threading.Thread(target=race, args=(i,))
+               for i in range(len(leases))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert any(got), "nobody acquired an uncontested expired lease"
+    survivors = [ls for ls in leases if ls.held and ls.renew()]
+    assert len(survivors) == 1, [ls.holder for ls in leases if ls.held]
+    # Every loser saw the winner's claim and reports not-held.
+    winner = survivors[0]
+    for ls in leases:
+        if ls is not winner:
+            assert not ls.held
+
+
+def test_daemon_stale_epoch_reject_matrix(tmp_path):
+    """The daemon-side fencing gate: absent/0/non-int epochs are inert
+    (single-router PR 16 contract), >= watermark advances and persists,
+    lower rejects with the structured stale_epoch event — across
+    daemon incarnations too."""
+    from g2vec_tpu.serve import leader
+
+    d = _daemon(tmp_path)
+    try:
+        for inert in ({}, {"router_epoch": 0}, {"router_epoch": -3},
+                      {"router_epoch": "5"}, {"router_epoch": True},
+                      {"router_epoch": 2.5}):
+            assert d._observe_epoch(dict(inert, op="submit")) is None
+        # Watermark never moved for any of those.
+        assert leader.read_epoch_file(
+            os.path.join(d.opts.state_dir,
+                         leader.ROUTER_EPOCH_FILE)) == 0
+        # A real epoch advances + persists.
+        assert d._observe_epoch({"op": "submit", "router_epoch": 3}) \
+            is None
+        assert leader.read_epoch_file(
+            os.path.join(d.opts.state_dir,
+                         leader.ROUTER_EPOCH_FILE)) == 3
+        # Equal passes (the same leader keeps commanding).
+        assert d._observe_epoch({"op": "cancel", "router_epoch": 3}) \
+            is None
+        # Lower rejects, structured, for every mutating op.
+        for op in ("submit", "cancel", "drain", "shutdown"):
+            rej = d._observe_epoch({"op": op, "router_epoch": 2})
+            assert rej is not None and rej["event"] == "rejected"
+            assert rej["error"] == "stale_epoch"
+            assert rej["got_epoch"] == 2 and rej["seen_epoch"] == 3
+    finally:
+        d.close()
+    # The watermark is durable: a relaunched daemon still rejects.
+    d2 = _daemon(tmp_path)
+    try:
+        rej = d2._observe_epoch({"op": "drain", "router_epoch": 1})
+        assert rej is not None and rej["error"] == "stale_epoch"
+        assert rej["seen_epoch"] == 3
+        assert d2._observe_epoch({"op": "drain", "router_epoch": 4}) \
+            is None
+    finally:
+        d2.close()
+
+
+def test_stale_epoch_gate_over_tcp_mutators_only(tmp_path):
+    """Wire-level matrix: every mutating op with a stale epoch gets the
+    structured reject BEFORE dispatch; reads (status/ping/result) stay
+    open no matter what epoch they carry — reads ARE degraded mode."""
+    from g2vec_tpu.serve import client
+
+    d = _daemon(tmp_path, listen="127.0.0.1:0")
+    th = threading.Thread(target=d.serve_forever, daemon=True)
+    th.start()
+    try:
+        deadline = time.time() + 30
+        while d.tcp_addr is None and time.time() < deadline:
+            time.sleep(0.05)
+        assert d.tcp_addr is not None
+        addr = f"{d.tcp_addr[0]}:{d.tcp_addr[1]}"
+        # Prime the watermark at 5 (the op itself may fail — the epoch
+        # observation happens before dispatch).
+        ev = next(client.request(addr, {"op": "cancel", "job_id": "x",
+                                        "router_epoch": 5}))
+        assert ev.get("error") != "stale_epoch"
+        for req in ({"op": "cancel", "job_id": "x", "router_epoch": 4},
+                    {"op": "drain", "router_epoch": 1},
+                    {"op": "shutdown", "router_epoch": 2},
+                    {"op": "submit", "router_epoch": 3, "job": {}}):
+            ev = next(client.request(addr, req))
+            assert ev["event"] == "rejected", req
+            assert ev["error"] == "stale_epoch", req
+            assert ev["seen_epoch"] == 5
+        # Reads never fence (and report the watermark).
+        st = next(client.request(addr, {"op": "status",
+                                        "router_epoch": 1}))
+        assert st["event"] == "status"
+        assert st["router_epoch"] == 5 and st["fenced"] is False
+        assert next(client.request(addr, {"op": "ping"}))["event"] \
+            == "pong"
+        pend = next(client.request(addr, {"op": "result",
+                                          "job_id": "nope"}))
+        assert pend["event"] == "pending"
+    finally:
+        d._stop.set()
+        th.join(timeout=15)
+        d.close()
+
+
+def test_fence_marker_quarantines_daemon(tsv_paths, tmp_path):
+    """A fence marker in the state dir self-quarantines the daemon:
+    admission closes with a structured 'fenced' reject, the scheduler
+    refuses to start batches, everything stays journaled, status
+    reports the quarantine, and the marker's epoch advances the
+    stale-epoch watermark."""
+    from g2vec_tpu.serve import leader
+
+    d = _daemon(tmp_path)
+    try:
+        ack = d.admit({"tenant": "a", "idem_key": "k-parked",
+                       "job": _job(tsv_paths, tmp_path, "q1")})
+        assert ack["event"] == "accepted"
+        leader.write_fence_marker(d.opts.state_dir, 9)
+        rej = d.admit({"tenant": "a", "idem_key": "k-after-fence",
+                       "job": _job(tsv_paths, tmp_path, "q2")})
+        assert rej["event"] == "rejected" and rej["error"] == "fenced"
+        # The scheduler parks instead of popping the queue.
+        assert d.step(timeout=0.05) == 0
+        jdir = os.path.join(d.opts.state_dir, "jobs")
+        assert len(os.listdir(jdir)) == 1       # parked job journaled
+        rdir = os.path.join(d.opts.state_dir, "results")
+        assert not os.path.isdir(rdir) or os.listdir(rdir) == []
+        st = d.status()
+        assert st["fenced"] is True and st["router_epoch"] == 9
+        # The marker's epoch is now the watermark: older leaders are
+        # stale even though they never spoke to this daemon again.
+        rej2 = d._observe_epoch({"op": "submit", "router_epoch": 8})
+        assert rej2 is not None and rej2["error"] == "stale_epoch"
+        # The successor's relaunch path clears the marker.
+        leader.clear_fence_marker(d.opts.state_dir)
+        assert leader.read_fence_marker(d.opts.state_dir) is None
+    finally:
+        d.close()
+
+
+def test_unverified_death_fences_before_migration(tmp_path):
+    """An UNREACHABLE (non-local, SIGKILL-unverifiable) replica gets a
+    fence marker before its journal is touched, and is never
+    relaunched; a local replica's failover writes no marker."""
+    from g2vec_tpu.serve import leader
+    from g2vec_tpu.serve.router import Router, RouterOptions
+
+    fleet_dir = str(tmp_path / "fleet")
+    r = Router(RouterOptions(fleet_dir=fleet_dir, replicas=2,
+                             remote_replicas=True),
+               console=lambda s: None)
+    spec = r.fleet.replica("r0")
+    assert not spec.local
+    os.makedirs(spec.state_dir, exist_ok=True)
+    assert r._failover("r0") == 0            # no journal: nothing moves
+    # Marker written with epoch 0 (no lease machinery): presence alone
+    # quarantines, and no local relaunch was attempted.
+    assert leader.read_fence_marker(spec.state_dir) == 0
+    assert spec.pid is None
+
+    # Local replicas keep the PR 16 behavior: no marker.
+    r2 = Router(RouterOptions(fleet_dir=str(tmp_path / "fleet2"),
+                              replicas=2), console=lambda s: None)
+    spec2 = r2.fleet.replica("r0")
+    os.makedirs(spec2.state_dir, exist_ok=True)
+    assert r2._failover("r0", relaunch=False) == 0
+    assert leader.read_fence_marker(spec2.state_dir) is None
+
+
+def test_fence_epoch_bumps_with_lease_and_zombie_never_migrates(
+        tmp_path):
+    """With leased leadership, fencing an unreachable replica bumps the
+    epoch first; a router that LOST the lease refuses to fence or
+    migrate at all (it is the zombie) and keeps stamping its stale
+    epoch."""
+    from g2vec_tpu.serve import leader
+    from g2vec_tpu.serve.router import Router, RouterOptions
+
+    fleet_dir = str(tmp_path / "fleet")
+    r = Router(RouterOptions(fleet_dir=fleet_dir, replicas=2,
+                             remote_replicas=True, lease_ttl_s=5.0),
+               console=lambda s: None)
+    assert r._lease is not None
+    assert r._lease.acquire() and r.router_epoch == 1
+    for name in ("r0", "r1"):
+        os.makedirs(r.fleet.replica(name).state_dir, exist_ok=True)
+    assert r._failover("r0", relaunch=False) == 0
+    assert leader.read_fence_marker(
+        r.fleet.replica("r0").state_dir) == 2      # bumped before fence
+    assert r.router_epoch == 2
+    # Leadership moves (usurper steals after the lease file vanishes).
+    os.unlink(os.path.join(fleet_dir, leader.LEASE_FILE))
+    usurper = leader.LeaderLease(fleet_dir, ttl_s=5.0, holder="U",
+                                 settle_s=0.01)
+    assert usurper.acquire() and usurper.epoch == 3
+    # The zombie must NOT fence r1 or touch its journal.
+    assert r._failover("r1", relaunch=False) == 0
+    assert leader.read_fence_marker(
+        r.fleet.replica("r1").state_dir) is None
+    assert r.router_epoch == 2                  # stale stamp, kept
+
+
+def test_client_address_rotation_and_degraded_mode(tsv_paths, tmp_path):
+    """submit_and_wait / poll_result_net rotate through an address list
+    (dead router first, live endpoint second); the degraded_* helpers
+    reach the fleet via published tcp_addr files when no router
+    answers."""
+    from g2vec_tpu.serve import client, protocol
+
+    fleet_dir = tmp_path / "fleet"
+    state = fleet_dir / "r0" / "state"
+    d = _daemon(tmp_path, listen="127.0.0.1:0",
+                state_dir=str(state))
+    th = threading.Thread(target=d.serve_forever, daemon=True)
+    th.start()
+    try:
+        deadline = time.time() + 30
+        while d.tcp_addr is None and time.time() < deadline:
+            time.sleep(0.05)
+        assert d.tcp_addr is not None
+        addr = f"{d.tcp_addr[0]}:{d.tcp_addr[1]}"
+        dead = "127.0.0.1:9"                  # discard port: refused
+        # Plant a durable record; poll via a rotating address list.
+        jid = protocol.idem_job_id("k-rotate")
+        os.makedirs(os.path.join(str(state), "results"), exist_ok=True)
+        with open(os.path.join(str(state), "results",
+                               f"{jid}.json"), "w") as fh:
+            json.dump({"event": "job_done", "job_id": jid}, fh)
+        rec = client.poll_result_net([dead, addr], jid,
+                                     deadline_s=60, interval=0.05,
+                                     jitter=0.01)
+        assert rec["job_id"] == jid and rec["event"] == "job_done"
+        # submit_and_wait rotates off the dead router too (the live
+        # daemon's structured reject proves the second hop answered).
+        d.opts.auth_token = "gate"
+        ev = client.submit_and_wait(
+            [dead, addr], _job(tsv_paths, tmp_path, "rot"),
+            retries=2, backoff=0.05, jitter=0.01, timeout=30)
+        assert ev["event"] == "rejected"
+        assert ev["error"] == "unauthorized"
+        d.opts.auth_token = None
+
+        # Degraded mode: the fleet's own published addresses.
+        assert client.fleet_addrs(str(fleet_dir)) == [addr]
+        assert client.router_addrs(str(fleet_dir)) == []
+        rec2 = client.degraded_result(str(fleet_dir), jid)
+        assert rec2["event"] == "job_done" and rec2["degraded"] is True
+        pend = client.degraded_result(str(fleet_dir), "i" + "0" * 12)
+        assert pend["event"] == "pending" and pend["degraded"] is True
+        st = client.degraded_status(str(fleet_dir))
+        assert st["degraded"] is True
+        assert st["replicas"][addr]["event"] == "status"
+        # A keyed degraded submit whose job already finished dedups
+        # client-side off the durable record — reconciliation IS the
+        # idem key.
+        evs = client.degraded_submit(str(fleet_dir),
+                                     _job(tsv_paths, tmp_path, "deg"),
+                                     idem_key="k-rotate")
+        assert evs[0]["event"] == "accepted"
+        assert evs[0]["deduped"] is True and evs[0]["job_id"] == jid
+        assert evs[1]["event"] == "job_done"
+        # No replicas at all: structured refusal / lost-connection.
+        empty = str(tmp_path / "nowhere")
+        os.makedirs(empty, exist_ok=True)
+        none = client.degraded_result(empty, "x")
+        assert none["error"] == "no_replicas"
+        with pytest.raises(client.ServeConnectionLost):
+            client.degraded_submit(empty,
+                                   _job(tsv_paths, tmp_path, "none"),
+                                   idem_key="k-none")
+    finally:
+        d._stop.set()
+        th.join(timeout=15)
+        d.close()
+
+
+def test_probe_keeps_fenced_replica_out_of_the_ring(tmp_path):
+    """A fenced replica answers status (reads stay open) but must read
+    as probe-DEAD: it rejects every admission, so rejoining the ring
+    would bounce its whole key range. Only a verified restart (which
+    clears the marker) lifts that."""
+    from g2vec_tpu.serve import leader
+    from g2vec_tpu.serve.router import Router, RouterOptions
+
+    d = _daemon(tmp_path, listen="127.0.0.1:0")
+    th = threading.Thread(target=d.serve_forever, daemon=True)
+    th.start()
+    try:
+        deadline = time.time() + 30
+        while d.tcp_addr is None and time.time() < deadline:
+            time.sleep(0.05)
+        assert d.tcp_addr is not None
+        r = Router(RouterOptions(fleet_dir=str(tmp_path / "fleet"),
+                                 replicas=1, remote_replicas=True),
+                   console=lambda s: None)
+        r.fleet.replica("r0").addr = \
+            f"{d.tcp_addr[0]}:{d.tcp_addr[1]}"
+        ok, _ = r.probe("r0")
+        assert ok
+        leader.write_fence_marker(d.opts.state_dir, 4)
+        ok2, _ = r.probe("r0")
+        assert not ok2
+        leader.clear_fence_marker(d.opts.state_dir)
+        ok3, _ = r.probe("r0")
+        assert ok3
+    finally:
+        d._stop.set()
+        th.join(timeout=15)
+        d.close()
+
+
+def test_replica_health_asymmetric_partition():
+    """The health table under a one-way partition: status replies stop
+    arriving while the replica keeps WORKING (journal non-empty the
+    whole time). It must walk healthy -> suspect -> dead on the probe
+    count alone; when replies return, the rejoin gate must hold it out
+    of the ring until its journal drains, and one mid-rejoin probe loss
+    drops it straight back to dead."""
+    from g2vec_tpu.resilience.lifecycle import ReplicaHealth
+
+    h = ReplicaHealth("r0", suspect_after=1, dead_after=3,
+                      rejoin_after=2)
+    assert h.on_probe(True, journal_depth=2, now=1.0) is None
+    assert h.in_ring
+    # Replies blackholed: the probe sees silence, not the live worker.
+    assert h.on_probe(False, now=2.0) == ("healthy", "suspect")
+    assert h.in_ring                      # suspect still routes
+    assert h.on_probe(False, now=3.0) is None
+    assert h.on_probe(False, now=4.0) == ("suspect", "dead")
+    assert not h.in_ring
+    # Probes back off for the corpse instead of storming it.
+    assert h.probe_interval(0.5) > 0.5
+    # Partition heals — but the replica still holds journaled work the
+    # router migrated off it; it must NOT rejoin with a stale journal.
+    assert h.on_probe(True, journal_depth=2, now=5.0) \
+        == ("dead", "rejoining")
+    assert not h.in_ring
+    assert h.on_probe(True, journal_depth=2, now=6.0) is None
+    assert not h.in_ring                  # gate holds: journal not empty
+    # One more blip mid-rejoin: straight back to dead, no credit kept.
+    assert h.on_probe(False, now=7.0) == ("rejoining", "dead")
+    # Full recovery: replies AND an empty journal, rejoin_after times.
+    assert h.on_probe(True, journal_depth=0, now=8.0) \
+        == ("dead", "rejoining")
+    assert h.on_probe(True, journal_depth=0, now=9.0) \
+        == ("rejoining", "healthy")
+    assert h.in_ring

@@ -146,7 +146,7 @@ def test_analyze_clean_repo_exits_zero():
     assert report["clean"] is True
     assert report["counts"]["active"] == 0
     assert report["counts"]["stale_baseline"] == 0
-    assert len(report["checkers"]) == 5
+    assert len(report["checkers"]) == 6
     assert report["elapsed_s"] < 30.0
 
 

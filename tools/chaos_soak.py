@@ -50,6 +50,26 @@ reaction-time distribution — the evidence behind
 ``bench.py --_autoscale_ab`` (BENCH_AUTOSCALE.json), which runs the
 identical seeded schedule against both fleet shapes.
 
+``--partition`` switches to **partition mode**: the control-plane
+drill behind ``bench.py --_partition_chaos`` (BENCH_PARTITION.json).
+The harness owns the replica daemons (the router runs
+``--remote-replicas``) and slides a userspace TCP relay in front of r0
+that can blackhole each direction independently. Three drill phases
+run inside the job storm: (1) *false-dead* — r0 is partitioned while
+alive and working; the leased router must fence it (epoch bump + fence
+marker) and migrate its journal, and r0 must self-quarantine off the
+shared-disk marker and stay OUT of the ring after the heal; (2)
+*zombie leader* — the active router is SIGSTOPped past its lease ttl,
+the standby takes over, and every mutating command the woken zombie
+still emits must die with the structured ``stale_epoch`` rejection
+(plus a deterministic per-replica epoch replay matrix); (3) a chain of
+``--takeovers`` router SIGKILLs, each gap carrying a degraded-mode
+client drill (replica-direct status, keyed submit, reconcile read).
+The pass bar is the router soak's exactly-once predicate plus: fence
+epoch >= 1, quarantine observed, zero terminal states or result bytes
+from r0 after its fencing, all stale replays rejected, every takeover
+completed, and the degraded drills answered.
+
 Scale knobs are flags with G2V_CHAOS_* env fallbacks so CI can shrink
 the soak (``G2V_CHAOS_JOBS=6 python tools/chaos_soak.py``). The
 committed artifacts (BENCH_CHAOS_SOAK.json, BENCH_ROUTER_CHAOS.json) are
@@ -65,6 +85,7 @@ import os
 import random
 import shutil
 import signal
+import socket
 import subprocess
 import sys
 import tempfile
@@ -165,6 +186,31 @@ def build_parser() -> argparse.ArgumentParser:
                    default=os.environ.get("G2V_CHAOS_QUOTAS"),
                    help="Forward --tenant-quotas SPEC to the replicas "
                         "(token-bucket rates + weighted-fair shares).")
+    p.add_argument("--partition", action="store_true",
+                   default=_env_int("G2V_CHAOS_PARTITION", 0) > 0,
+                   help="Partition mode: the control-plane drill. The "
+                        "harness launches the replicas itself (remote-"
+                        "replicas router mode) with a TCP relay in front "
+                        "of r0 that can blackhole either direction "
+                        "independently, plus an HA router pair "
+                        "(--lease-ttl-s + --standby). Drill phases: "
+                        "false-dead fence + self-quarantine of a merely "
+                        "partitioned replica; SIGSTOP the active router "
+                        "past its ttl and prove every zombie mutating "
+                        "command dies with structured stale_epoch; then "
+                        "a chain of --takeovers router SIGKILLs with "
+                        "degraded-mode client drills inside each gap.")
+    p.add_argument("--takeovers", type=int,
+                   default=_env_int("G2V_CHAOS_TAKEOVERS", 3),
+                   help="Partition mode: SIGKILL-the-active-router "
+                        "rounds after the zombie drill (a fresh standby "
+                        "is spawned before each).")
+    p.add_argument("--lease-ttl", type=float,
+                   default=_env_float("G2V_CHAOS_LEASE_TTL", 1.5),
+                   help="Partition mode: leadership lease ttl handed to "
+                        "the routers (--lease-ttl-s). Small keeps the "
+                        "takeover gaps short; the drill's clients must "
+                        "ride them out regardless.")
     return p
 
 
@@ -1514,6 +1560,725 @@ def run_autoscale_soak(opts, workdir: str) -> dict:
     }
 
 
+class _Relay:
+    """A userspace TCP partition injector for ONE replica: listens on
+    its own port, forwards byte streams to the replica's real address,
+    and can blackhole each direction independently (``drop_to_replica``
+    / ``drop_to_client``). Blackholing is accept-then-discard: SYNs
+    still complete (the kernel backlog answers those), but bytes die in
+    the relay — observably identical to an asymmetric partition for the
+    length-prefixed JSONL protocol, where a request that draws no reply
+    is a dead peer. jax-free and dependency-free by construction."""
+
+    def __init__(self, backend: str):
+        host, port = backend.rsplit(":", 1)
+        self.backend = (host, int(port))
+        self.drop_to_replica = threading.Event()
+        self.drop_to_client = threading.Event()
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._conns: List[socket.socket] = []
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(64)
+        self._srv.settimeout(0.25)
+        self.addr = f"127.0.0.1:{self._srv.getsockname()[1]}"
+        threading.Thread(target=self._accept_loop,
+                         name="chaos-relay", daemon=True).start()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            try:
+                back = socket.create_connection(self.backend, timeout=10)
+            except OSError:
+                conn.close()
+                continue
+            with self._lock:
+                self._conns += [conn, back]
+            threading.Thread(target=self._pump,
+                             args=(conn, back, self.drop_to_replica),
+                             daemon=True).start()
+            threading.Thread(target=self._pump,
+                             args=(back, conn, self.drop_to_client),
+                             daemon=True).start()
+
+    def _pump(self, src: socket.socket, dst: socket.socket,
+              drop: threading.Event) -> None:
+        try:
+            while True:
+                data = src.recv(65536)
+                if not data:
+                    break
+                if drop.is_set():
+                    continue       # the partition: read and discard
+                dst.sendall(data)
+        except OSError:
+            pass
+        finally:
+            try:
+                dst.shutdown(socket.SHUT_WR)
+            except OSError:
+                pass
+
+    def partition(self, to_replica: bool = True,
+                  to_client: bool = True) -> None:
+        if to_replica:
+            self.drop_to_replica.set()
+        if to_client:
+            self.drop_to_client.set()
+
+    def heal(self) -> None:
+        self.drop_to_replica.clear()
+        self.drop_to_client.clear()
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns, self._conns = self._conns, []
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+
+class PartitionSoak(RouterSoak):
+    """Soak state for partition mode. Unlike RouterSoak, the HARNESS
+    owns the replica daemons (the router runs --remote-replicas, so it
+    adopts and fences but never forks), which is what lets a relay sit
+    between the router and r0: r0's published tcp_addr file is
+    overwritten with the relay's address after boot, and the router
+    (deliberately) keeps using the published address instead of the
+    daemon's self-reported direct one."""
+
+    def __init__(self, opts, workdir: str):
+        super().__init__(opts, workdir)
+        self.replica_procs: Dict[str, subprocess.Popen] = {}
+        self.relay: Optional[_Relay] = None
+        self.router_serial = 0
+        self.router_metrics_files: List[str] = []
+        self.standby: Optional[subprocess.Popen] = None
+        self.takeover_s: List[float] = []
+        self.degraded_status_ok = 0
+        self.degraded_submits = 0
+        self.degraded_results_seen = 0
+        self.quiesce_rcs: List[Optional[int]] = []
+
+    # ---- fleet the harness owns -------------------------------------
+
+    def _replica_argv(self, i: int) -> List[str]:
+        rdir = os.path.join(self.fleet, f"r{i}")
+        return [sys.executable, "-m", "g2vec_tpu", "serve",
+                "--socket", os.path.join(rdir, "sock"),
+                "--state-dir", os.path.join(rdir, "state"),
+                "--listen", "127.0.0.1:0",
+                "--platform", "cpu",
+                "--cache-dir", os.path.join(self.wd, "cache"),
+                "--queue-depth", "64", "--max-join", "6",
+                "--metrics-jsonl", os.path.join(rdir, "metrics.jsonl")]
+
+    def launch_replicas(self) -> None:
+        for i in range(self.opts.replicas):
+            rdir = os.path.join(self.fleet, f"r{i}")
+            os.makedirs(os.path.join(rdir, "state"), exist_ok=True)
+            log = open(os.path.join(rdir, "serve.log"), "a")
+            self.replica_procs[f"r{i}"] = subprocess.Popen(
+                self._replica_argv(i), env=self.env, stdout=log,
+                stderr=subprocess.STDOUT)
+            log.close()
+        deadline = time.time() + 600
+        for i in range(self.opts.replicas):
+            af = os.path.join(self.fleet, f"r{i}", "state", "tcp_addr")
+            while time.time() < deadline:
+                try:
+                    with open(af) as fh:
+                        if fh.read().strip():
+                            break
+                except OSError:
+                    pass
+                if self.replica_procs[f"r{i}"].poll() is not None:
+                    raise RuntimeError(f"replica r{i} died during boot")
+                time.sleep(0.1)
+            else:
+                raise RuntimeError(f"replica r{i} never bound")
+        # The relay slides in front of r0: real address behind it, the
+        # relay's address published where the router (and fleet_addrs)
+        # will look.
+        af0 = os.path.join(self.fleet, "r0", "state", "tcp_addr")
+        with open(af0) as fh:
+            real = fh.read().strip()
+        self.relay = _Relay(real)
+        with open(af0 + ".tmp", "w") as fh:
+            fh.write(self.relay.addr + "\n")
+        os.replace(af0 + ".tmp", af0)
+        self.note(f"replicas up; relay {self.relay.addr} fronts "
+                  f"r0 ({real})")
+
+    # ---- HA router pair ---------------------------------------------
+
+    def _router_argv(self, standby: bool = False) -> List[str]:
+        self.router_serial += 1
+        m = os.path.join(self.wd,
+                         f"router-metrics-{self.router_serial}.jsonl")
+        self.router_metrics_files.append(m)
+        argv = [sys.executable, "-m", "g2vec_tpu", "serve",
+                "--replicas", str(self.opts.replicas),
+                "--listen", "127.0.0.1:0",
+                "--state-dir", self.fleet,
+                "--remote-replicas",
+                "--lease-ttl-s", str(self.opts.lease_ttl),
+                "--platform", "cpu",
+                "--cache-dir", os.path.join(self.wd, "cache"),
+                "--queue-depth", "64", "--max-join", "6",
+                "--probe-interval", "0.3", "--probe-deadline", "1.0",
+                "--metrics-jsonl", m]
+        if standby:
+            argv.append("--standby")
+        return argv
+
+    def launch_standby(self) -> None:
+        argv = self._router_argv(standby=True)
+        log = open(self.router_log, "a")
+        self.standby = subprocess.Popen(argv, env=self.env, stdout=log,
+                                        stderr=subprocess.STDOUT)
+        log.close()
+        self.note(f"standby router #{self.router_serial} watching "
+                  f"the lease")
+
+    def await_takeover(self, old_addr: str, t_from: float,
+                       timeout: float = 90.0) -> bool:
+        """Takeover latency as a CLIENT measures it: the moment a
+        router at a NEW published address answers status. The router's
+        own leader_elected takeover_s starts at its standby loop, not
+        at the kill — this is the end-to-end number."""
+        from g2vec_tpu.serve import client, protocol
+
+        addr_file = os.path.join(self.fleet, "router_addr")
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                with open(addr_file) as fh:
+                    a = fh.read().strip()
+            except OSError:
+                a = ""
+            if a and a != old_addr:
+                try:
+                    if client.status(a, timeout=5.0):
+                        took = time.time() - t_from
+                        self.addr = a
+                        self.proc = self.standby
+                        self.standby = None
+                        self.takeover_s.append(took)
+                        self.note(f"takeover: {a} answering "
+                                  f"{took:.2f}s after the fault")
+                        return True
+                except (OSError, client.ServeConnectionLost,
+                        protocol.ProtocolError):
+                    pass
+            time.sleep(0.1)
+        return False
+
+    # ---- accounting across every router incarnation -----------------
+
+    def router_events(self, kinds: Tuple[str, ...]) -> List[dict]:
+        out = []
+        for path in self.router_metrics_files:
+            try:
+                with open(path) as f:
+                    for line in f:
+                        try:
+                            ev = json.loads(line)
+                        except ValueError:
+                            continue
+                        if ev.get("event") in kinds:
+                            out.append(ev)
+            except OSError:
+                pass
+        return out
+
+    def failover_events(self) -> List[dict]:
+        return self.router_events(("failover",))
+
+    def replica_events(self, name: str, kind: str) -> List[dict]:
+        out = []
+        try:
+            with open(os.path.join(self.fleet, name,
+                                   "metrics.jsonl")) as f:
+                for line in f:
+                    try:
+                        ev = json.loads(line)
+                    except ValueError:
+                        continue
+                    if ev.get("event") == kind:
+                        out.append(ev)
+        except OSError:
+            pass
+        return out
+
+    # ---- submission (takeover- and zombie-aware) --------------------
+
+    def submit_one(self, k: int, job: dict) -> None:
+        """Same exactly-once submit loop as RouterSoak, with two more
+        transient rejections in the retry set: ``stale_epoch`` (the
+        attempt raced a takeover and reached the zombie — the SAME idem
+        key retried against the new leader is safe by construction) and
+        ``fenced`` (the ring briefly offered a quarantined replica).
+        ``self.addr`` is re-read every attempt, so retries follow the
+        published router_addr across takeovers."""
+        from g2vec_tpu.serve import client
+
+        rng = random.Random((self.opts.seed << 20) ^ k)
+        priority = "interactive" if rng.random() < 0.3 else "batch"
+        deadline_s = (round(rng.uniform(2.0, 8.0), 2)
+                      if rng.random() < 0.15 else None)
+        idem = f"soak-{self.opts.seed}-{k}"
+        for attempt in range(16):
+            try:
+                evs = client.submit_job(
+                    self.addr, job, tenant=f"t{k % 3}", timeout=600,
+                    priority=priority, deadline_s=deadline_s,
+                    idem_key=idem)
+                if evs and evs[-1].get("event") == "rejected":
+                    if evs[-1].get("error") in (
+                            "no_replicas", "draining", "stale_epoch",
+                            "fenced"):
+                        raise OSError(f"transient: {evs[-1]['error']}")
+                    with self.lock:
+                        self.rejected.append(k)
+                    return
+                jid = evs[0].get("job_id") if evs else None
+                if jid:
+                    with self.lock:
+                        self.acks[jid] = {"k": k, "job": job,
+                                          "deadline_s": deadline_s}
+                    return
+                break
+            except client.ServeConnectionLost as e:
+                if e.job_id:
+                    with self.lock:
+                        self.acks[e.job_id] = {"k": k, "job": job,
+                                               "deadline_s": deadline_s}
+                    return
+            except (client.ServeTimeout, OSError):
+                pass
+            time.sleep(min(3.0, 0.2 * (2 ** attempt))
+                       + rng.uniform(0.0, 0.25))
+        with self.lock:
+            self.unsubmitted.append(k)
+
+    # ---- degraded-mode client drill ---------------------------------
+
+    def degraded_drill(self, round_i: int, paths: dict,
+                       native_ok: bool) -> None:
+        """Runs INSIDE a takeover gap: no router is answering, so the
+        client falls back to the fleet's published replica addresses —
+        status roll-up, then a keyed submit (rotating the key when the
+        deterministic target turns out to be the fenced replica), then
+        the reconcile read of the job it just placed."""
+        from g2vec_tpu.serve import client
+
+        st = client.degraded_status(self.fleet)
+        if st.get("replicas"):
+            self.degraded_status_ok += 1
+        k = self.opts.jobs + round_i
+        job = self.make_job(k, paths, native_ok)
+        for j in range(6):
+            key = f"deg-{self.opts.seed}-{round_i}-{j}"
+            try:
+                evs = client.degraded_submit(self.fleet, job,
+                                             tenant="degraded",
+                                             idem_key=key, timeout=600)
+            except (client.ServeConnectionLost, client.ServeTimeout,
+                    OSError):
+                return
+            if evs and evs[-1].get("event") == "rejected":
+                continue       # crc32 target was the fenced replica
+            jid = evs[0].get("job_id") if evs else None
+            if not jid:
+                return
+            with self.lock:
+                self.acks[jid] = {"k": k, "job": job,
+                                  "deadline_s": None}
+                self.degraded_submits += 1
+            # The reconcile read: a durable record (it carries the
+            # terminal ``status``) or an honest ``pending`` — anything
+            # but a connection-level failure.
+            rec = client.degraded_result(self.fleet, jid)
+            if rec.get("status") or rec.get("event") == "pending":
+                self.degraded_results_seen += 1
+            self.note(f"degraded drill #{round_i}: submitted {jid} "
+                      f"router-less (key {key})")
+            return
+
+    # ---- quiesce ----------------------------------------------------
+
+    def stop_fleet(self) -> None:
+        """The harness owns the daemons (remote-replicas mode: the
+        router's own stop_all skips non-local replicas), so it drains
+        them itself. The fenced r0 exits too — its parked jobs were
+        migrated long ago, and drain does not need admission."""
+        for name in sorted(self.replica_procs):
+            proc = self.replica_procs[name]
+            if proc.poll() is None:
+                try:
+                    proc.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+        for name in sorted(self.replica_procs):
+            proc = self.replica_procs[name]
+            try:
+                self.quiesce_rcs.append(proc.wait(timeout=120))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                self.quiesce_rcs.append(proc.wait())
+
+
+def run_partition_soak(opts, workdir: str) -> dict:
+    """The partition-tolerance drill: false-dead fencing + replica
+    self-quarantine under a relay blackhole, zombie-leader command
+    rejection after a SIGSTOP-induced takeover, a chain of router
+    SIGKILLs each ridden out by a standby, and degraded-mode client
+    drills inside every takeover gap — all under the fleet-wide
+    exactly-once accounting of the router soak."""
+    from g2vec_tpu.data.synthetic import SyntheticSpec, write_synthetic_tsv
+    from g2vec_tpu.serve import client, leader
+
+    soak = PartitionSoak(opts, workdir)
+    native_ok = bool(shutil.which("g++")) and opts.stream_frac > 0
+    spec = SyntheticSpec(n_good=24, n_poor=20, module_size=12,
+                         n_background=24, n_expr_only=4, n_net_only=4,
+                         module_chords=2, background_edges=40, seed=7)
+    paths = write_synthetic_tsv(spec, os.path.join(workdir, "data"))
+    os.makedirs(os.path.join(workdir, "out"), exist_ok=True)
+
+    n = opts.jobs
+    rng = soak.rng
+    arrivals, t = [], 0.0
+    for _ in range(n):
+        arrivals.append(t)
+        t += rng.expovariate(1.0 / opts.mean_arrival)
+
+    soak.note(f"partition soak: {n} jobs over {opts.replicas} replicas "
+              f"(harness-owned), lease ttl {opts.lease_ttl}s, "
+              f"{opts.takeovers} takeover round(s), seed {opts.seed}")
+    soak.launch_replicas()
+    soak.launch_router()
+    soak.launch_standby()
+
+    threads: List[threading.Thread] = []
+    deg_threads: List[threading.Thread] = []
+
+    def arrival_loop():
+        t0 = time.time()
+        jobs = [soak.make_job(k, paths, native_ok) for k in range(n)]
+        for k in range(n):
+            now = time.time() - t0
+            if now < arrivals[k]:
+                time.sleep(arrivals[k] - now)
+            th = threading.Thread(target=soak.submit_one,
+                                  args=(k, jobs[k]), daemon=True)
+            th.start()
+            threads.append(th)
+
+    arr = threading.Thread(target=arrival_loop, daemon=True)
+    arr.start()
+    deadline = soak.t0 + opts.budget_s
+    budget_blown = False
+    r0_state = os.path.join(soak.fleet, "r0", "state")
+    drill = {"fence_epoch": None, "fenced_at": None,
+             "quarantine_to_park_s": None, "fenced_stays_out": False,
+             "stale_probe_rejects": 0, "stale_probe_targets": 0,
+             "zombie_rejects": 0}
+
+    def overdue() -> bool:
+        return time.time() > deadline
+
+    # ---- phase 1: false-dead — partition r0, fence, quarantine ------
+    t_wait = time.time() + 30
+    while time.time() < t_wait and not overdue():
+        with soak.lock:
+            if len(soak.acks) >= min(3, n):
+                break
+        time.sleep(0.2)
+    soak.note("phase 1: blackholing r0's replies (asymmetric), then "
+              "both directions")
+    soak.relay.partition(to_replica=False, to_client=True)
+    time.sleep(1.0)
+    soak.relay.partition(to_replica=True, to_client=True)
+    marker_path = leader.fence_marker_path(r0_state)
+    t_limit = time.time() + 60
+    while not os.path.exists(marker_path) and time.time() < t_limit \
+            and not overdue():
+        time.sleep(0.1)
+    if os.path.exists(marker_path):
+        try:
+            with open(marker_path) as fh:
+                raw = json.load(fh)
+            drill["fence_epoch"] = int(raw.get("epoch", 0))
+            drill["fenced_at"] = float(raw.get("fenced_at", 0.0))
+        except (OSError, ValueError, TypeError):
+            drill["fence_epoch"] = 0
+        soak.note(f"r0 fenced at epoch {drill['fence_epoch']} "
+                  f"(false-dead: the daemon is alive behind the relay)")
+    t_limit = time.time() + 60
+    quarantine = None
+    while quarantine is None and time.time() < t_limit and not overdue():
+        evs = soak.replica_events("r0", "quarantine")
+        quarantine = evs[0] if evs else None
+        time.sleep(0.2)
+    if quarantine and drill["fenced_at"]:
+        drill["quarantine_to_park_s"] = round(
+            quarantine["ts"] - drill["fenced_at"], 3)
+        soak.note(f"r0 self-quarantined {drill['quarantine_to_park_s']}s "
+                  f"after the marker landed ({quarantine.get('parked')} "
+                  f"job(s) parked)")
+    soak.relay.heal()
+    soak.note("phase 1: partition healed — r0 must STAY out of the ring")
+    time.sleep(3.0)
+    st = soak.router_status()
+    if st:
+        r0 = (st.get("replicas") or {}).get("r0") or {}
+        drill["fenced_stays_out"] = r0.get("state") not in ("healthy",
+                                                            "suspect")
+
+    # ---- phase 2: zombie leader — SIGSTOP past the ttl --------------
+    if not overdue():
+        soak.note("phase 2: SIGSTOP active router past its lease ttl")
+        old_addr, old_proc = soak.addr, soak.proc
+        t_stop = time.time()
+        try:
+            os.kill(old_proc.pid, signal.SIGSTOP)
+        except OSError:
+            pass
+        soak.await_takeover(old_addr, t_stop)
+        soak.launch_standby()
+        # Deterministic stale-epoch matrix: prime every replica's
+        # watermark with the NEW leader's epoch, then replay at
+        # epoch-1 — each must answer the structured stale_epoch
+        # rejection (the fenced r0 included: the gate runs before the
+        # quarantine check).
+        lease_st = leader.read_lease(
+            os.path.join(soak.fleet, leader.LEASE_FILE))
+        epoch = lease_st.epoch if lease_st else 0
+        if epoch > 1:
+            for addr in client.fleet_addrs(soak.fleet):
+                drill["stale_probe_targets"] += 1
+                try:
+                    list(client.request(addr, {"op": "cancel",
+                                               "job_id": "fence-probe",
+                                               "router_epoch": epoch},
+                                        timeout=10.0))
+                    evs = list(client.request(
+                        addr, {"op": "cancel",
+                               "job_id": "fence-probe",
+                               "router_epoch": epoch - 1},
+                        timeout=10.0))
+                    if evs and evs[-1].get("error") == "stale_epoch":
+                        drill["stale_probe_rejects"] += 1
+                except (OSError, client.ServeConnectionLost):
+                    pass
+        # Wake the old leader: it is a zombie now (its renew fails),
+        # and every mutating command it still emits carries its stale
+        # epoch — the daemons must refuse each one.
+        try:
+            os.kill(old_proc.pid, signal.SIGCONT)
+        except OSError:
+            pass
+        try:
+            client.cancel(old_addr, "zombie-victim", timeout=30.0)
+        except (OSError, client.ServeConnectionLost):
+            pass
+        t_limit = time.time() + 20
+        while time.time() < t_limit and not overdue():
+            zr = [ev for ev in soak.router_events(("stale_epoch",))
+                  if ev.get("side") == "router"]
+            if zr:
+                drill["zombie_rejects"] = len(zr)
+                break
+            time.sleep(0.25)
+        soak.note(f"zombie drill: {drill['stale_probe_rejects']}/"
+                  f"{drill['stale_probe_targets']} replicas rejected "
+                  f"the stale epoch; {drill['zombie_rejects']} zombie "
+                  f"command(s) refused")
+        # A zombie is never shut down gracefully — its exit path would
+        # SIGTERM replicas now owned by the new leader. SIGKILL only.
+        try:
+            os.kill(old_proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        old_proc.wait()
+
+    # ---- phase 3: takeover chain with degraded-mode gaps ------------
+    for round_i in range(opts.takeovers):
+        if overdue():
+            break
+        soak.note(f"phase 3.{round_i}: SIGKILL active router "
+                  f"(takeover chain)")
+        old_addr, victim = soak.addr, soak.proc
+        t_kill = time.time()
+        try:
+            os.kill(victim.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        victim.wait()
+        dth = threading.Thread(target=soak.degraded_drill,
+                               args=(round_i, paths, native_ok),
+                               daemon=True)
+        dth.start()
+        deg_threads.append(dth)
+        soak.await_takeover(old_addr, t_kill)
+        soak.launch_standby()
+
+    # ---- drain ------------------------------------------------------
+    arr.join(timeout=60)
+    for th in threads:
+        th.join(timeout=120)
+    for th in deg_threads:
+        th.join(timeout=600)
+    while not overdue():
+        if soak.proc.poll() is not None:
+            # The active died without us killing it: the standby is the
+            # recovery path even here.
+            soak.note("active router self-death mid-drain — waiting "
+                      "for the standby")
+            if not soak.await_takeover(soak.addr, time.time()):
+                break
+            soak.launch_standby()
+        with soak.lock:
+            acked = set(soak.acks)
+        if acked <= set(soak.results()) and not soak.journal_ids():
+            break
+        time.sleep(0.5)
+    else:
+        budget_blown = True
+        soak.note("BUDGET BLOWN — abandoning the drill")
+    # Kill the waiting standby FIRST: a clean router shutdown releases
+    # the lease, and a live standby would take over and reboot the
+    # fleet the harness is about to stop.
+    if soak.standby is not None and soak.standby.poll() is None:
+        soak.standby.kill()
+        soak.standby.wait()
+    try:
+        client.shutdown(soak.addr)
+        soak.proc.wait(timeout=180)
+    except (OSError, client.ServeConnectionLost,
+            subprocess.TimeoutExpired):
+        soak.proc.kill()
+        soak.proc.wait()
+    soak.stop_fleet()
+    soak.relay.close()
+
+    # ---- accounting --------------------------------------------------
+    results = soak.results()
+    locations = soak.result_locations()
+    with soak.lock:
+        acks = dict(soak.acks)
+    lost = sorted(jid for jid in acks if jid not in results)
+    term_counts = soak.terminal_event_counts()
+    duplicated = sorted(set(
+        [jid for jid, c in term_counts.items() if c > 1]
+        + [jid for jid, where in locations.items() if len(where) > 1]))
+    by_status: Dict[str, int] = {}
+    for jid in acks:
+        st_ = results.get(jid, {}).get("status", "LOST")
+        by_status[st_] = by_status.get(st_, 0) + 1
+
+    # The fenced replica's silence: after the marker landed, r0 must
+    # never mint another terminal state or result record (quiesce-drain
+    # job_drained notices are fine — those are parks, not results).
+    r0_violations: List[str] = []
+    if drill["fenced_at"]:
+        for ev in soak.replica_events("r0", "job_state"):
+            if ev.get("state") in TERMINAL_STATES \
+                    and ev.get("ts", 0.0) > drill["fenced_at"] + 0.05:
+                r0_violations.append(
+                    f"terminal {ev.get('state')} for "
+                    f"{ev.get('job_id')} at +"
+                    f"{ev['ts'] - drill['fenced_at']:.2f}s")
+        resd = os.path.join(r0_state, "results")
+        if os.path.isdir(resd):
+            for fn in os.listdir(resd):
+                path = os.path.join(resd, fn)
+                try:
+                    if os.stat(path).st_mtime > \
+                            drill["fenced_at"] + 0.05:
+                        r0_violations.append(f"result file {fn} "
+                                             f"written after fencing")
+                except OSError:
+                    pass
+
+    failovers = soak.failover_events()
+    requeue_lat = [ev.get("latency_s", 0.0) for ev in failovers]
+    elected = soak.router_events(("leader_elected",))
+    daemon_stales = sum(
+        len([ev for ev in soak.replica_events(f"r{i}", "stale_epoch")
+             if ev.get("side") == "daemon"])
+        for i in range(opts.replicas))
+
+    byte_checked, byte_identical, mismatches = _byte_parity(
+        soak, acks, results, workdir, opts.verify)
+
+    ok = (not budget_blown and not lost and not duplicated
+          and not soak.unsubmitted and not soak.journal_ids()
+          and by_status.get("failed", 0) == 0
+          and byte_identical == byte_checked
+          and (drill["fence_epoch"] or 0) >= 1
+          and quarantine is not None
+          and not r0_violations
+          and drill["fenced_stays_out"]
+          and drill["stale_probe_targets"] > 0
+          and drill["stale_probe_rejects"]
+          == drill["stale_probe_targets"]
+          and drill["zombie_rejects"] >= 1
+          and len(soak.takeover_s) >= opts.takeovers + 1
+          and soak.degraded_status_ok >= 1
+          and soak.degraded_submits >= 1)
+    return {
+        "ok": ok, "mode": "partition", "seed": opts.seed, "jobs": n,
+        "replicas": opts.replicas, "lease_ttl_s": opts.lease_ttl,
+        "accepted": len(acks), "rejected": len(soak.rejected),
+        "unsubmitted": len(soak.unsubmitted),
+        "terminal_by_status": by_status,
+        "lost": lost, "duplicated": duplicated,
+        "journal_leftover": soak.journal_ids(),
+        "fence_epoch": drill["fence_epoch"],
+        "quarantine_to_park_s": drill["quarantine_to_park_s"],
+        "quarantine_parked": (quarantine or {}).get("parked"),
+        "fenced_replica_violations": r0_violations,
+        "fenced_stays_out": drill["fenced_stays_out"],
+        "stale_probe_rejects": drill["stale_probe_rejects"],
+        "stale_probe_targets": drill["stale_probe_targets"],
+        "zombie_rejects": drill["zombie_rejects"],
+        "daemon_stale_events": daemon_stales,
+        "leader_elections": len(elected),
+        "takeovers": len(soak.takeover_s),
+        "takeover_p50_s": _percentile(soak.takeover_s, 0.5),
+        "takeover_p99_s": _percentile(soak.takeover_s, 0.99),
+        "degraded_status_ok": soak.degraded_status_ok,
+        "degraded_submits": soak.degraded_submits,
+        "degraded_results_seen": soak.degraded_results_seen,
+        "failovers": len(failovers),
+        "requeue_p50_s": _percentile(requeue_lat, 0.5),
+        "requeue_p99_s": _percentile(requeue_lat, 0.99),
+        "replica_quiesce_rcs": soak.quiesce_rcs,
+        "byte_checked": byte_checked, "byte_identical": byte_identical,
+        "mismatches": mismatches,
+        "budget_blown": budget_blown,
+        "wall_s": round(time.time() - soak.t0, 1),
+    }
+
+
 def run_soak(opts, workdir: str) -> dict:
     from g2vec_tpu.data.synthetic import SyntheticSpec, write_synthetic_tsv
     from g2vec_tpu.serve import client
@@ -1681,7 +2446,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     workdir = opts.workdir or tempfile.mkdtemp(prefix="g2vec-chaos-")
     os.makedirs(workdir, exist_ok=True)
     try:
-        if opts.autoscale:
+        if opts.partition:
+            if opts.replicas < 2:
+                opts.replicas = 3
+            summary = run_partition_soak(opts, workdir)
+        elif opts.autoscale:
             if opts.replicas < 1:
                 opts.replicas = 1
             summary = run_autoscale_soak(opts, workdir)

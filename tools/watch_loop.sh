@@ -87,6 +87,23 @@ while [ "$arms" -lt "$MAX_ARMS" ] && [ "$(date +%s)" -lt "$DEADLINE" ]; do
             echo "[watch_loop] chaos soak green (arm $arms)"
         fi
     fi
+    # Partition drill (every 3rd arm, offset from the chaos soak): the
+    # control-plane storm — relay-blackholed replica fenced +
+    # self-quarantined, zombie-leader commands rejected by epoch,
+    # standby takeovers with degraded-mode clients in the gaps —
+    # shrunk to one takeover round to fit the arm. Non-fatal but loud:
+    # red here means split-brain protection regressed.
+    if [ $((arms % 3)) -eq 2 ]; then
+        if ! JAX_PLATFORMS=cpu G2V_CHAOS_JOBS=6 G2V_CHAOS_BUDGET=420 \
+                G2V_CHAOS_TAKEOVERS=1 G2V_CHAOS_STREAM_FRAC=0 \
+                G2V_CHAOS_VERIFY=1 \
+                "$PY" -m pytest tests/test_chaos.py -q -m partition \
+                -p no:cacheprovider >/tmp/partition_arm$arms.log 2>&1; then
+            echo "[watch_loop] WARNING: partition drill FAILED on arm $arms (log: /tmp/partition_arm$arms.log)"
+        else
+            echo "[watch_loop] partition drill green (arm $arms)"
+        fi
+    fi
     left_h=$("$PY" -c "import sys,time;print(max(0.1,(float(sys.argv[1])-time.time())/3600))" "$DEADLINE")
     WATCHER_MAX_HOURS="$left_h" "$PY" tools/chip_watcher.py
     if "$PY" tools/chip_watcher.py --check-complete; then
