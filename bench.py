@@ -245,6 +245,35 @@ QUERY_DURATION = float(os.environ.get("G2VEC_BENCH_QUERY_DURATION", "25"))
 QUERY_P99_MS = float(os.environ.get("G2VEC_BENCH_QUERY_P99_MS", "10"))
 QUERY_ARTIFACT = "BENCH_QUERY.json"
 
+# Approximate-NN query plane A/B (ops/ann.py + the serve read plane):
+# (a) in-process QPS frontier, IVF-approx vs exact full-scan, over
+# growing bundle sizes — acceptance is approx >= ANN_SPEEDUP_MIN x
+# exact QPS at the LARGEST size with approx per-query p99 under
+# ANN_P99_MS and recall@10 >= 0.95 at the default nprobe; (b) the
+# recall@10 curve over nprobe (ending at nprobe=nlist, which must be
+# bitwise-equal to exact); (c) a federated fquery storm against a live
+# router fleet with one bundle-owning replica SIGKILLed mid-window —
+# dead bundles keep answering from shared disk with replica_down
+# attribution and zero errors. Env-shrinkable.
+ANN_SIZES = os.environ.get("G2VEC_BENCH_ANN_SIZES",
+                           "8192,32768,131072,262144")
+ANN_HIDDEN = int(os.environ.get("G2VEC_BENCH_ANN_HIDDEN", "64"))
+ANN_QUERIES = int(os.environ.get("G2VEC_BENCH_ANN_QUERIES", "400"))
+ANN_RECALL_QUERIES = int(os.environ.get(
+    "G2VEC_BENCH_ANN_RECALL_QUERIES", "64"))
+ANN_NPROBES = os.environ.get("G2VEC_BENCH_ANN_NPROBES", "1,2,4,8,16,32")
+ANN_SPEEDUP_MIN = float(os.environ.get("G2VEC_BENCH_ANN_SPEEDUP_MIN", "5"))
+ANN_P99_MS = float(os.environ.get("G2VEC_BENCH_ANN_P99_MS", "10"))
+ANN_FED_REPLICAS = int(os.environ.get("G2VEC_BENCH_ANN_FED_REPLICAS", "3"))
+ANN_FED_BUNDLES = int(os.environ.get("G2VEC_BENCH_ANN_FED_BUNDLES", "6"))
+ANN_FED_GENES = int(os.environ.get("G2VEC_BENCH_ANN_FED_GENES", "6000"))
+ANN_FED_RATE = float(os.environ.get("G2VEC_BENCH_ANN_FED_RATE", "30"))
+ANN_FED_DURATION = float(os.environ.get("G2VEC_BENCH_ANN_FED_DURATION",
+                                        "15"))
+ANN_FED_P99_MS = float(os.environ.get("G2VEC_BENCH_ANN_FED_P99_MS", "100"))
+ANN_SEED = int(os.environ.get("G2VEC_BENCH_ANN_SEED", "0"))
+ANN_ARTIFACT = "BENCH_ANN.json"
+
 # Million-node shard-scale sweep (parallel/shard.py + train/shard.py):
 # "genes:ranks" cells, run as real multi-process fleets of
 # tests/shard_worker.py over the KV transport. The diagonal (constant
@@ -2269,6 +2298,359 @@ def _query_latency() -> None:
         sys.exit(1)
 
 
+def _ann_ab_line(note) -> dict:
+    """Approximate-NN query plane A/B — the PR 18 proof.
+
+    Three arms. (a) QPS frontier: for each bundle size in ANN_SIZES,
+    build the IVF index (stage-5-style clustered embeddings) and race
+    per-query latency of ops/ann.ivf_topk at the default nprobe against
+    ops/knn.cosine_topk full scans; the largest size must clear
+    ANN_SPEEDUP_MIN x with approx p99 under ANN_P99_MS and recall@10 at
+    the default nprobe >= 0.95 (the pinned contract, measured not
+    assumed). (b) Recall curve: recall@10 / candidate fraction / p50
+    over the ANN_NPROBES ladder at the largest size, ending at
+    nprobe=nlist where the result must be BITWISE equal to exact.
+    (c) Federated: plant indexed bundles across a real router fleet's
+    shared state dirs, boot it, and run a seeded gene_rank /
+    bundle_overlap storm with one bundle-owning replica SIGKILLed
+    mid-window — its bundles must keep answering from the router's
+    disk read path (replica_down=True partials) with zero errors.
+
+    No jax in this process: ops/ann + ops/knn are numpy by design and
+    the fleet children own their own interpreters.
+    """
+    import random
+    import shutil
+    import signal
+    import tempfile
+
+    import numpy as np
+
+    from g2vec_tpu.io.writers import write_inventory_bundle
+    from g2vec_tpu.ops import ann, knn
+    from g2vec_tpu.serve import client as sclient
+    from g2vec_tpu.serve import protocol
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH",
+                                                            "")}
+    rng = np.random.default_rng(ANN_SEED)
+    k = 10
+
+    def _pct(xs, q):
+        s = sorted(xs)
+        return round(s[min(len(s) - 1, int(round(q * (len(s) - 1))))], 3)
+
+    def make_data(g, h):
+        # Clustered like real stage-4 output: k-means structure is what
+        # an IVF index exploits, uniform noise would be adversarial.
+        ncl = max(32, int(round(g ** 0.5)))
+        centers = rng.standard_normal((ncl, h)).astype(np.float32)
+        emb = centers[rng.integers(0, ncl, size=g)]
+        emb += 0.3 * rng.standard_normal((g, h)).astype(np.float32)
+        return np.ascontiguousarray(emb, dtype=np.float32)
+
+    # ---- (a) QPS x bundle-size frontier -------------------------------
+    sizes = sorted(int(s) for s in ANN_SIZES.split(",") if s.strip())
+    frontier = []
+    emb = norms = index = None
+    for g in sizes:
+        emb = make_data(g, ANN_HIDDEN)
+        norms = knn.row_norms(emb)
+        # Auto past the row floor; forced for env-shrunk smoke sizes.
+        nlist = ann.resolve_nlist(g, 0) or ann.resolve_nlist(g, 64)
+        t0 = time.perf_counter()
+        cents, posts, offs = ann.build_ivf(emb, nlist)
+        build_s = time.perf_counter() - t0
+        index = ann.IVFIndex(cents, posts, offs, g, ANN_HIDDEN)
+        qidx = rng.integers(0, g, size=ANN_QUERIES)
+        for qi in qidx[:8]:     # warm both paths (allocator, BLAS)
+            knn.cosine_topk(emb, norms, emb[qi], k, exclude=int(qi))
+            ann.ivf_topk(emb, norms, index, emb[qi], k,
+                         nprobe=ann.DEFAULT_NPROBE, exclude=int(qi))
+        ex_ms, exact_ids = [], []
+        for qi in qidx:
+            t1 = time.perf_counter()
+            idx, _ = knn.cosine_topk(emb, norms, emb[qi], k,
+                                     exclude=int(qi))
+            ex_ms.append((time.perf_counter() - t1) * 1e3)
+            exact_ids.append(set(int(i) for i in idx))
+        ap_ms, hits, cands = [], 0, 0
+        for qi, ex in zip(qidx, exact_ids):
+            t1 = time.perf_counter()
+            idx, _, nc = ann.ivf_topk(emb, norms, index, emb[qi], k,
+                                      nprobe=ann.DEFAULT_NPROBE,
+                                      exclude=int(qi))
+            ap_ms.append((time.perf_counter() - t1) * 1e3)
+            hits += len(ex & set(int(i) for i in idx))
+            cands += nc
+        # Full-probe spot check: nprobe=nlist must be bitwise exact.
+        bitwise = True
+        for qi in qidx[:10]:
+            ei, es = knn.cosine_topk(emb, norms, emb[qi], k,
+                                     exclude=int(qi))
+            ai, as_, _ = ann.ivf_topk(emb, norms, index, emb[qi], k,
+                                      nprobe=nlist, exclude=int(qi))
+            bitwise &= (np.array_equal(ei, ai)
+                        and np.array_equal(es, as_))
+        row = {
+            "genes": g, "hidden": ANN_HIDDEN, "nlist": nlist,
+            "build_s": round(build_s, 3),
+            "exact_qps": round(len(ex_ms) / (sum(ex_ms) / 1e3), 1),
+            "approx_qps": round(len(ap_ms) / (sum(ap_ms) / 1e3), 1),
+            "exact_p50_ms": _pct(ex_ms, 0.5),
+            "exact_p99_ms": _pct(ex_ms, 0.99),
+            "approx_p50_ms": _pct(ap_ms, 0.5),
+            "approx_p99_ms": _pct(ap_ms, 0.99),
+            "recall_at_10": round(hits / (k * len(qidx)), 4),
+            "cand_frac": round(cands / (len(qidx) * g), 4),
+            "nprobe": ann.DEFAULT_NPROBE,
+            "bitwise_full_probe_ok": bool(bitwise),
+        }
+        row["speedup_x"] = round(row["approx_qps"]
+                                 / max(row["exact_qps"], 1e-9), 2)
+        frontier.append(row)
+        note(f"frontier g={g}: exact {row['exact_qps']} qps, approx "
+             f"{row['approx_qps']} qps ({row['speedup_x']}x), recall@10 "
+             f"{row['recall_at_10']}, cand {row['cand_frac']:.1%}, "
+             f"build {row['build_s']}s")
+    largest = frontier[-1]
+
+    # ---- (b) recall@10 curve over nprobe (largest size) ---------------
+    nprobes = sorted({int(s) for s in ANN_NPROBES.split(",") if s.strip()}
+                     | {largest["nlist"]})
+    g = largest["genes"]
+    qidx = rng.integers(0, g, size=ANN_RECALL_QUERIES)
+    exact_ids = [(qi, set(int(i) for i in knn.cosine_topk(
+        emb, norms, emb[qi], k, exclude=int(qi))[0])) for qi in qidx]
+    curve = []
+    for npr in nprobes:
+        ms, hits, cands = [], 0, 0
+        for qi, ex in exact_ids:
+            t1 = time.perf_counter()
+            idx, _, nc = ann.ivf_topk(emb, norms, index, emb[qi], k,
+                                      nprobe=npr, exclude=int(qi))
+            ms.append((time.perf_counter() - t1) * 1e3)
+            hits += len(ex & set(int(i) for i in idx))
+            cands += nc
+        curve.append({
+            "nprobe": npr,
+            "recall_at_10": round(hits / (k * len(exact_ids)), 4),
+            "cand_frac": round(cands / (len(exact_ids) * g), 4),
+            "p50_ms": _pct(ms, 0.5),
+        })
+        note(f"recall curve nprobe={npr}: recall@10 "
+             f"{curve[-1]['recall_at_10']}, cand "
+             f"{curve[-1]['cand_frac']:.1%}, p50 {curve[-1]['p50_ms']}ms")
+    emb = norms = index = None     # release before the fleet boots
+
+    # ---- (c) federated fquery storm with a mid-window SIGKILL ---------
+    prng = random.Random(ANN_SEED)
+    wd = tempfile.mkdtemp(prefix="g2v-ann-")
+    fleet = os.path.join(wd, "fleet")
+    router_log = os.path.join(wd, "router.log")
+    proc = None
+    try:
+        genes = [f"G{i:05d}" for i in range(ANN_FED_GENES)]
+        owners = {}
+        for b in range(ANN_FED_BUNDLES):
+            jid = f"i{b:012d}"
+            rep = f"r{b % ANN_FED_REPLICAS}"
+            dest = os.path.join(fleet, rep, "state", "inventory", jid,
+                                "v0")
+            bemb = make_data(ANN_FED_GENES, ANN_HIDDEN)
+            scores = rng.standard_normal((2, ANN_FED_GENES)).astype(
+                np.float32)
+            write_inventory_bundle(dest, bemb, genes, scores,
+                                   {"source": "bench"}, ann_nlist=64)
+            owners[jid] = rep
+        jids = sorted(owners)
+        note(f"planted {len(jids)} indexed bundles "
+             f"({ANN_FED_GENES} genes each) over "
+             f"{ANN_FED_REPLICAS} replica state dirs")
+
+        argv = [sys.executable, "-m", "g2vec_tpu", "serve",
+                "--replicas", str(ANN_FED_REPLICAS),
+                "--listen", "127.0.0.1:0", "--state-dir", fleet,
+                "--platform", "cpu",
+                "--probe-interval", "0.4", "--probe-deadline", "3.0",
+                "--metrics-jsonl", os.path.join(wd, "metrics.jsonl")]
+        log = open(router_log, "a")
+        proc = subprocess.Popen(argv, env=env, stdout=log,
+                                stderr=subprocess.STDOUT)
+        log.close()
+        addr_file = os.path.join(fleet, "router_addr")
+        deadline = time.time() + 600
+        addr = None
+        while time.time() < deadline:
+            if os.path.exists(addr_file):
+                with open(addr_file) as f:
+                    addr = f.read().strip()
+                if addr:
+                    break
+            if proc.poll() is not None:
+                raise RuntimeError(f"router died during boot (rc="
+                                   f"{proc.returncode}; log: "
+                                   f"{router_log})")
+            time.sleep(0.2)
+        if not addr:
+            raise RuntimeError(f"router never bound (log: {router_log})")
+        pids = {}
+        while time.time() < deadline and len(pids) < ANN_FED_REPLICAS:
+            st = sclient.status(addr, timeout=10.0)
+            pids = {n: r.get("pid")
+                    for n, r in (st.get("replicas") or {}).items()
+                    if r.get("pid")}
+            time.sleep(0.3)
+        note(f"router up at {addr} ({len(pids)} replicas alive)")
+
+        # Cold pass: first touch maps every bundle (mmap + manifest
+        # sha256 + index map) on its home replica.
+        cold = []
+        for jid in jids:
+            t1 = time.time()
+            resp = sclient.query(addr, "neighbors", job_id=jid,
+                                 gene=genes[0], k=10, timeout=60.0)
+            if resp.get("event") != "query_result":
+                raise RuntimeError(f"cold query failed: {resp}")
+            if resp.get("recall_mode") != "approx":
+                raise RuntimeError(
+                    f"bundle {jid} not serving approx: {resp}")
+            cold.append((time.time() - t1) * 1e3)
+        note(f"cold first-touch: p50 {_pct(cold, 0.5)}ms over "
+             f"{len(cold)} bundles (all recall_mode=approx)")
+
+        victim = owners[jids[0]]
+        victim_pid = pids.get(victim)
+        kill_at = time.time() + ANN_FED_DURATION * 0.4
+        killed = False
+        lat = {"gene_rank": [], "bundle_overlap": []}
+        errors = []
+        down_partials = 0
+        down_bundles = set()
+        recall_modes = {}
+        end = time.time() + ANN_FED_DURATION
+        while time.time() < end:
+            if not killed and time.time() >= kill_at and victim_pid:
+                note(f"SIGKILL replica {victim} (pid {victim_pid}) "
+                     f"mid-window")
+                try:
+                    os.kill(victim_pid, signal.SIGKILL)
+                except OSError:
+                    pass
+                killed = True
+            fq = prng.choice(("gene_rank", "gene_rank",
+                              "bundle_overlap"))
+            kw = {"gene": prng.choice(genes)}
+            if fq == "gene_rank":
+                kw["k"] = 50
+            else:
+                kw.update(k=20, job_id=prng.choice(jids))
+            t1 = time.time()
+            try:
+                ev = sclient.fquery(addr, fq, timeout=30.0, **kw)
+            except (OSError, protocol.ProtocolError) as e:
+                errors.append(f"{type(e).__name__}: {e}"[:120])
+                continue
+            if ev.get("event") != "fquery_result":
+                errors.append(str(ev)[:120])
+                continue
+            lat[fq].append((time.time() - t1) * 1e3)
+            for p in ev.get("bundles") or []:
+                rm = p.get("recall_mode")
+                if rm:
+                    recall_modes[rm] = recall_modes.get(rm, 0) + 1
+                if p.get("replica_down"):
+                    down_partials += 1
+                    down_bundles.add(p.get("bundle"))
+            time.sleep(prng.expovariate(ANN_FED_RATE))
+
+        all_ms = lat["gene_rank"] + lat["bundle_overlap"]
+        fed_p99 = _pct(all_ms, 0.99) if all_ms else None
+        victim_bundles = {f"{j}/v0" for j, r in owners.items()
+                          if r == victim}
+        fed_ok = (killed and not errors and bool(all_ms)
+                  and fed_p99 is not None and fed_p99 < ANN_FED_P99_MS
+                  and victim_bundles <= down_bundles)
+        fed = {
+            "replicas": ANN_FED_REPLICAS, "bundles": len(jids),
+            "genes_per_bundle": ANN_FED_GENES,
+            "fqueries": len(all_ms), "fquery_errors": len(errors),
+            "errors_sample": errors[:5],
+            "cold_p50_ms": _pct(cold, 0.5),
+            "gene_rank_p50_ms": _pct(lat["gene_rank"], 0.5)
+            if lat["gene_rank"] else None,
+            "gene_rank_p99_ms": _pct(lat["gene_rank"], 0.99)
+            if lat["gene_rank"] else None,
+            "overlap_p50_ms": _pct(lat["bundle_overlap"], 0.5)
+            if lat["bundle_overlap"] else None,
+            "overlap_p99_ms": _pct(lat["bundle_overlap"], 0.99)
+            if lat["bundle_overlap"] else None,
+            "p99_ms": fed_p99, "p99_budget_ms": ANN_FED_P99_MS,
+            "replica_killed": victim if killed else None,
+            "replica_down_partials": down_partials,
+            "replica_down_bundles": sorted(down_bundles),
+            "recall_modes": recall_modes,
+            "ok": fed_ok,
+        }
+        note(f"federated: {len(all_ms)} fqueries, p99 {fed_p99}ms, "
+             f"{down_partials} replica_down partials over "
+             f"{sorted(down_bundles)}, recall modes {recall_modes}")
+    finally:
+        if proc is not None and proc.poll() is None:
+            try:
+                from g2vec_tpu.serve import client as sclient2
+
+                with open(os.path.join(fleet, "router_addr")) as f:
+                    sclient2.shutdown(f.read().strip(), timeout=15.0)
+            except Exception:
+                pass
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        shutil.rmtree(wd, ignore_errors=True)
+
+    ok = (largest["speedup_x"] >= ANN_SPEEDUP_MIN
+          and largest["approx_p99_ms"] < ANN_P99_MS
+          and largest["recall_at_10"] >= 0.95
+          and all(r["bitwise_full_probe_ok"] for r in frontier)
+          and curve[-1]["recall_at_10"] == 1.0
+          and fed_ok)
+    return {
+        "metric": "ann_approx_speedup_x", "value": largest["speedup_x"],
+        "unit": "x", "ok": ok,
+        "speedup_min_x": ANN_SPEEDUP_MIN, "p99_budget_ms": ANN_P99_MS,
+        "recall_contract": 0.95, "k": k, "seed": ANN_SEED,
+        "frontier": frontier, "recall_curve": curve, "federated": fed,
+        "note": "frontier: per-query approx (IVF, default nprobe) vs "
+                "exact full-scan QPS on clustered embeddings; recall "
+                "curve ends at nprobe=nlist (bitwise-equal to exact); "
+                "federated: seeded gene_rank/bundle_overlap storm vs a "
+                "live router fleet, one bundle-owning replica "
+                "SIGKILLed mid-window, its bundles answered from the "
+                "router's shared-disk read path (replica_down=True)",
+    }
+
+
+def _ann_ab() -> None:
+    """Standalone mode: run the approximate-NN A/B and refresh the
+    committed artifact."""
+    def note(msg):
+        print(f"# {msg}", file=sys.stderr, flush=True)
+
+    line = _ann_ab_line(note)
+    print(json.dumps(line), flush=True)
+    repo = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(repo, ANN_ARTIFACT), "w") as f:
+        json.dump({"line": line, "code_key": _current_code_key(repo),
+                   "written_by": "bench.py --_ann_ab"}, f, indent=1)
+    note(f"wrote {ANN_ARTIFACT}")
+    if not line["ok"]:
+        sys.exit(1)
+
+
 def _shard_scale_line(note) -> dict:
     """Million-node shard-scale sweep — ROADMAP item 2's headline.
 
@@ -3645,6 +4027,8 @@ if __name__ == "__main__":
         _autoscale_ab()
     elif "--_query_latency" in sys.argv:
         _query_latency()
+    elif "--_ann_ab" in sys.argv:
+        _ann_ab()
     elif "--_chaos_soak" in sys.argv:
         _chaos_soak()
     elif "--_shard_scale" in sys.argv:

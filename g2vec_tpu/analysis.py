@@ -112,7 +112,8 @@ def _renumber(km_idx: jax.Array, good: jax.Array, poor: jax.Array) -> jax.Array:
 
 def find_lgroups_device(embeddings, freq_idx: np.ndarray, *, key,
                         k: int = 3, compat_tiebreak: bool = False,
-                        n_init: int = 10, iters: int = 50) -> jax.Array:
+                        n_init: int = 10, iters: int = 50,
+                        return_centers: bool = False):
     """:func:`find_lgroups` staying ON DEVICE end to end.
 
     ``embeddings`` may be a device array (the trainer's snapshot slice) or
@@ -120,23 +121,29 @@ def find_lgroups_device(embeddings, freq_idx: np.ndarray, *, key,
     only at the writer boundary. The former host round trip (np.asarray
     before the jitted k-means, np.bincount/count_nonzero after) now moves
     three [k]-int vectors instead of three [G]-sized arrays.
+
+    ``return_centers`` additionally returns the winning restart's [k, d]
+    centers — the ANN coarse quantizer's seed (ops/ann.build_ivf), free
+    here because k-means already computed them.
     """
     from g2vec_tpu.ops.kmeans import kmeans
 
     if k < 3:
         raise ValueError(f"find_lgroups needs k >= 3 (good/poor/other), got {k}")
-    km_idx, _, _ = kmeans(embeddings, k, key, n_init=n_init, iters=iters)
+    km_idx, centers, _ = kmeans(embeddings, k, key, n_init=n_init,
+                                iters=iters)
     counts, good, poor = _vote_counts(km_idx, jnp.asarray(freq_idx), k)
     good_cluster, poor_cluster = _pick_clusters(
         np.asarray(counts), np.asarray(good), np.asarray(poor), k,
         compat_tiebreak)
-    return _renumber(km_idx, good_cluster, poor_cluster)
+    out = _renumber(km_idx, good_cluster, poor_cluster)
+    return (out, centers) if return_centers else out
 
 
 def find_lgroups_lanes(emb_stack, freq_stack: np.ndarray,
                        kmeans_seeds: Sequence[int], *, k: int = 3,
                        compat_tiebreak: bool = False, n_init: int = 10,
-                       iters: int = 50) -> jax.Array:
+                       iters: int = 50, return_centers: bool = False):
     """Lane-batched stage 5: one vmapped k-means program over the [B, G, H]
     embedding stack (every lane shares the gene axis, so the batched shape
     is manifest-invariant), per-lane k-means keys, the host vote per lane
@@ -151,15 +158,17 @@ def find_lgroups_lanes(emb_stack, freq_stack: np.ndarray,
         raise ValueError(f"find_lgroups needs k >= 3 (good/poor/other), got {k}")
     keys = jax.vmap(jax.random.key)(
         jnp.asarray(list(kmeans_seeds), dtype=jnp.uint32))
-    km, _, _ = _kmeans_lanes(emb_stack, keys, k, n_init, iters)  # [B, G]
+    # [B, G] labels, [B, k, d] per-lane winning centers
+    km, centers, _ = _kmeans_lanes(emb_stack, keys, k, n_init, iters)
     counts, good, poor = _vote_counts_lanes(km, jnp.asarray(freq_stack), k)
     counts, good, poor = (np.asarray(counts), np.asarray(good),
                           np.asarray(poor))
     picks = np.array([_pick_clusters(counts[b], good[b], poor[b], k,
                                      compat_tiebreak)
                       for b in range(km.shape[0])], dtype=np.int32)
-    return _renumber(km, jnp.asarray(picks[:, 0:1]),
-                     jnp.asarray(picks[:, 1:2]))
+    out = _renumber(km, jnp.asarray(picks[:, 0:1]),
+                    jnp.asarray(picks[:, 1:2]))
+    return (out, centers) if return_centers else out
 
 
 def find_lgroups(embeddings: np.ndarray, genes: Sequence[str],

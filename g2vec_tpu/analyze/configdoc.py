@@ -42,6 +42,7 @@ _PAYLOAD_FILES = ("g2vec_tpu/serve/daemon.py",
 #: reads are linted against.
 _ENVELOPES = {"payload": "SUBMIT_KEYS",
               "qreq": "QUERY_KEYS",
+              "fqreq": "FQUERY_KEYS",
               "rreq": "RESULT_KEYS"}
 
 

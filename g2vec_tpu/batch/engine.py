@@ -868,29 +868,33 @@ def _execute_lanes(engine: ResidentEngine, cfg: G2VecConfig,
                                for li in range(n_lanes)])
         lgroup_host = [None] * n_lanes
         lg_dev: List = [None] * n_lanes
+        km_centers: List = [None] * n_lanes   # per-lane [k, H] ANN seeds
         with timer.stage("lgroups"):
             for lo in range(0, n_lanes, cfg.lanes):
                 idx = list(range(lo, min(lo + cfg.lanes, n_lanes)))
                 if len(idx) == 1 and n_lanes == 1:
                     from g2vec_tpu.analysis import find_lgroups_device
 
-                    lg = find_lgroups_device(
+                    lg, kc = find_lgroups_device(
                         lane_emb[idx[0]], freq_stack[idx[0]],
                         key=jax.random.key(variants[idx[0]].kmeans_seed),
                         k=cfg.n_lgroups,
                         compat_tiebreak=cfg.compat_lgroup_tiebreak,
-                        iters=cfg.kmeans_iters)
+                        iters=cfg.kmeans_iters, return_centers=True)
                     lg_dev[idx[0]] = lg
+                    km_centers[idx[0]] = np.asarray(kc, dtype=np.float32)
                     continue
                 stack = jnp.stack([lane_emb[li] for li in idx])
-                lg = find_lgroups_lanes(
+                lg, kc = find_lgroups_lanes(
                     stack, freq_stack[idx],
                     [variants[li].kmeans_seed for li in idx],
                     k=cfg.n_lgroups,
                     compat_tiebreak=cfg.compat_lgroup_tiebreak,
-                    iters=cfg.kmeans_iters)
+                    iters=cfg.kmeans_iters, return_centers=True)
+                kc_host = np.asarray(kc, dtype=np.float32)
                 for b, li in enumerate(idx):
                     lg_dev[li] = lg[b]
+                    km_centers[li] = kc_host[b]
 
         console(">>> [batch] 6. Select biomarkers (vmapped per cohort)")
         fault_point("biomarkers")
@@ -952,7 +956,8 @@ def _execute_lanes(engine: ResidentEngine, cfg: G2VecConfig,
                     train_history=r.history, acc_val=r.acc_val,
                     walker_backend=walker_backend,
                     sampler_threads=sampler_threads,
-                    biomarker_scores=scores_host[li]))
+                    biomarker_scores=scores_host[li],
+                    km_centers=km_centers[li]))
                 lane_metrics[li].emit("done", outputs=outputs,
                                       stop_epoch=r.stop_epoch)
                 for path in outputs:

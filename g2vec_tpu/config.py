@@ -229,6 +229,10 @@ class G2VecConfig:
                                      # offline run is servable by
                                      # pointing `g2vec serve
                                      # --inventory-dir` at its directory
+    ann_nlist: int = 0               # IVF list count for the bundle's ANN
+                                     # index: 0 auto (~sqrt(G) past the
+                                     # row floor), >0 forced, <0 disabled
+                                     # (ops/ann.resolve_nlist)
 
     # ---- resilience (resilience/) ----
     supervise: bool = False          # wrap the run in the auto-resume
@@ -948,6 +952,13 @@ def build_parser() -> argparse.ArgumentParser:
                              "identical to what the serve daemon "
                              "publishes for the same config; `g2vec "
                              "serve --inventory-dir` makes it queryable.")
+    parser.add_argument("--ann-nlist", type=int, default=0, metavar="N",
+                        help="IVF list count for --emit-inventory's ANN "
+                             "index: 0 (default) auto-sizes to ~sqrt(G) "
+                             "once the bundle clears the row floor, N>0 "
+                             "forces N lists, N<0 disables the build. "
+                             "Seeded from the run's k-means centroids "
+                             "when shapes permit.")
     parser.add_argument("--no-native-io", action="store_true",
                         help="Disable the C++ TSV reader.")
     parser.add_argument("--debug-nans", action="store_true")
@@ -1084,6 +1095,7 @@ def config_from_args(argv=None) -> G2VecConfig:
         checkpoint_layout=args.checkpoint_layout,
         metrics_jsonl=args.metrics_jsonl,
         emit_inventory=args.emit_inventory,
+        ann_nlist=args.ann_nlist,
         use_native_io=not args.no_native_io,
         debug_nans=args.debug_nans,
         supervise=args.supervise,

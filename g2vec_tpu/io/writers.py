@@ -147,7 +147,9 @@ def write_stability(result_name: str, scenario: str,
 def write_inventory_bundle(bundle_dir: str, embeddings: np.ndarray,
                            genes: Sequence[str],
                            scores: Optional[np.ndarray],
-                           meta: dict) -> str:
+                           meta: dict, ann_nlist: int = 0,
+                           seed_centroids: Optional[np.ndarray] = None
+                           ) -> str:
     """Publish one query-plane bundle at ``bundle_dir`` (atomically).
 
     The whole bundle is staged in a ``.tmp.<pid>`` sibling and renamed
@@ -161,7 +163,17 @@ def write_inventory_bundle(bundle_dir: str, embeddings: np.ndarray,
     durable record's text outputs (the ``[2, G]`` score matrix is not
     recoverable from them); ``meta["has_scores"]`` records which kind
     this bundle is.
+
+    ``ann_nlist`` gates the IVF index build (ops/ann.py:resolve_nlist —
+    0 auto-indexes large bundles, <0 disables, >0 forces a list count);
+    when an index is built its three files are sha256'd into the SAME
+    manifest as the exact arrays and ``meta["ann"]`` records the build.
+    ``seed_centroids`` (the stage-5 k-means centers, when the caller
+    has them) seed the coarse quantizer for free; any shape mismatch
+    silently falls back to the deterministic row seeding.
     """
+    import time as _time
+
     from g2vec_tpu.utils.integrity import sha256_file, write_json_atomic
 
     embeddings = np.asarray(embeddings, dtype=np.float32)
@@ -169,7 +181,9 @@ def write_inventory_bundle(bundle_dir: str, embeddings: np.ndarray,
         raise ValueError(
             f"write_inventory_bundle: embeddings {embeddings.shape} vs "
             f"{len(genes)} genes")
+    from g2vec_tpu.ops import ann as ann_ops
     from g2vec_tpu.ops.knn import row_norms
+    from g2vec_tpu.resilience.faults import fault_point
 
     bundle_dir = os.path.abspath(bundle_dir)
     tmp = f"{bundle_dir}.tmp.{os.getpid()}"
@@ -190,9 +204,30 @@ def write_inventory_bundle(bundle_dir: str, embeddings: np.ndarray,
     with open(os.path.join(tmp, "genes.txt"), "w") as fout:
         for gene in genes:
             fout.write("%s\n" % gene)
+    nlist = ann_ops.resolve_nlist(embeddings.shape[0], ann_nlist)
+    ann_meta = None
+    if nlist:
+        t0 = _time.perf_counter()
+        centroids, postings, offsets = ann_ops.build_ivf(
+            embeddings, nlist, seed_centroids=seed_centroids)
+        np.save(os.path.join(tmp, "ann_centroids.npy"), centroids,
+                allow_pickle=False)
+        np.save(os.path.join(tmp, "ann_postings.npy"), postings,
+                allow_pickle=False)
+        np.save(os.path.join(tmp, "ann_offsets.npy"), offsets,
+                allow_pickle=False)
+        ann_meta = {"format": ann_ops.ANN_FORMAT, "nlist": int(nlist),
+                    "nprobe_default": ann_ops.DEFAULT_NPROBE,
+                    "seeded": bool(
+                        seed_centroids is not None
+                        and np.asarray(seed_centroids).ndim == 2
+                        and np.asarray(seed_centroids).shape[1]
+                        == embeddings.shape[1]),
+                    "build_ms": round(
+                        (_time.perf_counter() - t0) * 1000.0, 3)}
     meta = dict(meta, n_genes=int(embeddings.shape[0]),
                 hidden=int(embeddings.shape[1]),
-                has_scores=scores is not None)
+                has_scores=scores is not None, ann=ann_meta)
     write_json_atomic(os.path.join(tmp, "meta.json"), meta)
     files = {}
     for name in sorted(os.listdir(tmp)):
@@ -200,6 +235,13 @@ def write_inventory_bundle(bundle_dir: str, embeddings: np.ndarray,
                        "bytes": os.path.getsize(os.path.join(tmp, name))}
     write_json_atomic(os.path.join(tmp, INVENTORY_MANIFEST),
                       {"format": "g2vec-inventory-v1", "files": files})
+    if nlist:
+        # AFTER the manifest, BEFORE the rename: a kind=corrupt here
+        # publishes a bundle whose index bytes no longer match their
+        # manifest hash — the torn-index drill the lenient map path
+        # (serve/inventory.py) must catch and downgrade to exact.
+        fault_point("ann_build",
+                    path=os.path.join(tmp, "ann_postings.npy"))
     shutil.rmtree(bundle_dir, ignore_errors=True)
     os.makedirs(os.path.dirname(bundle_dir), exist_ok=True)
     os.rename(tmp, bundle_dir)

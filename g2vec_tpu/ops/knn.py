@@ -96,6 +96,50 @@ def cosine_topk(emb: np.ndarray, norms: np.ndarray, q: np.ndarray,
     return idx, sims[idx]
 
 
+def cosine_topk_subset(emb: np.ndarray, norms: np.ndarray,
+                       rows: np.ndarray, q: np.ndarray, k: int,
+                       exclude: int = -1, block_rows: int = 8192
+                       ) -> "tuple[np.ndarray, np.ndarray]":
+    """:func:`cosine_topk` restricted to a candidate subset of rows.
+
+    ``rows`` MUST be sorted ascending and duplicate-free (the IVF
+    probe in ops/ann.py produces exactly that); sortedness is what
+    makes tie-breaking identical to the full kernel — position order
+    within the candidate score vector IS ascending global row id, so
+    ``_topk_desc``'s ascending-position tie rule resolves ties by
+    ascending global index, same as the exact path.
+
+    Float-exactness contract (pinned by tests/test_ann.py): each
+    candidate row's score is computed with the SAME arithmetic as
+    :func:`cosine_topk` — one row dot ``emb[r] @ q``, the same
+    ``np.where`` zero-norm guard, the same ``-inf`` exclude — and a
+    row's dot product does not depend on which other rows share its
+    block. So whenever the true top-k rows are all in ``rows``, the
+    returned (idx, sims) equal the exact kernel's bitwise.
+    """
+    g, h = emb.shape
+    rows = np.asarray(rows, dtype=np.int64).reshape(-1)
+    m = rows.shape[0]
+    q = np.asarray(q, dtype=np.float32).reshape(h)
+    qn = np.sqrt(np.dot(q, q))
+    sims = np.empty(m, dtype=np.float32)
+    for lo in range(0, m, block_rows):
+        hi = min(m, lo + block_rows)
+        # Fancy-indexed gather materializes one candidate slab at a
+        # time; a memory-mapped ``emb`` faults only the touched pages.
+        block = np.asarray(emb[rows[lo:hi]], dtype=np.float32)
+        sims[lo:hi] = block @ q
+    denom = np.asarray(norms, dtype=np.float32)[rows] * qn
+    ok = denom > 0
+    sims = np.where(ok, sims / np.where(ok, denom, 1), np.float32(-2.0))
+    if 0 <= exclude < g:
+        pos = np.searchsorted(rows, exclude)
+        if pos < m and rows[pos] == exclude:
+            sims[pos] = -np.inf
+    loc = _topk_desc(sims, k)
+    return rows[loc], sims[loc]
+
+
 def topk_scores(scores: np.ndarray, k: int
                 ) -> "tuple[np.ndarray, np.ndarray]":
     """Top-k indices of a 1-D score vector by partial select.

@@ -25,6 +25,7 @@ Differences from the reference, all deliberate (SURVEY.md §7):
 from __future__ import annotations
 
 import dataclasses
+import json
 import os
 from typing import Callable, Dict, List, Optional
 
@@ -77,6 +78,12 @@ class PipelineResult:
                                  # so the serve daemon can publish the
                                  # inventory bundle without recomputing
                                  # stage 6
+    km_centers: Optional[np.ndarray] = None
+                                 # [k, hidden] float32 stage-5 k-means
+                                 # centers (winning restart) — seeds the
+                                 # bundle's IVF coarse quantizer
+                                 # (ops/ann.build_ivf) when hidden
+                                 # matches; None for sharded runs
 
 
 def _background_warm(fn, console):
@@ -787,6 +794,8 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print,
         import jax.numpy as jnp
 
         embed_sharded = shard_ctx is not None and shard_ctx.spec.embed_split
+        km_centers = None   # stage-5 centers (ANN seed); sharded runs
+                            # never materialize them whole
         if embed_sharded:
             # Gene-range-sharded stages 5-6 (ROADMAP item 2): every
             # array below is this rank's [g_local] slice; only
@@ -844,10 +853,13 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print,
             else:
                 emb = result.w_ih
             with timer.stage("lgroups"):
-                lgroup_dev = find_lgroups_device(
+                lgroup_dev, km_centers_dev = find_lgroups_device(
                     emb, freq_index(data.gene, gene_freq),
                     key=jax.random.key(cfg.kmeans_seed), k=cfg.n_lgroups,
-                    compat_tiebreak=cfg.compat_lgroup_tiebreak, iters=cfg.kmeans_iters)
+                    compat_tiebreak=cfg.compat_lgroup_tiebreak,
+                    iters=cfg.kmeans_iters, return_centers=True)
+                km_centers = np.asarray(km_centers_dev,
+                                        dtype=np.float32)
             _stage_edge("lgroups")
 
             console(">>> 6. Select biomarkers with gene scores")
@@ -923,13 +935,28 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print,
                         np.asarray(result.w_ih, dtype=np.float32),
                         list(data.gene), scores2,
                         {"source": "solo",
-                         "result_name": os.path.basename(cfg.result_name)})
+                         "result_name": os.path.basename(cfg.result_name)},
+                        ann_nlist=cfg.ann_nlist,
+                        seed_centroids=km_centers)
                     console("    %s" % bundle)
                     metrics.emit(
                         "inventory", bundle=os.path.basename(bundle),
                         bytes=sum(os.path.getsize(os.path.join(bundle, f))
                                   for f in os.listdir(bundle)),
                         outcome="published")
+                    with open(os.path.join(bundle, "meta.json")) as mf:
+                        ann_meta = json.load(mf).get("ann")
+                    if ann_meta:
+                        metrics.emit(
+                            "ann_build", bundle=os.path.basename(bundle),
+                            nlist=ann_meta.get("nlist"), outcome="built",
+                            ms=ann_meta.get("build_ms"),
+                            seeded=ann_meta.get("seeded"),
+                            postings=n_genes)
+                    else:
+                        metrics.emit(
+                            "ann_build", bundle=os.path.basename(bundle),
+                            nlist=0, outcome="skipped")
         _stage_edge("save")
         for path in outputs:
             console("    %s" % path)
@@ -957,7 +984,8 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print,
             walk_cache_hits=walk_cache_hits,
             stream_stats=(sres.stats.as_dict()
                           if cfg.train_mode == "streaming" else {}),
-            edge_stats=edge_attrib, biomarker_scores=scores2)
+            edge_stats=edge_attrib, biomarker_scores=scores2,
+            km_centers=km_centers)
     finally:
         if overlap is not None:
             # Drain, never raise: the exception in flight (if any) is the

@@ -123,7 +123,16 @@ SEAMS = ("load", "preprocess", "paths", "train", "lgroups", "biomarkers",
          # ``halo_build`` fires between the halo want-list round and the
          # row-ship round (epoch = group index) — a dead row SERVER at
          # setup time, named by its requesters' deadline expiry.
-         "walk_handoff", "halo_build")
+         "walk_handoff", "halo_build",
+         # ANN index publication (io/writers.py): fires after the bundle
+         # manifest is sealed and before the atomic rename, with the
+         # staged ann_postings.npy as the path — so kind=corrupt models
+         # a published bundle whose IVF index bytes mismatch their
+         # manifest hash. The query plane's contract (tests/test_ann.py
+         # corrupt drill): the index is refused at map time with a
+         # structured warning and queries fall back to the exact path —
+         # a corrupted index can never change answers.
+         "ann_build")
 
 
 class FaultPlanError(ValueError):

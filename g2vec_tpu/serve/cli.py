@@ -25,8 +25,12 @@ UNIX path or a TCP host:port — a daemon or the router)::
     g2vec serve --socket host:7433 --drain-replica r1
     g2vec serve --socket host:7433 --query list
     g2vec serve --socket host:7433 --query neighbors --query-job i1234 \\
-        --query-gene TP53 --query-k 10 [--query-variant v]
+        --query-gene TP53 --query-k 10 [--query-variant v] \\
+        [--exact | --nprobe N]
     g2vec serve --socket host:7433 --query topk_biomarkers --query-job i1234
+    g2vec serve --socket host:7433 --fquery gene_rank --query-gene TP53
+    g2vec serve --socket host:7433 --fquery bundle_overlap \\
+        --query-gene TP53 --query-job i1234 [--query-k 50]
     g2vec serve --socket host:7433 --result JOB_ID \\
         [--fields event,variants] [--max-bytes 65536]
 
@@ -215,6 +219,14 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "inventory — point the daemon at a directory of "
                         "solo --emit-inventory bundles to make them "
                         "queryable.")
+    p.add_argument("--ann-nlist", type=int, default=0, metavar="N",
+                   help="IVF coarse-quantizer list count for published "
+                        "bundle indexes: 0 (default) auto-sizes to "
+                        "~sqrt(G) once a bundle clears the row floor, "
+                        "N>0 forces N lists on every bundle, N<0 "
+                        "disables index builds entirely. Approx "
+                        "queries on index-less bundles silently serve "
+                        "exact.")
     p.add_argument("--max-result-bytes", type=int, default=0, metavar="N",
                    help="Server-side cap on one 'result' response "
                         "(default 0 = the 8 MiB line bound); over-cap "
@@ -284,6 +296,25 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--query-k", type=int, default=10, metavar="K",
                    help="Result count for --query neighbors / "
                         "topk_biomarkers (default 10).")
+    p.add_argument("--exact", action="store_true",
+                   help="Force the exact scan for --query neighbors "
+                        "(mode=exact), bypassing any ANN index — the "
+                        "ground-truth baseline for the approx plane.")
+    p.add_argument("--nprobe", type=int, default=0, metavar="N",
+                   help="IVF lists probed per approx neighbors query "
+                        "(default 0 = the server's default; values >= "
+                        "nlist are exact-equivalent).")
+    p.add_argument("--fquery", type=str, default=None,
+                   choices=("gene_rank", "bundle_overlap"),
+                   help="Client mode: one federated cross-bundle query "
+                        "— gene_rank ('which bundles rank --query-gene "
+                        "in their top --query-k biomarkers') or "
+                        "bundle_overlap ('bundles nearest the "
+                        "reference bundle by neighbor-set overlap'; "
+                        "the reference is --query-job/--query-variant). "
+                        "Routed, it scatter-gathers across the fleet; "
+                        "dead replicas' bundles answer from shared "
+                        "disk with replica_down attribution.")
     p.add_argument("--result", type=str, default=None, metavar="JOB_ID",
                    help="Client mode: fetch a job's durable terminal "
                         "record via the 'result' op.")
@@ -313,7 +344,7 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
 
     if args.status or args.ping or args.shutdown or args.submit \
             or args.cancel or args.drain or args.drain_replica \
-            or args.query or args.result:
+            or args.query or args.fquery or args.result:
         if not args.socket:
             build_serve_parser().error(
                 "client ops need --socket (a UNIX path or host:port)")
@@ -346,9 +377,24 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
                                   job_id=args.query_job,
                                   variant=args.query_variant,
                                   gene=args.query_gene,
-                                  k=args.query_k, auth_token=token)
+                                  k=args.query_k,
+                                  mode=("exact" if args.exact else None),
+                                  nprobe=(args.nprobe or None),
+                                  auth_token=token)
                 print(json.dumps(ev, indent=1))
                 return 0 if ev.get("event") == "query_result" else 4
+            if args.fquery:
+                ev = client.fquery(args.socket, args.fquery,
+                                   args.query_gene,
+                                   k=args.query_k,
+                                   mode=("exact" if args.exact
+                                         else None),
+                                   nprobe=(args.nprobe or None),
+                                   job_id=args.query_job,
+                                   variant=args.query_variant,
+                                   auth_token=token)
+                print(json.dumps(ev, indent=1))
+                return 0 if ev.get("event") == "fquery_result" else 4
             if args.result:
                 ev = client.result(
                     args.socket, args.result,
@@ -411,7 +457,8 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
                           "--inventory-budget-bytes",
                           str(args.inventory_budget_bytes),
                           "--query-cache-entries",
-                          str(args.query_cache_entries)]
+                          str(args.query_cache_entries),
+                          "--ann-nlist", str(args.ann_nlist)]
         if args.max_request_bytes:
             fwd += ["--max-request-bytes", str(args.max_request_bytes)]
         if args.max_result_bytes:
@@ -488,6 +535,7 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
         inventory_budget_bytes=args.inventory_budget_bytes,
         query_cache_entries=args.query_cache_entries,
         inventory_dir=args.inventory_dir,
+        ann_nlist=args.ann_nlist,
         max_result_bytes=args.max_result_bytes,
         tenant_quotas=args.tenant_quotas, shed=args.shed)
     return ServeDaemon(opts).serve_forever()

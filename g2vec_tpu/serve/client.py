@@ -198,12 +198,15 @@ def drain(socket_path: str, timeout: Optional[float] = 10.0,
 def query(socket_path: str, q: str, job_id: Optional[str] = None,
           variant: Optional[str] = None, gene: Optional[str] = None,
           k: Optional[int] = None, timeout: Optional[float] = 30.0,
-          auth_token: Optional[str] = None) -> dict:
+          auth_token: Optional[str] = None, mode: Optional[str] = None,
+          nprobe: Optional[int] = None) -> dict:
     """One read-plane query (``neighbors`` / ``topk_biomarkers`` /
     ``meta`` / ``list``) against a daemon or the router — the router
     routes it to the bundle's home replica and answers from disk itself
     when that replica is dead. Token-gated like the mutators: query
-    responses carry tenant embeddings/scores."""
+    responses carry tenant embeddings/scores. ``mode`` picks the
+    retrieval path (``approx`` default / ``exact`` ground truth);
+    ``nprobe`` widens the approx probe."""
     fields = {"q": q}
     if job_id is not None:
         fields["job_id"] = job_id
@@ -213,7 +216,42 @@ def query(socket_path: str, q: str, job_id: Optional[str] = None,
         fields["gene"] = gene
     if k is not None:
         fields["k"] = k
+    if mode is not None:
+        fields["mode"] = mode
+    if nprobe is not None:
+        fields["nprobe"] = nprobe
     return _one(socket_path, "query", timeout, auth_token=auth_token,
+                **fields)
+
+
+def fquery(socket_path: str, fq: str, gene: str,
+           k: Optional[int] = None, mode: Optional[str] = None,
+           nprobe: Optional[int] = None, job_id: Optional[str] = None,
+           variant: Optional[str] = None,
+           ref_genes: Optional[List[str]] = None,
+           timeout: Optional[float] = 30.0,
+           auth_token: Optional[str] = None) -> dict:
+    """One federated cross-bundle query (``gene_rank`` /
+    ``bundle_overlap``). Against the router it scatter-gathers over the
+    replica fleet (answering dead replicas' bundles from shared disk,
+    with per-bundle ``served_by``/``replica_down`` attribution);
+    against a single daemon it covers that daemon's bundles.
+    ``bundle_overlap`` needs either ``ref_genes`` or a reference
+    ``job_id``/``variant`` the server resolves into one."""
+    fields: dict = {"fq": fq, "gene": gene}
+    if k is not None:
+        fields["k"] = k
+    if mode is not None:
+        fields["mode"] = mode
+    if nprobe is not None:
+        fields["nprobe"] = nprobe
+    if job_id is not None:
+        fields["job_id"] = job_id
+    if variant is not None:
+        fields["variant"] = variant
+    if ref_genes is not None:
+        fields["ref_genes"] = ref_genes
+    return _one(socket_path, "fquery", timeout, auth_token=auth_token,
                 **fields)
 
 

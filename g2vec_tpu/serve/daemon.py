@@ -154,6 +154,11 @@ class ServeOptions:
     #: daemon at a directory of solo ``--emit-inventory`` bundles to
     #: make them queryable without a serve job.
     inventory_dir: Optional[str] = None
+    #: IVF list count for published bundles (ops/ann.resolve_nlist):
+    #: 0 auto-indexes bundles past the size threshold, <0 disables the
+    #: approximate plane entirely, >0 forces a list count (tests use
+    #: this to index tiny bundles).
+    ann_nlist: int = 0
     #: Server-side cap on one ``result`` response; an over-cap record
     #: becomes a structured ``oversized_result`` error (see
     #: protocol.bound_record). 0 = protocol.MAX_LINE_BYTES.
@@ -1400,7 +1405,11 @@ class ServeDaemon:
                 dest, lane.embeddings, list(lane.genes),
                 lane.biomarker_scores,
                 {"source": "serve", "job_id": job.job_id,
-                 "variant": v.name, "tenant": job.tenant})
+                 "variant": v.name, "tenant": job.tenant},
+                ann_nlist=self.opts.ann_nlist,
+                # Stage-5 k-means centers seed the IVF coarse quantizer
+                # for free when the engine carried them through.
+                seed_centroids=getattr(lane, "km_centers", None))
         except (OSError, ValueError) as e:
             self.metrics.emit("inventory", bundle=key, bytes=0,
                               outcome="publish_failed",
@@ -1418,6 +1427,29 @@ class ServeDaemon:
             bytes=sum(os.path.getsize(os.path.join(dest, fn))
                       for fn in os.listdir(dest)),
             outcome="published")
+        self._emit_ann_build(key, dest)
+
+    def _emit_ann_build(self, key: str, dest: str) -> None:
+        """One ``ann_build`` event per publication, read back from the
+        sealed bundle's meta.json so what is reported is what was
+        actually published (including the no-index case)."""
+        import json as _json
+
+        try:
+            with open(os.path.join(dest, "meta.json")) as f:
+                meta = _json.load(f)
+        except (OSError, ValueError):
+            meta = {}
+        ann = meta.get("ann")
+        if ann:
+            self.metrics.emit("ann_build", bundle=key,
+                              nlist=ann.get("nlist"), outcome="built",
+                              ms=ann.get("build_ms"),
+                              seeded=ann.get("seeded"),
+                              postings=meta.get("n_genes"))
+        else:
+            self.metrics.emit("ann_build", bundle=key, nlist=0,
+                              outcome="skipped")
 
     def _republish(self, job_id: str, key: str) -> bool:
         """Rebuild a lost/torn/tampered bundle from the durable
@@ -1442,11 +1474,16 @@ class ServeDaemon:
         dest = os.path.join(self._inventory_dir, job_id, variant)
         try:
             genes, emb = inventory.read_vectors_txt(vec_path)
+            # The index is rebuilt too (no seed centroids — they are
+            # not recoverable from text outputs, so the deterministic
+            # row seeding applies): a republished bundle must not
+            # silently lose its approximate path.
             write_inventory_bundle(
                 dest, emb, genes, None,
                 {"source": "republish", "job_id": job_id,
                  "variant": variant,
-                 "from": os.path.basename(vec_path)})
+                 "from": os.path.basename(vec_path)},
+                ann_nlist=self.opts.ann_nlist)
         except (OSError, ValueError) as e:
             self.metrics.emit("inventory", bundle=key, bytes=0,
                               outcome="republish_failed",
@@ -1460,6 +1497,7 @@ class ServeDaemon:
             bytes=sum(os.path.getsize(os.path.join(dest, fn))
                       for fn in os.listdir(dest)),
             outcome="republished")
+        self._emit_ann_build(key, dest)
         return True
 
     def _fail_or_requeue(self, job: ServeJob, err: str,
@@ -1559,21 +1597,35 @@ class ServeDaemon:
         if not isinstance(k, int) or isinstance(k, bool):
             return {"event": "error", "error": "bad_query",
                     "detail": f"'k' must be an int, got {k!r}"}
+        mode = qreq.get("mode", "approx")
+        if mode not in inventory.QUERY_MODES:
+            return {"event": "error", "error": "bad_query",
+                    "detail": f"'mode' must be one of "
+                              f"{inventory.QUERY_MODES}, got {mode!r}"}
+        nprobe = qreq.get("nprobe", 0)
+        if not isinstance(nprobe, int) or isinstance(nprobe, bool) \
+                or nprobe < 0:
+            return {"event": "error", "error": "bad_query",
+                    "detail": f"'nprobe' must be a non-negative int, "
+                              f"got {nprobe!r}"}
 
         def compute() -> dict:
             try:
                 return inventory.run_query(self.catalog, q, key,
-                                           gene=gene, k=k)
+                                           gene=gene, k=k, mode=mode,
+                                           nprobe=nprobe)
             except inventory.InventoryError as e:
                 if e.code in ("torn", "tampered") \
                         and self._republish(job_id, key):
                     return inventory.run_query(self.catalog, q, key,
-                                               gene=gene, k=k)
+                                               gene=gene, k=k,
+                                               mode=mode, nprobe=nprobe)
                 raise
 
         try:
             resp, was_hit = self.qcache.get_or_put(
-                inventory.cache_key(key, q, gene, k), compute)
+                inventory.cache_key(key, q, gene, k, mode, nprobe),
+                compute)
         except inventory.InventoryError as e:
             self.metrics.emit("query", q=q, cache="miss", bundle=key,
                               ms=round((time.time() - t0) * 1e3, 3),
@@ -1584,8 +1636,79 @@ class ServeDaemon:
         out["event"] = "query_result"
         self.metrics.emit("query", q=q,
                           cache="hit" if was_hit else "miss", bundle=key,
-                          ms=round((time.time() - t0) * 1e3, 3))
+                          ms=round((time.time() - t0) * 1e3, 3),
+                          mode=mode, recall_mode=out.get("recall_mode"))
         return out
+
+    def handle_fquery(self, fqreq: dict) -> dict:
+        """The federated read plane, single-replica flavor: one
+        ``fquery`` sub-op (inventory.FQUERY_SUBOPS) across EVERY bundle
+        this replica serves. The router scatter-gathers this very
+        handler across the fleet and merges; standalone daemons answer
+        directly with the same shape (minus cross-replica
+        attribution)."""
+        t0 = time.time()
+        fq = fqreq.get("fq")
+        gene = fqreq.get("gene")
+        if not isinstance(gene, str) or not gene:
+            return {"event": "error", "error": "bad_query",
+                    "detail": "fquery needs a 'gene' string"}
+        k = fqreq.get("k", 50)
+        if not isinstance(k, int) or isinstance(k, bool):
+            return {"event": "error", "error": "bad_query",
+                    "detail": f"'k' must be an int, got {k!r}"}
+        mode = fqreq.get("mode", "approx")
+        nprobe = fqreq.get("nprobe", 0)
+        if not isinstance(nprobe, int) or isinstance(nprobe, bool) \
+                or nprobe < 0:
+            return {"event": "error", "error": "bad_query",
+                    "detail": f"'nprobe' must be a non-negative int, "
+                              f"got {nprobe!r}"}
+        ref_genes = fqreq.get("ref_genes")
+        if ref_genes is not None and not (
+                isinstance(ref_genes, list)
+                and all(isinstance(g, str) for g in ref_genes)):
+            return {"event": "error", "error": "bad_query",
+                    "detail": "'ref_genes' must be a list of strings"}
+        if fq == "bundle_overlap" and not ref_genes:
+            # Standalone convenience: derive the reference neighbor set
+            # from the named bundle so a single-daemon client need not
+            # run two requests. The router resolves this itself and
+            # always forwards ref_genes.
+            job_id = fqreq.get("job_id")
+            if not isinstance(job_id, str) or not job_id:
+                return {"event": "error", "error": "bad_query",
+                        "detail": "bundle_overlap needs 'ref_genes' or "
+                                  "a reference 'job_id'"}
+            ref_key, err = self._resolve_bundle(job_id,
+                                                fqreq.get("variant"))
+            if err is not None:
+                return err
+            try:
+                ref_resp = inventory.run_query(
+                    self.catalog, "neighbors", ref_key, gene=gene, k=k,
+                    mode=mode, nprobe=nprobe)
+            except inventory.InventoryError as e:
+                return {"event": "error", "error": e.code,
+                        "detail": e.detail, "bundle": ref_key}
+            ref_genes = ref_resp["neighbors"]
+        try:
+            partials = inventory.run_fquery(
+                self.catalog, fq, gene, k=k, mode=mode, nprobe=nprobe,
+                ref_genes=ref_genes)
+        except inventory.InventoryError as e:
+            self.metrics.emit("fquery", fq=fq,
+                              ms=round((time.time() - t0) * 1e3, 3),
+                              error=e.code)
+            return {"event": "error", "error": e.code, "detail": e.detail}
+        self.metrics.emit("fquery", fq=fq,
+                          ms=round((time.time() - t0) * 1e3, 3),
+                          bundles=len(partials))
+        return {"event": "fquery_result", "fq": fq, "gene": gene,
+                "k": k, "mode": mode,
+                "bundles": inventory.merge_fquery(fq, partials),
+                "ref_genes": ref_genes if fq == "bundle_overlap"
+                else None}
 
     # ---- status -----------------------------------------------------------
 
@@ -1685,7 +1808,7 @@ class ServeDaemon:
             op = req.get("op")
             if self.opts.auth_token is not None \
                     and op in ("submit", "cancel", "drain", "shutdown",
-                               "query") \
+                               "query", "fquery") \
                     and req.get("auth_token") != self.opts.auth_token:
                 # Tenancy is checked AT ADMISSION: a mutating op without
                 # the shared secret never reaches planning or the queue.
@@ -1755,6 +1878,9 @@ class ServeDaemon:
             elif op == "query":
                 qreq = req
                 protocol.write_event(f, self.handle_query(qreq))
+            elif op == "fquery":
+                fqreq = req
+                protocol.write_event(f, self.handle_fquery(fqreq))
             elif op == "cancel":
                 job_id = req.get("job_id")
                 if not isinstance(job_id, str) or not job_id:

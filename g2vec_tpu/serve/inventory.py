@@ -42,11 +42,26 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from g2vec_tpu.io.writers import INVENTORY_MANIFEST
+from g2vec_tpu.ops import ann as ann_ops
 from g2vec_tpu.ops import knn
 
 #: Sub-ops a ``query`` request may name (protocol vocabulary; the CLI
 #: and daemon/router dispatch validate against this tuple).
 QUERY_SUBOPS = ("neighbors", "topk_biomarkers", "meta", "list")
+
+#: Retrieval modes for the ``neighbors`` sub-op: ``approx`` probes the
+#: bundle's IVF index (ops/ann.py) and exact-rescores the survivors —
+#: float-exact whenever the true top-k lives in the probed lists —
+#: while ``exact`` is the ground-truth blocked kernel. ``approx`` is
+#: the default and silently serves exactly when a bundle has no index
+#: (small bundles below the auto threshold, pre-index republications).
+QUERY_MODES = ("approx", "exact")
+
+#: Federated cross-bundle sub-ops (the ``fquery`` op): ``gene_rank``
+#: asks every bundle where it ranks ``gene`` in its prognostic scores;
+#: ``bundle_overlap`` ranks bundles by how much their neighborhood of
+#: ``gene`` overlaps a reference neighbor set.
+FQUERY_SUBOPS = ("gene_rank", "bundle_overlap")
 
 
 class InventoryError(Exception):
@@ -81,16 +96,41 @@ class _Bundle:
         from g2vec_tpu.utils.integrity import sha256_file
 
         files = manifest.get("files", {})
+        # Verification is two-tier: the EXACT arrays are load-bearing
+        # (a mismatch refuses the whole bundle, as ever), while the
+        # ``ann_*`` index files degrade — a torn/tampered index is
+        # refused AT MAP TIME with a structured warning and the bundle
+        # still serves through the exact path. A corrupted index can
+        # therefore never change an answer, only slow one down.
+        ann_bad: Optional[dict] = None
         for name, want in sorted(files.items()):
             fp = os.path.join(path, name)
+            is_ann = name.startswith("ann_")
             if not os.path.exists(fp):
+                if is_ann:
+                    ann_bad = ann_bad or {
+                        "code": "torn",
+                        "detail": f"{path}: manifest names {name} but "
+                                  f"it is missing"}
+                    continue
                 raise InventoryError("torn", f"{path}: manifest names "
                                              f"{name} but it is missing")
             if os.path.getsize(fp) != want.get("bytes"):
+                if is_ann:
+                    ann_bad = ann_bad or {
+                        "code": "tampered",
+                        "detail": f"{fp}: {os.path.getsize(fp)} bytes, "
+                                  f"manifest says {want.get('bytes')}"}
+                    continue
                 raise InventoryError(
                     "tampered", f"{fp}: {os.path.getsize(fp)} bytes, "
                                 f"manifest says {want.get('bytes')}")
             if sha256_file(fp) != want.get("sha256"):
+                if is_ann:
+                    ann_bad = ann_bad or {
+                        "code": "tampered",
+                        "detail": f"{fp}: sha256 mismatch vs manifest"}
+                    continue
                 raise InventoryError("tampered", f"{fp}: sha256 mismatch "
                                                  f"vs manifest")
         for required in ("embeddings.npy", "norms.npy", "genes.txt",
@@ -118,6 +158,35 @@ class _Bundle:
                             f"vs {len(self.genes)} genes")
         self.gene_index: Dict[str, int] = {
             g: i for i, g in enumerate(self.genes)}
+        #: IVF index (ops/ann.py), or None with ``ann_error`` carrying
+        #: the structured refusal when index files exist but failed
+        #: verification or shape sanity. Both None = bundle simply has
+        #: no index (below the auto threshold, or ann disabled).
+        self.ann = None
+        self.ann_error: Optional[dict] = None
+        ann_names = [n for n in files if n.startswith("ann_")]
+        if ann_bad is not None:
+            self.ann_error = ann_bad
+        elif ann_names:
+            try:
+                missing = [n for n in ann_ops.ANN_FILES
+                           if n not in files]
+                if missing:
+                    raise ValueError(f"manifest lacks {missing}")
+                self.ann = ann_ops.IVFIndex(
+                    np.load(os.path.join(path, "ann_centroids.npy"),
+                            mmap_mode="r", allow_pickle=False),
+                    np.load(os.path.join(path, "ann_postings.npy"),
+                            mmap_mode="r", allow_pickle=False),
+                    np.load(os.path.join(path, "ann_offsets.npy"),
+                            mmap_mode="r", allow_pickle=False),
+                    n_rows=len(self.genes),
+                    hidden=int(self.embeddings.shape[1]))
+            except (OSError, ValueError) as e:
+                self.ann = None
+                self.ann_error = {
+                    "code": "tampered",
+                    "detail": f"{path}: ann index refused ({e})"}
         #: mapped-budget charge: the npy payloads (the mmap'd set).
         self.nbytes = sum(int(w.get("bytes", 0))
                           for n, w in files.items() if n.endswith(".npy"))
@@ -248,7 +317,8 @@ class InventoryCatalog:
                     meta = json.load(f)
                 entry.update(
                     n_genes=meta.get("n_genes"), hidden=meta.get("hidden"),
-                    has_scores=meta.get("has_scores"))
+                    has_scores=meta.get("has_scores"),
+                    ann=bool(meta.get("ann")))
             except (OSError, ValueError):
                 entry["torn"] = True
             out.append(entry)
@@ -322,24 +392,46 @@ class QueryCache:
                     if total else None}
 
 
-def cache_key(bundle: str, q: str, gene: Optional[str], k: int) -> str:
-    return "\x00".join((bundle, q, gene or "", str(int(k))))
+def cache_key(bundle: str, q: str, gene: Optional[str], k: int,
+              mode: str = "exact", nprobe: int = 0) -> str:
+    """The QueryCache key. ``mode``/``nprobe`` are part of it so an
+    approx result can never be served for an exact request (or for a
+    different probe width) of the same (bundle, q, gene, k)."""
+    return "\x00".join((bundle, q, gene or "", str(int(k)),
+                        mode, str(int(nprobe))))
 
 
 def run_query(catalog: InventoryCatalog, q: str, bundle_key: str,
               gene: Optional[str] = None, k: int = 10,
-              block_rows: int = 8192) -> dict:
+              block_rows: int = 8192, mode: str = "approx",
+              nprobe: int = 0) -> dict:
     """Evaluate one ``neighbors`` / ``topk_biomarkers`` / ``meta``
     sub-op against the catalog (``list`` is :meth:`InventoryCatalog.
     listing` — it takes no bundle). Shared verbatim by the daemon and
-    the router's failover read path so both answer identically."""
+    the router's failover read path so both answer identically.
+
+    ``mode`` steers the ``neighbors`` sub-op only (the other sub-ops
+    are always exact): ``approx`` probes the bundle's IVF index and
+    exact-rescores survivors; ``exact`` is the ground-truth kernel.
+    The response's ``recall_mode`` says how the answer was actually
+    produced — ``approx``, ``exact``, or ``exact_fallback`` (an index
+    was expected but refused at map time; ``ann_warning`` carries the
+    structured refusal).
+    """
     if q not in ("neighbors", "topk_biomarkers", "meta"):
         raise InventoryError("bad_query", f"unknown sub-op {q!r}; "
                                           f"expected one of {QUERY_SUBOPS}")
+    if mode not in QUERY_MODES:
+        raise InventoryError("bad_query", f"unknown mode {mode!r}; "
+                                          f"expected one of {QUERY_MODES}")
     k = int(k)
     if q != "meta" and not (1 <= k <= 10000):
         raise InventoryError("bad_query", f"k must be in [1, 10000], "
                                           f"got {k}")
+    nprobe = int(nprobe)
+    if not (0 <= nprobe <= 10000):
+        raise InventoryError("bad_query", f"nprobe must be in "
+                                          f"[0, 10000], got {nprobe}")
     b = catalog.get(bundle_key)
     if q == "meta":
         return {"bundle": bundle_key, "meta": b.meta,
@@ -355,11 +447,27 @@ def run_query(catalog: InventoryCatalog, q: str, bundle_key: str,
                                  f"gene {gene!r} not in bundle "
                                  f"{bundle_key!r}")
         qvec = np.asarray(b.embeddings[gi], dtype=np.float32)
+        if mode == "approx" and b.ann is not None:
+            eff = nprobe or ann_ops.DEFAULT_NPROBE
+            idx, sims, ncand = ann_ops.ivf_topk(
+                b.embeddings, b.norms, b.ann, qvec, k, nprobe=eff,
+                exclude=gi, block_rows=block_rows)
+            return {"bundle": bundle_key, "gene": gene, "k": k,
+                    "neighbors": [b.genes[i] for i in idx],
+                    "sims": [float(s) for s in sims],
+                    "mode": "approx", "recall_mode": "approx",
+                    "nprobe": int(min(max(eff, 1), b.ann.nlist)),
+                    "nlist": b.ann.nlist, "candidates": ncand}
         idx, sims = knn.cosine_topk(b.embeddings, b.norms, qvec, k,
                                     exclude=gi, block_rows=block_rows)
-        return {"bundle": bundle_key, "gene": gene, "k": k,
-                "neighbors": [b.genes[i] for i in idx],
-                "sims": [float(s) for s in sims]}
+        out = {"bundle": bundle_key, "gene": gene, "k": k,
+               "neighbors": [b.genes[i] for i in idx],
+               "sims": [float(s) for s in sims],
+               "mode": mode, "recall_mode": "exact"}
+        if mode == "approx" and b.ann_error is not None:
+            out["recall_mode"] = "exact_fallback"
+            out["ann_warning"] = b.ann_error
+        return out
     # topk_biomarkers
     if b.scores is None:
         raise InventoryError(
@@ -374,6 +482,112 @@ def run_query(catalog: InventoryCatalog, q: str, bundle_key: str,
         out[group] = {"genes": [b.genes[i] for i in idx],
                       "scores": [float(s) for s in sc]}
     return out
+
+
+def run_fquery(catalog: InventoryCatalog, fq: str, gene: str,
+               k: int = 50, mode: str = "approx", nprobe: int = 0,
+               ref_genes: Optional[Sequence[str]] = None,
+               block_rows: int = 8192) -> List[dict]:
+    """Evaluate one federated sub-op against EVERY bundle the catalog
+    can see, returning one partial dict per bundle — never aborting on
+    a bad bundle (a torn/tampered/score-less bundle contributes a
+    structured per-bundle ``error`` instead). The daemon runs this over
+    its own inventory; the router runs it over a dead replica's shared
+    state dir, so both produce merge-compatible partials.
+
+    ``gene_rank``: per bundle, the 1-based rank of ``gene`` in each of
+    the good/poor prognostic score rows (ties by ascending row index —
+    the same order :func:`ops.knn.topk_scores` would surface them) and
+    whether that lands in the top ``k``. ``bundle_overlap``: per bundle
+    containing ``gene``, the fraction of ``ref_genes`` (the reference
+    neighbor set) found in that bundle's own ``k`` nearest neighbors of
+    ``gene`` — approx/exact per ``mode``, attributed via
+    ``recall_mode``.
+    """
+    if fq not in FQUERY_SUBOPS:
+        raise InventoryError(
+            "bad_query", f"unknown fquery sub-op {fq!r}; expected one "
+                         f"of {FQUERY_SUBOPS}")
+    if not gene:
+        raise InventoryError("bad_query", "fquery needs a 'gene' symbol")
+    k = int(k)
+    if not (1 <= k <= 10000):
+        raise InventoryError("bad_query", f"k must be in [1, 10000], "
+                                          f"got {k}")
+    ref = None
+    if fq == "bundle_overlap":
+        if not ref_genes:
+            raise InventoryError(
+                "bad_query", "bundle_overlap needs 'ref_genes' (or a "
+                             "reference 'job_id' the daemon/router "
+                             "resolves into one)")
+        ref = set(ref_genes)
+    out: List[dict] = []
+    for key in sorted(scan_bundles(catalog.roots)):
+        part: dict = {"bundle": key}
+        try:
+            b = catalog.get(key)
+        except InventoryError as e:
+            part["error"] = e.code
+            out.append(part)
+            continue
+        gi = b.gene_index.get(gene)
+        if gi is None:
+            part["present"] = False
+            out.append(part)
+            continue
+        part["present"] = True
+        if fq == "gene_rank":
+            if b.scores is None:
+                part["error"] = "scores_unavailable"
+            else:
+                for row, group in enumerate(("good", "poor")):
+                    s = np.asarray(b.scores[row], dtype=np.float32)
+                    sv = s[gi]
+                    rank = int(1 + np.count_nonzero(s > sv)
+                               + np.count_nonzero(s[:gi] == sv))
+                    part[group] = {"rank": rank, "in_top_k": rank <= k}
+        else:
+            resp = run_query(catalog, "neighbors", key, gene=gene, k=k,
+                             block_rows=block_rows, mode=mode,
+                             nprobe=nprobe)
+            shared = len(set(resp["neighbors"]) & ref)
+            part["overlap"] = round(shared / max(len(ref), 1), 6)
+            part["shared"] = shared
+            part["recall_mode"] = resp.get("recall_mode", "exact")
+        out.append(part)
+    return out
+
+
+def merge_fquery(fq: str, partials: Sequence[dict]) -> List[dict]:
+    """Merge scatter-gathered per-bundle partials into one ranked list.
+
+    Dedupe is first-wins by bundle key (callers put alive-owner answers
+    before failover reads, so a live replica always outranks a disk
+    read of the same bundle). Ordering: ``gene_rank`` sorts by best
+    (lowest) rank across the good/poor groups; ``bundle_overlap`` by
+    overlap descending; bundles without a score (absent gene,
+    per-bundle errors) sort after scored ones; ties break by bundle
+    key so the merged order is deterministic across runs.
+    """
+    seen: Dict[str, dict] = {}
+    for p in partials:
+        key = str(p.get("bundle"))
+        if key not in seen:
+            seen[key] = p
+
+    def sort_key(p: dict):
+        if fq == "gene_rank":
+            ranks = [p[g]["rank"] for g in ("good", "poor")
+                     if isinstance(p.get(g), dict)]
+            return (0 if ranks else 1,
+                    min(ranks) if ranks else 1 << 30,
+                    str(p.get("bundle")))
+        ov = p.get("overlap")
+        return (0 if ov is not None else 1, -(ov or 0.0),
+                str(p.get("bundle")))
+
+    return sorted(seen.values(), key=sort_key)
 
 
 def read_vectors_txt(path: str) -> Tuple[List[str], np.ndarray]:

@@ -94,7 +94,24 @@ SUBMIT_KEYS = ("op", "job", "tenant", "priority", "deadline_s",
 #: names the sub-op (inventory.QUERY_SUBOPS: neighbors /
 #: topk_biomarkers / meta / list); ``variant`` selects a lane of a
 #: multi-variant job (optional when the job has exactly one).
-QUERY_KEYS = ("op", "q", "job_id", "variant", "gene", "k", "auth_token")
+#: ``mode`` picks the retrieval path (``approx`` — the IVF index with
+#: exact rescoring, the default — or ``exact``, the ground-truth
+#: blocked kernel) and ``nprobe`` widens the approx probe; both ride
+#: the cache key so approx and exact results never collide.
+QUERY_KEYS = ("op", "q", "job_id", "variant", "gene", "k", "mode",
+              "nprobe", "auth_token")
+
+#: The federated-query envelope vocabulary: ``fqreq`` reads in
+#: daemon.py/router.py are linted against this tuple. ``fq`` names the
+#: cross-bundle sub-op (inventory.FQUERY_SUBOPS: ``gene_rank`` — which
+#: bundles rank ``gene`` in their top-k prognostic scores — or
+#: ``bundle_overlap`` — bundles ranked by neighbor-set overlap with a
+#: reference bundle's neighborhood of ``gene``). ``job_id``/``variant``
+#: name the reference bundle for ``bundle_overlap``; ``ref_genes`` is
+#: the router-resolved reference neighbor list it forwards to replicas
+#: so every partial is scored against the same reference.
+FQUERY_KEYS = ("op", "fq", "gene", "k", "mode", "nprobe", "job_id",
+               "variant", "ref_genes", "auth_token")
 
 #: The result-request envelope vocabulary: ``rreq`` reads in
 #: daemon.py/router.py are linted against this tuple. ``fields``

@@ -62,9 +62,11 @@ from g2vec_tpu.resilience.supervisor import ReplicaFleet, ReplicaSpec
 from g2vec_tpu.serve import inventory, leader, protocol
 from g2vec_tpu.utils.metrics import MetricsWriter
 
-#: Token-gated ops: the mutators, plus ``query`` — a read, but one that
-#: exposes tenant embeddings/scores, not just health (probes stay open).
-_AUTH_OPS = ("submit", "cancel", "drain_replica", "shutdown", "query")
+#: Token-gated ops: the mutators, plus ``query``/``fquery`` — reads,
+#: but ones that expose tenant embeddings/scores, not just health
+#: (probes stay open).
+_AUTH_OPS = ("submit", "cancel", "drain_replica", "shutdown", "query",
+             "fquery")
 
 
 def sanitize_client_submit(req: dict) -> dict:
@@ -1446,8 +1448,20 @@ class Router:
         if not isinstance(k, int) or isinstance(k, bool):
             return {"event": "error", "error": "bad_query",
                     "detail": f"'k' must be an int, got {k!r}"}
+        mode = qreq.get("mode", "approx")
+        if mode not in inventory.QUERY_MODES:
+            return {"event": "error", "error": "bad_query",
+                    "detail": f"'mode' must be one of "
+                              f"{inventory.QUERY_MODES}, got {mode!r}"}
+        nprobe = qreq.get("nprobe", 0)
+        if not isinstance(nprobe, int) or isinstance(nprobe, bool) \
+                or nprobe < 0:
+            return {"event": "error", "error": "bad_query",
+                    "detail": f"'nprobe' must be a non-negative int, "
+                              f"got {nprobe!r}"}
         try:
-            resp = inventory.run_query(cat, q, key, gene=gene, k=k)
+            resp = inventory.run_query(cat, q, key, gene=gene, k=k,
+                                       mode=mode, nprobe=nprobe)
         except inventory.InventoryError as e:
             self.metrics.emit("query", q=q, cache="router_local",
                               served_by="router", error=e.code,
@@ -1458,6 +1472,105 @@ class Router:
                           served_by="router",
                           ms=round((time.time() - t0) * 1e3, 3))
         return dict(resp, event="query_result", served_by="router")
+
+    def handle_fquery(self, fqreq: dict) -> dict:
+        """Federated cross-bundle read: scatter the sub-op to every
+        ALIVE replica (each answers over its own bundles via
+        daemon.handle_fquery), answer DEAD replicas' bundles from their
+        shared state dirs exactly like ``list`` does, and merge the
+        partials into one ranked list. Every partial carries
+        ``served_by`` (and ``replica_down`` for failover reads), and
+        ``bundle_overlap`` partials carry ``recall_mode`` — so a caller
+        can see per bundle whether the answer came from a live owner or
+        a disk read, approximately or exactly."""
+        t0 = time.time()
+        fq = fqreq.get("fq")
+        if fq not in inventory.FQUERY_SUBOPS:
+            return {"event": "error", "error": "bad_query",
+                    "detail": f"unknown fquery sub-op {fq!r}; expected "
+                              f"one of {inventory.FQUERY_SUBOPS}"}
+        gene = fqreq.get("gene")
+        if not isinstance(gene, str) or not gene:
+            return {"event": "error", "error": "bad_query",
+                    "detail": "fquery needs a 'gene' string"}
+        k = fqreq.get("k", 50)
+        if not isinstance(k, int) or isinstance(k, bool):
+            return {"event": "error", "error": "bad_query",
+                    "detail": f"'k' must be an int, got {k!r}"}
+        mode = fqreq.get("mode", "approx")
+        if mode not in inventory.QUERY_MODES:
+            return {"event": "error", "error": "bad_query",
+                    "detail": f"'mode' must be one of "
+                              f"{inventory.QUERY_MODES}, got {mode!r}"}
+        nprobe = fqreq.get("nprobe", 0)
+        if not isinstance(nprobe, int) or isinstance(nprobe, bool) \
+                or nprobe < 0:
+            return {"event": "error", "error": "bad_query",
+                    "detail": f"'nprobe' must be a non-negative int, "
+                              f"got {nprobe!r}"}
+        ref_genes = fqreq.get("ref_genes")
+        if ref_genes is not None and not (
+                isinstance(ref_genes, list)
+                and all(isinstance(g, str) for g in ref_genes)):
+            return {"event": "error", "error": "bad_query",
+                    "detail": "'ref_genes' must be a list of strings"}
+        if fq == "bundle_overlap" and not ref_genes:
+            # Resolve the reference neighbor set ONCE through the
+            # normal routed read (home replica if alive, shared disk if
+            # not), then forward it verbatim to every replica — all
+            # partials must score against the same reference.
+            job_id = fqreq.get("job_id")
+            if not isinstance(job_id, str) or not job_id:
+                return {"event": "error", "error": "bad_query",
+                        "detail": "bundle_overlap needs 'ref_genes' or "
+                                  "a reference 'job_id'"}
+            ref = self.handle_query({
+                "op": "query", "q": "neighbors", "job_id": job_id,
+                "variant": fqreq.get("variant"), "gene": gene, "k": k,
+                "mode": mode, "nprobe": nprobe,
+                "auth_token": fqreq.get("auth_token")})
+            if ref.get("event") != "query_result":
+                return ref
+            ref_genes = ref.get("neighbors")
+        partials: List[dict] = []
+        for name in self.fleet.names():
+            forwarded = False
+            if self.fleet.alive(name):
+                try:
+                    resp = self._request(
+                        name, dict(fqreq, ref_genes=ref_genes),
+                        timeout=10.0)
+                    forwarded = True
+                    if resp.get("event") == "fquery_result":
+                        for part in resp.get("bundles") or []:
+                            if isinstance(part, dict):
+                                partials.append(dict(part,
+                                                     served_by=name))
+                except (OSError, protocol.ProtocolError):
+                    # Fall through to the shared-disk read; the probe
+                    # loop confirms the death on its own cadence.
+                    with self._hlock:
+                        self.health[name].force_dead(now=time.time())
+            if forwarded:
+                continue
+            try:
+                local = inventory.run_fquery(
+                    self._inv_local[name], fq, gene, k=k, mode=mode,
+                    nprobe=nprobe, ref_genes=ref_genes)
+            except inventory.InventoryError:
+                continue
+            partials.extend(dict(p, served_by="router",
+                                 replica_down=True) for p in local)
+        merged = inventory.merge_fquery(fq, partials)
+        self.metrics.emit(
+            "fquery", fq=fq, ms=round((time.time() - t0) * 1e3, 3),
+            bundles=len(merged),
+            replica_down=sum(1 for p in merged
+                             if p.get("replica_down")))
+        return {"event": "fquery_result", "fq": fq, "gene": gene,
+                "k": k, "mode": mode, "bundles": merged,
+                "ref_genes": (ref_genes if fq == "bundle_overlap"
+                              else None)}
 
     # ---- submit relay -----------------------------------------------------
 
@@ -1725,6 +1838,9 @@ class Router:
             elif op == "query":
                 qreq = req
                 protocol.write_event(f, self.handle_query(qreq))
+            elif op == "fquery":
+                fqreq = req
+                protocol.write_event(f, self.handle_fquery(fqreq))
             elif op == "cancel":
                 job_id = req.get("job_id")
                 if not isinstance(job_id, str) or not job_id:

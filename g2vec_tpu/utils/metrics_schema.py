@@ -26,6 +26,9 @@ Conventions:
 """
 
 EVENT_SCHEMAS = {
+    'ann_build': {
+        "required": ['bundle', 'nlist', 'outcome'],
+        "optional": ['error', 'ms', 'postings', 'seeded']},
     'auth_rejected': {
         "required": ['op'],
         "optional": []},
@@ -80,6 +83,9 @@ EVENT_SCHEMAS = {
     'fleet_replan': {
         "required": ['attempt', 'delay_seconds', 'new_mesh', 'old_mesh', 'surviving_devices', 'surviving_ranks'],
         "optional": []},
+    'fquery': {
+        "required": ['fq', 'ms'],
+        "optional": ['bundles', 'error', 'replica_down', 'served_by']},
     'gave_up': {
         "required": ['attempt', 'classified', 'error'],
         "optional": []},
@@ -145,7 +151,8 @@ EVENT_SCHEMAS = {
         "optional": ['parked']},
     'query': {
         "required": ['cache', 'ms', 'q'],
-        "optional": ['bundle', 'error', 'served_by']},
+        "optional": ['bundle', 'error', 'mode', 'nprobe', 'recall_mode',
+                     'served_by']},
     'replica_adopted': {
         "required": ['journal_depth', 'pid', 'replica'],
         "optional": []},

@@ -1,0 +1,698 @@
+"""Approximate-NN query plane (PR 18): the IVF index (ops/ann.py) —
+build determinism, structural validation, the nprobe>=nlist bitwise
+contract, the recall@k >= 0.95 contract at pruning scale — plus the
+serve-side integration: indexed bundle publication/verification,
+approx/exact cache-key separation, index tamper/torn/corrupt drills
+(always exact fallback, never a wrong answer), tamper-then-republish
+keeping the approx plane, and the federated ``fquery`` op on the
+daemon and the router (dead-owner disk reads with attribution).
+
+Bitwise assertions use INTEGER-VALUED float32 embeddings throughout
+(dot products are sums of small integers, exact in float32 under any
+summation order — the same trick as tests/test_query.py), so "approx
+rescore == exact kernel on shared rows" carries no BLAS caveats.
+"""
+import json
+import os
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from g2vec_tpu.ops import ann, knn
+from g2vec_tpu.resilience import faults
+from g2vec_tpu.serve import inventory, protocol
+
+pytestmark = pytest.mark.ann
+
+
+# ---------------------------------------------------------------------------
+# Shared fixtures/helpers (test_query.py idioms)
+# ---------------------------------------------------------------------------
+
+def _int_embeddings(g=257, h=8, seed=0):
+    rng = np.random.default_rng(seed)
+    emb = rng.integers(-5, 6, size=(g, h)).astype(np.float32)
+    if g > 7:
+        emb[7] = 0.0              # zero-norm row: scores -2.0, no nan
+    if g > 101:
+        emb[100] = emb[3]         # exact duplicates: forced ties
+        emb[101] = emb[3]
+    return emb
+
+
+def _clustered_int_embeddings(g, h, n_clusters, seed=0):
+    """Integer-valued clustered rows: well-separated integer centers
+    plus small integer noise, so IVF pruning is meaningful AND every
+    dot product stays exact in float32."""
+    rng = np.random.default_rng(seed)
+    centers = rng.integers(-30, 31, size=(n_clusters, h))
+    which = rng.integers(0, n_clusters, size=g)
+    noise = rng.integers(-2, 3, size=(g, h))
+    return (centers[which] + noise).astype(np.float32)
+
+
+def _naive_cosine(emb, q, k, exclude=-1):
+    emb = np.asarray(emb, dtype=np.float32)
+    q = np.asarray(q, dtype=np.float32)
+    g = emb.shape[0]
+    sims = emb @ q
+    norms = np.sqrt((emb * emb).sum(axis=1))
+    qn = np.float32(np.sqrt(np.dot(q, q)))
+    denom = norms * qn
+    ok = denom > 0
+    sims = np.where(ok, sims / np.where(ok, denom, 1), np.float32(-2.0))
+    if 0 <= exclude < g:
+        sims[exclude] = -np.inf
+    order = np.lexsort((np.arange(g), -sims))[:min(k, g)]
+    return order, sims[order]
+
+
+def _plant_bundle(dest, g=48, h=8, seed=0, with_scores=True,
+                  ann_nlist=0, clustered=False):
+    """Write one real bundle (optionally indexed); returns what went in."""
+    from g2vec_tpu.io.writers import write_inventory_bundle
+
+    rng = np.random.default_rng(seed)
+    if clustered:
+        emb = _clustered_int_embeddings(g, h, max(4, g // 12), seed=seed)
+    else:
+        emb = rng.integers(-5, 6, size=(g, h)).astype(np.float32)
+    genes = [f"G{i:03d}" for i in range(g)]
+    scores = (rng.standard_normal((2, g)).astype(np.float32)
+              if with_scores else None)
+    write_inventory_bundle(dest, emb, genes, scores, {"source": "test"},
+                           ann_nlist=ann_nlist)
+    return emb, genes, scores
+
+
+def _daemon(tmp_path, **opt_overrides):
+    from g2vec_tpu.serve.daemon import ServeDaemon, ServeOptions
+
+    opts = ServeOptions(
+        socket_path=os.path.join(str(tmp_path), "serve.sock"),
+        state_dir=os.path.join(str(tmp_path), "state"), **opt_overrides)
+    return ServeDaemon(opts, console=lambda s: None)
+
+
+def _roundtrip(d, req):
+    a, b = socket.socketpair()
+    t = threading.Thread(target=d._handle_conn, args=(a,), daemon=True)
+    t.start()
+    f = b.makefile("rwb")
+    try:
+        protocol.write_event(f, req)
+        ev = protocol.read_event(f)
+    finally:
+        f.close()
+        b.close()
+        t.join(timeout=30)
+    return ev
+
+
+def _flip_byte(path, from_end=3):
+    with open(path, "r+b") as f:
+        f.seek(os.path.getsize(path) - from_end)
+        orig = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([orig[0] ^ 0xFF]))
+
+
+# ---------------------------------------------------------------------------
+# resolve_nlist / build structure / determinism
+# ---------------------------------------------------------------------------
+
+def test_resolve_nlist_contract():
+    assert ann.resolve_nlist(10**6, -1) == 0          # disabled
+    assert ann.resolve_nlist(0, 0) == 0               # nothing to index
+    assert ann.resolve_nlist(100, 8) == 8             # explicit
+    assert ann.resolve_nlist(5, 8) == 5               # clamped to rows
+    assert ann.resolve_nlist(ann.ANN_AUTO_MIN_ROWS - 1, 0) == 0
+    auto = ann.resolve_nlist(ann.ANN_AUTO_MIN_ROWS, 0)
+    assert auto == int(round(ann.ANN_AUTO_MIN_ROWS ** 0.5))
+    assert ann.resolve_nlist(10**6, 0) == 1000        # sqrt scaling
+
+
+def test_build_ivf_structure_and_postings_invariants():
+    emb = _int_embeddings(g=300)
+    cents, postings, offsets = ann.build_ivf(emb, 12)
+    assert cents.shape == (12, 8) and cents.dtype == np.float32
+    assert postings.shape == (300,) and postings.dtype == np.int32
+    assert offsets.shape == (13,) and offsets.dtype == np.int64
+    # offsets partition [0, G]; postings are a permutation of rows.
+    assert offsets[0] == 0 and offsets[-1] == 300
+    assert np.all(np.diff(offsets) >= 0)
+    assert np.array_equal(np.sort(postings), np.arange(300))
+    # Within each list, ids ascend — the order the subset kernel's tie
+    # rule depends on.
+    for li in range(12):
+        lst = postings[offsets[li]:offsets[li + 1]]
+        assert np.all(np.diff(lst) > 0) or lst.size <= 1
+
+
+def test_build_ivf_is_deterministic():
+    emb = _int_embeddings(g=300, seed=4)
+    a = ann.build_ivf(emb, 10)
+    b = ann.build_ivf(emb.copy(), 10)
+    for x, y in zip(a, b):
+        assert x.tobytes() == y.tobytes()
+    # Seeded builds are deterministic too, and a shape-mismatched seed
+    # silently falls back to the row seeding (same bytes as unseeded).
+    seed_c = np.random.default_rng(9).integers(
+        -5, 6, size=(3, 8)).astype(np.float32)
+    s1 = ann.build_ivf(emb, 10, seed_centroids=seed_c)
+    s2 = ann.build_ivf(emb, 10, seed_centroids=seed_c.copy())
+    for x, y in zip(s1, s2):
+        assert x.tobytes() == y.tobytes()
+    bad_seed = np.ones((3, 5), dtype=np.float32)      # hidden mismatch
+    s3 = ann.build_ivf(emb, 10, seed_centroids=bad_seed)
+    for x, y in zip(a, s3):
+        assert x.tobytes() == y.tobytes()
+
+
+def test_build_ivf_rejects_bad_inputs():
+    emb = _int_embeddings(g=20)
+    for bad_nlist in (0, -1, 21):
+        with pytest.raises(ValueError):
+            ann.build_ivf(emb, bad_nlist)
+    with pytest.raises(ValueError):
+        ann.build_ivf(np.empty((0, 8), dtype=np.float32), 1)
+    with pytest.raises(ValueError):
+        ann.build_ivf(np.ones(8, dtype=np.float32), 1)
+
+
+def test_ivf_index_refuses_structural_corruption():
+    emb = _int_embeddings(g=50)
+    cents, postings, offsets = ann.build_ivf(emb, 5)
+    ann.IVFIndex(cents, postings, offsets, n_rows=50, hidden=8)  # sane
+    bad_off = offsets.copy()
+    bad_off[2], bad_off[3] = bad_off[3] + 1, bad_off[2]   # non-monotone
+    with pytest.raises(ValueError):
+        ann.IVFIndex(cents, postings, bad_off, n_rows=50, hidden=8)
+    bad_post = postings.copy()
+    bad_post[0] = 50                                      # out of range
+    with pytest.raises(ValueError):
+        ann.IVFIndex(cents, bad_post, offsets, n_rows=50, hidden=8)
+    with pytest.raises(ValueError):
+        ann.IVFIndex(cents, postings[:-1], offsets, n_rows=50, hidden=8)
+    with pytest.raises(ValueError):
+        ann.IVFIndex(cents, postings, offsets, n_rows=50, hidden=16)
+    with pytest.raises(ValueError):
+        ann.IVFIndex(cents, postings, offsets[:-1], n_rows=50, hidden=8)
+
+
+# ---------------------------------------------------------------------------
+# Kernel exactness: subset kernel, nprobe>=nlist, edge cases
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("k", [1, 5, 257, 400])
+@pytest.mark.parametrize("block_rows", [1, 13, 8192])
+def test_subset_kernel_on_full_rows_is_bitwise_exact(k, block_rows):
+    emb = _int_embeddings()
+    norms = knn.row_norms(emb)
+    rows = np.arange(emb.shape[0], dtype=np.int64)
+    for exclude in (-1, 3):
+        idx, sims = knn.cosine_topk_subset(emb, norms, rows, emb[3], k,
+                                           exclude=exclude,
+                                           block_rows=block_rows)
+        ref_idx, ref_sims = knn.cosine_topk(emb, norms, emb[3], k,
+                                            exclude=exclude)
+        assert np.array_equal(idx, ref_idx)
+        assert np.array_equal(sims, ref_sims)
+
+
+def test_subset_kernel_restricted_rows_match_masked_naive():
+    emb = _int_embeddings()
+    norms = knn.row_norms(emb)
+    rows = np.arange(0, emb.shape[0], 3, dtype=np.int64)  # every 3rd row
+    idx, sims = knn.cosine_topk_subset(emb, norms, rows, emb[3], 7,
+                                       exclude=3)
+    ref_idx, ref_sims = _naive_cosine(emb[rows], emb[3], 7,
+                                      exclude=int(np.searchsorted(rows, 3)))
+    assert np.array_equal(idx, rows[ref_idx])
+    assert np.array_equal(sims, ref_sims.astype(np.float32))
+    assert set(idx.tolist()) <= set(rows.tolist())
+
+
+def test_nprobe_ge_nlist_is_bitwise_equal_to_exact():
+    emb = _int_embeddings(g=300, seed=2)
+    norms = knn.row_norms(emb)
+    cents, postings, offsets = ann.build_ivf(emb, 8)
+    index = ann.IVFIndex(cents, postings, offsets, n_rows=300, hidden=8)
+    for nprobe in (8, 9, 10000):
+        for exclude in (-1, 3):
+            idx, sims, ncand = ann.ivf_topk(emb, norms, index, emb[3],
+                                            10, nprobe=nprobe,
+                                            exclude=exclude)
+            assert ncand == 300       # full coverage, no pruning
+            ref_idx, ref_sims = knn.cosine_topk(emb, norms, emb[3], 10,
+                                                exclude=exclude)
+            assert np.array_equal(idx, ref_idx)
+            assert np.array_equal(sims, ref_sims)
+
+
+def test_k_exceeding_candidates_and_g():
+    emb = _int_embeddings(g=60, seed=5)
+    norms = knn.row_norms(emb)
+    cents, postings, offsets = ann.build_ivf(emb, 6)
+    index = ann.IVFIndex(cents, postings, offsets, n_rows=60, hidden=8)
+    # k > G with full probe: every row comes back, descending.
+    idx, sims, ncand = ann.ivf_topk(emb, norms, index, emb[0], 500,
+                                    nprobe=6)
+    assert ncand == 60 and idx.shape == (60,)
+    assert np.all(np.diff(sims) <= 0)
+    # k > candidate count with a narrow probe: all candidates, no more.
+    idx, sims, ncand = ann.ivf_topk(emb, norms, index, emb[0], 500,
+                                    nprobe=1)
+    assert idx.shape == (ncand,) and 0 < ncand < 60
+
+
+def test_empty_posting_lists_yield_empty_result_not_crash():
+    # Hand-built index: every row lives in list 1, list 0 is empty. A
+    # query sitting on centroid 0 with nprobe=1 probes only the empty
+    # list — the contract is an EMPTY result, never an exception (the
+    # serve layer then surfaces whatever its caller does with zero
+    # neighbors; correctness is preserved because nothing is invented).
+    g = 12
+    emb = np.eye(g, 4, dtype=np.float32) + 1.0
+    norms = knn.row_norms(emb)
+    cents = np.array([[1.0, 0, 0, 0], [0, 1, 0, 0]], dtype=np.float32)
+    postings = np.arange(g, dtype=np.int32)
+    offsets = np.array([0, 0, g], dtype=np.int64)
+    index = ann.IVFIndex(cents, postings, offsets, n_rows=g, hidden=4)
+    q = np.array([100.0, 0, 0, 0], dtype=np.float32)  # sits on list 0
+    idx, sims, ncand = ann.ivf_topk(emb, norms, index, q, 3, nprobe=1)
+    assert ncand == 0 and idx.size == 0 and sims.size == 0
+    # Probing both lists recovers everything.
+    idx, sims, ncand = ann.ivf_topk(emb, norms, index, q, 3, nprobe=2)
+    assert ncand == g and idx.size == 3
+
+
+def test_duplicate_rows_tie_by_ascending_index_in_approx_path():
+    emb = _int_embeddings()            # rows 3, 100, 101 identical
+    norms = knn.row_norms(emb)
+    cents, postings, offsets = ann.build_ivf(emb, 4)
+    index = ann.IVFIndex(cents, postings, offsets, n_rows=emb.shape[0],
+                         hidden=8)
+    # Duplicates land in the same list (identical vectors assign
+    # identically), so even nprobe=1 sees all three; excluding row 3
+    # must surface 100 before 101 — the exact kernel's tie rule.
+    idx, sims, _ = ann.ivf_topk(emb, norms, index, emb[3], 2,
+                                nprobe=1, exclude=3)
+    assert idx[0] == 100 and idx[1] == 101
+    assert sims[0] == sims[1]
+
+
+def test_lloyd_update_parity_with_jax_kmeans():
+    """ops/ann's numpy Lloyd step mirrors ops.kmeans._update_centers —
+    including the empty-cluster freeze — up to f64-accumulate-then-cast
+    rounding (the jax side sums in f32, so parity is allclose, not
+    bitwise; the freeze itself IS bitwise)."""
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    from g2vec_tpu.ops.kmeans import _update_centers
+
+    rng = np.random.default_rng(11)
+    x = rng.integers(-5, 6, size=(120, 6)).astype(np.float32)
+    centers = rng.integers(-5, 6, size=(7, 6)).astype(np.float32)
+    centers[5] = 1000.0            # guaranteed-empty cluster
+    assign = ann._assign(ann._normalize_rows(x), centers)
+    xn = ann._normalize_rows(x)
+    ours = ann.lloyd_update(xn, centers, assign)
+    onehot = jax.nn.one_hot(jnp.asarray(assign), 7, dtype=jnp.float32)
+    theirs = np.asarray(_update_centers(onehot, jnp.asarray(xn),
+                                        jnp.asarray(centers)))
+    assert np.allclose(ours, theirs, rtol=1e-5, atol=1e-6)
+    # Empty cluster 5: frozen VERBATIM on both sides.
+    assert np.array_equal(ours[5], centers[5])
+    assert np.array_equal(theirs[5], centers[5])
+
+
+# ---------------------------------------------------------------------------
+# The recall contract, at a scale where pruning actually prunes
+# ---------------------------------------------------------------------------
+
+def test_recall_contract_at_pruning_scale():
+    """The headline contract: nlist=32/nprobe=4 over 6000 clustered
+    rows scans <G candidates per query yet keeps recall@10 >= 0.95,
+    and every id the approx path returns carries the EXACT kernel's
+    similarity for that id, bitwise."""
+    g, h, k, nprobe = 6000, 32, 10, 4
+    emb = _clustered_int_embeddings(g, h, 40, seed=3)
+    norms = knn.row_norms(emb)
+    cents, postings, offsets = ann.build_ivf(emb, 32)
+    index = ann.IVFIndex(cents, postings, offsets, n_rows=g, hidden=h)
+    rng = np.random.default_rng(17)
+    queries = rng.choice(g, size=50, replace=False)
+    hits = total = 0
+    for gi in queries:
+        gi = int(gi)
+        idx, sims, ncand = ann.ivf_topk(emb, norms, index, emb[gi], k,
+                                        nprobe=nprobe, exclude=gi)
+        assert 0 < ncand < g       # pruning really happened
+        ref_idx, ref_sims = knn.cosine_topk(emb, norms, emb[gi], k,
+                                            exclude=gi)
+        exact = {int(i): float(s) for i, s in zip(ref_idx, ref_sims)}
+        for i, s in zip(idx, sims):
+            if int(i) in exact:    # shared ids: bitwise-identical score
+                assert float(s) == exact[int(i)]
+        hits += len(set(idx.tolist()) & set(ref_idx.tolist()))
+        total += k
+    recall = hits / total
+    assert recall >= 0.95, f"recall@{k}={recall:.3f} < 0.95"
+
+
+# ---------------------------------------------------------------------------
+# Serve integration: cache keys, indexed bundles, tamper/corrupt drills
+# ---------------------------------------------------------------------------
+
+def test_cache_key_separates_mode_and_nprobe():
+    base = inventory.cache_key("j/v0", "neighbors", "G001", 10)
+    keys = {base,
+            inventory.cache_key("j/v0", "neighbors", "G001", 10,
+                                mode="approx"),
+            inventory.cache_key("j/v0", "neighbors", "G001", 10,
+                                mode="approx", nprobe=4),
+            inventory.cache_key("j/v0", "neighbors", "G001", 10,
+                                mode="approx", nprobe=8),
+            inventory.cache_key("j/v0", "neighbors", "G001", 10,
+                                mode="exact", nprobe=0)}
+    assert len(keys) == 4       # exact/0 == the default key, rest differ
+    assert inventory.cache_key("j/v0", "neighbors", "G001", 10,
+                               mode="exact", nprobe=0) == base
+
+
+def test_indexed_bundle_roundtrip_and_mode_attribution(tmp_path):
+    from g2vec_tpu.io.writers import INVENTORY_MANIFEST
+
+    dest = str(tmp_path / "inv" / "j1" / "v0")
+    emb, genes, _ = _plant_bundle(dest, g=96, h=8, seed=1, ann_nlist=8,
+                                  clustered=True)
+    with open(os.path.join(dest, INVENTORY_MANIFEST)) as f:
+        man = json.load(f)["files"]
+    for fn in ann.ANN_FILES:
+        assert fn in man and os.path.exists(os.path.join(dest, fn)), fn
+    with open(os.path.join(dest, "meta.json")) as f:
+        meta = json.load(f)
+    assert meta["ann"]["format"] == ann.ANN_FORMAT
+    assert meta["ann"]["nlist"] == 8 and meta["ann"]["build_ms"] >= 0
+
+    cat = inventory.InventoryCatalog([str(tmp_path / "inv")],
+                                     budget_bytes=1 << 30)
+    b = cat.get("j1/v0")
+    assert b.ann is not None and b.ann.nlist == 8 and b.ann_error is None
+    ent = next(e for e in cat.listing() if e["bundle"] == "j1/v0")
+    assert ent["ann"] is True
+
+    approx = inventory.run_query(cat, "neighbors", "j1/v0", gene=genes[5],
+                                 k=6, mode="approx", nprobe=2)
+    assert approx["recall_mode"] == "approx" and approx["mode"] == "approx"
+    assert approx["nprobe"] == 2 and approx["nlist"] == 8
+    assert 0 < approx["candidates"] < 96
+    exact = inventory.run_query(cat, "neighbors", "j1/v0", gene=genes[5],
+                                k=6, mode="exact")
+    assert exact["recall_mode"] == "exact"
+    ref_idx, ref_sims = _naive_cosine(emb, emb[5], 6, exclude=5)
+    assert exact["neighbors"] == [genes[i] for i in ref_idx]
+    # Full-width probe: approx answers == exact answers, values and all.
+    full = inventory.run_query(cat, "neighbors", "j1/v0", gene=genes[5],
+                               k=6, mode="approx", nprobe=8)
+    assert full["neighbors"] == exact["neighbors"]
+    assert full["sims"] == exact["sims"]
+    # Unindexed bundle: mode=approx silently serves exact, no warning.
+    _plant_bundle(str(tmp_path / "inv" / "j2" / "v0"), g=20, seed=2)
+    plain = inventory.run_query(cat, "neighbors", "j2/v0", gene="G000",
+                                k=3, mode="approx")
+    assert plain["recall_mode"] == "exact" and "ann_warning" not in plain
+    with pytest.raises(inventory.InventoryError) as ei:
+        inventory.run_query(cat, "neighbors", "j1/v0", gene=genes[0],
+                            mode="blended")
+    assert ei.value.code == "bad_query"
+    with pytest.raises(inventory.InventoryError):
+        inventory.run_query(cat, "neighbors", "j1/v0", gene=genes[0],
+                            nprobe=-1)
+
+
+def test_tampered_or_torn_index_falls_back_to_exact(tmp_path):
+    dest = str(tmp_path / "inv" / "j1" / "v0")
+    emb, genes, _ = _plant_bundle(dest, g=64, h=8, seed=6, ann_nlist=4)
+    _flip_byte(os.path.join(dest, "ann_postings.npy"))
+    cat = inventory.InventoryCatalog([str(tmp_path / "inv")],
+                                     budget_bytes=1 << 30)
+    b = cat.get("j1/v0")                 # maps: core arrays verify fine
+    assert b.ann is None and b.ann_error["code"] == "tampered"
+    resp = inventory.run_query(cat, "neighbors", "j1/v0", gene=genes[2],
+                               k=5, mode="approx")
+    assert resp["recall_mode"] == "exact_fallback"
+    assert resp["ann_warning"]["code"] == "tampered"
+    ref_idx, _ = _naive_cosine(emb, emb[2], 5, exclude=2)
+    assert resp["neighbors"] == [genes[i] for i in ref_idx]  # right answer
+    # Torn index (file deleted): same degradation, code "torn".
+    dest2 = str(tmp_path / "inv" / "j2" / "v0")
+    _plant_bundle(dest2, g=64, h=8, seed=7, ann_nlist=4)
+    os.unlink(os.path.join(dest2, "ann_offsets.npy"))
+    b2 = cat.get("j2/v0")
+    assert b2.ann is None and b2.ann_error["code"] == "torn"
+    r2 = inventory.run_query(cat, "neighbors", "j2/v0", gene="G001",
+                             k=3, mode="approx")
+    assert r2["recall_mode"] == "exact_fallback"
+    # mode=exact on the same bundle: clean, no warning attached.
+    r3 = inventory.run_query(cat, "neighbors", "j2/v0", gene="G001",
+                             k=3, mode="exact")
+    assert r3["recall_mode"] == "exact" and "ann_warning" not in r3
+    # Core arrays stay strict: the two-tier gate never loosened them.
+    dest3 = str(tmp_path / "inv" / "j3" / "v0")
+    _plant_bundle(dest3, g=32, h=8, seed=8, ann_nlist=4)
+    _flip_byte(os.path.join(dest3, "embeddings.npy"))
+    with pytest.raises(inventory.InventoryError) as ei:
+        cat.get("j3/v0")
+    assert ei.value.code == "tampered"
+
+
+def test_ann_build_fault_seam_corrupt_drill(tmp_path):
+    """kind=corrupt at the ann_build seam models post-manifest bitrot
+    of the staged index: publication succeeds, the manifest hash then
+    refuses the index at map time, and queries degrade to exact with
+    the structured warning — a corrupted index can never mis-answer."""
+    assert "ann_build" in faults.SEAMS
+    faults.install_plan("stage=ann_build,kind=corrupt")
+    try:
+        dest = str(tmp_path / "inv" / "j1" / "v0")
+        emb, genes, _ = _plant_bundle(dest, g=64, h=8, seed=9,
+                                      ann_nlist=4)
+    finally:
+        faults.install_plan(None)
+    cat = inventory.InventoryCatalog([str(tmp_path / "inv")],
+                                     budget_bytes=1 << 30)
+    b = cat.get("j1/v0")
+    assert b.ann is None and b.ann_error["code"] == "tampered"
+    resp = inventory.run_query(cat, "neighbors", "j1/v0", gene=genes[0],
+                               k=4, mode="approx")
+    assert resp["recall_mode"] == "exact_fallback"
+    ref_idx, _ = _naive_cosine(emb, emb[0], 4, exclude=0)
+    assert resp["neighbors"] == [genes[i] for i in ref_idx]
+
+
+# ---------------------------------------------------------------------------
+# Daemon: mode plumbing, cache separation, republish, fquery
+# ---------------------------------------------------------------------------
+
+def test_daemon_query_modes_and_cache_separation(tmp_path):
+    d = _daemon(tmp_path, ann_nlist=4)
+    jid = "i" + "a" * 12
+    dest = os.path.join(d.opts.state_dir, "inventory", jid, "v0")
+    emb, genes, _ = _plant_bundle(dest, g=48, h=8, seed=1, ann_nlist=4,
+                                  clustered=True)
+    base = {"q": "neighbors", "job_id": jid, "gene": genes[3], "k": 5}
+    ap = d.handle_query(dict(base))                    # default: approx
+    assert ap["event"] == "query_result"
+    assert ap["recall_mode"] == "approx" and ap["nlist"] == 4
+    ex = d.handle_query(dict(base, mode="exact"))
+    assert ex["recall_mode"] == "exact"
+    ref_idx, ref_sims = _naive_cosine(emb, emb[3], 5, exclude=3)
+    assert ex["neighbors"] == [genes[i] for i in ref_idx]
+    # Distinct cache entries per (mode, nprobe): repeating each exact
+    # request hits, switching mode/nprobe misses.
+    h0 = d.qcache.stats()["hits"]
+    assert d.handle_query(dict(base))["recall_mode"] == "approx"
+    assert d.qcache.stats()["hits"] == h0 + 1
+    n2 = d.handle_query(dict(base, nprobe=2))
+    assert n2["nprobe"] == 2
+    assert d.qcache.stats()["hits"] == h0 + 1          # a miss, cached new
+    assert d.handle_query(dict(base, mode="exact"))["recall_mode"] == \
+        "exact"
+    assert d.qcache.stats()["hits"] == h0 + 2
+    for bad in [dict(base, mode="blended"), dict(base, nprobe=-2),
+                dict(base, nprobe=True)]:
+        resp = d.handle_query(bad)
+        assert resp["event"] == "error" and resp["error"] == "bad_query"
+
+
+def test_daemon_republish_rebuilds_ann_index(tmp_path):
+    """Tamper-then-republish: the rebuilt bundle carries a fresh index
+    (daemon ann_nlist applies to republication too), so the approx
+    plane survives the round trip — mode=approx serves recall_mode
+    approx again, not a permanent exact_fallback."""
+    d = _daemon(tmp_path, ann_nlist=4)
+    jid = "i" + "b" * 12
+    rng = np.random.default_rng(3)
+    emb = rng.integers(-5, 6, size=(20, 8)).astype(np.float32)
+    genes = [f"G{i:03d}" for i in range(20)]
+    vec = os.path.join(str(tmp_path), "q_vectors.txt")
+    with open(vec, "w") as f:
+        f.write("GeneSymbol\t" + "\t".join(f"d{i}" for i in range(8))
+                + "\n")
+        for g, row in zip(genes, emb):
+            f.write(g + "\t" + "\t".join(repr(float(x)) for x in row)
+                    + "\n")
+    with open(os.path.join(d.opts.state_dir, "results", f"{jid}.json"),
+              "w") as f:
+        json.dump({"event": "job_done", "job_id": jid, "status": "done",
+                   "variants": {"v0": {"outputs": [vec]}}}, f)
+    dest = os.path.join(d.opts.state_dir, "inventory", jid, "v0")
+    _plant_bundle(dest, g=20, h=8, seed=3, ann_nlist=4)
+    _flip_byte(os.path.join(dest, "embeddings.npy"))   # core tamper
+
+    resp = d.handle_query({"q": "neighbors", "job_id": jid,
+                           "variant": "v0", "gene": "G000", "k": 3})
+    assert resp["event"] == "query_result", resp
+    assert resp["recall_mode"] == "approx"             # index rebuilt
+    want, _ = _naive_cosine(emb, emb[0], 3, exclude=0)
+    full = d.handle_query({"q": "neighbors", "job_id": jid,
+                           "variant": "v0", "gene": "G000", "k": 3,
+                           "nprobe": 4})               # nprobe == nlist
+    assert full["neighbors"] == [genes[i] for i in want]
+    meta = d.handle_query({"q": "meta", "job_id": jid, "variant": "v0"})
+    assert meta["meta"]["source"] == "republish"
+    assert meta["meta"]["ann"]["nlist"] == 4
+
+
+def test_daemon_fquery_gene_rank_and_bundle_overlap(tmp_path):
+    d = _daemon(tmp_path)
+    planted = {}
+    for jid, seed in [("i" + "c" * 12, 1), ("i" + "d" * 12, 2)]:
+        dest = os.path.join(d.opts.state_dir, "inventory", jid, "v0")
+        planted[jid] = _plant_bundle(dest, g=30, h=8, seed=seed,
+                                     ann_nlist=4)
+    # A scores-less bundle and a bundle missing the gene, for
+    # per-bundle attribution.
+    jid3 = "i" + "e" * 12
+    _plant_bundle(os.path.join(d.opts.state_dir, "inventory", jid3,
+                               "v0"), g=30, h=8, seed=3,
+                  with_scores=False)
+    jid4 = "i" + "f" * 12
+    from g2vec_tpu.io.writers import write_inventory_bundle
+    write_inventory_bundle(
+        os.path.join(d.opts.state_dir, "inventory", jid4, "v0"),
+        np.ones((5, 8), dtype=np.float32),
+        [f"X{i}" for i in range(5)], None, {"source": "test"})
+
+    fr = d.handle_fquery({"fq": "gene_rank", "gene": "G005", "k": 10})
+    assert fr["event"] == "fquery_result" and fr["ref_genes"] is None
+    by_bundle = {p["bundle"]: p for p in fr["bundles"]}
+    assert len(by_bundle) == 4
+    for jid in planted:
+        p = by_bundle[f"{jid}/v0"]
+        scores = planted[jid][2]
+        for row, group in enumerate(("good", "poor")):
+            s = scores[row]
+            want = int(1 + np.count_nonzero(s > s[5]))
+            assert p[group]["rank"] == want
+            assert p[group]["in_top_k"] == (want <= 10)
+    assert by_bundle[f"{jid3}/v0"]["error"] == "scores_unavailable"
+    assert by_bundle[f"{jid4}/v0"]["present"] is False
+    # Ranked bundles sort before errored/absent ones, best rank first.
+    ranked = [p for p in fr["bundles"] if "good" in p]
+    assert ranked == sorted(
+        ranked, key=lambda p: min(p["good"]["rank"], p["poor"]["rank"]))
+    assert fr["bundles"][-2:] == sorted(
+        fr["bundles"][-2:], key=lambda p: p["bundle"])
+
+    # bundle_overlap with the reference derived from a named bundle:
+    # the reference bundle overlaps itself fully.
+    jref = "i" + "c" * 12
+    ov = d.handle_fquery({"fq": "bundle_overlap", "gene": "G005",
+                          "k": 5, "job_id": jref})
+    assert ov["event"] == "fquery_result"
+    assert len(ov["ref_genes"]) == 5
+    parts = {p["bundle"]: p for p in ov["bundles"]}
+    assert parts[f"{jref}/v0"]["overlap"] == 1.0
+    assert parts[f"{jref}/v0"]["recall_mode"] in ("approx", "exact")
+    assert parts[f"{jid4}/v0"]["present"] is False
+    # Sorted by overlap descending (scored bundles first).
+    scored = [p["overlap"] for p in ov["bundles"]
+              if p.get("overlap") is not None]
+    assert scored == sorted(scored, reverse=True)
+    # Without ref_genes or a reference job: structured refusal.
+    bad = d.handle_fquery({"fq": "bundle_overlap", "gene": "G005"})
+    assert bad["event"] == "error" and bad["error"] == "bad_query"
+    assert d.handle_fquery({"fq": "nope", "gene": "G005"})["event"] == \
+        "error"
+
+
+def test_fquery_op_is_token_gated_on_the_wire(tmp_path):
+    d = _daemon(tmp_path, auth_token="sekret-43")
+    resp = _roundtrip(d, {"op": "fquery", "fq": "gene_rank",
+                          "gene": "G000"})
+    assert resp["event"] == "rejected" and resp["error"] == "unauthorized"
+    resp = _roundtrip(d, {"op": "fquery", "fq": "gene_rank",
+                          "gene": "G000", "auth_token": "sekret-43"})
+    assert resp["event"] == "fquery_result" and resp["bundles"] == []
+
+
+# ---------------------------------------------------------------------------
+# Router: federated scatter-gather with dead-owner disk reads
+# ---------------------------------------------------------------------------
+
+def test_router_fquery_answers_dead_replicas_from_disk(tmp_path):
+    """No replica process ever boots: every bundle owner is dead, so
+    the router answers the whole federated query from the shared fleet
+    directory, attributing each partial served_by=router +
+    replica_down=True — the read plane's failover contract extended to
+    fquery."""
+    from g2vec_tpu.serve.router import Router, RouterOptions
+
+    fleet_dir = str(tmp_path / "fleet")
+    r = Router(RouterOptions(fleet_dir=fleet_dir, replicas=2),
+               console=lambda s: None)
+    jid_a, jid_b = "i" + "a" * 12, "i" + "b" * 12
+    dest_a = os.path.join(fleet_dir, "r0", "state", "inventory", jid_a,
+                          "v0")
+    dest_b = os.path.join(fleet_dir, "r1", "state", "inventory", jid_b,
+                          "v0")
+    emb_a, genes, scores_a = _plant_bundle(dest_a, g=30, h=8, seed=1,
+                                           ann_nlist=4)
+    _plant_bundle(dest_b, g=30, h=8, seed=2)
+
+    fr = r.handle_fquery({"fq": "gene_rank", "gene": "G007", "k": 10})
+    assert fr["event"] == "fquery_result"
+    parts = {p["bundle"]: p for p in fr["bundles"]}
+    assert set(parts) == {f"{jid_a}/v0", f"{jid_b}/v0"}
+    for p in parts.values():
+        assert p["served_by"] == "router" and p["replica_down"] is True
+        assert p["good"]["rank"] >= 1 and p["poor"]["rank"] >= 1
+    s = scores_a[0]
+    assert parts[f"{jid_a}/v0"]["good"]["rank"] == \
+        int(1 + np.count_nonzero(s > s[7]))
+
+    # bundle_overlap: the reference resolves through the routed read
+    # (also a disk read here), then every bundle scores against it.
+    ov = r.handle_fquery({"fq": "bundle_overlap", "gene": "G007",
+                          "k": 5, "job_id": jid_a})
+    assert ov["event"] == "fquery_result" and len(ov["ref_genes"]) == 5
+    parts = {p["bundle"]: p for p in ov["bundles"]}
+    assert parts[f"{jid_a}/v0"]["overlap"] == 1.0
+    assert parts[f"{jid_a}/v0"]["recall_mode"] in ("approx", "exact")
+    assert parts[f"{jid_b}/v0"]["recall_mode"] == "exact"  # no index
+    assert all(p["replica_down"] for p in parts.values())
+    # Merge order: overlap descending, ties/absent by bundle key.
+    ovs = [p.get("overlap") for p in ov["bundles"]]
+    assert ovs == sorted(ovs, key=lambda v: (-1e9 if v is None else -v))
+
+    bad = r.handle_fquery({"fq": "bundle_overlap", "gene": "G007",
+                           "job_id": "i" + "z" * 12})
+    assert bad["event"] == "error" and bad["error"] == "not_found"
+    assert r.handle_fquery({"fq": "gene_rank", "gene": ""})["event"] == \
+        "error"

@@ -47,7 +47,11 @@ while [ "$arms" -lt "$MAX_ARMS" ] && [ "$(date +%s)" -lt "$DEADLINE" ]; do
     # ops + token gating, lazy republish, result bounding, router
     # failover reads), and the autoscale matrix (token-bucket/shed/
     # scaling-policy units, weighted-fair convergence, controller
-    # hysteresis, client shed backoff, router aggregate status).
+    # hysteresis, client shed backoff, router aggregate status), and the
+    # ann matrix (IVF build/probe units, nprobe>=nlist bitwise equality,
+    # the recall@k contract at pruning scale, index tamper/corrupt
+    # exact-fallback drills, federated fquery scatter-gather with
+    # dead-owner attribution).
     # Non-fatal: a red matrix is reported, the chip battery still runs.
     if ! JAX_PLATFORMS=cpu "$PY" -m pytest tests/test_resilience.py \
             tests/test_fleet.py tests/test_fleet_e2e.py \
@@ -55,7 +59,7 @@ while [ "$arms" -lt "$MAX_ARMS" ] && [ "$(date +%s)" -lt "$DEADLINE" ]; do
             tests/test_serve.py tests/test_stream.py tests/test_shard.py \
             tests/test_router.py tests/test_edge.py \
             tests/test_scenario.py tests/test_query.py \
-            tests/test_autoscale.py \
+            tests/test_autoscale.py tests/test_ann.py \
             -q -m "not slow" \
             -p no:cacheprovider >/tmp/fault_matrix_arm$arms.log 2>&1; then
         echo "[watch_loop] WARNING: fault/fleet matrix FAILED on arm $arms (log: /tmp/fault_matrix_arm$arms.log)"
