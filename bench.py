@@ -274,6 +274,42 @@ ANN_FED_P99_MS = float(os.environ.get("G2VEC_BENCH_ANN_FED_P99_MS", "100"))
 ANN_SEED = int(os.environ.get("G2VEC_BENCH_ANN_SEED", "0"))
 ANN_ARTIFACT = "BENCH_ANN.json"
 
+# Incremental update plane A/B (incremental.py + the serve update op):
+# cold pipeline run -> published bundle -> bootstrap update (records
+# per-range walk artifacts + fingerprints) -> (a) no-op re-update,
+# which must walk ZERO rows and republish byte-identical array files;
+# (b) a ~UPDATE_DELTA_FRAC edge delta, where the delta re-walk +
+# warm-start fine-tune must land within UPDATE_WALL_FRAC x the wall of
+# a cold retrain of the SAME updated inputs while holding the PR 7
+# statistical band against it; (c) a torn-read probe — at least
+# UPDATE_MIN_READS serve-path queries spanning UPDATE_FLIPS generation
+# flips, every answer a complete pre-flip or post-flip result.
+# The synthetic cohort is a scaled-up cousin of the band-validated
+# tests/test_update.py spec: enough patients that BOTH training
+# trajectories converge to the planted-module answer, and enough walk
+# volume that the walls measure the delta plane rather than fixed
+# per-run overheads. Env-shrinkable.
+UPDATE_GOOD = int(os.environ.get("G2VEC_BENCH_UPDATE_GOOD", "48"))
+UPDATE_POOR = int(os.environ.get("G2VEC_BENCH_UPDATE_POOR", "40"))
+UPDATE_MODULE = int(os.environ.get("G2VEC_BENCH_UPDATE_MODULE", "16"))
+UPDATE_SMOD = int(os.environ.get("G2VEC_BENCH_UPDATE_SMOD", "20"))
+UPDATE_BG = int(os.environ.get("G2VEC_BENCH_UPDATE_BG", "24"))
+UPDATE_BG_EDGES = int(os.environ.get("G2VEC_BENCH_UPDATE_BG_EDGES",
+                                     "40"))
+UPDATE_NBIO = int(os.environ.get("G2VEC_BENCH_UPDATE_NBIO", "16"))
+UPDATE_LENPATH = int(os.environ.get("G2VEC_BENCH_UPDATE_LENPATH", "32"))
+UPDATE_REPS = int(os.environ.get("G2VEC_BENCH_UPDATE_REPS", "48"))
+UPDATE_EPOCH = int(os.environ.get("G2VEC_BENCH_UPDATE_EPOCH", "60"))
+UPDATE_DELTA_FRAC = float(os.environ.get(
+    "G2VEC_BENCH_UPDATE_DELTA_FRAC", "0.005"))
+UPDATE_WALL_FRAC = float(os.environ.get(
+    "G2VEC_BENCH_UPDATE_WALL_FRAC", "0.35"))
+UPDATE_MIN_READS = int(os.environ.get("G2VEC_BENCH_UPDATE_MIN_READS",
+                                      "100"))
+UPDATE_FLIPS = int(os.environ.get("G2VEC_BENCH_UPDATE_FLIPS", "8"))
+UPDATE_SEED = int(os.environ.get("G2VEC_BENCH_UPDATE_SEED", "7"))
+UPDATE_ARTIFACT = "BENCH_UPDATE.json"
+
 # Million-node shard-scale sweep (parallel/shard.py + train/shard.py):
 # "genes:ranks" cells, run as real multi-process fleets of
 # tests/shard_worker.py over the KV transport. The diagonal (constant
@@ -2307,7 +2343,10 @@ def _ann_ab_line(note) -> dict:
     ops/knn.cosine_topk full scans; the largest size must clear
     ANN_SPEEDUP_MIN x with approx p99 under ANN_P99_MS and recall@10 at
     the default nprobe >= 0.95 (the pinned contract, measured not
-    assumed). (b) Recall curve: recall@10 / candidate fraction / p50
+    assumed); each size also A/Bs the posting-major candidate storage
+    (one contiguous slab read per probed list) against the row-gather
+    path — same queries, bitwise-equal answers required. (b) Recall
+    curve: recall@10 / candidate fraction / p50
     over the ANN_NPROBES ladder at the largest size, ending at
     nprobe=nlist where the result must be BITWISE equal to exact.
     (c) Federated: plant indexed bundles across a real router fleet's
@@ -2364,11 +2403,20 @@ def _ann_ab_line(note) -> dict:
         cents, posts, offs = ann.build_ivf(emb, nlist)
         build_s = time.perf_counter() - t0
         index = ann.IVFIndex(cents, posts, offs, g, ANN_HIDDEN)
+        # Posting-major twin: same lists, but candidate vectors stored
+        # contiguously in posting order so each probed list is one slab.
+        t0 = time.perf_counter()
+        pm_index = ann.IVFIndex(cents, posts, offs, g, ANN_HIDDEN,
+                                pvecs=np.ascontiguousarray(emb[posts]))
+        pm_build_s = time.perf_counter() - t0
         qidx = rng.integers(0, g, size=ANN_QUERIES)
-        for qi in qidx[:8]:     # warm both paths (allocator, BLAS)
+        for qi in qidx[:8]:     # warm all three paths (allocator, BLAS)
             knn.cosine_topk(emb, norms, emb[qi], k, exclude=int(qi))
             ann.ivf_topk(emb, norms, index, emb[qi], k,
                          nprobe=ann.DEFAULT_NPROBE, exclude=int(qi))
+            ann.ivf_topk(emb, norms, pm_index, emb[qi], k,
+                         nprobe=ann.DEFAULT_NPROBE, exclude=int(qi),
+                         posting_major=True)
         ex_ms, exact_ids = [], []
         for qi in qidx:
             t1 = time.perf_counter()
@@ -2376,15 +2424,29 @@ def _ann_ab_line(note) -> dict:
                                      exclude=int(qi))
             ex_ms.append((time.perf_counter() - t1) * 1e3)
             exact_ids.append(set(int(i) for i in idx))
-        ap_ms, hits, cands = [], 0, 0
+        ap_ms, hits, cands, gather_out = [], 0, 0, []
         for qi, ex in zip(qidx, exact_ids):
             t1 = time.perf_counter()
-            idx, _, nc = ann.ivf_topk(emb, norms, index, emb[qi], k,
-                                      nprobe=ann.DEFAULT_NPROBE,
-                                      exclude=int(qi))
+            idx, sims, nc = ann.ivf_topk(emb, norms, index, emb[qi], k,
+                                         nprobe=ann.DEFAULT_NPROBE,
+                                         exclude=int(qi))
             ap_ms.append((time.perf_counter() - t1) * 1e3)
             hits += len(ex & set(int(i) for i in idx))
             cands += nc
+            gather_out.append((idx, sims))
+        # Storage A/B: same queries through the posting-major slab
+        # layout — must be bitwise-equal to the gather path at the
+        # same nprobe (pvecs rows are byte-equal copies).
+        pm_ms, pm_bitwise = [], True
+        for qi, (gi, gs) in zip(qidx, gather_out):
+            t1 = time.perf_counter()
+            idx, sims, _ = ann.ivf_topk(emb, norms, pm_index, emb[qi],
+                                        k, nprobe=ann.DEFAULT_NPROBE,
+                                        exclude=int(qi),
+                                        posting_major=True)
+            pm_ms.append((time.perf_counter() - t1) * 1e3)
+            pm_bitwise &= (np.array_equal(gi, idx)
+                           and np.array_equal(gs, sims))
         # Full-probe spot check: nprobe=nlist must be bitwise exact.
         bitwise = True
         for qi in qidx[:10]:
@@ -2407,12 +2469,21 @@ def _ann_ab_line(note) -> dict:
             "cand_frac": round(cands / (len(qidx) * g), 4),
             "nprobe": ann.DEFAULT_NPROBE,
             "bitwise_full_probe_ok": bool(bitwise),
+            "pm_build_s": round(pm_build_s, 3),
+            "pm_qps": round(len(pm_ms) / (sum(pm_ms) / 1e3), 1),
+            "pm_p50_ms": _pct(pm_ms, 0.5),
+            "pm_p99_ms": _pct(pm_ms, 0.99),
+            "pm_bitwise_vs_gather_ok": bool(pm_bitwise),
         }
         row["speedup_x"] = round(row["approx_qps"]
                                  / max(row["exact_qps"], 1e-9), 2)
+        row["pm_vs_gather_x"] = round(row["pm_qps"]
+                                      / max(row["approx_qps"], 1e-9), 2)
         frontier.append(row)
         note(f"frontier g={g}: exact {row['exact_qps']} qps, approx "
-             f"{row['approx_qps']} qps ({row['speedup_x']}x), recall@10 "
+             f"{row['approx_qps']} qps ({row['speedup_x']}x), "
+             f"posting-major {row['pm_qps']} qps "
+             f"({row['pm_vs_gather_x']}x vs gather), recall@10 "
              f"{row['recall_at_10']}, cand {row['cand_frac']:.1%}, "
              f"build {row['build_s']}s")
     largest = frontier[-1]
@@ -2443,7 +2514,7 @@ def _ann_ab_line(note) -> dict:
         note(f"recall curve nprobe={npr}: recall@10 "
              f"{curve[-1]['recall_at_10']}, cand "
              f"{curve[-1]['cand_frac']:.1%}, p50 {curve[-1]['p50_ms']}ms")
-    emb = norms = index = None     # release before the fleet boots
+    emb = norms = index = pm_index = None   # release before fleet boot
 
     # ---- (c) federated fquery storm with a mid-window SIGKILL ---------
     prng = random.Random(ANN_SEED)
@@ -2616,6 +2687,7 @@ def _ann_ab_line(note) -> dict:
           and largest["approx_p99_ms"] < ANN_P99_MS
           and largest["recall_at_10"] >= 0.95
           and all(r["bitwise_full_probe_ok"] for r in frontier)
+          and all(r["pm_bitwise_vs_gather_ok"] for r in frontier)
           and curve[-1]["recall_at_10"] == 1.0
           and fed_ok)
     return {
@@ -2625,7 +2697,9 @@ def _ann_ab_line(note) -> dict:
         "recall_contract": 0.95, "k": k, "seed": ANN_SEED,
         "frontier": frontier, "recall_curve": curve, "federated": fed,
         "note": "frontier: per-query approx (IVF, default nprobe) vs "
-                "exact full-scan QPS on clustered embeddings; recall "
+                "exact full-scan QPS on clustered embeddings, plus a "
+                "posting-major storage A/B (contiguous slab reads vs "
+                "row gathers, bitwise-equal answers); recall "
                 "curve ends at nprobe=nlist (bitwise-equal to exact); "
                 "federated: seeded gene_rank/bundle_overlap storm vs a "
                 "live router fleet, one bundle-owning replica "
@@ -2647,6 +2721,301 @@ def _ann_ab() -> None:
         json.dump({"line": line, "code_key": _current_code_key(repo),
                    "written_by": "bench.py --_ann_ab"}, f, indent=1)
     note(f"wrote {ANN_ARTIFACT}")
+    if not line["ok"]:
+        sys.exit(1)
+
+
+def _update_ab_line(note) -> dict:
+    """Incremental update plane A/B — the PR 19 proof.
+
+    One synthetic cohort (a scaled-up cousin of the band-validated
+    tests/test_update.py spec), four checkpoints. (a) Cold pipeline run ->
+    published bundle -> bootstrap update, which re-walks every owner
+    range once and records per-range walk artifacts + fingerprints.
+    (b) No-op re-update: fingerprint-identical inputs must walk ZERO
+    rows, hit the cache on every range, and republish array files that
+    are byte-for-byte the prior generation's. (c) ~UPDATE_DELTA_FRAC
+    edge delta: the delta re-walk + warm-start fine-tune must finish
+    within UPDATE_WALL_FRAC x the wall of a cold retrain of the SAME
+    updated inputs (both timed compile-warm, same process) while
+    holding the PR 7 statistical band against it. (d) Torn-read probe:
+    >= UPDATE_MIN_READS serve-path queries spanning UPDATE_FLIPS
+    generation flips — every answer must be a complete pre-flip or
+    post-flip result for its gene, never a mix.
+    """
+    import dataclasses
+    import shutil
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from g2vec_tpu import pipeline
+    from g2vec_tpu.cache import resolve_cache_tiers
+    from g2vec_tpu.config import G2VecConfig
+    from g2vec_tpu.data.synthetic import SyntheticSpec, write_synthetic_tsv
+    from g2vec_tpu.incremental import (BAND_DACC, BAND_OVERLAP,
+                                       run_update, within_band)
+    from g2vec_tpu.io.writers import read_generation, write_inventory_bundle
+    from g2vec_tpu.serve.daemon import ServeDaemon, ServeOptions
+
+    ARRAYS = ("embeddings.npy", "norms.npy", "scores.npy", "genes.txt")
+
+    def _array_bytes(gen_dir):
+        out = {}
+        for fn in ARRAYS:
+            with open(os.path.join(gen_dir, fn), "rb") as f:
+                out[fn] = f.read()
+        return out
+
+    wd = tempfile.mkdtemp(prefix="g2v-upd-")
+    try:
+        spec = SyntheticSpec(n_good=UPDATE_GOOD, n_poor=UPDATE_POOR,
+                             module_size=UPDATE_MODULE,
+                             shared_module_size=UPDATE_SMOD,
+                             n_background=UPDATE_BG,
+                             n_expr_only=4, n_net_only=4,
+                             module_chords=2,
+                             background_edges=UPDATE_BG_EDGES,
+                             seed=UPDATE_SEED)
+        syn = os.path.join(wd, "syn")
+        os.makedirs(syn, exist_ok=True)
+        tsv = write_synthetic_tsv(spec, syn)
+        os.makedirs(os.path.join(wd, "out"), exist_ok=True)
+        cfg = G2VecConfig(
+            expression_file=tsv["expression"],
+            clinical_file=tsv["clinical"],
+            network_file=tsv["network"],
+            result_name=os.path.join(wd, "out", "cold"),
+            lenPath=UPDATE_LENPATH, numRepetition=UPDATE_REPS,
+            sizeHiddenlayer=16, epoch=UPDATE_EPOCH, learningRate=0.05,
+            numBiomarker=UPDATE_NBIO, compute_dtype="float32",
+            walker_backend="device",
+            cache_dir=os.path.join(wd, "cache"))
+
+        # ---- (a) cold run -> publish -> bootstrap update --------------
+        t0 = time.perf_counter()
+        cold = pipeline.run(cfg, console=lambda s: None)
+        cold_first_wall = time.perf_counter() - t0
+        note(f"cold run (compile-inclusive): {cold_first_wall:.1f}s, "
+             f"acc {cold.acc_val:.3f}")
+        bundle = os.path.join(wd, "bundle")
+        write_inventory_bundle(bundle, cold.embeddings, list(cold.genes),
+                               cold.biomarker_scores, {"source": "cold"},
+                               ann_nlist=4, seed_centroids=cold.km_centers)
+        _, wc = resolve_cache_tiers(cfg.cache_dir, None, True)
+        up1 = run_update(cfg, bundle, walk_cache=wc)
+        write_inventory_bundle(
+            bundle, up1.embeddings, up1.genes, up1.biomarker_scores,
+            {"source": "update"}, ann_nlist=4,
+            seed_centroids=up1.km_centers,
+            extra_files={"delta_fingerprints.json": up1.fingerprints})
+        boot = {k: up1.stats[k] for k in
+                ("mode", "walked_rows", "ranges_rewalked", "ranges_total",
+                 "n_genes", "wall_s")}
+        boot["ok"] = (boot["mode"] == "bootstrap"
+                      and boot["ranges_rewalked"] == boot["ranges_total"])
+        note(f"bootstrap: {boot['ranges_total']} ranges, "
+             f"{boot['walked_rows']} rows, {boot['wall_s']}s")
+
+        # ---- (b) no-op re-update: zero walks, byte-identical arrays ---
+        up2 = run_update(cfg, bundle, walk_cache=wc)
+        gen_prev = os.path.join(bundle, read_generation(bundle))
+        gen_noop = write_inventory_bundle(
+            bundle, up2.embeddings, up2.genes, up2.biomarker_scores,
+            {"source": "update"}, ann_nlist=4,
+            extra_files={"delta_fingerprints.json": up2.fingerprints})
+        byte_identical = _array_bytes(gen_prev) == _array_bytes(gen_noop)
+        noop = {k: up2.stats[k] for k in
+                ("mode", "walked_rows", "ranges_rewalked", "cache_hits",
+                 "ranges_total", "wall_s")}
+        noop["byte_identical_arrays"] = bool(byte_identical)
+        noop["ok"] = (noop["mode"] == "noop" and noop["walked_rows"] == 0
+                      and noop["cache_hits"] == noop["ranges_total"]
+                      and byte_identical)
+        note(f"noop: walked {noop['walked_rows']} rows, byte-identical "
+             f"arrays {byte_identical}, {noop['wall_s']}s")
+
+        # ---- (c) ~1% edge delta: delta wall vs cold-retrain wall ------
+        with open(tsv["network"]) as f:
+            lines = f.read().splitlines()
+        header, rows = lines[0], [r for r in lines[1:] if r.strip()]
+        have = set()
+        for r in rows:
+            a, b = r.split("\t")[:2]
+            have.add((a, b))
+            have.add((b, a))
+        gmod = sorted({g for pair in have for g in pair
+                       if g.startswith("GMOD")})
+        m = max(1, int(round(UPDATE_DELTA_FRAC * len(rows))))
+        new_pairs = []
+        for a in gmod:
+            for b in gmod:
+                if a < b and (a, b) not in have:
+                    new_pairs.append((a, b))
+                    have.add((a, b))
+                    have.add((b, a))
+                if len(new_pairs) >= m:
+                    break
+            if len(new_pairs) >= m:
+                break
+        net2 = os.path.join(wd, "net_delta.txt")
+        with open(net2, "w") as f:
+            f.write("\n".join([header] + rows
+                              + [f"{a}\t{b}" for a, b in new_pairs])
+                    + "\n")
+        cfg_d = dataclasses.replace(
+            cfg, network_file=net2,
+            result_name=os.path.join(wd, "out", "cold2"))
+        t0 = time.perf_counter()
+        cold2 = pipeline.run(cfg_d, console=lambda s: None)
+        cold_wall = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        upd = run_update(cfg_d, bundle, walk_cache=wc)
+        delta_wall = time.perf_counter() - t0
+        ratio = delta_wall / max(cold_wall, 1e-9)
+        band_ok, band_detail = within_band(
+            upd.acc_val, cold2.acc_val, upd.biomarkers, cold2.biomarkers)
+        delta = {k: upd.stats[k] for k in
+                 ("mode", "walked_rows", "ranges_rewalked",
+                  "ranges_total", "cache_hits", "epochs", "stop_epoch")}
+        delta.update({
+            "edges_added": len(new_pairs), "edges_base": len(rows),
+            "delta_frac": round(len(new_pairs) / len(rows), 4),
+            "cold_first_wall_s": round(cold_first_wall, 3),
+            "cold_wall_s": round(cold_wall, 3),
+            "delta_wall_s": round(delta_wall, 3),
+            "wall_frac": round(ratio, 3),
+            "wall_budget": UPDATE_WALL_FRAC,
+            "subset_rewalk": bool(0 < delta["ranges_rewalked"]
+                                  < delta["ranges_total"]),
+        })
+        delta["ok"] = (delta["mode"] == "delta" and delta["subset_rewalk"]
+                       and ratio <= UPDATE_WALL_FRAC)
+        band = {"dacc": band_detail["dacc"],
+                "overlap": band_detail["overlap"],
+                "dacc_budget": BAND_DACC, "overlap_floor": BAND_OVERLAP,
+                "delta_acc": round(float(upd.acc_val), 4),
+                "cold_acc": round(float(cold2.acc_val), 4),
+                "ok": bool(band_ok)}
+        note(f"delta: +{len(new_pairs)} edges "
+             f"({delta['delta_frac']:.1%}), rewalked "
+             f"{delta['ranges_rewalked']}/{delta['ranges_total']} "
+             f"ranges, wall {delta['delta_wall_s']}s vs cold "
+             f"{delta['cold_wall_s']}s ({delta['wall_frac']}x), band "
+             f"dacc {band['dacc']} overlap {band['overlap']}")
+
+        # ---- (d) torn-read probe across generation flips --------------
+        sd = ServeDaemon(ServeOptions(
+            socket_path=os.path.join(wd, "serve.sock"),
+            state_dir=os.path.join(wd, "state")), console=lambda s: None)
+        try:
+            rng = np.random.default_rng(UPDATE_SEED)
+            g, h = 64, 16
+            genes = [f"G{i:05d}" for i in range(g)]
+            emb_a = rng.standard_normal((g, h)).astype(np.float32)
+            emb_b = np.ascontiguousarray(emb_a[::-1])
+            probes = genes[:6]
+
+            def plant(jid, emb):
+                root = os.path.join(sd.opts.state_dir, "inventory",
+                                    jid, "v0")
+                write_inventory_bundle(root, emb, genes, None, {})
+                return root
+
+            plant("i" + "a" * 12, emb_a)
+            plant("i" + "b" * 12, emb_b)
+            live = plant("i" + "e" * 12, emb_a)
+
+            def answer(jid, gene):
+                r = sd.handle_query({"q": "neighbors", "job_id": jid,
+                                     "variant": "v0", "gene": gene,
+                                     "k": 5, "mode": "exact"})
+                if r.get("event") != "query_result":
+                    raise RuntimeError(str(r)[:200])
+                return (tuple(r["neighbors"]), tuple(r["sims"]))
+
+            expect = {gene: {answer("i" + "a" * 12, gene),
+                             answer("i" + "b" * 12, gene)}
+                      for gene in probes}
+            stop = threading.Event()
+
+            def writer():
+                for i in range(UPDATE_FLIPS):
+                    emb = emb_b if i % 2 == 0 else emb_a
+                    write_inventory_bundle(live, emb, genes, None, {})
+                    key = "i" + "e" * 12 + "/v0"
+                    sd.catalog.invalidate(key)
+                    sd.qcache.invalidate_bundle(key)
+                    sd._inv_known = {}
+                    time.sleep(0.05)
+                stop.set()
+
+            t = threading.Thread(target=writer)
+            t.start()
+            reads, torn = 0, 0
+            while not stop.is_set() or reads < 2 * UPDATE_MIN_READS:
+                gene = probes[reads % len(probes)]
+                if answer("i" + "e" * 12, gene) not in expect[gene]:
+                    torn += 1
+                reads += 1
+                if reads > 20000:
+                    break
+                # In-process reads are ~50k/s; pace them so the read
+                # window actually spans every flip.
+                time.sleep(0.0005)
+            t.join()
+        finally:
+            sd.close()
+        torn_probe = {"reads": reads, "flips": UPDATE_FLIPS,
+                      "torn": torn, "min_reads": UPDATE_MIN_READS,
+                      "ok": bool(reads >= UPDATE_MIN_READS
+                                 and torn == 0)}
+        note(f"torn probe: {reads} reads across {UPDATE_FLIPS} flips, "
+             f"{torn} torn")
+    finally:
+        shutil.rmtree(wd, ignore_errors=True)
+
+    ok = (boot["ok"] and noop["ok"] and delta["ok"] and band["ok"]
+          and torn_probe["ok"])
+    return {
+        "metric": "update_delta_wall_frac",
+        "value": delta["wall_frac"], "unit": "x_cold_wall",
+        "budget": UPDATE_WALL_FRAC, "ok": ok, "seed": UPDATE_SEED,
+        "cohort": {"n_good": UPDATE_GOOD, "n_poor": UPDATE_POOR,
+                   "module_size": UPDATE_MODULE,
+                   "shared_module_size": UPDATE_SMOD,
+                   "n_background": UPDATE_BG,
+                   "background_edges": UPDATE_BG_EDGES,
+                   "numBiomarker": UPDATE_NBIO,
+                   "lenPath": UPDATE_LENPATH, "reps": UPDATE_REPS,
+                   "epoch": UPDATE_EPOCH},
+        "bootstrap": boot, "noop": noop, "delta": delta, "band": band,
+        "torn_probe": torn_probe,
+        "note": "delta wall vs cold-retrain wall, both compile-warm in "
+                "one process; no-op republish must be byte-identical; "
+                "band is the PR 7 contract (|dACC|, top-N biomarker "
+                "overlap) vs a cold retrain of the updated inputs; "
+                "torn probe hammers a live daemon across generation "
+                "flips (complete-old or complete-new, never a mix)",
+    }
+
+
+def _update_ab() -> None:
+    """Standalone mode: run the incremental-update A/B and refresh the
+    committed artifact."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    def note(msg):
+        print(f"# {msg}", file=sys.stderr, flush=True)
+
+    line = _update_ab_line(note)
+    print(json.dumps(line), flush=True)
+    repo = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(repo, UPDATE_ARTIFACT), "w") as f:
+        json.dump({"line": line, "code_key": _current_code_key(repo),
+                   "written_by": "bench.py --_update_ab"}, f, indent=1)
+    note(f"wrote {UPDATE_ARTIFACT}")
     if not line["ok"]:
         sys.exit(1)
 
@@ -4029,6 +4398,8 @@ if __name__ == "__main__":
         _query_latency()
     elif "--_ann_ab" in sys.argv:
         _ann_ab()
+    elif "--_update_ab" in sys.argv:
+        _update_ab()
     elif "--_chaos_soak" in sys.argv:
         _chaos_soak()
     elif "--_shard_scale" in sys.argv:

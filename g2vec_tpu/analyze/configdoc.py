@@ -43,7 +43,8 @@ _PAYLOAD_FILES = ("g2vec_tpu/serve/daemon.py",
 _ENVELOPES = {"payload": "SUBMIT_KEYS",
               "qreq": "QUERY_KEYS",
               "fqreq": "FQUERY_KEYS",
-              "rreq": "RESULT_KEYS"}
+              "rreq": "RESULT_KEYS",
+              "ureq": "UPDATE_KEYS"}
 
 
 def _tuple_of_str(tree: ast.Module, name: str) -> Optional[Set[str]]:

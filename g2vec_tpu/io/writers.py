@@ -32,6 +32,52 @@ import numpy as np
 #: carries run-context fields — job id, publish source — and is excluded).
 INVENTORY_ARRAYS = ("embeddings.npy", "norms.npy", "scores.npy", "genes.txt")
 INVENTORY_MANIFEST = "MANIFEST.json"
+#: Generation pointer at the bundle ROOT: one line naming the live
+#: generation subdirectory (``gen-NNNNNN``). Written tmp + os.replace
+#: LAST, so the flip is the atomic commit point — a reader resolves
+#: the pointer and maps entirely-old or entirely-new files, never a
+#: mix. Bundles written before the generational layout have their
+#: files flat in the root (no pointer) and stay readable as-is.
+GENERATION_FILE = "GENERATION"
+#: Top-M prognostic-score shortlist rows per group kept in
+#: ``ann_scores.npy`` (int64 ``[2, M]``) — computed with the exact
+#: top-k kernel at build time, so serving a k <= M biomarker query
+#: from the prefix is identical to the exact answer by construction.
+ANN_SCORE_TOPM = 1024
+
+
+def read_generation(bundle_dir: str) -> str:
+    """The live generation subdir name from ``bundle_dir``'s pointer,
+    or ``""`` for a pre-generational flat bundle (files in the root)."""
+    try:
+        with open(os.path.join(bundle_dir, GENERATION_FILE)) as f:
+            return f.read().strip()
+    except OSError:
+        return ""
+
+
+def _next_generation(bundle_dir: str) -> int:
+    """Serial for the next generation: one past the live pointer's, or
+    past the highest ``gen-*`` dir present (an orphan from a crash
+    between the subdir rename and the pointer flip must not be reused)."""
+    serial = 0
+    cur = read_generation(bundle_dir)
+    if cur.startswith("gen-"):
+        try:
+            serial = int(cur[4:])
+        except ValueError:
+            serial = 0
+    try:
+        names = os.listdir(bundle_dir)
+    except OSError:
+        names = []
+    for name in names:
+        if name.startswith("gen-"):
+            try:
+                serial = max(serial, int(name[4:]))
+            except ValueError:
+                continue
+    return serial + 1
 
 
 def write_biomarkers(result_name: str, biomarkers: Sequence[str]) -> str:
@@ -148,16 +194,30 @@ def write_inventory_bundle(bundle_dir: str, embeddings: np.ndarray,
                            genes: Sequence[str],
                            scores: Optional[np.ndarray],
                            meta: dict, ann_nlist: int = 0,
-                           seed_centroids: Optional[np.ndarray] = None
+                           seed_centroids: Optional[np.ndarray] = None,
+                           extra_files: Optional[dict] = None
                            ) -> str:
-    """Publish one query-plane bundle at ``bundle_dir`` (atomically).
+    """Publish one query-plane bundle generation under ``bundle_dir``.
 
-    The whole bundle is staged in a ``.tmp.<pid>`` sibling and renamed
-    into place, so a reader never maps a torn half-written directory —
-    it sees either the old bundle, the new one, or nothing. The sha256
-    manifest (written last, atomically itself) is the read-side
-    integrity gate: serve/inventory.py refuses to map a bundle whose
-    manifest is missing or whose hashes mismatch.
+    Generation-atomic: the new contents are staged in a ``.tmp.<pid>``
+    sibling, renamed to ``<bundle_dir>/gen-NNNNNN``, and COMMITTED by
+    rewriting the :data:`GENERATION` pointer (tmp + ``os.replace``,
+    rename-last). A concurrent reader resolves the pointer once and
+    maps entirely-old or entirely-new files — never a torn mix — and
+    a crash anywhere before the pointer flip leaves the prior
+    generation serving untouched (the orphan subdir is swept by the
+    next publish). The previous generation is kept on disk so in-flight
+    readers of the old pointer still resolve; older ones are removed.
+    The sha256 manifest (written before the renames, atomically itself)
+    is the read-side integrity gate: serve/inventory.py refuses to map
+    a generation whose manifest is missing or whose hashes mismatch.
+
+    ``extra_files`` maps extra file names to JSON-serializable objects
+    written into the generation and sha256'd into its manifest — the
+    update plane stores its ``delta_fingerprints.json`` this way
+    (``delta_``/``ann_`` prefixed files ride the LENIENT verification
+    tier: corruption costs incrementality or index coverage, never
+    query correctness).
 
     ``scores`` may be ``None`` for a partial republication from the
     durable record's text outputs (the ``[2, G]`` score matrix is not
@@ -216,8 +276,27 @@ def write_inventory_bundle(bundle_dir: str, embeddings: np.ndarray,
                 allow_pickle=False)
         np.save(os.path.join(tmp, "ann_offsets.npy"), offsets,
                 allow_pickle=False)
+        # Posting-major vector copy: the RAW float32 rows reordered so
+        # a probed list's candidates are one contiguous slab (streams)
+        # instead of a fancy-indexed gather (~100 ns/row of cache
+        # misses). Raw — not pre-normalized — because bitwise equality
+        # with the gather path requires the identical row-dot-then-
+        # divide arithmetic of ops/knn.
+        np.save(os.path.join(tmp, "ann_vectors.npy"),
+                np.ascontiguousarray(embeddings[postings]),
+                allow_pickle=False)
+        score_topm = 0
+        if scores is not None:
+            from g2vec_tpu.ops.knn import topk_scores
+            score_topm = min(int(embeddings.shape[0]), ANN_SCORE_TOPM)
+            short = np.stack([topk_scores(scores[r], score_topm)[0]
+                              for r in range(scores.shape[0])])
+            np.save(os.path.join(tmp, "ann_scores.npy"),
+                    short.astype(np.int64), allow_pickle=False)
         ann_meta = {"format": ann_ops.ANN_FORMAT, "nlist": int(nlist),
                     "nprobe_default": ann_ops.DEFAULT_NPROBE,
+                    "posting_major": True,
+                    "score_topm": int(score_topm),
                     "seeded": bool(
                         seed_centroids is not None
                         and np.asarray(seed_centroids).ndim == 2
@@ -225,6 +304,8 @@ def write_inventory_bundle(bundle_dir: str, embeddings: np.ndarray,
                         == embeddings.shape[1]),
                     "build_ms": round(
                         (_time.perf_counter() - t0) * 1000.0, 3)}
+    for name, obj in sorted((extra_files or {}).items()):
+        write_json_atomic(os.path.join(tmp, name), obj)
     meta = dict(meta, n_genes=int(embeddings.shape[0]),
                 hidden=int(embeddings.shape[1]),
                 has_scores=scores is not None, ann=ann_meta)
@@ -242,10 +323,48 @@ def write_inventory_bundle(bundle_dir: str, embeddings: np.ndarray,
         # (serve/inventory.py) must catch and downgrade to exact.
         fault_point("ann_build",
                     path=os.path.join(tmp, "ann_postings.npy"))
-    shutil.rmtree(bundle_dir, ignore_errors=True)
-    os.makedirs(os.path.dirname(bundle_dir), exist_ok=True)
-    os.rename(tmp, bundle_dir)
-    return bundle_dir
+    os.makedirs(bundle_dir, exist_ok=True)
+    gen_name = "gen-%06d" % _next_generation(bundle_dir)
+    os.rename(tmp, os.path.join(bundle_dir, gen_name))
+    # BEFORE the pointer flip: a kind=crash here leaves the new
+    # generation orphaned and the OLD pointer serving — the mid-flip
+    # SIGKILL drill; journal recovery replays the publish. A
+    # kind=corrupt flips bytes in the pointer the reader must refuse.
+    fault_point("update_publish",
+                path=os.path.join(bundle_dir, GENERATION_FILE))
+    ptmp = os.path.join(bundle_dir, f".{GENERATION_FILE}.tmp.{os.getpid()}")
+    with open(ptmp, "w") as f:
+        f.write(gen_name + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(ptmp, os.path.join(bundle_dir, GENERATION_FILE))
+    _gc_generations(bundle_dir, gen_name)
+    return os.path.join(bundle_dir, gen_name)
+
+
+def _gc_generations(bundle_dir: str, live: str) -> None:
+    """Sweep everything but the live generation, its immediate
+    predecessor (in-flight readers of the just-replaced pointer must
+    still resolve; the no-delta byte-identity check also compares
+    across the last flip), and the pointer itself. Removes legacy flat
+    bundle files on the first generational publish over an old-layout
+    bundle — open maps of them stay valid (POSIX unlink semantics)."""
+    try:
+        serial = int(live[4:])
+    except ValueError:
+        return
+    keep = {live, "gen-%06d" % (serial - 1), GENERATION_FILE}
+    for name in sorted(os.listdir(bundle_dir)):
+        if name in keep:
+            continue
+        path = os.path.join(bundle_dir, name)
+        try:
+            if os.path.isdir(path):
+                shutil.rmtree(path, ignore_errors=True)
+            else:
+                os.unlink(path)
+        except OSError:
+            pass
 
 
 def write_vectors(result_name: str, vectors: np.ndarray,

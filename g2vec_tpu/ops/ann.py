@@ -179,7 +179,8 @@ class IVFIndex:
     refused before it can ever mis-answer a query."""
 
     def __init__(self, centroids: np.ndarray, postings: np.ndarray,
-                 offsets: np.ndarray, n_rows: int, hidden: int):
+                 offsets: np.ndarray, n_rows: int, hidden: int,
+                 pvecs: Optional[np.ndarray] = None):
         centroids = np.asarray(centroids)
         postings = np.asarray(postings)
         offsets = np.asarray(offsets)
@@ -207,13 +208,19 @@ class IVFIndex:
         self.offsets = off
         self.nlist = nlist
         self.n_rows = int(n_rows)
+        if pvecs is not None:
+            pvecs = np.asarray(pvecs)
+            if pvecs.ndim != 2 or pvecs.shape[0] != int(n_rows) \
+                    or pvecs.shape[1] != int(hidden):
+                raise ValueError(f"ann posting-major vectors "
+                                 f"{pvecs.shape} vs [{n_rows}, {hidden}]")
+        self.pvecs = pvecs
 
-    def probe(self, q: np.ndarray, nprobe: int) -> np.ndarray:
-        """Sorted (ascending, duplicate-free) candidate row ids from
-        the ``nprobe`` nearest lists — nearest under the SAME metric
-        the build assigned rows with (squared euclidean against the
-        normalized query), so a row always probes its own list first
-        when the query sits on it."""
+    def probe_lists(self, q: np.ndarray, nprobe: int) -> np.ndarray:
+        """Ascending-sorted ids of the ``nprobe`` nearest lists —
+        nearest under the SAME metric the build assigned rows with
+        (squared euclidean against the normalized query), so a row
+        always probes its own list first when the query sits on it."""
         nprobe = min(max(int(nprobe), 1), self.nlist)
         q = np.asarray(q, dtype=np.float32).reshape(-1)
         qn = np.sqrt(np.dot(q, q))
@@ -225,17 +232,83 @@ class IVFIndex:
             lists = np.argpartition(scores, nprobe - 1)[:nprobe]
         else:
             lists = np.arange(self.nlist)
+        return np.sort(lists)
+
+    def probe(self, q: np.ndarray, nprobe: int) -> np.ndarray:
+        """Sorted (ascending, duplicate-free) candidate row ids from
+        the ``nprobe`` nearest lists (:meth:`probe_lists`)."""
         parts = [np.asarray(
             self.postings[self.offsets[li]:self.offsets[li + 1]])
-            for li in np.sort(lists)]
+            for li in self.probe_lists(q, nprobe)]
         if not parts:
             return np.empty(0, dtype=np.int64)
         return np.sort(np.concatenate(parts).astype(np.int64))
 
 
+def posting_major_topk(norms: np.ndarray, index: IVFIndex, q: np.ndarray,
+                       k: int, nprobe: int = DEFAULT_NPROBE,
+                       exclude: int = -1, block_rows: int = 8192
+                       ) -> "Tuple[np.ndarray, np.ndarray, int]":
+    """The streaming twin of probe + :func:`knn.cosine_topk_subset`:
+    candidate vectors come from the index's posting-major copy
+    (``index.pvecs``), so each probed list is ONE contiguous slab read
+    instead of a per-row fancy-indexed gather over the ``[G, H]`` map.
+
+    Bitwise-equality contract (pinned by tests/test_ann.py): slab
+    reads only assemble the candidate ARENA — the dots themselves run
+    over the arena reordered to ascending global row id, in the SAME
+    ``block_rows`` blocks as :func:`knn.cosine_topk_subset`, followed
+    by the same ``np.where`` zero-norm guard against ``norms[row] *
+    qn``, the same ``-inf`` exclude, and the same ``_topk_desc``
+    select. Matching the GEMV block shapes is load-bearing, not
+    cosmetic: BLAS dispatches different accumulation kernels by
+    operand shape, so the same float32 row dotted inside a 39-row
+    slab and inside an 8192-row block can differ in the last ulp.
+    Scoring per-list slabs directly would therefore break bitwise
+    equality at scale even though every row value is byte-identical.
+    """
+    lists = index.probe_lists(q, nprobe)
+    q32 = np.asarray(q, dtype=np.float32).reshape(-1)
+    qn = np.sqrt(np.dot(q32, q32))
+    id_parts, vec_parts = [], []
+    for li in lists:
+        o0, o1 = index.offsets[li], index.offsets[li + 1]
+        if o1 <= o0:
+            continue
+        id_parts.append(np.asarray(index.postings[o0:o1],
+                                   dtype=np.int64))
+        vec_parts.append(np.asarray(index.pvecs[o0:o1],
+                                    dtype=np.float32))
+    if not id_parts:
+        return (np.empty(0, dtype=np.int64),
+                np.empty(0, dtype=np.float32), 0)
+    ids = np.concatenate(id_parts)
+    # Rows live in exactly one list, so ids are unique; sorting them
+    # ascending makes position order == global row id order, the
+    # precondition for _topk_desc's tie rule matching the exact path.
+    order = np.argsort(ids, kind="stable")
+    ids = ids[order]
+    vecs = np.concatenate(vec_parts)[order]
+    m = ids.shape[0]
+    sims = np.empty(m, dtype=np.float32)
+    for lo in range(0, m, block_rows):
+        hi = min(m, lo + block_rows)
+        sims[lo:hi] = vecs[lo:hi] @ q32
+    denom = np.asarray(norms, dtype=np.float32)[ids] * qn
+    ok = denom > 0
+    sims = np.where(ok, sims / np.where(ok, denom, 1), np.float32(-2.0))
+    if 0 <= exclude < index.n_rows:
+        pos = np.searchsorted(ids, exclude)
+        if pos < ids.shape[0] and ids[pos] == exclude:
+            sims[pos] = -np.inf
+    loc = knn._topk_desc(sims, k)
+    return ids[loc], sims[loc], int(ids.size)
+
+
 def ivf_topk(emb: np.ndarray, norms: np.ndarray, index: IVFIndex,
              q: np.ndarray, k: int, nprobe: int = DEFAULT_NPROBE,
-             exclude: int = -1, block_rows: int = 8192
+             exclude: int = -1, block_rows: int = 8192,
+             posting_major: Optional[bool] = None
              ) -> "Tuple[np.ndarray, np.ndarray, int]":
     """Approximate cosine top-k: probe, then exact-rescore survivors.
 
@@ -244,9 +317,29 @@ def ivf_topk(emb: np.ndarray, norms: np.ndarray, index: IVFIndex,
     delegates to :func:`ops.knn.cosine_topk` outright, so the
     degenerate case is STRUCTURALLY bitwise-equal to the exact path,
     not merely numerically close.
+
+    ``posting_major`` selects the candidate storage: ``None`` (auto)
+    streams the contiguous posting-ordered copy whenever the index
+    carries one (:func:`posting_major_topk` — bitwise-equal answers),
+    ``False`` forces the row-gather path (the bench A/B control),
+    ``True`` requires the copy and raises without it.
     """
-    cand = index.probe(q, nprobe)
     g = emb.shape[0]
+    use_pm = (index.pvecs is not None) if posting_major is None \
+        else bool(posting_major)
+    if use_pm and index.pvecs is None:
+        raise ValueError("posting_major=True but the index carries no "
+                         "posting-major vector copy")
+    if use_pm:
+        nprobe_eff = min(max(int(nprobe), 1), index.nlist)
+        if nprobe_eff >= index.nlist:
+            idx, sims = knn.cosine_topk(emb, norms, q, k,
+                                        exclude=exclude,
+                                        block_rows=block_rows)
+            return idx, sims, g
+        return posting_major_topk(norms, index, q, k, nprobe=nprobe,
+                                  exclude=exclude, block_rows=block_rows)
+    cand = index.probe(q, nprobe)
     if cand.size >= g:
         idx, sims = knn.cosine_topk(emb, norms, q, k, exclude=exclude,
                                     block_rows=block_rows)

@@ -930,32 +930,36 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print,
                 else:
                     from g2vec_tpu.io.writers import write_inventory_bundle
 
-                    bundle = write_inventory_bundle(
-                        cfg.result_name + "_inventory",
+                    bundle_root = cfg.result_name + "_inventory"
+                    gen_dir = write_inventory_bundle(
+                        bundle_root,
                         np.asarray(result.w_ih, dtype=np.float32),
                         list(data.gene), scores2,
                         {"source": "solo",
                          "result_name": os.path.basename(cfg.result_name)},
                         ann_nlist=cfg.ann_nlist,
                         seed_centroids=km_centers)
-                    console("    %s" % bundle)
+                    console("    %s" % gen_dir)
                     metrics.emit(
-                        "inventory", bundle=os.path.basename(bundle),
-                        bytes=sum(os.path.getsize(os.path.join(bundle, f))
-                                  for f in os.listdir(bundle)),
+                        "inventory", bundle=os.path.basename(bundle_root),
+                        bytes=sum(
+                            os.path.getsize(os.path.join(gen_dir, f))
+                            for f in os.listdir(gen_dir)),
                         outcome="published")
-                    with open(os.path.join(bundle, "meta.json")) as mf:
+                    with open(os.path.join(gen_dir, "meta.json")) as mf:
                         ann_meta = json.load(mf).get("ann")
                     if ann_meta:
                         metrics.emit(
-                            "ann_build", bundle=os.path.basename(bundle),
+                            "ann_build",
+                            bundle=os.path.basename(bundle_root),
                             nlist=ann_meta.get("nlist"), outcome="built",
                             ms=ann_meta.get("build_ms"),
                             seeded=ann_meta.get("seeded"),
                             postings=n_genes)
                     else:
                         metrics.emit(
-                            "ann_build", bundle=os.path.basename(bundle),
+                            "ann_build",
+                            bundle=os.path.basename(bundle_root),
                             nlist=0, outcome="skipped")
         _stage_edge("save")
         for path in outputs:

@@ -132,7 +132,17 @@ SEAMS = ("load", "preprocess", "paths", "train", "lgroups", "biomarkers",
          # corrupt drill): the index is refused at map time with a
          # structured warning and queries fall back to the exact path —
          # a corrupted index can never change answers.
-         "ann_build")
+         "ann_build",
+         # Generation-atomic republish (io/writers.py): fires between
+         # the staged generation directory's rename into the bundle and
+         # the GENERATION pointer flip — the exact window a SIGKILL
+         # leaves an orphan gen-* directory on disk with the pointer
+         # (and therefore every reader) still on the old generation.
+         # The update drill (tests/test_update.py) pins the contract:
+         # queries keep answering from the old generation, the orphan
+         # is swept by the next successful publish, and the journaled
+         # update re-runs to completion after relaunch.
+         "update_publish")
 
 
 class FaultPlanError(ValueError):

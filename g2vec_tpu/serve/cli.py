@@ -417,11 +417,28 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
             with src:
                 job = json.load(src)
             try:
-                events = client.submit_job(args.socket, job,
-                                           tenant=args.tenant,
-                                           priority=args.priority,
-                                           deadline_s=args.deadline_s,
-                                           auth_token=token)
+                #: a submit file may carry the whole ``update`` op
+                #: envelope (op/job_id/idem_key/job/...) — route it to
+                #: the incremental-retrain path instead of nesting the
+                #: envelope inside a plain submit's job object (where
+                #: the schema gate would reject `op` as an unknown key).
+                if isinstance(job, dict) and job.get("op") == "update":
+                    events = client.update_job(
+                        args.socket, job.get("job_id", ""),
+                        job.get("job", {}),
+                        idem_key=job.get("idem_key", ""),
+                        variant=job.get("variant"),
+                        epochs=int(job.get("epochs", 0) or 0),
+                        tenant=args.tenant,
+                        priority=args.priority,
+                        deadline_s=args.deadline_s,
+                        auth_token=token)
+                else:
+                    events = client.submit_job(args.socket, job,
+                                               tenant=args.tenant,
+                                               priority=args.priority,
+                                               deadline_s=args.deadline_s,
+                                               auth_token=token)
             except client.ServeConnectionLost as e:
                 print(json.dumps({"event": "connection_lost",
                                   "job_id": e.job_id, "error": str(e)}))

@@ -156,6 +156,48 @@ def submit_job(socket_path: str, job: dict, tenant: str = "default",
         f"{job_id or '<unacknowledged>'} finished", job_id=job_id)
 
 
+def update_job(socket_path: str, target_job_id: str, job: dict,
+               idem_key: str, variant: Optional[str] = None,
+               epochs: int = 0, tenant: str = "default",
+               timeout: Optional[float] = None,
+               priority: Optional[str] = None,
+               deadline_s: Optional[float] = None,
+               auth_token: Optional[str] = None) -> List[dict]:
+    """Incrementally retrain ``target_job_id``'s published bundle from
+    the updated inputs in ``job`` and stream events to completion —
+    the ``update`` op. ``idem_key`` is REQUIRED (the op is
+    idempotency-keyed: a resubmit after a lost ack dedups instead of
+    retraining twice). ``epochs`` bounds the warm-start fine-tune
+    (0 lets the daemon pick). Same return/raise contract as
+    :func:`submit_job`."""
+    events: List[dict] = []
+    payload = {"op": "update", "job_id": target_job_id, "job": job,
+               "idem_key": idem_key, "tenant": tenant}
+    if variant is not None:
+        payload["variant"] = variant
+    if epochs:
+        payload["epochs"] = int(epochs)
+    if priority is not None:
+        payload["priority"] = priority
+    if deadline_s is not None:
+        payload["deadline_s"] = deadline_s
+    if auth_token is not None:
+        payload["auth_token"] = auth_token
+    try:
+        for ev in request(socket_path, payload, timeout=timeout):
+            events.append(ev)
+            kind = ev.get("event")
+            if kind == "rejected" or kind in _TERMINAL:
+                return events
+    except socket.timeout:
+        raise ServeTimeout(
+            f"no event from the daemon within {timeout}s while waiting "
+            f"on the update of {target_job_id}") from None
+    raise ServeConnectionLost(
+        f"daemon stream closed before the update of {target_job_id} "
+        f"finished")
+
+
 def _one(socket_path: str, op: str, timeout: Optional[float],
          auth_token: Optional[str] = None, **fields) -> dict:
     payload = {"op": op, **fields}

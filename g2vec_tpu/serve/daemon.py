@@ -251,6 +251,15 @@ class ServeJob:
     #: Client-generated idempotency key; job_id is derived from it, so a
     #: retried/re-routed submission dedups instead of duplicating.
     idem_key: Optional[str] = None
+    #: The write half of the read plane: set (to the target bundle's
+    #: job_id) when this job is an ``update`` op. Update jobs carry no
+    #: lanes — they bypass the engine batch and run the incremental
+    #: engine (delta re-walk + warm-start fine-tune), then republish
+    #: the target bundle as a new generation. Their join_key is unique,
+    #: so they never merge into a training batch.
+    update_of: Optional[str] = None
+    update_variant: Optional[str] = None
+    update_epochs: int = 0       # 0 = incremental.run_update's default
     cancel_ev: threading.Event = dataclasses.field(
         default_factory=threading.Event)
 
@@ -673,6 +682,57 @@ class ServeDaemon:
         variants_spec = base.pop("variants", None)
         seeds = base.pop("seeds", 0)
         cfg = config_from_job(base, self._defaults)
+        #: ``update`` payloads share the submit pipeline end to end
+        #: (validation, idem dedup, quotas, journal, recovery) but plan
+        #: into a lane-less job the scheduler hands to the incremental
+        #: engine instead of a training batch.
+        ureq = payload if payload.get("op") == "update" else None
+        if ureq is not None:
+            if not idem_key:
+                raise ValueError(
+                    "update requires 'idem_key' — the op is "
+                    "idempotency-keyed by contract (resubmits after a "
+                    "lost ack must dedup, and failover re-derives the "
+                    "same id on any replica)")
+            target = ureq.get("job_id")
+            if not isinstance(target, str) or not target:
+                raise ValueError("update needs a 'job_id' string naming "
+                                 "the target bundle")
+            uvariant = ureq.get("variant")
+            if uvariant is not None and not isinstance(uvariant, str):
+                raise ValueError(f"'variant' must be a string, "
+                                 f"got {uvariant!r}")
+            epochs = ureq.get("epochs", 0)
+            if not isinstance(epochs, int) or isinstance(epochs, bool) \
+                    or epochs < 0:
+                raise ValueError(f"'epochs' must be a non-negative int, "
+                                 f"got {epochs!r}")
+            if variants_spec is not None or seeds:
+                raise ValueError("an update job cannot set 'variants' "
+                                 "or 'seeds' — it targets one existing "
+                                 "bundle")
+            raw = {k: v for k, v in payload.items()
+                   if k not in ("auth_token", "relay_token", "requeue",
+                                "submitted_at", "router_epoch")}
+            if submitted_at is None and self._trusted_requeue(payload):
+                sa = payload.get("submitted_at")
+                if isinstance(sa, (int, float)) \
+                        and not isinstance(sa, bool):
+                    submitted_at = float(sa)
+            job = ServeJob(
+                job_id=(idem_job_id(idem_key) if job_id is None
+                        else job_id),
+                tenant=tenant, cfg=cfg, variants=[], raw=raw,
+                submitted_at=(time.time() if submitted_at is None
+                              else submitted_at),
+                priority=priority, deadline_s=deadline_s,
+                idem_key=idem_key, update_of=target,
+                update_variant=uvariant, update_epochs=epochs)
+            # Unique join key: an update must never merge into an
+            # engine batch, and two updates of one bundle must run
+            # serially (distinct ids -> distinct keys -> no join).
+            job.join_key = ("update", job.job_id)
+            return job
         if variants_spec is not None and seeds:
             raise ValueError("job sets both 'variants' and 'seeds' — "
                              "pick one")
@@ -1146,6 +1206,17 @@ class ServeDaemon:
         job = self._queue.pop(timeout=timeout)
         if job is None:
             return 0
+        if job.update_of is not None:
+            if job.cancel_ev.is_set():
+                self._finish_terminal(job, "cancelled",
+                                      "cancelled while queued")
+                return 0
+            if job.deadline_expired():
+                self._finish_terminal(
+                    job, "deadline_exceeded",
+                    f"deadline_s={job.deadline_s} elapsed while queued")
+                return 0
+            return self._run_update_job(job)
         batch = [job] + self._queue.take_compatible(
             job.join_key, self.opts.max_join - 1)
         # Pre-execution lifecycle filter: a job cancelled or past its
@@ -1364,6 +1435,152 @@ class ServeDaemon:
             for j in batch:
                 self._running.pop(j.job_id, None)
 
+    def _run_update_job(self, job: ServeJob) -> int:
+        """One ``update`` job: delta re-walk + warm-start fine-tune
+        (incremental.run_update) against the target bundle's live
+        generation, then a generation-atomic republish. Shares the
+        submit lifecycle — journaled until the durable record lands
+        (SIGKILL replays from the journal), parks when fenced, honors
+        cancel/deadline/drain at the engine's check boundaries."""
+        with self._lock:
+            self._running[job.job_id] = job
+        try:
+            return self._run_update_inner(job)
+        finally:
+            with self._lock:
+                self._running.pop(job.job_id, None)
+
+    def _run_update_inner(self, job: ServeJob) -> int:
+        key, err = self._resolve_bundle(job.update_of,
+                                        job.update_variant)
+        if err is not None:
+            self._fail_or_requeue(
+                job, f"update target: {err.get('error')}: "
+                     f"{err.get('detail')}", "fatal")
+            return 0
+        path = self._inv_known.get(key)
+        self._job_state(job.job_id, "started", update_of=key,
+                        attempt=job.attempts)
+        self._notify(job, {"event": "started", "job_id": job.job_id,
+                           "update_of": key,
+                           "epochs": job.update_epochs})
+
+        def check() -> None:
+            if self._draining:
+                raise DrainRequested(detail="daemon drain")
+            if self._fenced():
+                raise DrainRequested(detail="fenced by router")
+            if job.cancel_ev.is_set():
+                raise JobCancelled(job.job_id)
+            if job.deadline_expired():
+                raise JobDeadlineExceeded(
+                    job.job_id, detail=f"deadline_s={job.deadline_s}")
+
+        from g2vec_tpu.cache import resolve_cache_tiers
+        from g2vec_tpu.incremental import run_update
+
+        _, wc = resolve_cache_tiers(
+            job.cfg.cache_dir or self.opts.cache_dir, None,
+            job.cfg.walk_cache)
+        t0 = time.time()
+        try:
+            res = run_update(
+                job.cfg, path, walk_cache=wc,
+                epochs=job.update_epochs, console=self.console,
+                check=check,
+                emit=lambda kind, **f: self.metrics.emit(
+                    kind, bundle=key, job_id=job.job_id, **f))
+        except JobInterrupted as e:
+            if isinstance(e, DrainRequested):
+                self._job_state(job.job_id, "drained", update_of=key)
+                self._notify(job, {"event": "job_drained",
+                                   "job_id": job.job_id,
+                                   "note": "update stays journaled and "
+                                           "re-runs on the next start"})
+                self._notify(job, None)
+            else:
+                self._finish_terminal(job, e.reason, str(e))
+            return 0
+        except BaseException as e:  # noqa: BLE001 — classified below
+            if isinstance(e, (KeyboardInterrupt, SystemExit)):
+                raise
+            from g2vec_tpu.resilience.supervisor import classify_exception
+
+            err_s = f"{type(e).__name__}: {e}"[:500]
+            self.console(f"[serve] update {job.job_id} failed: {err_s}")
+            self._fail_or_requeue(job, err_s, classify_exception(e))
+            return 0
+        wall = time.time() - t0
+        if self._fenced():
+            # Between the last check boundary and the publish: the
+            # survivor owns this update now. No pointer flip, no record.
+            self._job_state(job.job_id, "drained", update_of=key)
+            self._notify(job, {"event": "job_drained",
+                               "job_id": job.job_id,
+                               "note": "replica fenced; update stays "
+                                       "journaled for migration"})
+            self._notify(job, None)
+            return 0
+        from g2vec_tpu.io.writers import write_inventory_bundle
+
+        try:
+            gen_dir = write_inventory_bundle(
+                path, res.embeddings, res.genes, res.biomarker_scores,
+                {"source": "update", "job_id": job.update_of,
+                 "variant": job.update_variant, "tenant": job.tenant,
+                 "updated_by": job.job_id, "mode": res.stats["mode"]},
+                ann_nlist=self.opts.ann_nlist,
+                seed_centroids=res.km_centers,
+                extra_files={"delta_fingerprints.json":
+                             res.fingerprints})
+        except (OSError, ValueError) as e:
+            # Unlike a submit's best-effort publish, republication IS
+            # the update's deliverable — failure fails the job.
+            self._fail_or_requeue(
+                job, f"republish failed: {type(e).__name__}: {e}"[:500],
+                "retryable" if isinstance(e, OSError) else "fatal")
+            return 0
+        generation = os.path.basename(gen_dir)
+        # The invalidation triple (same order as _publish_inventory):
+        # readers re-map the new generation, every cached answer keyed
+        # to the old generation becomes unreachable, resolution rescans.
+        self.catalog.invalidate(key)
+        self.qcache.invalidate_bundle(key)
+        self._inv_known = {}
+        self.metrics.emit(
+            "republish", bundle=key, generation=generation,
+            mode=res.stats["mode"],
+            bytes=sum(os.path.getsize(os.path.join(gen_dir, fn))
+                      for fn in os.listdir(gen_dir)))
+        self._emit_ann_build(key, gen_dir)
+        now = time.time()
+        acc = res.acc_val
+        record = {"event": "job_done", "job_id": job.job_id,
+                  "tenant": job.tenant, "status": "done",
+                  "idem_key": job.idem_key, "update_of": key,
+                  "generation": generation, "stats": res.stats,
+                  "acc_val": (None if acc != acc else round(acc, 6)),
+                  "wall_seconds": round(wall, 3),
+                  "latency_seconds": round(now - job.submitted_at, 3),
+                  "submitted_at": job.submitted_at, "finished_at": now}
+        write_json_atomic(
+            os.path.join(self._results_dir, f"{job.job_id}.json"),
+            record)
+        self._unjournal(job)
+        self._cleanup_ckpt(job.job_id)
+        with self._lock:
+            self.jobs_done += 1
+        self._tenant_count(job.tenant, "done")
+        self._job_state(job.job_id, "done", update_of=key)
+        self.metrics.emit("update", bundle=key, job_id=job.job_id,
+                          generation=generation, **res.stats)
+        self._notify(job, record)
+        self._notify(job, None)
+        self.console(f"[serve] update {job.job_id} -> {key} "
+                     f"({generation}, mode={res.stats['mode']}, "
+                     f"walked={res.stats['walked_rows']}) in {wall:.2f}s")
+        return 1
+
     def _route_outputs(self, job: ServeJob, v: LaneVariant, lane) -> List[str]:
         """Move a lane's spool files to the job's requested result_name —
         a rename, so served bytes ARE the engine's lane bytes."""
@@ -1401,7 +1618,7 @@ class ServeDaemon:
                               error="lane carried no embedding table")
             return
         try:
-            write_inventory_bundle(
+            gen_dir = write_inventory_bundle(
                 dest, lane.embeddings, list(lane.genes),
                 lane.biomarker_scores,
                 {"source": "serve", "job_id": job.job_id,
@@ -1424,10 +1641,11 @@ class ServeDaemon:
         self._inv_known = {}
         self.metrics.emit(
             "inventory", bundle=key,
-            bytes=sum(os.path.getsize(os.path.join(dest, fn))
-                      for fn in os.listdir(dest)),
+            bytes=sum(os.path.getsize(os.path.join(gen_dir, fn))
+                      for fn in os.listdir(gen_dir)),
+            generation=os.path.basename(gen_dir),
             outcome="published")
-        self._emit_ann_build(key, dest)
+        self._emit_ann_build(key, gen_dir)
 
     def _emit_ann_build(self, key: str, dest: str) -> None:
         """One ``ann_build`` event per publication, read back from the
@@ -1478,7 +1696,7 @@ class ServeDaemon:
             # not recoverable from text outputs, so the deterministic
             # row seeding applies): a republished bundle must not
             # silently lose its approximate path.
-            write_inventory_bundle(
+            gen_dir = write_inventory_bundle(
                 dest, emb, genes, None,
                 {"source": "republish", "job_id": job_id,
                  "variant": variant,
@@ -1494,10 +1712,11 @@ class ServeDaemon:
         self._inv_known = {}
         self.metrics.emit(
             "inventory", bundle=key,
-            bytes=sum(os.path.getsize(os.path.join(dest, fn))
-                      for fn in os.listdir(dest)),
+            bytes=sum(os.path.getsize(os.path.join(gen_dir, fn))
+                      for fn in os.listdir(gen_dir)),
+            generation=os.path.basename(gen_dir),
             outcome="republished")
-        self._emit_ann_build(key, dest)
+        self._emit_ann_build(key, gen_dir)
         return True
 
     def _fail_or_requeue(self, job: ServeJob, err: str,
@@ -1623,8 +1842,13 @@ class ServeDaemon:
                 raise
 
         try:
+            # The generation joins the cache key: a republish flips the
+            # pointer, which changes every key, which makes any cached
+            # pre-flip answer structurally unreachable — the cache can
+            # never serve a stale generation (tests/test_update.py).
             resp, was_hit = self.qcache.get_or_put(
-                inventory.cache_key(key, q, gene, k, mode, nprobe),
+                inventory.cache_key(key, q, gene, k, mode, nprobe,
+                                    self.catalog.generation(key)),
                 compute)
         except inventory.InventoryError as e:
             self.metrics.emit("query", q=q, cache="miss", bundle=key,
@@ -1807,8 +2031,8 @@ class ServeDaemon:
                 return
             op = req.get("op")
             if self.opts.auth_token is not None \
-                    and op in ("submit", "cancel", "drain", "shutdown",
-                               "query", "fquery") \
+                    and op in ("submit", "update", "cancel", "drain",
+                               "shutdown", "query", "fquery") \
                     and req.get("auth_token") != self.opts.auth_token:
                 # Tenancy is checked AT ADMISSION: a mutating op without
                 # the shared secret never reaches planning or the queue.
@@ -1822,7 +2046,7 @@ class ServeDaemon:
                         "detail": f"op {op!r} requires a valid "
                                   f"'auth_token' on this listener"})
                 return
-            if op in ("submit", "cancel", "drain", "shutdown"):
+            if op in ("submit", "update", "cancel", "drain", "shutdown"):
                 # Fencing gate, mutating ops only: a command stamped
                 # with a superseded leadership epoch comes from a
                 # zombie ex-leader — reject it structurally so the
@@ -1833,7 +2057,10 @@ class ServeDaemon:
                 if stale is not None:
                     protocol.write_event(f, stale)
                     return
-            if op == "submit":
+            if op in ("submit", "update"):
+                # ``update`` is a write: it rides the submit pipeline
+                # (idem dedup, quotas, journal, event stream) and is
+                # told apart at planning by its op field.
                 sub: "queue.Queue" = queue.Queue()
                 resp = self.admit(req, subscriber=sub)
                 protocol.write_event(f, resp)

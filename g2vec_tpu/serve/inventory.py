@@ -2,16 +2,24 @@
 
 The batch plane (daemon/engine) produces embeddings; this module serves
 them at interactive rates without ever holding a full ``[G, H]`` table
-per query. A *bundle* is the binary directory written by
+per query. A *bundle* is the generational directory written by
 ``io/writers.write_inventory_bundle``::
 
     <root>/<key>/
-        embeddings.npy   float32 [G, H]
-        norms.npy        float32 [G] precomputed row L2 norms
-        scores.npy       float32 [2, G] prognostic scores (optional)
-        genes.txt        one symbol per row, row order == array order
-        meta.json        lane/run metadata (job_id, variant, config echo)
-        MANIFEST.json    sha256 + byte size per file (utils/integrity)
+        GENERATION       pointer: one line naming the live generation
+        gen-NNNNNN/
+            embeddings.npy   float32 [G, H]
+            norms.npy        float32 [G] precomputed row L2 norms
+            scores.npy       float32 [2, G] prognostic scores (optional)
+            genes.txt        one symbol per row, row order == array order
+            meta.json        lane/run metadata (job_id, variant, config)
+            MANIFEST.json    sha256 + byte size per file (utils/integrity)
+
+A reader resolves the pointer ONCE at map time and reads every file
+from that generation, so a concurrent republish (the ``update`` op's
+atomic flip — writers.py renames the pointer last) can never hand it a
+mixed old/new file set. Bundles from before the generational layout
+keep their files flat in ``<key>/`` (no pointer) and map unchanged.
 
 The daemon publishes one bundle per completed (job, variant) under
 ``<state>/inventory/<job_id>/<variant>/``; solo runs with
@@ -41,9 +49,16 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from g2vec_tpu.io.writers import INVENTORY_MANIFEST
+from g2vec_tpu.io.writers import (GENERATION_FILE, INVENTORY_MANIFEST,
+                                  read_generation)
 from g2vec_tpu.ops import ann as ann_ops
 from g2vec_tpu.ops import knn
+
+#: Manifest-name prefixes on the LENIENT verification tier: derived
+#: data whose corruption may cost coverage (index probes, biomarker
+#: shortlists, delta fingerprints) but never correctness — the exact
+#: arrays stay strict.
+LENIENT_PREFIXES = ("ann_", "delta_")
 
 #: Sub-ops a ``query`` request may name (protocol vocabulary; the CLI
 #: and daemon/router dispatch validate against this tuple).
@@ -83,13 +98,23 @@ class _Bundle:
     """
 
     def __init__(self, path: str):
-        man_path = os.path.join(path, INVENTORY_MANIFEST)
+        # Resolve the generation pointer ONCE; every file below reads
+        # from the resolved root, so a republish flipping the pointer
+        # mid-map cannot hand this bundle a mixed file set.
+        generation = read_generation(path)
+        if generation and (not generation.startswith("gen-")
+                           or "/" in generation or ".." in generation):
+            raise InventoryError(
+                "torn", f"{path}: corrupt {GENERATION_FILE} pointer "
+                        f"({generation!r})")
+        root = os.path.join(path, generation) if generation else path
+        man_path = os.path.join(root, INVENTORY_MANIFEST)
         try:
             with open(man_path) as f:
                 manifest = json.load(f)
         except FileNotFoundError:
             raise InventoryError(
-                "torn", f"{path}: no {INVENTORY_MANIFEST} (interrupted "
+                "torn", f"{root}: no {INVENTORY_MANIFEST} (interrupted "
                 f"publication or not a bundle)")
         except ValueError as e:
             raise InventoryError("torn", f"{man_path}: unparseable ({e})")
@@ -102,59 +127,65 @@ class _Bundle:
         # refused AT MAP TIME with a structured warning and the bundle
         # still serves through the exact path. A corrupted index can
         # therefore never change an answer, only slow one down.
-        ann_bad: Optional[dict] = None
+        bad: Dict[str, dict] = {}
         for name, want in sorted(files.items()):
-            fp = os.path.join(path, name)
-            is_ann = name.startswith("ann_")
+            fp = os.path.join(root, name)
+            lenient = name.startswith(LENIENT_PREFIXES)
             if not os.path.exists(fp):
-                if is_ann:
-                    ann_bad = ann_bad or {
+                if lenient:
+                    bad.setdefault(name.split("_", 1)[0], {
                         "code": "torn",
-                        "detail": f"{path}: manifest names {name} but "
-                                  f"it is missing"}
+                        "detail": f"{root}: manifest names {name} but "
+                                  f"it is missing"})
                     continue
-                raise InventoryError("torn", f"{path}: manifest names "
+                raise InventoryError("torn", f"{root}: manifest names "
                                              f"{name} but it is missing")
             if os.path.getsize(fp) != want.get("bytes"):
-                if is_ann:
-                    ann_bad = ann_bad or {
+                if lenient:
+                    bad.setdefault(name.split("_", 1)[0], {
                         "code": "tampered",
                         "detail": f"{fp}: {os.path.getsize(fp)} bytes, "
-                                  f"manifest says {want.get('bytes')}"}
+                                  f"manifest says {want.get('bytes')}"})
                     continue
                 raise InventoryError(
                     "tampered", f"{fp}: {os.path.getsize(fp)} bytes, "
                                 f"manifest says {want.get('bytes')}")
             if sha256_file(fp) != want.get("sha256"):
-                if is_ann:
-                    ann_bad = ann_bad or {
+                if lenient:
+                    bad.setdefault(name.split("_", 1)[0], {
                         "code": "tampered",
-                        "detail": f"{fp}: sha256 mismatch vs manifest"}
+                        "detail": f"{fp}: sha256 mismatch vs manifest"})
                     continue
                 raise InventoryError("tampered", f"{fp}: sha256 mismatch "
                                                  f"vs manifest")
+        ann_bad = bad.get("ann")
         for required in ("embeddings.npy", "norms.npy", "genes.txt",
                          "meta.json"):
             if required not in files:
                 raise InventoryError(
-                    "torn", f"{path}: manifest lacks {required}")
+                    "torn", f"{root}: manifest lacks {required}")
         self.path = path
-        self.embeddings = np.load(os.path.join(path, "embeddings.npy"),
+        self.root = root
+        #: The live generation name mapped at construction ("" for a
+        #: pre-generational flat bundle). Part of the QueryCache key,
+        #: so a republish structurally invalidates cached answers.
+        self.generation = generation
+        self.embeddings = np.load(os.path.join(root, "embeddings.npy"),
                                   mmap_mode="r", allow_pickle=False)
-        self.norms = np.load(os.path.join(path, "norms.npy"),
+        self.norms = np.load(os.path.join(root, "norms.npy"),
                              mmap_mode="r", allow_pickle=False)
         self.scores = None
         if "scores.npy" in files:
-            self.scores = np.load(os.path.join(path, "scores.npy"),
+            self.scores = np.load(os.path.join(root, "scores.npy"),
                                   mmap_mode="r", allow_pickle=False)
-        with open(os.path.join(path, "genes.txt")) as f:
+        with open(os.path.join(root, "genes.txt")) as f:
             self.genes: List[str] = [ln.rstrip("\n") for ln in f]
-        with open(os.path.join(path, "meta.json")) as f:
+        with open(os.path.join(root, "meta.json")) as f:
             self.meta = json.load(f)
         if self.embeddings.ndim != 2 or \
                 self.embeddings.shape[0] != len(self.genes):
             raise InventoryError(
-                "tampered", f"{path}: embeddings {self.embeddings.shape} "
+                "tampered", f"{root}: embeddings {self.embeddings.shape} "
                             f"vs {len(self.genes)} genes")
         self.gene_index: Dict[str, int] = {
             g: i for i, g in enumerate(self.genes)}
@@ -164,6 +195,10 @@ class _Bundle:
         #: no index (below the auto threshold, or ann disabled).
         self.ann = None
         self.ann_error: Optional[dict] = None
+        #: int64 [2, M] exact-prefix biomarker shortlist (ann_scores.npy)
+        #: or None; serves approx ``topk_biomarkers`` for k <= M with
+        #: answers identical to the exact kernel by construction.
+        self.ann_scores = None
         ann_names = [n for n in files if n.startswith("ann_")]
         if ann_bad is not None:
             self.ann_error = ann_bad
@@ -173,23 +208,62 @@ class _Bundle:
                            if n not in files]
                 if missing:
                     raise ValueError(f"manifest lacks {missing}")
+                pvecs = None
+                if "ann_vectors.npy" in files:
+                    pvecs = np.load(
+                        os.path.join(root, "ann_vectors.npy"),
+                        mmap_mode="r", allow_pickle=False)
                 self.ann = ann_ops.IVFIndex(
-                    np.load(os.path.join(path, "ann_centroids.npy"),
+                    np.load(os.path.join(root, "ann_centroids.npy"),
                             mmap_mode="r", allow_pickle=False),
-                    np.load(os.path.join(path, "ann_postings.npy"),
+                    np.load(os.path.join(root, "ann_postings.npy"),
                             mmap_mode="r", allow_pickle=False),
-                    np.load(os.path.join(path, "ann_offsets.npy"),
+                    np.load(os.path.join(root, "ann_offsets.npy"),
                             mmap_mode="r", allow_pickle=False),
                     n_rows=len(self.genes),
-                    hidden=int(self.embeddings.shape[1]))
+                    hidden=int(self.embeddings.shape[1]),
+                    pvecs=pvecs)
+                if "ann_scores.npy" in files and self.scores is not None:
+                    short = np.load(
+                        os.path.join(root, "ann_scores.npy"),
+                        mmap_mode="r", allow_pickle=False)
+                    if short.ndim != 2 \
+                            or short.shape[0] != self.scores.shape[0] \
+                            or short.shape[1] > len(self.genes):
+                        raise ValueError(
+                            f"ann_scores {short.shape} vs "
+                            f"[{self.scores.shape[0]}, "
+                            f"<= {len(self.genes)}]")
+                    self.ann_scores = short
             except (OSError, ValueError) as e:
                 self.ann = None
+                self.ann_scores = None
                 self.ann_error = {
                     "code": "tampered",
-                    "detail": f"{path}: ann index refused ({e})"}
+                    "detail": f"{root}: ann index refused ({e})"}
+        #: delta_fingerprints.json payload for the update plane's
+        #: owner-range diff, or None (absent / failed the lenient
+        #: verification tier — incrementality degrades to a full
+        #: re-walk, never a wrong answer).
+        self.fingerprints = None
+        if "delta_fingerprints.json" in files and "delta" not in bad:
+            try:
+                with open(os.path.join(
+                        root, "delta_fingerprints.json")) as f:
+                    self.fingerprints = json.load(f)
+            except (OSError, ValueError):
+                self.fingerprints = None
         #: mapped-budget charge: the npy payloads (the mmap'd set).
         self.nbytes = sum(int(w.get("bytes", 0))
                           for n, w in files.items() if n.endswith(".npy"))
+
+
+def _is_bundle(path: str) -> bool:
+    """A directory is a bundle if it carries a generation pointer
+    (generational layout) or a root manifest (pre-generational flat
+    layout)."""
+    return os.path.exists(os.path.join(path, GENERATION_FILE)) or \
+        os.path.exists(os.path.join(path, INVENTORY_MANIFEST))
 
 
 def scan_bundles(roots: Sequence[str]) -> Dict[str, str]:
@@ -204,14 +278,13 @@ def scan_bundles(roots: Sequence[str]) -> Dict[str, str]:
             p1 = os.path.join(root, d1)
             if not os.path.isdir(p1) or d1.startswith("."):
                 continue
-            if os.path.exists(os.path.join(p1, INVENTORY_MANIFEST)):
+            if _is_bundle(p1):
                 found.setdefault(d1, p1)
                 continue
             for d2 in sorted(os.listdir(p1)):
                 p2 = os.path.join(p1, d2)
                 if os.path.isdir(p2) and not d2.startswith(".") and \
-                        os.path.exists(os.path.join(p2,
-                                                    INVENTORY_MANIFEST)):
+                        not d2.startswith("gen-") and _is_bundle(p2):
                     found.setdefault(f"{d1}/{d2}", p2)
     return found
 
@@ -306,19 +379,37 @@ class InventoryCatalog:
             if old is not None:
                 self._bytes_mapped -= old.nbytes
 
+    def generation(self, key: str) -> str:
+        """The generation the next :func:`run_query` over ``key`` will
+        answer from: the already-mapped bundle's pointer when cached —
+        the cached arrays ARE the answer source, and keying the
+        QueryCache by the on-disk pointer instead could label an
+        old-array answer with the new generation inside the
+        flip→invalidate window — else the on-disk pointer. Unknown or
+        flat bundles read as ``""`` (their queries fail or key
+        generation-lessly, both safe)."""
+        with self._lock:
+            hit = self._mapped.get(key)
+            if hit is not None:
+                return hit.generation
+        path = scan_bundles(self.roots).get(key)
+        return read_generation(path) if path else ""
+
     def listing(self) -> List[dict]:
         """Catalog view straight from disk (cheap: meta.json only,
         nothing is mapped or verified)."""
         out = []
         for key, path in sorted(scan_bundles(self.roots).items()):
             entry = {"bundle": key}
+            gen = read_generation(path)
             try:
-                with open(os.path.join(path, "meta.json")) as f:
+                with open(os.path.join(path, gen, "meta.json")) as f:
                     meta = json.load(f)
                 entry.update(
                     n_genes=meta.get("n_genes"), hidden=meta.get("hidden"),
                     has_scores=meta.get("has_scores"),
-                    ann=bool(meta.get("ann")))
+                    ann=bool(meta.get("ann")),
+                    generation=gen or None)
             except (OSError, ValueError):
                 entry["torn"] = True
             out.append(entry)
@@ -393,12 +484,18 @@ class QueryCache:
 
 
 def cache_key(bundle: str, q: str, gene: Optional[str], k: int,
-              mode: str = "exact", nprobe: int = 0) -> str:
+              mode: str = "exact", nprobe: int = 0,
+              generation: str = "") -> str:
     """The QueryCache key. ``mode``/``nprobe`` are part of it so an
     approx result can never be served for an exact request (or for a
-    different probe width) of the same (bundle, q, gene, k)."""
+    different probe width) of the same (bundle, q, gene, k).
+    ``generation`` is the bundle's live generation pointer, read at
+    request time: a republish flips the pointer, which changes every
+    key, so a cached pre-flip answer is STRUCTURALLY unreachable even
+    if the explicit ``invalidate_bundle`` call were lost (pinned by
+    tests/test_update.py)."""
     return "\x00".join((bundle, q, gene or "", str(int(k)),
-                        mode, str(int(nprobe))))
+                        mode, str(int(nprobe)), generation))
 
 
 def run_query(catalog: InventoryCatalog, q: str, bundle_key: str,
@@ -435,6 +532,7 @@ def run_query(catalog: InventoryCatalog, q: str, bundle_key: str,
     b = catalog.get(bundle_key)
     if q == "meta":
         return {"bundle": bundle_key, "meta": b.meta,
+                "generation": b.generation,
                 "mapped_bytes": b.nbytes, "n_genes": len(b.genes),
                 "hidden": int(b.embeddings.shape[1])}
     if q == "neighbors":
@@ -453,14 +551,18 @@ def run_query(catalog: InventoryCatalog, q: str, bundle_key: str,
                 b.embeddings, b.norms, b.ann, qvec, k, nprobe=eff,
                 exclude=gi, block_rows=block_rows)
             return {"bundle": bundle_key, "gene": gene, "k": k,
+                    "generation": b.generation,
                     "neighbors": [b.genes[i] for i in idx],
                     "sims": [float(s) for s in sims],
                     "mode": "approx", "recall_mode": "approx",
+                    "storage": "posting_major"
+                    if b.ann.pvecs is not None else "gather",
                     "nprobe": int(min(max(eff, 1), b.ann.nlist)),
                     "nlist": b.ann.nlist, "candidates": ncand}
         idx, sims = knn.cosine_topk(b.embeddings, b.norms, qvec, k,
                                     exclude=gi, block_rows=block_rows)
         out = {"bundle": bundle_key, "gene": gene, "k": k,
+               "generation": b.generation,
                "neighbors": [b.genes[i] for i in idx],
                "sims": [float(s) for s in sims],
                "mode": mode, "recall_mode": "exact"}
@@ -475,7 +577,27 @@ def run_query(catalog: InventoryCatalog, q: str, bundle_key: str,
             f"bundle {bundle_key!r} was republished from the durable "
             f"record's text outputs, which do not carry the [2, G] "
             f"score matrix — re-run the job to restore it")
-    out = {"bundle": bundle_key, "k": k}
+    out = {"bundle": bundle_key, "k": k, "generation": b.generation}
+    short = b.ann_scores
+    if mode == "approx" and short is not None \
+            and k <= int(short.shape[1]):
+        # Shortlist prefix: ann_scores rows are the exact kernel's own
+        # top-M order (computed at build time), and _topk_desc's
+        # deterministic tie rule makes top-k a PREFIX of top-M — so
+        # this answer is identical to the exact path, k row reads
+        # instead of a [G] scan.
+        out["recall_mode"] = "approx"
+        out["shortlist_m"] = int(short.shape[1])
+        for row, group in enumerate(("good", "poor")):
+            idx = np.asarray(short[row, :k], dtype=np.int64)
+            sc = np.asarray(b.scores[row], dtype=np.float32)[idx]
+            out[group] = {"genes": [b.genes[i] for i in idx],
+                          "scores": [float(s) for s in sc]}
+        return out
+    out["recall_mode"] = "exact"
+    if mode == "approx" and b.ann_error is not None:
+        out["recall_mode"] = "exact_fallback"
+        out["ann_warning"] = b.ann_error
     for row, group in enumerate(("good", "poor")):
         idx, sc = knn.topk_scores(np.asarray(b.scores[row],
                                              dtype=np.float32), k)

@@ -24,6 +24,8 @@ Requests::
      "max_bytes": 65536}                 # optional response size cap
     {"op": "query", "q": "neighbors", "job_id": "i...",  # read plane:
      "variant": "base", "gene": "TP53", "k": 10}         # see QUERY_KEYS
+    {"op": "update", "job_id": "i...", "job": {...},     # incremental
+     "epochs": 10}      # delta re-walk + warm-start, see UPDATE_KEYS
     {"op": "drain"}     # stop admitting, checkpoint, journal, exit 0
 
 Addressing: an address containing ``host:port`` dials TCP, anything else
@@ -112,6 +114,23 @@ QUERY_KEYS = ("op", "q", "job_id", "variant", "gene", "k", "mode",
 #: so every partial is scored against the same reference.
 FQUERY_KEYS = ("op", "fq", "gene", "k", "mode", "nprobe", "job_id",
                "variant", "ref_genes", "auth_token")
+
+#: The update-request envelope vocabulary: ``ureq`` reads in
+#: daemon.py/router.py are linted against this tuple. ``update`` is the
+#: write half of the read plane: ``job_id``/``variant`` name the target
+#: bundle (the prior generation), ``job`` carries the UPDATED input
+#: config (same vocabulary as a submit's ``job``, validated by
+#: config.SERVE_JOB_KEYS), ``epochs`` bounds the warm-start fine-tune
+#: (0 = the engine's default cap). Updates are idempotency-keyed and
+#: journaled exactly like submits — ``idem_key`` resubmits ack the same
+#: derived id; a SIGKILL mid-update replays from the journal — and the
+#: router sticky-routes them to the target bundle's home replica so the
+#: generation pointer has exactly one writer. ``requeue``/
+#: ``submitted_at``/``relay_token``/``router_epoch`` carry the same
+#: failover/fencing semantics as SUBMIT_KEYS.
+UPDATE_KEYS = ("op", "job_id", "variant", "job", "tenant", "epochs",
+               "priority", "deadline_s", "idem_key", "auth_token",
+               "requeue", "submitted_at", "relay_token", "router_epoch")
 
 #: The result-request envelope vocabulary: ``rreq`` reads in
 #: daemon.py/router.py are linted against this tuple. ``fields``

@@ -65,8 +65,8 @@ from g2vec_tpu.utils.metrics import MetricsWriter
 #: Token-gated ops: the mutators, plus ``query``/``fquery`` — reads,
 #: but ones that expose tenant embeddings/scores, not just health
 #: (probes stay open).
-_AUTH_OPS = ("submit", "cancel", "drain_replica", "shutdown", "query",
-             "fquery")
+_AUTH_OPS = ("submit", "update", "cancel", "drain_replica", "shutdown",
+             "query", "fquery")
 
 
 def sanitize_client_submit(req: dict) -> dict:
@@ -1665,11 +1665,64 @@ class Router:
                 "detail": f"no healthy replica reachable "
                           f"(tried {tried or 'none'})"})
 
-    def _relay_to(self, f, target: str, payload: dict) -> bool:
-        """Forward one submit to ``target`` and relay its event stream.
-        Returns False if the replica was unreachable BEFORE acking (safe
-        to try the next ring successor — nothing was accepted)."""
-        out = dict(payload, op="submit",
+    def _relay_update(self, f, req: dict) -> None:
+        """Sticky-route an ``update`` to the TARGET bundle's home
+        replica — the generation pointer must have exactly one writer,
+        and that writer must be the replica whose inventory root holds
+        the bundle (the daemon republishes in place). A retried key
+        whose update is already journaled goes back to its journal
+        owner (idem dedup lives there); a finished one streams the
+        durable record. No ring fallback: an update has exactly one
+        legal destination, and relaying it elsewhere would fork the
+        bundle's generation history — if the home is down, the client
+        gets a structured ``retry_later`` and the same idem_key dedups
+        or runs after failover/relaunch."""
+        ureq = sanitize_client_submit(req)
+        if not ureq.get("idem_key"):
+            # Router-minted key: updates are idempotency-keyed by
+            # contract (the daemon rejects keyless ones).
+            ureq["idem_key"] = f"r-{uuid.uuid4().hex}"
+        target = ureq.get("job_id")
+        if not isinstance(target, str) or not target:
+            protocol.write_event(
+                f, {"event": "rejected", "error": "bad_job",
+                    "detail": "update needs a 'job_id' string naming "
+                              "the target bundle"})
+            return
+        jid = protocol.idem_job_id(ureq["idem_key"])
+        rec = self._read_result_any(jid)
+        if rec is not None:
+            protocol.write_event(f, {"event": "accepted",
+                                     "job_id": jid, "deduped": True})
+            protocol.write_event(f, rec)
+            return
+        owner = self._journal_owner(jid) or self._bundle_owner(target)
+        if owner is None:
+            protocol.write_event(
+                f, {"event": "rejected", "error": "not_found",
+                    "job_id": target,
+                    "detail": f"no bundle for job {target!r} on any "
+                              f"replica"})
+            return
+        if not self.fleet.alive(owner) \
+                or not self._relay_to(f, owner, ureq, op="update"):
+            self.metrics.emit("update_retry_later", job_id=jid,
+                              bundle_owner=owner)
+            protocol.write_event(
+                f, {"event": "rejected", "error": "retry_later",
+                    "job_id": jid,
+                    "detail": f"bundle home {owner} is unreachable; "
+                              f"retry with the same idem_key once the "
+                              f"replica relaunches"})
+        return
+
+    def _relay_to(self, f, target: str, payload: dict,
+                  op: str = "submit") -> bool:
+        """Forward one submit/update to ``target`` and relay its event
+        stream. Returns False if the replica was unreachable BEFORE
+        acking (safe to try the next ring successor — nothing was
+        accepted)."""
+        out = dict(payload, op=op,
                    router_epoch=self.router_epoch)
         if not out.get("router_epoch"):
             out.pop("router_epoch", None)     # HA off: byte-compat
@@ -1697,7 +1750,7 @@ class Router:
                 # WE are the zombie: a newer leader exists. Surface the
                 # reject to the client rather than spraying the stale
                 # submit at ring successors (each would reject it too).
-                self._on_stale_epoch(target, "submit", first)
+                self._on_stale_epoch(target, op, first)
                 protocol.write_event(f, first)
                 return True
             job_id = first.get("job_id")
@@ -1814,6 +1867,8 @@ class Router:
                 return
             if op == "submit":
                 self._relay_submit(f, req)
+            elif op == "update":
+                self._relay_update(f, req)
             elif op == "status":
                 protocol.write_event(f, self.status())
             elif op == "ping":

@@ -745,7 +745,8 @@ def _split_indices(n_paths: int, seed: int, val_fraction: float):
 
 def _pack_split(paths: np.ndarray, labels: np.ndarray, idx: np.ndarray, *,
                 packed_genes: Optional[int], n_genes: int, n_genes_pad: int,
-                row_multiple: int, use_pallas: bool):
+                row_multiple: int, use_pallas: bool,
+                row_bucket: int = 0):
     """Host-side packing of one split into the device layout.
 
     The multi-hot crosses the host->device boundary as packed bits
@@ -760,6 +761,16 @@ def _pack_split(paths: np.ndarray, labels: np.ndarray, idx: np.ndarray, *,
     n_rows = len(idx)
     y = labels[idx].astype(np.float32).reshape(-1, 1)
     n_pad = pad_to_multiple(n_rows, row_multiple)
+    if row_bucket:
+        # Round the padded row count up to a coarse bucket (itself kept
+        # a multiple of row_multiple, so shard-evenness survives). The
+        # extra rows are ordinary weight-0 padding; the win is shape
+        # stability — successive fine-tunes whose unique-path counts
+        # drift by a handful of rows land in the SAME bucket and reuse
+        # the compiled train/eval programs instead of paying a fresh
+        # XLA compile per update.
+        bucket = pad_to_multiple(row_bucket, row_multiple)
+        n_pad = pad_to_multiple(n_pad, bucket)
     w = _pad_rows(np.ones((n_rows, 1), np.float32), n_pad)
     # Repack row chunks into the device layout; host temp memory stays
     # bounded (one chunk of dense bools) even at pod-scale path counts.
@@ -889,6 +900,8 @@ def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
                donate: bool = True, kernel_autotune: bool = False,
                autotune_cache_path: Optional[str] = None,
                check: Optional[Callable[[], None]] = None,
+               warm_start: Optional[tuple] = None,
+               row_bucket: int = 0,
                ) -> TrainResult:
     """Train the modified CBOW; returns the embedding table and history.
 
@@ -907,6 +920,26 @@ def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
     chunk program compiles (persisted under ``autotune_cache_path`` —
     cache.py's --cache-dir autotune tier — so repeat runs skip the sweep);
     it is a no-op on the XLA (non-Pallas) path.
+
+    ``warm_start`` is the incremental-update plane's entry point: a
+    ``(w_ih [n_genes, hidden], w_ho [hidden, 1])`` float array pair
+    that REPLACES the seeded draw as the initial parameters (the
+    caller owns the PR 4 init contract — incremental.py draws the full
+    seeded init at the new gene count and overwrites carried-over rows
+    with the prior bundle's embedding). Padding to the layout's
+    ``n_genes_pad`` happens here with zero rows, exactly as
+    ``init_params(pad_to=...)`` pads, so warm starts are as
+    layout-independent as cold ones. Optimizer state is fresh (Adam
+    moments restart — fine-tunes are short and the prior moments are
+    not in the bundle).
+
+    ``row_bucket`` (0 = off) rounds each split's padded row count up to
+    a multiple of the bucket with ordinary weight-0 rows. The padding
+    is inert (masked means, zero-weight eval) but pins the program
+    shapes: repeated fine-tunes whose deduplicated path counts drift by
+    a few rows hit the in-process compile cache instead of recompiling
+    — the incremental update plane's per-update wall is dominated by
+    exactly that recompile without it.
     """
     if paths.shape[0] < 2:
         raise ValueError(f"need at least 2 paths to split, got {paths.shape[0]}")
@@ -954,7 +987,8 @@ def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
     def _pack_host(idx):
         return _pack_split(paths, labels, idx, packed_genes=packed_genes,
                            n_genes=n_genes, n_genes_pad=n_genes_pad,
-                           row_multiple=row_multiple, use_pallas=use_pallas)
+                           row_multiple=row_multiple, use_pallas=use_pallas,
+                           row_bucket=row_bucket)
 
     def _put_x(packed_np):
         if use_pallas:
@@ -994,6 +1028,19 @@ def train_cbow(paths: np.ndarray, labels: np.ndarray, *,
     # mesh shape) — the parity tests compare runs across layouts.
     params = init_params(key, n_genes, hidden, param_dtype=pdtype,
                          pad_to=n_genes_pad)
+    if warm_start is not None:
+        wi = np.asarray(warm_start[0], dtype=np.float32)
+        wo = np.asarray(warm_start[1], dtype=np.float32).reshape(
+            hidden, 1)
+        if wi.shape != (n_genes, hidden):
+            raise ValueError(
+                f"warm_start w_ih {wi.shape} vs ({n_genes}, {hidden})")
+        if n_genes_pad > n_genes:
+            wi = np.concatenate(
+                [wi, np.zeros((n_genes_pad - n_genes, hidden),
+                              dtype=np.float32)], axis=0)
+        params = CBOWParams(w_ih=jnp.asarray(wi, dtype=pdtype),
+                            w_ho=jnp.asarray(wo, dtype=pdtype))
     if ctx.mesh is not None:
         params = CBOWParams(w_ih=ctx.put(params.w_ih, ctx.w_ih_spec),
                             w_ho=ctx.put(params.w_ho, ctx.w_ho_spec))

@@ -44,6 +44,10 @@ EVENT_SCHEMAS = {
     'config': {
         "required": [],
         "optional": []},
+    'delta_walk': {
+        "required": ['group', 'outcome'],
+        "optional": ['bundle', 'cache_hits', 'job_id', 'ranges_rewalked',
+                     'ranges_total', 'walked_rows']},
     'done': {
         "required": [],
         "optional": ['acc_val', 'buckets', 'n_lanes', 'n_paths', 'outputs', 'overlap_saved_s', 'runs_per_hour', 'sampler_threads', 'stage_extras', 'stage_seconds', 'stop_epoch', 'stop_epochs', 'stream_totals', 'train_mode', 'walk_cache_hits', 'walk_stats', 'walker_backend', 'wall_seconds']},
@@ -100,7 +104,7 @@ EVENT_SCHEMAS = {
         "optional": []},
     'inventory': {
         "required": ['bundle', 'bytes', 'outcome'],
-        "optional": ['error']},
+        "optional": ['error', 'generation']},
     'job_accepted': {
         "required": ['n_lanes', 'priority', 'queued', 'tenant'],
         "optional": []},
@@ -171,6 +175,9 @@ EVENT_SCHEMAS = {
     'replicate': {
         "required": ['acc_val', 'index', 'n_selected', 'name'],
         "optional": []},
+    'republish': {
+        "required": ['bundle', 'bytes', 'generation', 'mode'],
+        "optional": []},
     'resume': {
         "required": ['attempt', 'checkpoint_dir'],
         "optional": []},
@@ -234,6 +241,15 @@ EVENT_SCHEMAS = {
     'train_done': {
         "required": ['acc_tr', 'acc_val', 'stop_epoch', 'stopped_early'],
         "optional": ['bucket', 'bucket_mode']},
+    'update': {
+        "required": ['bundle', 'generation', 'job_id'],
+        "optional": ['cache_hits', 'carried_rows', 'epochs', 'mode',
+                     'n_genes', 'prior_generation', 'ranges_rewalked',
+                     'ranges_total', 'stop_epoch', 'walked_rows',
+                     'wall_s']},
+    'update_retry_later': {
+        "required": ['bundle_owner', 'job_id'],
+        "optional": []},
     'walk_cache': {
         "required": ['group', 'outcome'],
         "optional": ['n_rows']},

@@ -87,6 +87,13 @@ def _plant_bundle(dest, g=48, h=8, seed=0, with_scores=True,
     return emb, genes, scores
 
 
+def _gen(dest):
+    """Resolve a bundle root to its live generation directory."""
+    from g2vec_tpu.io.writers import read_generation
+
+    return os.path.join(dest, read_generation(dest))
+
+
 def _daemon(tmp_path, **opt_overrides):
     from g2vec_tpu.serve.daemon import ServeDaemon, ServeOptions
 
@@ -304,6 +311,71 @@ def test_duplicate_rows_tie_by_ascending_index_in_approx_path():
     assert sims[0] == sims[1]
 
 
+def test_posting_major_topk_bitwise_vs_gather():
+    """The posting-major contiguous candidate storage is a pure layout
+    change: for every (query, nprobe) the streamed slab path returns
+    the gather path's answer bitwise — same ids, same float32 sims,
+    same candidate count — including the nprobe>=nlist delegation."""
+    emb = _clustered_int_embeddings(160, 8, 8, seed=11)
+    norms = knn.row_norms(emb)
+    cen, post, off = ann.build_ivf(emb, 8)
+    gather = ann.IVFIndex(cen, post, off, emb.shape[0], emb.shape[1])
+    pm = ann.IVFIndex(cen, post, off, emb.shape[0], emb.shape[1],
+                      pvecs=np.ascontiguousarray(emb[post]))
+    for qi in (0, 3, 17, 59, 121):
+        for nprobe in (1, 2, 3, 8):
+            gi, gs, gc = ann.ivf_topk(emb, norms, gather, emb[qi], 5,
+                                      nprobe=nprobe, exclude=qi,
+                                      posting_major=False)
+            pi, ps, pc = ann.ivf_topk(emb, norms, pm, emb[qi], 5,
+                                      nprobe=nprobe, exclude=qi,
+                                      posting_major=True)
+            assert np.array_equal(gi, pi), (qi, nprobe)
+            assert np.array_equal(gs, ps), (qi, nprobe)
+            assert gc == pc
+    # auto mode streams iff the index carries the copy; forcing
+    # posting-major without one is a loud error, not a silent gather.
+    ai, _, _ = ann.ivf_topk(emb, norms, pm, emb[0], 5, nprobe=2,
+                            exclude=0)
+    bi, _, _ = ann.ivf_topk(emb, norms, gather, emb[0], 5, nprobe=2,
+                            exclude=0)
+    assert np.array_equal(ai, bi)
+    with pytest.raises(ValueError, match="posting-major"):
+        ann.ivf_topk(emb, norms, gather, emb[0], 3, posting_major=True)
+
+
+def test_topk_biomarkers_shortlist_matches_exact(tmp_path):
+    """The ann_scores shortlist serves approx topk_biomarkers with
+    answers IDENTICAL to the exact kernel (top-k is a prefix of the
+    build-time top-M), and a torn shortlist degrades to exact with the
+    same attribution contract as the neighbors path."""
+    dest = str(tmp_path / "inv" / "j1" / "v0")
+    _plant_bundle(dest, g=64, h=8, seed=5, ann_nlist=4, clustered=True)
+    cat = inventory.InventoryCatalog([str(tmp_path / "inv")],
+                                     budget_bytes=1 << 30)
+    b = cat.get("j1/v0")
+    assert b.ann_scores is not None and b.ann_scores.shape == (2, 64)
+    approx = inventory.run_query(cat, "topk_biomarkers", "j1/v0", k=5,
+                                 mode="approx")
+    assert approx["recall_mode"] == "approx"
+    assert approx["shortlist_m"] == 64
+    exact = inventory.run_query(cat, "topk_biomarkers", "j1/v0", k=5,
+                                mode="exact")
+    assert exact["recall_mode"] == "exact"
+    for group in ("good", "poor"):
+        assert approx[group] == exact[group]
+    # Torn shortlist (lenient tier): the approx request falls back to
+    # the exact scan, answer unchanged, refusal attributed.
+    os.unlink(os.path.join(_gen(dest), "ann_scores.npy"))
+    cat.invalidate("j1/v0")
+    again = inventory.run_query(cat, "topk_biomarkers", "j1/v0", k=5,
+                                mode="approx")
+    assert again["recall_mode"] == "exact_fallback"
+    assert again["ann_warning"]["code"] == "torn"
+    for group in ("good", "poor"):
+        assert again[group] == exact[group]
+
+
 def test_lloyd_update_parity_with_jax_kmeans():
     """ops/ann's numpy Lloyd step mirrors ops.kmeans._update_centers —
     including the empty-cluster freeze — up to f64-accumulate-then-cast
@@ -390,11 +462,12 @@ def test_indexed_bundle_roundtrip_and_mode_attribution(tmp_path):
     dest = str(tmp_path / "inv" / "j1" / "v0")
     emb, genes, _ = _plant_bundle(dest, g=96, h=8, seed=1, ann_nlist=8,
                                   clustered=True)
-    with open(os.path.join(dest, INVENTORY_MANIFEST)) as f:
+    with open(os.path.join(_gen(dest), INVENTORY_MANIFEST)) as f:
         man = json.load(f)["files"]
     for fn in ann.ANN_FILES:
-        assert fn in man and os.path.exists(os.path.join(dest, fn)), fn
-    with open(os.path.join(dest, "meta.json")) as f:
+        assert fn in man and \
+            os.path.exists(os.path.join(_gen(dest), fn)), fn
+    with open(os.path.join(_gen(dest), "meta.json")) as f:
         meta = json.load(f)
     assert meta["ann"]["format"] == ann.ANN_FORMAT
     assert meta["ann"]["nlist"] == 8 and meta["ann"]["build_ms"] >= 0
@@ -438,7 +511,7 @@ def test_indexed_bundle_roundtrip_and_mode_attribution(tmp_path):
 def test_tampered_or_torn_index_falls_back_to_exact(tmp_path):
     dest = str(tmp_path / "inv" / "j1" / "v0")
     emb, genes, _ = _plant_bundle(dest, g=64, h=8, seed=6, ann_nlist=4)
-    _flip_byte(os.path.join(dest, "ann_postings.npy"))
+    _flip_byte(os.path.join(_gen(dest), "ann_postings.npy"))
     cat = inventory.InventoryCatalog([str(tmp_path / "inv")],
                                      budget_bytes=1 << 30)
     b = cat.get("j1/v0")                 # maps: core arrays verify fine
@@ -452,7 +525,7 @@ def test_tampered_or_torn_index_falls_back_to_exact(tmp_path):
     # Torn index (file deleted): same degradation, code "torn".
     dest2 = str(tmp_path / "inv" / "j2" / "v0")
     _plant_bundle(dest2, g=64, h=8, seed=7, ann_nlist=4)
-    os.unlink(os.path.join(dest2, "ann_offsets.npy"))
+    os.unlink(os.path.join(_gen(dest2), "ann_offsets.npy"))
     b2 = cat.get("j2/v0")
     assert b2.ann is None and b2.ann_error["code"] == "torn"
     r2 = inventory.run_query(cat, "neighbors", "j2/v0", gene="G001",
@@ -465,7 +538,7 @@ def test_tampered_or_torn_index_falls_back_to_exact(tmp_path):
     # Core arrays stay strict: the two-tier gate never loosened them.
     dest3 = str(tmp_path / "inv" / "j3" / "v0")
     _plant_bundle(dest3, g=32, h=8, seed=8, ann_nlist=4)
-    _flip_byte(os.path.join(dest3, "embeddings.npy"))
+    _flip_byte(os.path.join(_gen(dest3), "embeddings.npy"))
     with pytest.raises(inventory.InventoryError) as ei:
         cat.get("j3/v0")
     assert ei.value.code == "tampered"
@@ -553,7 +626,7 @@ def test_daemon_republish_rebuilds_ann_index(tmp_path):
                    "variants": {"v0": {"outputs": [vec]}}}, f)
     dest = os.path.join(d.opts.state_dir, "inventory", jid, "v0")
     _plant_bundle(dest, g=20, h=8, seed=3, ann_nlist=4)
-    _flip_byte(os.path.join(dest, "embeddings.npy"))   # core tamper
+    _flip_byte(os.path.join(_gen(dest), "embeddings.npy"))  # core tamper
 
     resp = d.handle_query({"q": "neighbors", "job_id": jid,
                            "variant": "v0", "gene": "G000", "k": 3})

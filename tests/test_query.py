@@ -74,6 +74,15 @@ def _plant_bundle(dest, g=30, h=8, seed=0, with_scores=True):
     return emb, genes, scores
 
 
+def _gen(dest):
+    """Resolve a bundle root to its live generation directory (bundles
+    are generational since the incremental-update plane; flat legacy
+    bundles resolve to themselves)."""
+    from g2vec_tpu.io.writers import read_generation
+
+    return os.path.join(dest, read_generation(dest))
+
+
 def _roundtrip(d, req):
     """One request over the daemon's real connection handler via a
     socketpair — exercises the auth gate and the op dispatch without a
@@ -202,7 +211,7 @@ def test_bundle_roundtrip_preserves_arrays(tmp_path):
 def test_tampered_bundle_is_refused(tmp_path):
     dest = str(tmp_path / "inv" / "j1" / "v0")
     _plant_bundle(dest)
-    path = os.path.join(dest, "embeddings.npy")
+    path = os.path.join(_gen(dest), "embeddings.npy")
     with open(path, "r+b") as f:             # same size, different bytes
         f.seek(os.path.getsize(path) - 3)
         orig = f.read(1)
@@ -223,17 +232,22 @@ def test_tampered_bundle_is_refused(tmp_path):
 
 
 def test_torn_bundle_is_refused(tmp_path):
+    from g2vec_tpu.io.writers import GENERATION_FILE
+
     dest = str(tmp_path / "inv" / "j1" / "v0")
     _plant_bundle(dest)
-    os.unlink(os.path.join(dest, "genes.txt"))   # manifest names it
+    gen = _gen(dest)
+    os.unlink(os.path.join(gen, "genes.txt"))    # manifest names it
     cat = inventory.InventoryCatalog([str(tmp_path / "inv")],
                                      budget_bytes=1 << 30)
     with pytest.raises(inventory.InventoryError) as ei:
         cat.get("j1/v0")
     assert ei.value.code == "torn"
-    # Without a manifest the directory is not a bundle at all: it never
-    # enters the catalog, so the failure mode is not_found.
-    os.unlink(os.path.join(dest, inventory.INVENTORY_MANIFEST))
+    # Without a manifest or generation pointer the directory is not a
+    # bundle at all: it never enters the catalog, so the failure mode
+    # is not_found.
+    os.unlink(os.path.join(gen, inventory.INVENTORY_MANIFEST))
+    os.unlink(os.path.join(dest, GENERATION_FILE))
     with pytest.raises(inventory.InventoryError) as ei:
         cat.get("j1/v0")
     assert ei.value.code == "not_found"
@@ -366,10 +380,11 @@ def served(tsv_paths, tmp_path_factory):
                                          "train_seed": 1}]}})
     assert sub["event"] == "accepted"
     assert d.step() == 1
+    root = os.path.join(d.opts.state_dir, "inventory",
+                        sub["job_id"], "v0")
     return {"d": d, "job_id": sub["job_id"],
             "key": f"{sub['job_id']}/v0",
-            "dir": os.path.join(d.opts.state_dir, "inventory",
-                                sub["job_id"], "v0")}
+            "root": root, "dir": _gen(root)}
 
 
 def test_daemon_publishes_verified_bundle(served):
@@ -462,7 +477,7 @@ def test_solo_emit_inventory_bundle_is_byte_identical(served, tsv_paths,
     solo_dir = lane.result_name + "_inventory"
     assert os.path.isdir(solo_dir)
     for fn in INVENTORY_ARRAYS:
-        with open(os.path.join(solo_dir, fn), "rb") as a, \
+        with open(os.path.join(_gen(solo_dir), fn), "rb") as a, \
                 open(os.path.join(served["dir"], fn), "rb") as b:
             assert a.read() == b.read(), \
                 f"{fn}: solo bundle differs from served bundle"
@@ -500,7 +515,7 @@ def test_daemon_lazy_republish_from_durable_record(tmp_path):
                    "variants": {"v0": {"outputs": [vec]}}}, f)
     dest = os.path.join(d.opts.state_dir, "inventory", jid, "v0")
     _plant_bundle(dest)
-    path = os.path.join(dest, "embeddings.npy")
+    path = os.path.join(_gen(dest), "embeddings.npy")
     with open(path, "r+b") as f:
         f.seek(os.path.getsize(path) - 3)
         orig = f.read(1)
@@ -523,7 +538,7 @@ def test_daemon_lazy_republish_from_durable_record(tmp_path):
     jid2 = "i" + "b" * 12
     dest2 = os.path.join(d.opts.state_dir, "inventory", jid2, "v0")
     _plant_bundle(dest2, seed=9)
-    p2 = os.path.join(dest2, "norms.npy")
+    p2 = os.path.join(_gen(dest2), "norms.npy")
     with open(p2, "r+b") as f:
         f.truncate(os.path.getsize(p2) - 4)
     resp = d.handle_query({"q": "neighbors", "job_id": jid2,

@@ -51,7 +51,11 @@ while [ "$arms" -lt "$MAX_ARMS" ] && [ "$(date +%s)" -lt "$DEADLINE" ]; do
     # ann matrix (IVF build/probe units, nprobe>=nlist bitwise equality,
     # the recall@k contract at pruning scale, index tamper/corrupt
     # exact-fallback drills, federated fquery scatter-gather with
-    # dead-owner attribution).
+    # dead-owner attribution), and the update matrix (delta-range/
+    # fingerprint/frontier units, bootstrap->noop byte identity,
+    # expr-only stage-3 skip, delta re-walk + statistical band vs cold
+    # retrain, daemon update lifecycle, generation-keyed QueryCache,
+    # cross-republish torn-read hammer, update_publish SIGKILL drill).
     # Non-fatal: a red matrix is reported, the chip battery still runs.
     if ! JAX_PLATFORMS=cpu "$PY" -m pytest tests/test_resilience.py \
             tests/test_fleet.py tests/test_fleet_e2e.py \
@@ -60,6 +64,7 @@ while [ "$arms" -lt "$MAX_ARMS" ] && [ "$(date +%s)" -lt "$DEADLINE" ]; do
             tests/test_router.py tests/test_edge.py \
             tests/test_scenario.py tests/test_query.py \
             tests/test_autoscale.py tests/test_ann.py \
+            tests/test_update.py \
             -q -m "not slow" \
             -p no:cacheprovider >/tmp/fault_matrix_arm$arms.log 2>&1; then
         echo "[watch_loop] WARNING: fault/fleet matrix FAILED on arm $arms (log: /tmp/fault_matrix_arm$arms.log)"
