@@ -172,6 +172,27 @@ STREAM_AB_WALK_REPS = tuple(int(x) for x in os.environ.get(
     "G2VEC_BENCH_STREAM_WALK_REPS", "4,12").split(","))
 STREAM_AB_ARTIFACT = "BENCH_STREAM_AB.json"
 
+# On-device walk sampling A/B (ops/device_walker.py, PR 20): paths/s for
+# the bit-exact splitmix64 device CSR sampler vs the host C++ pool at
+# the same shard plan (byte identity re-checked shard-by-shard IN-RUN,
+# the A/B aborts on any mismatch), plus the fused --device-feed
+# streaming arm vs the host ring (time-to-first-update, end-to-end
+# wall, h2d_bytes_saved, zero-ring-puts). The CPU numbers bound
+# dispatch/kernel overhead only — the H2D-elision win is chip-shaped,
+# so the chip sweep lines are emitted as explicit nulls off-chip
+# (watcher-gated), never faked from CPU timings. Env-shrinkable.
+DEVICE_WALK_GENES = int(os.environ.get("G2VEC_BENCH_DEVICE_GENES", "4000"))
+DEVICE_WALK_EDGES = int(os.environ.get("G2VEC_BENCH_DEVICE_EDGES", "24000"))
+DEVICE_WALK_LEN = int(os.environ.get("G2VEC_BENCH_DEVICE_LEN", "40"))
+DEVICE_WALK_WREPS = int(os.environ.get("G2VEC_BENCH_DEVICE_WREPS", "2"))
+DEVICE_WALK_TIMING_REPS = int(os.environ.get("G2VEC_BENCH_DEVICE_REPS", "3"))
+DEVICE_WALK_SHARDS = int(os.environ.get("G2VEC_BENCH_DEVICE_SHARDS", "6"))
+DEVICE_FEED_GENES = int(os.environ.get("G2VEC_BENCH_DEVICE_FEED_GENES",
+                                       "1200"))
+DEVICE_FEED_EPOCHS = int(os.environ.get("G2VEC_BENCH_DEVICE_FEED_EPOCHS",
+                                        "2"))
+DEVICE_WALK_ARTIFACT = "BENCH_DEVICE_WALK.json"
+
 # Chaos soak (tools/chaos_soak.py): a seeded fault storm against the
 # serve daemon — SIGKILLs, SIGTERM drains, armed fault plans at the
 # durable seams, client cancels and tight deadlines — whose acceptance
@@ -3020,6 +3041,197 @@ def _update_ab() -> None:
         sys.exit(1)
 
 
+def _device_walk_line(note) -> dict:
+    """On-device walk sampling A/B — the PR 20 proof.
+
+    (a) Sampler A/B: host C++ pool vs the device CSR sampler over the
+    SAME shard plan, min-of-N timings, with the packed rows compared
+    byte-for-byte on EVERY timed shard — a mismatch fails the bench, so
+    a paths/s number can never be quoted for a walker that drifted off
+    the bit-exact contract. Device compile time is reported separately
+    from steady-state sampling (the jit cache amortizes it across
+    shards of one (len_path, degree-bucket) shape).
+    (b) Feed A/B: native-ring streaming vs the fused --device-feed arm
+    at the same config — end-to-end wall, time-to-first-update (the
+    instant the first shard is ready at the trainer), h2d_bytes_saved,
+    and the zero-host-ring-puts invariant, with final embeddings
+    byte-identical across arms.
+    (c) Chip sweep: genes x paths/s cells that only mean anything with
+    a real accelerator attached; off-chip they are emitted as explicit
+    null lines so a watcher run on hardware is REQUIRED to fill them.
+    """
+    import numpy as np
+
+    import jax
+
+    from g2vec_tpu.ops import device_walker as dwk
+    from g2vec_tpu.ops import host_walker as hwk
+    from g2vec_tpu.train.stream import train_cbow_streaming
+
+    platform = jax.devices()[0].platform
+    on_chip = platform not in ("cpu",)
+    G, E, L = DEVICE_WALK_GENES, DEVICE_WALK_EDGES, DEVICE_WALK_LEN
+    wreps = DEVICE_WALK_WREPS
+
+    r = np.random.default_rng(7)
+    src = r.integers(0, G, size=E).astype(np.int32)
+    dst = r.integers(0, G, size=E).astype(np.int32)
+    w = r.random(E, dtype=np.float32)
+
+    # -- (a) sampler A/B over one shard plan, bit identity in-run ------
+    plan = hwk.plan_shards(G, wreps, 0, len_path=L)
+    shards = list(range(min(plan.n_shards, DEVICE_WALK_SHARDS)))
+    note(f"sampler A/B: G={G} E={E} L={L} reps={wreps} "
+         f"shards={len(shards)}/{plan.n_shards} [{platform}]")
+    csr = hwk.edges_to_csr(src, dst, w, G)
+    kw = dict(seed=4242, csr=csr)
+
+    t0 = time.perf_counter()
+    dwk.walk_shard_device(src, dst, w, G, plan, shards[0], **kw)
+    compile_s = time.perf_counter() - t0
+
+    rows = 0
+    host_s = dev_s = 0.0
+    bit_identical = True
+    for s in shards:
+        ht = dt = float("inf")
+        host = device = None
+        for _ in range(DEVICE_WALK_TIMING_REPS):
+            t0 = time.perf_counter()
+            host = hwk.walk_shard(src, dst, w, G, plan, s, **kw)
+            ht = min(ht, time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            device = dwk.walk_shard_device(src, dst, w, G, plan, s, **kw)
+            dt = min(dt, time.perf_counter() - t0)
+        host_s += ht
+        dev_s += dt
+        rows += int(host.shape[0])
+        if host.tobytes() != device.tobytes():
+            bit_identical = False
+            note(f"BIT MISMATCH on shard {s} — A/B void")
+            break
+    sampler = {
+        "bit_identical": bit_identical,
+        "rows_sampled": rows, "shards_timed": len(shards),
+        "host_paths_per_s": (rows / host_s) if host_s > 0 else None,
+        "device_paths_per_s": (rows / dev_s) if dev_s > 0 else None,
+        "device_vs_host": (host_s / dev_s) if dev_s > 0 else None,
+        "device_compile_s": compile_s,
+    }
+    note(f"host {sampler['host_paths_per_s']:.0f} paths/s, device "
+         f"{sampler['device_paths_per_s']:.0f} paths/s "
+         f"(x{sampler['device_vs_host']:.2f}), compile {compile_s:.2f}s, "
+         f"bit_identical={bit_identical}")
+
+    # -- (b) fused feed A/B: native ring vs --device-feed --------------
+    Gf = DEVICE_FEED_GENES
+    def _grp(seed):
+        rr = np.random.default_rng(seed)
+        Ef = Gf * 6
+        return (rr.integers(0, Gf, Ef).astype(np.int32),
+                rr.integers(0, Gf, Ef).astype(np.int32),
+                rr.random(Ef, dtype=np.float32))
+    feed_kw = dict(
+        groups=[_grp(1), _grp(2)], n_genes=Gf,
+        genes=np.array([f"g{i}" for i in range(Gf)]), hidden=32,
+        learning_rate=0.05, max_epochs=DEVICE_FEED_EPOCHS, seed=3,
+        walk_seed=5, len_path=20, reps=2, compute_dtype="float32")
+
+    def _arm(tag, **over):
+        marks = []
+        t_start = time.perf_counter()
+        res = train_cbow_streaming(
+            **feed_kw, **over,
+            check=lambda: marks.append(time.perf_counter() - t_start))
+        wall = time.perf_counter() - t_start
+        # marks[0] is the epoch-0 entry tick; marks[1] fires once the
+        # FIRST shard is ready at the trainer (time-to-first-update).
+        ttfu = marks[1] if len(marks) > 1 else None
+        note(f"{tag}: wall {wall:.2f}s ttfu {ttfu:.3f}s")
+        return res, wall, ttfu
+
+    ring_res, ring_wall, ring_ttfu = _arm("ring (native)")
+    _arm("ring (device sampler)", walker_backend="device")
+    fused_res, fused_wall, fused_ttfu = _arm(
+        "device feed", walker_backend="device", device_feed=True)
+    feed_ok = (np.asarray(ring_res.train.w_ih).tobytes()
+               == np.asarray(fused_res.train.w_ih).tobytes())
+    feed = {
+        "n_genes": Gf, "epochs": DEVICE_FEED_EPOCHS,
+        "ring_wall_s": ring_wall, "device_feed_wall_s": fused_wall,
+        "ring_ttfu_s": ring_ttfu, "device_feed_ttfu_s": fused_ttfu,
+        "ttfu_delta_s": ((ring_ttfu - fused_ttfu)
+                         if ring_ttfu is not None and fused_ttfu is not None
+                         else None),
+        "h2d_bytes_saved": int(fused_res.stats.h2d_bytes_saved),
+        "device_ring_puts": int(fused_res.stats.shards_emitted),
+        "outputs_bit_identical": feed_ok,
+    }
+
+    # -- (c) chip sweep: honest nulls off-chip --------------------------
+    chip = []
+    for chip_g in (65536, 262144):
+        metric = f"device_walk_paths_per_s_g{chip_g}"
+        if not on_chip:
+            chip.append({
+                "metric": metric, "value": None, "unit": "paths/s",
+                "skipped": "no accelerator attached — CPU dispatch "
+                           "timings cannot stand in for on-chip "
+                           "sampling + H2D elision; a watcher run on "
+                           "hardware (tools/watch_loop.sh chip battery) "
+                           "must fill this line"})
+            continue
+        rc = np.random.default_rng(chip_g)
+        Ec = chip_g * 4
+        sc = rc.integers(0, chip_g, Ec).astype(np.int32)
+        dc = rc.integers(0, chip_g, Ec).astype(np.int32)
+        wc = rc.random(Ec, dtype=np.float32)
+        pc = hwk.plan_shards(chip_g, 1, 0, len_path=L)
+        dwk.walk_shard_device(sc, dc, wc, chip_g, pc, 0, seed=1)  # warm
+        t0 = time.perf_counter()
+        out = dwk.walk_shard_device(sc, dc, wc, chip_g, pc, 0, seed=1)
+        dt = time.perf_counter() - t0
+        chip.append({"metric": metric,
+                     "value": out.shape[0] / dt if dt > 0 else None,
+                     "unit": "paths/s", "skipped": None})
+
+    ok = bool(bit_identical and feed_ok
+              and feed["device_ring_puts"] == 0
+              and feed["h2d_bytes_saved"] > 0)
+    return {
+        "bench": "device_walk", "ok": ok, "platform": platform,
+        "config": {"n_genes": G, "n_edges": E, "len_path": L,
+                   "walk_reps": wreps,
+                   "timing_reps": DEVICE_WALK_TIMING_REPS},
+        "sampler": sampler, "feed": feed, "chip": chip,
+        "note": "CPU A/B bounds sampler dispatch overhead only; the "
+                "H2D-elision benefit is chip-shaped, so chip lines are "
+                "watcher-gated explicit nulls off-chip, never faked. "
+                "paths/s is void unless bit_identical — the rows are "
+                "byte-compared against the host pool on every timed "
+                "shard.",
+    }
+
+
+def _device_walk() -> None:
+    """Standalone mode: run the on-device walk sampling A/B and refresh
+    the committed artifact."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    def note(msg):
+        print(f"# {msg}", file=sys.stderr, flush=True)
+
+    line = _device_walk_line(note)
+    print(json.dumps(line), flush=True)
+    repo = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(repo, DEVICE_WALK_ARTIFACT), "w") as f:
+        json.dump({"line": line, "code_key": _current_code_key(repo),
+                   "written_by": "bench.py --_device_walk"}, f, indent=1)
+    note(f"wrote {DEVICE_WALK_ARTIFACT}")
+    if not line["ok"]:
+        sys.exit(1)
+
+
 def _shard_scale_line(note) -> dict:
     """Million-node shard-scale sweep — ROADMAP item 2's headline.
 
@@ -4406,5 +4618,7 @@ if __name__ == "__main__":
         _shard_scale()
     elif "--_edge_ab" in sys.argv:
         _edge_ab()
+    elif "--_device_walk" in sys.argv:
+        _device_walk()
     else:
         main()

@@ -549,15 +549,14 @@ def _execute_lanes(engine: ResidentEngine, cfg: G2VecConfig,
     from g2vec_tpu.analysis import (biomarker_scores_lanes, freq_index,
                                     find_lgroups_lanes, top_biomarkers,
                                     warm_lgroups_compile)
-    from g2vec_tpu.cache import (DEVICE_FAMILY, NATIVE_FAMILY,
-                                 configure_xla_cache, walk_cache_key)
+    from g2vec_tpu.cache import (NATIVE_FAMILY, configure_xla_cache,
+                                 walk_cache_key)
     from g2vec_tpu.io.writers import (write_biomarkers, write_lgroups,
                                       write_vectors)
     from g2vec_tpu.ops.backend import resolve_walker_backend
-    from g2vec_tpu.ops.graph import neighbor_table, thresholded_edges
+    from g2vec_tpu.ops.graph import thresholded_edges
     from g2vec_tpu.ops.host_walker import resolve_sampler_threads
-    from g2vec_tpu.ops.walker import (count_gene_freq, generate_path_set,
-                                      integrate_path_sets)
+    from g2vec_tpu.ops.walker import count_gene_freq, integrate_path_sets
     from g2vec_tpu.parallel.mesh import make_mesh_context
     from g2vec_tpu.pipeline import PipelineResult, _background_warm
     from g2vec_tpu.preprocess import permute_labels
@@ -670,8 +669,11 @@ def _execute_lanes(engine: ResidentEngine, cfg: G2VecConfig,
                         np.asarray(s_k), np.asarray(d_k), np.asarray(w_k),
                         n_genes, len_path=cfg.lenPath,
                         reps=cfg.numRepetition, seed=(v.seed << 1) | gi,
-                        family=(NATIVE_FAMILY if walker_backend == "native"
-                                else DEVICE_FAMILY))
+                        # One family for BOTH backends: the device
+                        # sampler is bit-exact with the native one
+                        # (cache.py NATIVE_FAMILY contract), so lanes
+                        # share walk products across backends too.
+                        family=NATIVE_FAMILY)
                     if ckey not in walk_of_key:
                         task = f"{pfx}walk:{group}:{ckey[:12]}"
                         walk_of_key[ckey] = task
@@ -681,9 +683,7 @@ def _execute_lanes(engine: ResidentEngine, cfg: G2VecConfig,
                             np.asarray(w_k), n_genes,
                             seed=(v.seed << 1) | gi,
                             backend=walker_backend, tier=walk_tier,
-                            ckey=ckey, group=group, mesh_ctx=mesh_ctx,
-                            neighbor_table=neighbor_table,
-                            generate_path_set=generate_path_set))
+                            ckey=ckey, group=group))
                     share_count[walk_of_key[ckey]] += 1
                     lane_walks[li].append(walk_of_key[ckey])
         n_walk_tasks = len(walk_of_key)
@@ -994,7 +994,7 @@ def _execute_lanes(engine: ResidentEngine, cfg: G2VecConfig,
 
 
 def _make_walk_task(cfg, s, d, w, n_genes, *, seed, backend, tier, ckey,
-                    group, mesh_ctx, neighbor_table, generate_path_set):
+                    group):
     """One distinct walk product: tier lookup (in-process memo, then the
     sha256-verified disk tier), else sample through the lane-shared
     backend and store. Runs on the overlap pool; the native sampler fans
@@ -1012,19 +1012,14 @@ def _make_walk_task(cfg, s, d, w, n_genes, *, seed, backend, tier, ckey,
                 reps=cfg.numRepetition, seed=seed,
                 n_threads=cfg.sampler_threads)
         else:
-            import jax
+            # Bit-exact device sampler (ops/device_walker.py): the same
+            # splitmix64 rows the native branch emits, so the shared
+            # NATIVE_FAMILY cache key is honest for both branches.
+            from g2vec_tpu.ops.device_walker import generate_path_set_device
 
-            table = neighbor_table(s, d, w, n_genes)
-            # Matches the solo pipeline's stream: key(seed) folded by the
-            # group index — ``seed`` here is (lane_seed << 1) | group, so
-            # recover the fold the solo path applies.
-            ps = generate_path_set(
-                table, jax.random.fold_in(jax.random.key(seed >> 1),
-                                          seed & 1),
-                len_path=cfg.lenPath, reps=cfg.numRepetition,
-                walker_batch=cfg.walker_batch,
-                walker_hbm_budget=cfg.walker_hbm_budget,
-                mesh_ctx=mesh_ctx)
+            ps = generate_path_set_device(
+                s, d, w, n_genes, len_path=cfg.lenPath,
+                reps=cfg.numRepetition, seed=seed)
         tier.store(ckey, ps, n_genes, meta={"group": group})
         return ps
 

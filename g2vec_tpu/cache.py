@@ -105,6 +105,16 @@ def _configured_xla_dir() -> Optional[str]:
 #: PRNG-family tags baked into every key. Version them on ANY change to
 #: the corresponding sampler's stream derivation — a stale artifact from
 #: an older stream family must miss, not load.
+#:
+#: NATIVE_FAMILY names the splitmix64 STREAM family, not a host/device
+#: implementation: the bit-exact device sampler (ops/device_walker.py)
+#: emits byte-identical packed rows for the same (CSR bytes, walk
+#: params, seed), so BOTH production backends key under it — a device
+#: run HITS a host-populated entry and vice versa (the cross-backend
+#: cache contract, pinned in tests/test_device_walker.py). DEVICE_FAMILY
+#: is the legacy jax.random lockstep walker's tag, kept so its old
+#: artifacts stay addressable and can never collide with splitmix64
+#: entries.
 NATIVE_FAMILY = "native-splitmix64-v1"
 DEVICE_FAMILY = "device-jaxrandom-v1"
 

@@ -104,8 +104,13 @@ class G2VecConfig:
                                      # collective), else the JAX lockstep
                                      # walker (measured basis:
                                      # ops/backend.py). "device"/"native"
-                                     # pin a sampler; each is per-seed
-                                     # deterministic in its own PRNG family
+                                     # pin a sampler; both run the SAME
+                                     # splitmix64 walk — device rows are
+                                     # byte-identical to the C++ sampler's
+                                     # (ops/device_walker.py parity
+                                     # contract), so goldens, walk-cache
+                                     # entries, and bands transfer between
+                                     # backends unchanged
     sampler_threads: int = 0         # host cores for the native sampler's
                                      # thread pool (0 = all cores; output is
                                      # bit-identical at ANY count — streams
@@ -148,6 +153,17 @@ class G2VecConfig:
                                      # this many shards wait unconsumed.
                                      # Peak host path memory ~= shard x
                                      # (depth + 2 in-flight)
+    device_feed: bool = False        # fuse the device walker into the
+                                     # streaming trainer: epoch 0 samples
+                                     # each shard ON DEVICE and feeds the
+                                     # minibatch step device-resident — no
+                                     # host ring, no per-shard H2D (spool
+                                     # still written, asynchronously, for
+                                     # epoch 1..N replay + durability).
+                                     # Requires --train-mode streaming +
+                                     # --walker-backend device. Outputs
+                                     # byte-identical to the ring feed
+                                     # (train/stream.py)
     stream_patience: int = 5         # streaming early stop: epochs without
                                      # a strict val-ACC improvement before
                                      # stopping (1 = the full-batch
@@ -352,12 +368,28 @@ class G2VecConfig:
         if self.stream_patience < 1:
             raise ValueError(
                 f"stream_patience must be >= 1, got {self.stream_patience}")
-        if self.train_mode == "streaming":
-            if self.walker_backend == "device":
+        if self.device_feed:
+            if self.train_mode != "streaming":
                 raise ValueError(
-                    "--train-mode streaming needs the native sampler's "
-                    "shard emission (walker index ranges); "
-                    "--walker-backend device cannot stream")
+                    "--device-feed fuses device sampling into the "
+                    "STREAMING trainer; add --train-mode streaming")
+            if self.walker_backend != "device":
+                raise ValueError(
+                    "--device-feed samples shards on device; add "
+                    "--walker-backend device")
+            if self.graph_shards or self.embed_shards:
+                raise ValueError(
+                    "--device-feed does not compose with "
+                    "--graph-shards/--embed-shards yet — the sharded "
+                    "trainer exchanges sampled shards over the KV "
+                    "transport, which is a host path")
+        if self.train_mode == "streaming":
+            if self.walker_backend == "device" and (
+                    self.graph_shards or self.embed_shards):
+                raise ValueError(
+                    "sharded streaming (--graph-shards/--embed-shards) "
+                    "needs the native sampler's thread pool per rank; "
+                    "--walker-backend device does not compose")
             sharded = bool(self.graph_shards or self.embed_shards)
             # The sharded mode (ROADMAP item 2) IS streaming x
             # distributed: --graph-shards/--embed-shards open that gate.
@@ -425,8 +457,11 @@ class G2VecConfig:
                     "walk graph; add --train-mode streaming")
             if self.walker_backend == "device":
                 raise ValueError(
-                    "--edge-partition needs the native sampler's resumable "
-                    "partial walks; --walker-backend device cannot")
+                    "--edge-partition's owner-range handoff transport "
+                    "still drives the native partial walker; "
+                    "--walker-backend device (and --device-feed) are "
+                    "refused until the handoff transport is ported to "
+                    "the device sampler's suspend/resume states")
             if self.num_processes and self.num_processes > 1 \
                     and not self.graph_shards:
                 raise ValueError(
@@ -611,6 +646,7 @@ SERVE_JOB_KEYS = (
     # processes — fleet topology is daemon infrastructure, not a per-job
     # knob.
     "train_mode", "shard_paths", "prefetch_depth", "stream_patience",
+    "device_feed",
     # Streaming checkpoint cadence (shards between cursor writes). The
     # daemon owns WHERE checkpoints go (its state dir); a job may only
     # tune how often its own cursor is cut.
@@ -840,6 +876,15 @@ def build_parser() -> argparse.ArgumentParser:
                              "--train-mode streaming (default 2); the "
                              "sampler blocks when D shards wait "
                              "unconsumed (backpressure).")
+    parser.add_argument("--device-feed", action="store_true",
+                        help="Fuse device sampling into the streaming "
+                             "trainer: epoch 0 shards are sampled ON "
+                             "DEVICE and consumed device-resident (no "
+                             "host ring, no per-shard H2D; spool written "
+                             "asynchronously for replay). Requires "
+                             "--train-mode streaming --walker-backend "
+                             "device. Outputs byte-identical to the ring "
+                             "feed.")
     parser.add_argument("--stream-patience", type=int, default=5,
                         metavar="K",
                         help="Streaming early stop: stop after K epochs "
@@ -1073,6 +1118,7 @@ def config_from_args(argv=None) -> G2VecConfig:
         train_mode=args.train_mode,
         shard_paths=args.shard_paths,
         prefetch_depth=args.prefetch_depth,
+        device_feed=args.device_feed,
         stream_patience=args.stream_patience,
         graph_shards=args.graph_shards,
         embed_shards=args.embed_shards,

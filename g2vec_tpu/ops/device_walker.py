@@ -1,0 +1,519 @@
+"""CSR-native device walk sampler, bit-exact with the host C++ walker.
+
+This module replaces the legacy dense-adjacency device walker as the
+production device sampler (docstring lineage: ``ops/walker.py`` began as
+the faithful JAX port of generate_randomPath, ref: G2Vec.py:328-346 —
+weighted no-revisit walks, Categorical over the current node's positive
+out-edge weights restricted to unvisited targets, early stop at dead
+ends — but its dense form materializes the [G, G] transition matrix and
+its sparse form draws from a jax.random PRNG family the host sampler
+cannot reproduce). Here the walk runs over the SAME CSR arrays the
+native sampler scans (ops/host_walker.edges_to_csr) and draws from the
+SAME PRNG: splitmix64, emulated on device as uint32 lane pairs with one
+fixed-constant state advance per uniform draw — the exact per-draw
+contract ``WalkStateBatch.rng`` pins (PR 13). Device paths are therefore
+**bitwise identical** to native/walker.cpp for the same (CSR bytes, walk
+params, seed): every golden, walk-cache entry, and statistical band
+transfers between backends unchanged.
+
+How bit-exactness is achieved (the parity contract, ARCHITECTURE.md
+§24):
+
+- splitmix64 state and outputs are uint64 values carried as (hi, lo)
+  uint32 lane pairs; add/xor/shift/multiply are emulated lanewise
+  (16-bit limb products for the low-64 multiply), so every stream word
+  equals the C++ ``uint64_t`` stream word.
+- uniform01 is ``(out >> 11) * 2^-53`` in the C++ walker; on device the
+  53-bit integer splits as hi-21/lo-32 words and
+  ``u = hi*2^-21 + lo*2^-53`` — both scalings are exact powers of two
+  and the sum is exactly representable, so ``u`` is the identical f64.
+- the per-step CDF is accumulated in float64 by an explicit SEQUENTIAL
+  scan over the degree axis (XLA's ``cumsum`` uses a pairwise tree and
+  does NOT reproduce left-to-right accumulation); ineligible slots add
+  exactly 0.0, so masked lane sums equal the host's compacted cumbuf.
+- selection counts eligible slots with ``cum <= target`` — the same
+  index the host's lower-bound search returns — with the same
+  last-eligible fallback when rounding puts ``target`` at ``total``.
+- the state advances ONLY on an actual draw: dead ends and suspensions
+  break before drawing, exactly as walk_range/walk_partial_range do.
+
+float64 on device: CPU and GPU backends execute IEEE f64 natively (the
+tier-1 parity pins run on CPU). TPU chips have no native f64 — XLA:TPU
+emulation is not IEEE-bitwise — so on TPU this sampler is
+throughput-correct but the bitwise contract is only *claimed* where a
+chip-gated bench line has re-checked it (BENCH_DEVICE_WALK.json keeps
+those lines gated, never faked).
+
+Suspend/resume: :func:`advance_walk_states_device` consumes the same
+:class:`~g2vec_tpu.ops.host_walker.WalkStateBatch` the native
+walk_partial advances — (gene, remaining, rng-word) state round-trips
+between backends mid-walk with word-for-word rng parity.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Set, Tuple
+
+import numpy as np
+
+from g2vec_tpu.ops.host_walker import (ShardPlan, WalkStateBatch,
+                                       edges_to_csr)
+
+# The splitmix64 constants (Steele et al.; native/walker.cpp uses the
+# same literals).
+GOLDEN = 0x9E3779B97F4A7C15
+MIX1 = 0xBF58476D1CE4E5B9
+MIX2 = 0x94D049BB133111EB
+_MASK64 = (1 << 64) - 1
+
+
+# ---- host-side reference + seeding (the fuzz battery's oracle) -------------
+
+def splitmix64_ref(state: int) -> Tuple[int, int]:
+    """One splitmix64 draw in pure Python: (new_state, output word).
+
+    The word-for-word oracle the device emulation is fuzzed against —
+    matches native/walker.cpp's ``splitmix64(uint64_t&)`` exactly.
+    """
+    state = (state + GOLDEN) & _MASK64
+    z = state
+    z = ((z ^ (z >> 30)) * MIX1) & _MASK64
+    z = ((z ^ (z >> 27)) * MIX2) & _MASK64
+    return state, z ^ (z >> 31)
+
+
+def uniform01_ref(state: int) -> Tuple[int, float]:
+    """One uniform01 draw in pure Python: (new_state, u in [0, 1))."""
+    state, z = splitmix64_ref(state)
+    return state, float(z >> 11) * (2.0 ** -53)
+
+
+def init_walk_state_np(seed: int, stream_ids: np.ndarray) -> np.ndarray:
+    """The per-walker PRNG init, in numpy: raw state =
+    ``seed ^ (stream_id * GOLDEN)`` plus one discarded splitmix64 call
+    (state advance only — the discarded output never touches state).
+    Bit-identical to native ``g2v_init_walk_state`` without needing the
+    C++ toolchain, so a toolchain-free host can still seed device walks.
+    """
+    sid = np.ascontiguousarray(stream_ids, dtype=np.uint64)
+    seed64 = np.uint64(seed & _MASK64)
+    with np.errstate(over="ignore"):
+        st = (seed64 ^ (sid * np.uint64(GOLDEN))) + np.uint64(GOLDEN)
+    return st
+
+
+# ---- uint32 lane-pair u64 emulation (device) -------------------------------
+# Everything below runs under jit; uint32 arithmetic wraps mod 2^32 on
+# every backend, which is exactly the carry discipline the emulation
+# needs. Python int scalars stay weakly typed, so `x >> 11` keeps x's
+# uint32 dtype.
+
+def _u64_add(xh, xl, yh, yl):
+    lo = xl + yl
+    carry = (lo < xl).astype(lo.dtype)
+    return xh + yh + carry, lo
+
+
+def _mul32_wide(a, b):
+    """uint32 x uint32 -> (hi, lo) uint32 pair of the 64-bit product,
+    via 16-bit limbs (no 64-bit multiplier needed on any backend)."""
+    a0, a1 = a & 0xFFFF, a >> 16
+    b0, b1 = b & 0xFFFF, b >> 16
+    p00 = a0 * b0
+    mid = a0 * b1 + a1 * b0          # may wrap: the wrap IS bit 2^48
+    mid_wrap = (mid < a0 * b1).astype(a.dtype)
+    lo = p00 + (mid << 16)
+    carry = (lo < p00).astype(a.dtype)
+    hi = a1 * b1 + (mid >> 16) + (mid_wrap << 16) + carry
+    return hi, lo
+
+
+def _u64_mul(xh, xl, yh, yl):
+    """Low 64 bits of the u64 product (all splitmix64 needs)."""
+    hi, lo = _mul32_wide(xl, yl)
+    return hi + xl * yh + xh * yl, lo
+
+
+def _u64_xorshr(h, l, k: int):
+    """(h, l) ^= (h, l) >> k for 0 < k < 32."""
+    return h ^ (h >> k), l ^ ((l >> k) | (h << (32 - k)))
+
+
+def _splitmix64_device(sh, sl):
+    """One device splitmix64 draw on (hi, lo) uint32 lane pairs:
+    returns (new_state_hi, new_state_lo, out_hi, out_lo)."""
+    import jax.numpy as jnp
+
+    sh, sl = _u64_add(sh, sl, jnp.uint32(GOLDEN >> 32),
+                      jnp.uint32(GOLDEN & 0xFFFFFFFF))
+    zh, zl = _u64_xorshr(sh, sl, 30)
+    zh, zl = _u64_mul(zh, zl, jnp.uint32(MIX1 >> 32),
+                      jnp.uint32(MIX1 & 0xFFFFFFFF))
+    zh, zl = _u64_xorshr(zh, zl, 27)
+    zh, zl = _u64_mul(zh, zl, jnp.uint32(MIX2 >> 32),
+                      jnp.uint32(MIX2 & 0xFFFFFFFF))
+    zh, zl = _u64_xorshr(zh, zl, 31)
+    return sh, sl, zh, zl
+
+
+def _uniform01_device(zh, zl):
+    """``(word >> 11) * 2^-53`` from the (hi, lo) output pair, exactly:
+    the 53-bit integer splits as 21 high / 32 low bits, each converts to
+    f64 exactly, each scaling is a power of two, and the sum is exactly
+    representable — IEEE addition then returns it exactly."""
+    import jax.numpy as jnp
+
+    v_hi = (zh >> 11).astype(jnp.float64)
+    v_lo = ((zh << 21) | (zl >> 11)).astype(jnp.float64)
+    return v_hi * (2.0 ** -21) + v_lo * (2.0 ** -53)
+
+
+# ---- the walk kernel -------------------------------------------------------
+
+def _x64():
+    """float64 lives behind jax's x64 switch; the kernels trace AND run
+    inside this context so the f64 CDF math is real f64 everywhere."""
+    from jax.experimental import enable_x64
+
+    return enable_x64()
+
+
+def _pow2(n: int) -> int:
+    return 1 << max(0, (n - 1)).bit_length()
+
+
+@functools.lru_cache(maxsize=32)
+def _get_walk_fn(len_path: int, d_slots: int):
+    """The jitted step scan for (len_path, padded-degree) — walker count
+    and CSR sizes specialize through jit's own shape cache."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    D, L = d_slots, len_path
+
+    def run(indptr, indices_pad, weights_pad, avail, cur, rng_hi, rng_lo,
+            pos, paths):
+        n_walkers = cur.shape[0]
+        d_arange = jnp.arange(D, dtype=jnp.int32)
+        l_arange = jnp.arange(L, dtype=jnp.int32)
+        susp0 = jnp.zeros((n_walkers,), dtype=bool)
+
+        def step(carry, _):
+            cur, sh, sl, pos, paths, susp, dead = carry
+            # Gate order = walk_partial_range's: the length check guards
+            # the availability check guards the scan guards the draw.
+            not_full = pos < L
+            live = (~susp) & (~dead) & not_full
+            avail_cur = avail[cur] != 0
+            suspend_now = live & (~avail_cur)
+            susp = susp | suspend_now
+            active = live & avail_cur
+            # CSR row slice at a static width: indices/weights carry D
+            # trailing pad entries, so the dynamic_slice never clamps.
+            row_off = indptr[cur]
+            deg = indptr[cur + 1] - row_off
+            cand = jax.vmap(
+                lambda o: lax.dynamic_slice(indices_pad, (o,), (D,)))(row_off)
+            wrow = jax.vmap(
+                lambda o: lax.dynamic_slice(weights_pad, (o,), (D,)))(row_off)
+            in_row = d_arange[None, :] < deg[:, None]
+            # No-revisit via path replay (the C++ visited mask is wiped
+            # by path replay too): -1 pads never match a candidate, and
+            # out-of-row pad candidates are masked by in_row.
+            seen = (paths[:, :, None] == cand[:, None, :]).any(axis=1)
+            elig = in_row & (~seen) & (wrow > 0.0)
+            # Sequential f64 mass accumulation over the degree axis —
+            # jnp.cumsum's pairwise tree would NOT reproduce the host's
+            # left-to-right double sums; ineligible lanes add exactly
+            # 0.0, so eligible lanes hold exactly the compacted cumbuf.
+            wm = jnp.where(elig, wrow.astype(jnp.float64), 0.0)
+
+            def cum_step(acc, col):
+                acc = acc + col
+                return acc, acc
+
+            total, cum_t = lax.scan(
+                cum_step, jnp.zeros((n_walkers,), dtype=jnp.float64), wm.T)
+            cum = cum_t.T
+            m = jnp.sum(elig, axis=1, dtype=jnp.int32)
+            dead_now = active & ((m == 0) | (total <= 0.0))
+            draw = active & (~dead_now)
+            # One state advance per ACTUAL draw: advance speculatively,
+            # commit only where a draw happens (dead ends/suspensions
+            # freeze the stream, exactly as the C++ break does).
+            nsh, nsl, zh, zl = _splitmix64_device(sh, sl)
+            u = _uniform01_device(zh, zl)
+            sh = jnp.where(draw, nsh, sh)
+            sl = jnp.where(draw, nsl, sl)
+            target = u * total
+            # The host's lower-bound: smallest eligible j with
+            # target < cum[j] == the count of eligible cum <= target;
+            # rounding can put target at total — fall through to the
+            # last eligible slot, as the C++ clamp does.
+            j = jnp.sum(elig & (cum <= target[:, None]), axis=1,
+                        dtype=jnp.int32)
+            j = jnp.minimum(j, jnp.maximum(m - 1, 0))
+            rank = jnp.cumsum(elig.astype(jnp.int32), axis=1) - 1
+            sel = elig & (rank == j[:, None])
+            nxt = jnp.sum(jnp.where(sel, cand, 0), axis=1,
+                          dtype=jnp.int32)
+            write = draw[:, None] & (l_arange[None, :] == pos[:, None])
+            paths = jnp.where(write, nxt[:, None], paths)
+            pos = pos + draw.astype(jnp.int32)
+            cur = jnp.where(draw, nxt, cur)
+            return (cur, sh, sl, pos, paths, susp, dead | dead_now), None
+
+        carry = (cur, rng_hi, rng_lo, pos, paths, susp0, susp0)
+        # L-1 trips cover the worst case: a pos=1 resume draws L-2 steps
+        # and still needs one trip to notice a terminal suspension.
+        (cur, rng_hi, rng_lo, pos, paths, susp, _), _ = lax.scan(
+            step, carry, None, length=L - 1)
+        return cur, rng_hi, rng_lo, pos, paths, susp
+
+    return jax.jit(run)
+
+
+@functools.lru_cache(maxsize=32)
+def _get_pack_fn(nbytes: int):
+    """Jitted path -> np.packbits-layout packer: an O(W*L) bit scatter
+    (no [W, G] dense transient; no-revisit means every (byte, bit)
+    contribution is unique, so uint8 add IS bitwise or). Column
+    ``nbytes`` is the dump slot for -1 pads, sliced off."""
+    import jax
+    import jax.numpy as jnp
+
+    def pack(paths):
+        n, length = paths.shape
+        valid = paths >= 0
+        node = jnp.where(valid, paths, 0)
+        byte_idx = jnp.where(valid, node >> 3, nbytes)
+        bits = jnp.where(valid, (128 >> (node & 7)), 0).astype(jnp.uint8)
+        rows = jnp.broadcast_to(
+            jnp.arange(n, dtype=jnp.int32)[:, None], (n, length))
+        out = jnp.zeros((n, nbytes + 1), dtype=jnp.uint8)
+        out = out.at[rows, byte_idx].add(bits)
+        return out[:, :nbytes]
+
+    return jax.jit(pack)
+
+
+def _padded_csr(csr, d_slots: int):
+    """CSR arrays with ``d_slots`` trailing pad entries so the static-
+    width row slice never clamps (pad weights are 0 => never eligible)."""
+    indptr, indices, weights = csr
+    indptr = np.ascontiguousarray(indptr, dtype=np.int32)
+    indices = np.concatenate(
+        [np.ascontiguousarray(indices, dtype=np.int32),
+         np.zeros(d_slots, np.int32)])
+    weights = np.concatenate(
+        [np.ascontiguousarray(weights, dtype=np.float32),
+         np.zeros(d_slots, np.float32)])
+    return indptr, indices, weights
+
+
+def _max_degree(indptr: np.ndarray) -> int:
+    if indptr.shape[0] <= 1:
+        return 1
+    return max(1, int(np.max(indptr[1:] - indptr[:-1])))
+
+
+def _split_rng(rng: np.ndarray):
+    rng = np.ascontiguousarray(rng, dtype=np.uint64)
+    return ((rng >> np.uint64(32)).astype(np.uint32),
+            (rng & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+
+
+def _join_rng(hi: np.ndarray, lo: np.ndarray) -> np.ndarray:
+    return ((hi.astype(np.uint64) << np.uint64(32))
+            | lo.astype(np.uint64))
+
+
+def _run_states(csr, n_genes: int, avail: np.ndarray, cur: np.ndarray,
+                rng: np.ndarray, pos: np.ndarray, paths: np.ndarray,
+                len_path: int, *, as_device: bool = False):
+    """Advance explicit walk states on device; returns
+    (cur, rng, pos, paths, status) — numpy, or device arrays for paths
+    when ``as_device`` (the fused-feed fast path keeps them resident)."""
+    if len_path < 1:
+        raise ValueError(f"len_path must be >= 1, got {len_path}")
+    indptr = np.ascontiguousarray(csr[0], dtype=np.int32)
+    d_slots = _pow2(_max_degree(indptr))
+    indptr, indices_pad, weights_pad = _padded_csr(
+        (indptr, csr[1], csr[2]), d_slots)
+    avail = np.ascontiguousarray(avail, dtype=np.uint8)
+    n = cur.shape[0]
+    # Pad the walker axis to a power of two: shard tails reuse the
+    # bucket's compiled program instead of re-tracing per remainder
+    # width. Pad walkers are born full (pos = len_path) — inert.
+    n_pad = _pow2(max(1, n))
+    if n_pad != n:
+        pad = n_pad - n
+        cur = np.concatenate([cur, np.zeros(pad, np.int32)])
+        rng = np.concatenate([rng, np.zeros(pad, np.uint64)])
+        pos = np.concatenate(
+            [pos, np.full(pad, len_path, np.int32)])
+        paths = np.concatenate(
+            [paths, np.full((pad, len_path), -1, np.int32)], axis=0)
+    rng_hi, rng_lo = _split_rng(rng)
+    with _x64():
+        fn = _get_walk_fn(len_path, d_slots)
+        out = fn(indptr, indices_pad, weights_pad, avail,
+                 np.ascontiguousarray(cur, dtype=np.int32), rng_hi, rng_lo,
+                 np.ascontiguousarray(pos, dtype=np.int32),
+                 np.ascontiguousarray(paths, dtype=np.int32))
+        if as_device:
+            cur2, hi, lo, pos2, paths2, susp = out
+            return (np.asarray(cur2)[:n],
+                    _join_rng(np.asarray(hi)[:n], np.asarray(lo)[:n]),
+                    np.asarray(pos2)[:n], paths2, susp, n)
+        cur2, hi, lo, pos2, paths2, susp = [np.asarray(a) for a in out]
+    return (cur2[:n], _join_rng(hi[:n], lo[:n]), pos2[:n], paths2[:n],
+            susp[:n].astype(np.uint8))
+
+
+def advance_walk_states_device(states: WalkStateBatch, csr, n_genes: int,
+                               avail: np.ndarray, len_path: int,
+                               n_threads: int = 0) -> np.ndarray:
+    """Device twin of :func:`~g2vec_tpu.ops.host_walker.
+    advance_walk_states`: advance every walk IN PLACE over an
+    availability-masked CSR until it finishes or suspends; returns the
+    [M] uint8 status array (0 finished, 1 suspended). Bit-identical to
+    the native advance for the same states — including the frozen rng
+    word of a suspended walker (``n_threads`` is accepted for signature
+    parity and ignored; the device batches instead of threading)."""
+    cur, rng, pos, paths, status = _run_states(
+        csr, n_genes, avail, states.cur, states.rng, states.pos,
+        states.paths, len_path)
+    states.cur[:] = cur
+    states.rng[:] = rng
+    states.pos[:] = pos
+    states.paths[:] = paths
+    return status
+
+
+def _shard_init(plan: ShardPlan, shard: int, seed: int,
+                starts: Optional[np.ndarray]):
+    """Initial (cur, rng, pos, paths) for a shard — walk_shard's
+    rep-major walker order and global-index PRNG streams, seeded by the
+    numpy init (no native lib needed)."""
+    lo, hi = plan.start_range(shard)
+    k = hi - lo
+    sub = (np.arange(lo, hi, dtype=np.int32) if starts is None
+           else np.ascontiguousarray(starts[lo:hi], dtype=np.int32))
+    start_col = np.tile(sub, plan.reps)
+    wids = (np.arange(plan.reps, dtype=np.uint64)[:, None]
+            * np.uint64(plan.n_starts)
+            + np.arange(lo, hi, dtype=np.uint64)[None, :]).ravel()
+    n = k * plan.reps
+    paths = np.full((n, plan.len_path), -1, np.int32)
+    paths[:, 0] = start_col
+    return (np.ascontiguousarray(start_col), init_walk_state_np(seed, wids),
+            np.ones(n, np.int32), paths)
+
+
+def walk_shard_device_arrays(src, dst, w, n_genes: int, plan: ShardPlan,
+                             shard: int, *, seed: int,
+                             csr: Optional[tuple] = None,
+                             starts: Optional[np.ndarray] = None):
+    """One group's shard rows sampled on device ->
+    ``(packed_device [rows, ceil(G/8)] uint8, rows)`` with the packed
+    array still DEVICE-RESIDENT (the fused streaming feed slices it into
+    the minibatch step without a host round-trip). Byte-identical to
+    :func:`~g2vec_tpu.ops.host_walker.walk_shard` for the same (plan,
+    shard, seed, CSR bytes)."""
+    from g2vec_tpu.resilience.faults import fault_point
+
+    if starts is not None and len(starts) != plan.n_starts:
+        raise ValueError(
+            f"plan.n_starts ({plan.n_starts}) must match len(starts) "
+            f"({len(starts)})")
+    if csr is None:
+        csr = edges_to_csr(np.asarray(src), np.asarray(dst), np.asarray(w),
+                           n_genes)
+    # The mid-scan fault seam: an injected crash lands between state
+    # init and the device scan — recovery is a clean recompute (the
+    # sampler is a pure function of (plan, shard, seed)), and the drill
+    # pins that the recomputed rows are byte-identical.
+    fault_point("device_walk", epoch=shard)
+    cur, rng, pos, paths = _shard_init(plan, shard, seed, starts)
+    avail = np.ones(n_genes, np.uint8)
+    _, _, _, paths_dev, _, n = _run_states(
+        csr, n_genes, avail, cur, rng, pos, paths, plan.len_path,
+        as_device=True)
+    nbytes = (n_genes + 7) // 8
+    with _x64():
+        packed = _get_pack_fn(nbytes)(paths_dev)[:n]
+    return packed, n
+
+
+def walk_shard_device(src, dst, w, n_genes: int, plan: ShardPlan,
+                      shard: int, *, seed: int, n_threads: int = 0,
+                      csr: Optional[tuple] = None,
+                      starts: Optional[np.ndarray] = None) -> np.ndarray:
+    """Drop-in device twin of :func:`~g2vec_tpu.ops.host_walker.
+    walk_shard` — same signature (``n_threads`` ignored), same
+    [group_rows, ceil(G/8)] packed rows, byte-for-byte."""
+    packed, _ = walk_shard_device_arrays(
+        src, dst, w, n_genes, plan, shard, seed=seed, csr=csr,
+        starts=starts)
+    return np.asarray(packed)
+
+
+def walk_packed_rows_device(src, dst, w, n_genes: int, *, len_path: int,
+                            reps: int, seed: int,
+                            starts: Optional[np.ndarray] = None,
+                            walker_lo: int = 0,
+                            walker_hi: Optional[int] = None,
+                            csr: Optional[tuple] = None) -> np.ndarray:
+    """Device twin of :func:`~g2vec_tpu.ops.host_walker.
+    walk_packed_rows`: walks for the global walker index range
+    [walker_lo, walker_hi) -> packed multi-hot rows, byte-identical to
+    the native sampler's."""
+    if len_path < 1:
+        raise ValueError(f"len_path must be >= 1, got {len_path}")
+    if starts is None:
+        starts = np.arange(n_genes, dtype=np.int32)
+    starts = np.asarray(starts, dtype=np.int32)
+    if starts.size and (starts.min() < 0 or starts.max() >= n_genes):
+        raise ValueError(f"starts contains node ids outside [0, {n_genes})")
+    n_starts = starts.shape[0]
+    total = n_starts * reps
+    walker_hi = total if walker_hi is None else walker_hi
+    if not (0 <= walker_lo <= walker_hi <= total):
+        raise ValueError(
+            f"walker range [{walker_lo}, {walker_hi}) outside [0, {total}]")
+    if csr is None:
+        csr = edges_to_csr(np.asarray(src), np.asarray(dst), np.asarray(w),
+                           n_genes)
+    all_starts = np.tile(starts, reps)[walker_lo:walker_hi]
+    wids = np.arange(walker_lo, walker_hi, dtype=np.uint64)
+    n = walker_hi - walker_lo
+    paths = np.full((n, len_path), -1, np.int32)
+    paths[:, 0] = all_starts
+    avail = np.ones(n_genes, np.uint8)
+    _, _, _, paths_dev, _, n_live = _run_states(
+        csr, n_genes, avail, np.ascontiguousarray(all_starts),
+        init_walk_state_np(seed, wids), np.ones(n, np.int32), paths,
+        len_path, as_device=True)
+    nbytes = (n_genes + 7) // 8
+    with _x64():
+        packed = _get_pack_fn(nbytes)(paths_dev)[:n_live]
+    return np.asarray(packed)
+
+
+def generate_path_set_device(src, dst, w, n_genes: int, *, len_path: int,
+                             reps: int, seed: int,
+                             starts: Optional[np.ndarray] = None) -> \
+        Set[bytes]:
+    """All-sources x reps device walks -> set of packed multi-hot rows.
+
+    The device twin of :func:`~g2vec_tpu.ops.host_walker.
+    generate_path_set_native` — byte-identical rows, so the two backends
+    share one walk-cache PRNG family (g2vec_tpu/cache.py NATIVE_FAMILY)
+    and a device run HITS a host-populated cache entry.
+    """
+    packed = walk_packed_rows_device(
+        src, dst, w, n_genes, len_path=len_path, reps=reps, seed=seed,
+        starts=starts)
+    return {row.tobytes() for row in packed}

@@ -20,11 +20,17 @@ backends apart. Same walk SEMANTICS (no revisit, weight-proportional
 sampling, dead-end stop, every gene a start node reps times,
 ref: G2Vec.py:324-352); per-seed deterministic for any thread count
 (streams are keyed by (seed, repetition, start-index) within this
-backend's own counter-based PRNG family). The two backends draw from
-different PRNG families — the device walker derives its streams via
-jax.random split/fold_in — so their path sets differ for the same seed;
-each is individually deterministic, exactly the documented dense/sparse
-caveat in generate_path_set.
+backend's own counter-based PRNG family).
+
+PARITY ORACLE: this sampler's splitmix64 streams and walk-step contract
+are now shared verbatim by the production device sampler
+(:mod:`g2vec_tpu.ops.device_walker`) — device packed rows are
+BYTE-IDENTICAL to this module's for the same (CSR bytes, walk params,
+seed), including mid-walk :class:`WalkStateBatch` suspend/resume, and
+the tier-1 parity battery pins host-vs-device word-for-word
+(tests/test_device_walker.py). The legacy jax.random lockstep walker in
+ops/walker.py remains the one differently-seeded family (the documented
+DEVICE_FAMILY caveat in cache.py).
 """
 from __future__ import annotations
 

@@ -1,4 +1,14 @@
-"""L3 — vectorized weighted random walks on device.
+"""L3 — vectorized weighted random walks on device (jax.random family).
+
+PRODUCTION NOTE: this module's walkers draw from the jax.random PRNG
+family and are only *statistically* equivalent to the host C++ sampler.
+The production device sampler is now :mod:`g2vec_tpu.ops.device_walker`
+— a CSR-native splitmix64 walker whose packed rows are BYTE-IDENTICAL
+to the native sampler's (one shared walk-cache family, backend-blind
+goldens). The dense [G, G] entry points here are deprecated (shimmed
+with DeprecationWarning — they cannot reach production scales); the
+sparse neighbor-table walker remains for mesh-sharded table experiments
+and as the legacy DEVICE_FAMILY artifact reader.
 
 Reference semantics (generate_pathSet / generate_randomPath,
 G2Vec.py:324-352), reproduced distributionally:
@@ -136,8 +146,8 @@ def _visited_from_path_list(path_list: jax.Array, n_genes: int) -> jax.Array:
 
 
 @partial(jax.jit, static_argnames=("len_path",))
-def random_walks(adj: jax.Array, starts: jax.Array, key: jax.Array,
-                 len_path: int) -> jax.Array:
+def _random_walks_dense(adj: jax.Array, starts: jax.Array, key: jax.Array,
+                        len_path: int) -> jax.Array:
     """Walk |starts| walkers for <= len_path nodes; return visited [W, G] bool.
 
     ``adj``: [G, G] float32 non-negative directed transition weights (zero =
@@ -176,6 +186,31 @@ def random_walks(adj: jax.Array, starts: jax.Array, key: jax.Array,
 
     (visited, _, _), _ = jax.lax.scan(step, state0, uniforms)
     return visited
+
+
+def random_walks(adj: jax.Array, starts: jax.Array, key: jax.Array,
+                 len_path: int) -> jax.Array:
+    """DEPRECATED dense walker shim — see :func:`_random_walks_dense`.
+
+    The dense [G, G] walker is retired as a production path: it cannot
+    reach the 262k+-gene scales the rest of the repo benches (the table
+    alone is G^2 floats), and the production device sampler is now the
+    bit-exact CSR walker in :mod:`g2vec_tpu.ops.device_walker` (same
+    rows as the host C++ sampler, byte for byte). This shim keeps the
+    dense kernel callable for small/test graphs but warns so no caller
+    silently regresses to dense; new code should use
+    ``device_walker.walk_packed_rows_device`` (production) or
+    :func:`random_walks_sparse` (jax.random family, mesh-sharded
+    tables).
+    """
+    import warnings
+
+    warnings.warn(
+        "ops.walker.random_walks (dense [G, G] adjacency) is deprecated: "
+        "use ops.device_walker (bit-exact CSR device sampler) or "
+        "random_walks_sparse (neighbor tables)", DeprecationWarning,
+        stacklevel=2)
+    return _random_walks_dense(adj, starts, key, len_path)
 
 
 # Prefix-segmented no-revisit compare: at step s only slots 0..s of the
@@ -355,7 +390,7 @@ def _packed_walk_sparse(nbr_idx, nbr_w, starts, keys, len_path: int):
 
 @partial(jax.jit, static_argnames=("len_path",))
 def _packed_walk_dense(adj, starts, keys, len_path: int):
-    visited = random_walks(adj, starts, keys, len_path)
+    visited = _random_walks_dense(adj, starts, keys, len_path)
     return _packbits_rows(visited)
 
 
@@ -557,6 +592,14 @@ def generate_path_set(adj, key: jax.Array, *, len_path: int, reps: int,
         table = (ctx.put(jnp.asarray(nbr_idx, dtype=jnp.int32), table_spec),
                  ctx.put(jnp.asarray(nbr_w, dtype=jnp.float32), table_spec))
     else:
+        import warnings
+
+        warnings.warn(
+            "generate_path_set with a dense [G, G] adjacency is "
+            "deprecated: pass a neighbor-table pair, or use "
+            "ops.device_walker.generate_path_set_device (bit-exact CSR "
+            "device sampler) — the dense table cannot reach production "
+            "scales", DeprecationWarning, stacklevel=2)
         n_genes = int(adj.shape[0])
         d_slots = n_genes
         table = ctx.put(jnp.asarray(adj, dtype=jnp.float32), P())

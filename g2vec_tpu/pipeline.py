@@ -145,9 +145,8 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print,
     from g2vec_tpu.analysis import biomarker_scores_device, top_biomarkers
     from g2vec_tpu.io.readers import load_clinical, load_expression, load_network
     from g2vec_tpu.io.writers import write_biomarkers, write_lgroups, write_vectors
-    from g2vec_tpu.ops.graph import neighbor_table, thresholded_edges
-    from g2vec_tpu.ops.walker import (count_gene_freq, generate_path_set,
-                                      integrate_path_sets)
+    from g2vec_tpu.ops.graph import thresholded_edges
+    from g2vec_tpu.ops.walker import count_gene_freq, integrate_path_sets
     from g2vec_tpu.parallel.mesh import make_mesh_context
     from g2vec_tpu.preprocess import (edges_to_indices, find_common_genes,
                                       fold_cohort, make_gene2idx,
@@ -368,8 +367,7 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print,
         # "auto" = host-walks-chip-trains: the walk step is CPU-shaped
         # (pointer-chase, no matmul), the trainer is MXU-shaped — measured
         # basis and resolution rules in ops/backend.py.
-        from g2vec_tpu.cache import (DEVICE_FAMILY, NATIVE_FAMILY,
-                                     walk_cache_key)
+        from g2vec_tpu.cache import NATIVE_FAMILY, walk_cache_key
         from g2vec_tpu.ops.backend import resolve_walker_backend
         from g2vec_tpu.ops.host_walker import resolve_sampler_threads
         from g2vec_tpu.parallel.overlap import OverlapScheduler
@@ -409,12 +407,16 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print,
             # (val-ACC parity band + biomarker overlap, ARCHITECTURE.md
             # §12); bitwise-deterministic WITHIN the mode at any thread
             # count / ring depth.
-            if walker_backend != "native":
+            # Both production samplers stream: the native C++ pool and
+            # the bit-exact device walker emit byte-identical shard rows
+            # over the same walker-index ranges (ops/device_walker.py
+            # parity contract), so the trainer's shard sequence — and
+            # its outputs — are the same bytes either way.
+            if walker_backend not in ("native", "device"):
                 raise ValueError(
-                    "--train-mode streaming needs the native sampler "
-                    "(shard emission over walker-index ranges); this host "
-                    f"resolved walker_backend={walker_backend!r} — build "
-                    "the C++ toolchain or use --train-mode full")
+                    "--train-mode streaming needs a shard-emitting "
+                    "sampler (native or device); this host resolved "
+                    f"walker_backend={walker_backend!r}")
             from g2vec_tpu.parallel.shard import make_shard_context
             from g2vec_tpu.train.stream import (EVAL_ROWS_CAP,
                                                 train_cbow_streaming)
@@ -540,7 +542,9 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print,
                     on_epoch=on_epoch, console=console,
                     shard_ctx=shard_ctx, walk_starts=cfg.walk_starts,
                     edge_ctx=edge_ctx,
-                    eval_rows_cap=(cfg.stream_eval_rows or EVAL_ROWS_CAP))
+                    eval_rows_cap=(cfg.stream_eval_rows or EVAL_ROWS_CAP),
+                    walker_backend=walker_backend,
+                    device_feed=cfg.device_feed)
             if edge_ctx is not None:
                 st = edge_ctx.stats
                 metrics.emit("handoff", mode=edge_ctx.mode,
@@ -571,6 +575,13 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print,
                          sampler_threads=sampler_threads,
                          walk_cache_hits=walk_cache_hits)
             metrics.emit("stream", **sres.stats.as_dict())
+            if walker_backend == "device":
+                wall = sres.stats.sampling_wall_s
+                metrics.emit(
+                    "device_walk",
+                    paths_per_s=(n_paths / wall if wall > 0 else 0.0),
+                    h2d_bytes_saved=sres.stats.h2d_bytes_saved,
+                    feed_mode=sres.stats.feed_mode)
             timer.annotate("paths",
                            sampling_wall_s=sres.stats.sampling_wall_s,
                            walker_backend=walker_backend,
@@ -606,8 +617,12 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print,
                             np.asarray(s_k), np.asarray(d_k), np.asarray(w_k),
                             n_genes, len_path=cfg.lenPath,
                             reps=cfg.numRepetition, seed=(cfg.seed << 1) | i,
-                            family=(NATIVE_FAMILY if walker_backend == "native"
-                                    else DEVICE_FAMILY))
+                            # One family for BOTH backends: the device
+                            # sampler's rows are byte-identical to the
+                            # native sampler's, so a device run HITS a
+                            # host-populated entry and vice versa
+                            # (cache.py NATIVE_FAMILY contract).
+                            family=NATIVE_FAMILY)
                         cached = walk_cache.load(ckey)
                         if cached is not None:
                             path_sets[i] = cached
@@ -665,12 +680,20 @@ def run(cfg: G2VecConfig, console: Callable[[str], None] = print,
                         else:
                             path_sets[i] = _walk()
                         continue
-                    table = neighbor_table(s_k, d_k, w_k, n_genes)
-                    path_sets[i] = generate_path_set(
-                        table, jax.random.fold_in(key, i), len_path=cfg.lenPath,
-                        reps=cfg.numRepetition, walker_batch=cfg.walker_batch,
-                        walker_hbm_budget=cfg.walker_hbm_budget,
-                        mesh_ctx=mesh_ctx)
+                    # Device backend: the bit-exact CSR device sampler
+                    # (ops/device_walker.py) — the SAME splitmix64 walk
+                    # as the native branch above, byte for byte, so the
+                    # walk-cache key and every downstream golden are
+                    # backend-invariant. (The legacy dense/jax.random
+                    # walker survives only behind a deprecation shim in
+                    # ops/walker.py.)
+                    from g2vec_tpu.ops.device_walker import \
+                        generate_path_set_device
+
+                    path_sets[i] = generate_path_set_device(
+                        np.asarray(s_k), np.asarray(d_k), np.asarray(w_k),
+                        n_genes, len_path=cfg.lenPath,
+                        reps=cfg.numRepetition, seed=(cfg.seed << 1) | i)
                     if walk_cache is not None and ckey:
                         walk_cache.store(ckey, path_sets[i], n_genes,
                                          meta={"group": group})

@@ -79,6 +79,12 @@ KINDS = ("crash", "fatal", "sigkill", "stall", "corrupt")
 SEAMS = ("load", "preprocess", "paths", "train", "lgroups", "biomarkers",
          "save", "checkpoint_write", "checkpoint_finalize",
          "native_load", "native_walker_load",
+         # Bit-exact device sampler (ops/device_walker.py): fires inside
+         # walk_shard_device between state init and the device scan
+         # (epoch = shard index). Recovery is a clean recompute — the
+         # sampler is a pure function of (plan, shard, seed) — and the
+         # drill pins that recomputed rows are byte-identical.
+         "device_walk",
          "allgather", "stage_barrier", "heartbeat",
          # Walk-artifact cache (g2vec_tpu/cache.py): fires right after a
          # store finalizes, so kind=corrupt models post-save bitrot that

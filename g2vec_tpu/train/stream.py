@@ -72,6 +72,7 @@ import threading
 import time
 import warnings
 from collections import deque
+from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, Iterator, List, Optional
 
 import numpy as np
@@ -340,6 +341,16 @@ class StreamStats:
     checkpoints: int = 0             # cursor checkpoints written this run
     checkpoint_wall_s: float = 0.0   # wall spent inside save_stream_state
     resumed: int = 0                 # 1 = this run restored a cursor
+    feed_mode: str = "ring"          # "ring": sampled rows cross the host
+                                     # shard ring + per-shard H2D upload.
+                                     # "device": epoch-0 shards sampled ON
+                                     # device and consumed device-resident
+                                     # (--device-feed; ops/device_walker.py)
+    h2d_bytes_saved: int = 0         # packed training bytes that never
+                                     # crossed host->device because the
+                                     # device feed kept them resident
+    device_recomputes: int = 0       # device-walk faults recovered by a
+                                     # clean recompute (device_walk seam)
 
     def as_dict(self) -> Dict:
         return dataclasses.asdict(self)
@@ -400,6 +411,7 @@ def train_cbow_streaming(
         on_epoch: Optional[Callable[[int, float, float, float], None]] = None,
         console: Callable[[str], None] = print,
         shard_ctx=None, walk_starts: int = 0, edge_ctx=None,
+        walker_backend: str = "native", device_feed: bool = False,
         ) -> StreamTrainResult:
     """Stream walk shards from the sampler pool straight into minibatch
     SGD; returns the trained embeddings plus the streaming twin of the
@@ -520,13 +532,53 @@ def train_cbow_streaming(
             csr.append(edges_to_csr(np.asarray(s), np.asarray(d),
                                     np.asarray(w), n_genes))
 
+    if walker_backend not in ("native", "device"):
+        raise ValueError(
+            f"walker_backend must be native|device, got {walker_backend!r}")
+    if walker_backend == "device" and (graph_multi or embed_multi
+                                       or edge_multi):
+        raise ValueError(
+            "the device walker does not compose with sharded/edge-"
+            "partitioned streaming yet — those producers exchange shards "
+            "over host transports keyed to the native pool")
+    if device_feed and walker_backend != "device":
+        raise ValueError("device_feed requires walker_backend='device'")
+
     def _walk_group(gi: int, shard_index: int) -> np.ndarray:
         s, d, w = groups[gi]
+        if walker_backend == "device":
+            # Bit-exact device sampler (ops/device_walker.py): the SAME
+            # packed bytes walk_shard would emit, so the ring, spool,
+            # dedup, and every downstream consumer are backend-blind.
+            return _device_walk_group(gi, shard_index)
         return walk_shard(np.asarray(s), np.asarray(d), np.asarray(w),
                           n_genes, plan, shard_index,
                           seed=(walk_seed << 1) | gi,
                           n_threads=sampler_threads, csr=csr[gi],
                           starts=starts)
+
+    def _device_walk_group(gi: int, shard_index: int,
+                           as_device: bool = False):
+        """One group's shard rows via the device sampler; retries ONCE on
+        a device_walk fault with a clean recompute — the sampler is a
+        pure function of (plan, shard, seed), so the recomputed rows are
+        byte-identical (the fault drill pins this)."""
+        from g2vec_tpu.ops.device_walker import (walk_shard_device,
+                                                 walk_shard_device_arrays)
+
+        s, d, w = groups[gi]
+        args = (np.asarray(s), np.asarray(d), np.asarray(w), n_genes,
+                plan, shard_index)
+        kw = dict(seed=(walk_seed << 1) | gi, csr=csr[gi], starts=starts)
+        for attempt in (0, 1):
+            try:
+                if as_device:
+                    return walk_shard_device_arrays(*args, **kw)
+                return walk_shard_device(*args, **kw)
+            except Exception:
+                if attempt:
+                    raise
+                stats.device_recomputes += 1
 
     def _walk_shard_rows(shard_index: int) -> np.ndarray:
         return np.concatenate([_walk_group(0, shard_index),
@@ -563,6 +615,24 @@ def train_cbow_streaming(
         spool_dir = tempfile.mkdtemp(prefix="g2v-stream-")
         spool_is_tmp = True
     spool = ShardSpool(spool_dir)
+
+    # --device-feed spool writes leave the fast path: one writer thread
+    # persists each shard's bytes while the SGD step consumes the
+    # device-resident copy. _drain_spool joins outstanding writes at
+    # every consistency boundary (cursor cuts, replay start, teardown) —
+    # durability is deferred, never dropped.
+    stats.feed_mode = "device" if device_feed else "ring"
+    spool_futs: Dict[int, object] = {}
+    spool_pool = (ThreadPoolExecutor(max_workers=1,
+                                     thread_name_prefix="g2v-spool")
+                  if device_feed else None)
+
+    def _spool_async(shard: Shard) -> None:
+        spool_futs[shard.index] = spool_pool.submit(spool.save, shard)
+
+    def _drain_spool() -> None:
+        for si in sorted(spool_futs):
+            spool_futs.pop(si).result()
 
     fingerprint = {
         "hidden": hidden, "learning_rate": learning_rate,
@@ -701,7 +771,11 @@ def train_cbow_streaming(
 
     # The producer (re)samples ONLY the epoch-0 tail: a resume at epoch
     # >= 1 (or at a terminal cursor) replays the durable spool instead.
-    need_producer = (resume_done == RUN_IN_PROGRESS and start_epoch == 0)
+    # The fused device feed has NO producer thread — epoch 0's shards
+    # are sampled on device inside the consumer loop itself (the ring
+    # stays empty; shards_emitted == 0 is the pinned assertion).
+    need_producer = (resume_done == RUN_IN_PROGRESS and start_epoch == 0
+                     and not device_feed)
     remove_closer = None
     producer_thread = None
     if need_producer:
@@ -731,6 +805,12 @@ def train_cbow_streaming(
         split_fns = make_split_fns(cdtype, decision_threshold)
         update_fn = eval_fn = None       # rebound to the split step below
     else:
+        if device_feed:
+            # The fused feed keeps packed rows device-resident in the
+            # plain XLA unpack layout; the Pallas block-packed layout
+            # packs on HOST (pm.pack_blockwise) and would reintroduce
+            # the per-shard H2D hop the feed exists to remove.
+            use_pallas = False
         ctx = make_mesh_context(None)
         layout = _plan_layout(tr_nom, n_genes, hidden, compute_dtype, ctx,
                               use_pallas)
@@ -981,6 +1061,70 @@ def train_cbow_streaming(
         if pending is not None:
             yield pending
 
+    def _feed_x_device(packed_dev, row_idx: np.ndarray):
+        """Device twin of _upload's x path: gather the kept train rows
+        from the DEVICE-RESIDENT packed shard, pad rows and byte-columns
+        to the exact layout _pack_rows builds (column pads are zero
+        bytes; walker rows never set bits past n_genes, so the padded
+        bytes are identical), and unpack on device. No packed training
+        bytes cross host->device."""
+        n = int(row_idx.shape[0])
+        nbytes = int(packed_dev.shape[1])
+        sel = jnp.take(packed_dev, jnp.asarray(row_idx, dtype=jnp.int32),
+                       axis=0)
+        out = jnp.zeros((tr_pad, n_genes_pad // 8), dtype=jnp.uint8)
+        out = out.at[:n, :nbytes].set(sel)
+        stats.h2d_bytes_saved += tr_pad * (n_genes_pad // 8)
+        return unpack_fn(out)
+
+    def _device_epoch0_feed(start: int):
+        """Epoch 0 under --device-feed: each shard is sampled ON DEVICE
+        (ops/device_walker.py) and its training rows consumed
+        device-resident — zero ring puts, zero per-shard H2D for the
+        minibatch step. One D2H copy per shard feeds the host-side
+        byproducts (common-row filter, dedup, eval buffers — the bytes
+        a resume checkpoint needs anyway) and the ASYNC spool write
+        (epoch 1..N replay + durability; _drain_spool joins before any
+        cursor cut and before replay). Same double-buffer discipline and
+        deferred-accumulate contract as _device_feed, and bit-identical
+        outputs: same rows, same filter, same split, same layout bytes.
+        """
+        pending = None
+        for si in range(start, n_shards):
+            fault_point("prefetch", epoch=si)
+            t_walk = time.perf_counter()
+            g0, _ = _device_walk_group(0, si, as_device=True)
+            g1, _ = _device_walk_group(1, si, as_device=True)
+            packed_dev = jnp.concatenate([g0, g1], axis=0)
+            rows_np = np.asarray(packed_dev)       # one D2H per shard
+            producer_wall[0] += time.perf_counter() - t_walk
+            labels = _shard_labels(si)
+            _spool_async(Shard(si, rows_np, labels))
+            keep = _filter_rows(rows_np, labels)
+            if not len(keep):
+                continue             # every row was group-common noise
+            fx, fy = rows_np[keep], labels[keep]
+            tr_idx, vl_idx = _shard_split(fx.shape[0], seed, si,
+                                          val_fraction)
+
+            def acc_cb(fx=fx, fy=fy, tr=tr_idx, vl=vl_idx, k=len(keep)):
+                kept_rows[0] += k
+                _accumulate(fx, fy, tr, vl)
+
+            n = int(tr_idx.shape[0])
+            y = np.zeros((tr_pad, 1), np.float32)
+            y[:n, 0] = fy[tr_idx]
+            w = np.zeros((tr_pad, 1), np.float32)
+            w[:n] = 1.0
+            nxt = (si, acc_cb,
+                   (_feed_x_device(packed_dev, keep[tr_idx]),
+                    jnp.asarray(y), jnp.asarray(w)))
+            if pending is not None:
+                yield pending
+            pending = nxt
+        if pending is not None:
+            yield pending
+
     # ---- the epoch loop ----
     # Early stop: the SAME metric as full-batch (held-out val accuracy,
     # snapshot-at-the-best returned), evaluated at shard-epoch
@@ -1020,6 +1164,11 @@ def train_cbow_streaming(
         the loop owns, keyed to the NEXT shard to train."""
         if not checkpoint_dir:
             return
+        if device_feed:
+            # A cursor must never reference spool bytes still in flight
+            # on the async writer — join them first (cheap: at most the
+            # last shard or two are outstanding).
+            _drain_spool()
         t0 = time.perf_counter()
         leaves, _ = jax.tree_util.tree_flatten(
             (params, opt_state, snapshot))
@@ -1130,9 +1279,15 @@ def train_cbow_streaming(
             offset = start_shard if resumed_here else 0
             losses = list(losses0) if resumed_here else []
             _checked(epoch, offset, losses)
-            feed = _device_feed(
-                _epoch0_iter(offset) if epoch == 0 else _replay_iter(offset),
-                epoch0=(epoch == 0))
+            if device_feed and epoch == 0:
+                feed = _device_epoch0_feed(offset)
+            else:
+                if device_feed:
+                    _drain_spool()   # replay reads the spool; join writes
+                feed = _device_feed(
+                    _epoch0_iter(offset) if epoch == 0
+                    else _replay_iter(offset),
+                    epoch0=(epoch == 0))
             for si, acc_cb, (x_dev, y_dev, w_dev) in feed:
                 _checked(epoch, si, losses)
                 if acc_cb is not None:
@@ -1208,6 +1363,12 @@ def train_cbow_streaming(
                          else RUN_COMPLETED))
     finally:
         ring.cancel()
+        if spool_pool is not None:
+            try:
+                _drain_spool()
+            except BaseException:  # noqa: BLE001 — best-effort flush; a
+                pass               # write error already failed the epoch
+            spool_pool.shutdown(wait=True)
         if remove_closer is not None:
             remove_closer()
         if producer_thread is not None:

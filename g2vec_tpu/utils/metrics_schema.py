@@ -48,6 +48,9 @@ EVENT_SCHEMAS = {
         "required": ['group', 'outcome'],
         "optional": ['bundle', 'cache_hits', 'job_id', 'ranges_rewalked',
                      'ranges_total', 'walked_rows']},
+    'device_walk': {
+        "required": ['feed_mode', 'h2d_bytes_saved', 'paths_per_s'],
+        "optional": ['device_recomputes', 'shards']},
     'done': {
         "required": [],
         "optional": ['acc_val', 'buckets', 'n_lanes', 'n_paths', 'outputs', 'overlap_saved_s', 'runs_per_hour', 'sampler_threads', 'stage_extras', 'stage_seconds', 'stop_epoch', 'stop_epochs', 'stream_totals', 'train_mode', 'walk_cache_hits', 'walk_stats', 'walker_backend', 'wall_seconds']},

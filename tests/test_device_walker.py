@@ -1,0 +1,411 @@
+"""Bit-exact device walker (ops/device_walker.py): splitmix64 lane-pair
+fuzz battery, host-vs-device packed-row parity across shard plans and
+thread counts, word-for-word suspend/resume rng parity (including the
+depth-1-remaining and dead-end-at-resume edges), the cross-backend
+walk-cache HIT contract, the dense-walker deprecation shim, the
+device_walk fault drill (clean recompute, byte-identical), and the
+fused --device-feed streaming run (zero ring puts, outputs
+byte-identical to the native ring feed)."""
+import shutil
+
+import numpy as np
+import pytest
+
+from g2vec_tpu.ops import device_walker as dw
+from g2vec_tpu.ops import host_walker as hw
+from g2vec_tpu.resilience import faults
+
+pytestmark = pytest.mark.device
+
+g_plus_plus = shutil.which("g++")
+needs_native = pytest.mark.skipif(
+    g_plus_plus is None, reason="no C++ toolchain in this environment")
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv(faults.ENV_PLAN, raising=False)
+    monkeypatch.delenv(faults.ENV_STATE, raising=False)
+    faults._reset_for_tests()
+    yield
+    faults._reset_for_tests()
+
+
+def _rand_graph(G, E, r):
+    src = r.integers(0, G, size=E).astype(np.int32)
+    dst = r.integers(0, G, size=E).astype(np.int32)
+    w = (r.random(E, dtype=np.float32)
+         * (10.0 ** r.integers(-3, 4, size=E)).astype(np.float32))
+    w[r.random(E) < 0.1] = 0.0          # exercise eligibility masking
+    return src, dst, w
+
+
+# ---- satellite 1: splitmix64 fuzz battery ---------------------------------
+
+def test_splitmix64_device_words_match_reference_fuzz():
+    """uint32-pair emulation vs the pure-Python reference, word for word,
+    over random seeds and draw counts."""
+    import jax.numpy as jnp
+
+    r = np.random.default_rng(11)
+    states = r.integers(0, 2**64, size=128, dtype=np.uint64)
+    with dw._x64():
+        sh, sl = dw._split_rng(states)
+        sh, sl = jnp.asarray(sh), jnp.asarray(sl)
+        for _ in range(7):              # 7 draws x 128 streams
+            nsh, nsl, zh, zl = dw._splitmix64_device(sh, sl)
+            new = dw._join_rng(np.asarray(nsh), np.asarray(nsl))
+            out = dw._join_rng(np.asarray(zh), np.asarray(zl))
+            u_dev = np.asarray(dw._uniform01_device(zh, zl))
+            for i, s in enumerate(states):
+                want_state, want_word = dw.splitmix64_ref(int(s))
+                assert int(new[i]) == want_state
+                assert int(out[i]) == want_word
+                # The split-sum uniform is the EXACT f64 the C++ walker
+                # computes from the same word.
+                assert u_dev[i] == float(want_word >> 11) * 2.0**-53
+            states = new
+            sh, sl = nsh, nsl
+
+
+def test_init_state_numpy_twin_derivation():
+    """init_walk_state_np == seed ^ (sid * GOLDEN) advanced by one
+    discarded splitmix64 call, for edge-case seeds."""
+    wids = np.arange(37, dtype=np.uint64)
+    for seed in (0, 1, 2**63, 2**64 - 1, 0xDEADBEEF):
+        got = dw.init_walk_state_np(seed, wids)
+        for i in range(len(wids)):
+            raw = (seed ^ (int(wids[i]) * dw.GOLDEN)) & dw._MASK64
+            want, _ = dw.splitmix64_ref(raw)      # discard advances state
+            assert int(got[i]) == want
+
+
+@needs_native
+def test_init_state_matches_native():
+    from g2vec_tpu.native.walker_bindings import init_walk_state
+
+    wids = np.arange(64, dtype=np.uint64)
+    for seed in (0, 7, 2**63 + 5, 2**64 - 1):
+        assert np.array_equal(init_walk_state(seed, wids),
+                              dw.init_walk_state_np(seed, wids))
+
+
+# ---- tentpole: packed-row bitwise parity ----------------------------------
+
+@needs_native
+def test_packed_rows_parity_across_graphs():
+    r = np.random.default_rng(3)
+    for trial in range(6):
+        G = int(r.integers(5, 150))
+        E = int(r.integers(0, G * 6 + 1))
+        src, dst, w = _rand_graph(G, E, r)
+        L = int(r.integers(1, 10))      # includes len_path=1
+        reps = int(r.integers(1, 4))
+        seed = int(r.integers(0, 2**63))
+        host = hw.walk_packed_rows(src, dst, w, G, len_path=L, reps=reps,
+                                   seed=seed)
+        dev = dw.walk_packed_rows_device(src, dst, w, G, len_path=L,
+                                         reps=reps, seed=seed)
+        assert host.shape == dev.shape
+        assert host.tobytes() == dev.tobytes(), f"trial {trial}"
+
+
+@needs_native
+@pytest.mark.parametrize("shard_paths", [16, 64, 0])
+@pytest.mark.parametrize("n_threads", [1, 3])
+def test_shard_parity_across_plans_and_sampler_threads(shard_paths,
+                                                       n_threads):
+    """Device shards byte-identical to the host pool's at ANY shard plan
+    and --sampler-threads setting (thread count must be a no-op)."""
+    r = np.random.default_rng(17)
+    G = 90
+    src, dst, w = _rand_graph(G, 500, r)
+    plan = hw.plan_shards(G, 2, shard_paths, len_path=7)
+    for s in range(min(plan.n_shards, 4)):
+        host = hw.walk_shard(src, dst, w, G, plan, s, seed=12345,
+                             n_threads=n_threads)
+        dev = dw.walk_shard_device(src, dst, w, G, plan, s, seed=12345)
+        assert host.tobytes() == dev.tobytes()
+
+
+# ---- suspend/resume: word-for-word WalkStateBatch parity ------------------
+
+@needs_native
+def test_suspend_resume_roundtrip_word_for_word():
+    """Availability-masked advance on both backends: identical statuses,
+    paths, AND rng words at every round — then a cross-backend resume
+    (host-advanced states resumed on device, and vice versa)."""
+    r = np.random.default_rng(23)
+    G = 60
+    src, dst, w = _rand_graph(G, 380, r)
+    csr = hw.edges_to_csr(src, dst, w, G)
+    L = 8
+    plan = hw.plan_shards(G, 2, 48, len_path=L)
+    st_h = hw.shard_walk_states(plan, 0, seed=99)
+    st_d = hw.shard_walk_states(plan, 0, seed=99)
+    for round_i in range(3):
+        avail = (r.random(G) < 0.55).astype(np.uint8)
+        if round_i == 2:
+            avail = np.ones(G, np.uint8)   # final round: everyone finishes
+        stat_h = hw.advance_walk_states(st_h, csr, G, avail, L)
+        stat_d = dw.advance_walk_states_device(st_d, csr, G, avail, L)
+        assert np.array_equal(stat_h, stat_d)
+        assert np.array_equal(st_h.cur, st_d.cur)
+        assert np.array_equal(st_h.pos, st_d.pos)
+        assert np.array_equal(st_h.paths, st_d.paths)
+        assert np.array_equal(st_h.rng, st_d.rng)   # word-for-word
+    assert stat_h.max() == 0
+
+    # Cross-backend handoff: advance on one backend, resume on the other.
+    st_a = hw.shard_walk_states(plan, 1, seed=99)
+    st_b = hw.shard_walk_states(plan, 1, seed=99)
+    avail = (np.arange(G) % 3 != 0).astype(np.uint8)
+    hw.advance_walk_states(st_a, csr, G, avail, L)       # host first
+    dw.advance_walk_states_device(st_b, csr, G, avail, L)  # device first
+    full = np.ones(G, np.uint8)
+    sa = dw.advance_walk_states_device(st_a, csr, G, full, L)  # dev resume
+    sb = hw.advance_walk_states(st_b, csr, G, full, L)         # host resume
+    assert np.array_equal(sa, sb)
+    assert np.array_equal(st_a.paths, st_b.paths)
+    assert np.array_equal(st_a.rng, st_b.rng)
+
+
+def test_depth_1_remaining_finishes_without_availability():
+    """A walker with one slot remaining finishes; a walker already full
+    never consults availability (the host loop checks plen < len_path
+    FIRST) — pins the device kernel's gate ordering."""
+    src = np.array([0, 1], np.int32)
+    dst = np.array([1, 0], np.int32)
+    w = np.array([1.0, 1.0], np.float32)
+    G, L = 2, 2
+    csr = hw.edges_to_csr(src, dst, w, G)
+    avail = np.array([1, 0], np.uint8)   # target node unavailable
+    rng0 = dw.init_walk_state_np(5, np.arange(1, dtype=np.uint64))
+    paths = np.full((1, L), -1, np.int32)
+    paths[0, 0] = 0
+    states = hw.WalkStateBatch(row=np.zeros(1, np.int64),
+                               cur=np.zeros(1, np.int32), rng=rng0.copy(),
+                               pos=np.ones(1, np.int32), paths=paths)
+    status = dw.advance_walk_states_device(states, csr, G, avail, L)
+    assert status[0] == 0                # finished, NOT suspended
+    assert states.pos[0] == 2 and states.paths[0, 1] == 1
+    want_rng, _ = dw.splitmix64_ref(int(rng0[0]))   # exactly one draw
+    assert int(states.rng[0]) == want_rng
+
+
+def test_dead_end_at_resume_freezes_rng():
+    """A suspended walker that resumes into a dead end exits WITHOUT
+    drawing — the rng word stays frozen at its suspension value."""
+    src = np.array([0, 1], np.int32)
+    dst = np.array([1, 0], np.int32)
+    w = np.array([1.0, 1.0], np.float32)
+    G, L = 2, 3
+    csr = hw.edges_to_csr(src, dst, w, G)
+    rng0 = dw.init_walk_state_np(9, np.arange(1, dtype=np.uint64))
+    paths = np.full((1, L), -1, np.int32)
+    paths[0, 0] = 0
+    states = hw.WalkStateBatch(row=np.zeros(1, np.int64),
+                               cur=np.zeros(1, np.int32), rng=rng0.copy(),
+                               pos=np.ones(1, np.int32), paths=paths)
+    # Walk 0 -> 1 (one draw), then suspend: node 1 unavailable.
+    status = dw.advance_walk_states_device(
+        states, csr, G, np.array([1, 0], np.uint8), L)
+    assert status[0] == 1 and states.cur[0] == 1 and states.pos[0] == 2
+    after_draw, _ = dw.splitmix64_ref(int(rng0[0]))
+    assert int(states.rng[0]) == after_draw
+    # Resume fully available: 1's only neighbor (0) is visited -> dead
+    # end, no draw, rng unchanged.
+    status = dw.advance_walk_states_device(
+        states, csr, G, np.ones(G, np.uint8), L)
+    assert status[0] == 0
+    assert int(states.rng[0]) == after_draw          # frozen
+    assert states.pos[0] == 2                        # truncated path
+
+
+# ---- satellite 2: cross-backend walk-cache contract -----------------------
+
+@needs_native
+def test_walk_cache_cross_backend_hit(tmp_path):
+    """Host-populated walk-cache entries HIT for device runs and vice
+    versa: both backends key under ONE PRNG family (NATIVE_FAMILY)
+    because their packed rows are byte-identical."""
+    from g2vec_tpu.cache import NATIVE_FAMILY, WalkCache, walk_cache_key
+
+    r = np.random.default_rng(31)
+    G = 40
+    src, dst, w = _rand_graph(G, 220, r)
+    kw = dict(len_path=5, reps=2, seed=77)
+    host_set = hw.generate_path_set_native(src, dst, w, G, **kw)
+    dev_set = dw.generate_path_set_device(src, dst, w, G, **kw)
+    assert host_set == dev_set           # identical BYTES, not just stats
+
+    key = walk_cache_key(src, dst, w, G, family=NATIVE_FAMILY, **kw)
+    # host populates -> device-keyed lookup hits
+    cache = WalkCache(str(tmp_path / "walks"))
+    cache.store(key, host_set, G, meta={"group": "g"})
+    assert cache.load(key) == dev_set
+    # device populates -> host-keyed lookup hits
+    cache2 = WalkCache(str(tmp_path / "walks2"))
+    cache2.store(key, dev_set, G, meta={"group": "g"})
+    assert cache2.load(key) == host_set
+
+
+def test_pipeline_keys_both_backends_under_native_family():
+    """The family-selection sites must never split the key space again —
+    a spurious DEVICE_FAMILY key would force a miss on backend flip."""
+    import re
+
+    for path in ("g2vec_tpu/pipeline.py", "g2vec_tpu/batch/engine.py"):
+        text = open(path).read()
+        for m in re.finditer(r"family\s*=\s*([A-Z_]+)", text):
+            assert m.group(1) == "NATIVE_FAMILY", path
+
+
+# ---- satellite 3: dense walker retirement ---------------------------------
+
+def test_dense_walker_deprecation_shim():
+    """The dense [G, G] paths stay callable (small/test graphs) but warn
+    — no caller silently regresses to dense."""
+    import jax
+
+    from g2vec_tpu.ops.walker import generate_path_set, random_walks
+
+    adj = np.zeros((4, 4), np.float32)
+    adj[0, 1] = adj[1, 2] = adj[2, 3] = 1.0
+    with pytest.warns(DeprecationWarning, match="dense"):
+        visited = np.asarray(random_walks(
+            adj, np.array([0], np.int32), jax.random.key(0), 4))
+    assert visited[0, 0] and visited.shape == (1, 4)
+    with pytest.warns(DeprecationWarning, match="dense"):
+        ps = generate_path_set(adj, jax.random.key(0), len_path=3, reps=1)
+    assert len(ps) >= 1
+
+    # The sparse form (neighbor tables) stays warning-free.
+    import warnings as _w
+
+    from g2vec_tpu.ops.graph import neighbor_table
+
+    table = neighbor_table(np.array([0, 1], np.int32),
+                           np.array([1, 2], np.int32),
+                           np.array([1.0, 1.0], np.float32), 3)
+    with _w.catch_warnings():
+        _w.simplefilter("error", DeprecationWarning)
+        generate_path_set(table, jax.random.key(0), len_path=2, reps=1)
+
+
+# ---- streaming: device backend + fused device feed ------------------------
+
+def _stream_kwargs():
+    G = 40
+    def grp(seed):
+        r = np.random.default_rng(seed)
+        E = 240
+        return (r.integers(0, G, E).astype(np.int32),
+                r.integers(0, G, E).astype(np.int32),
+                r.random(E, dtype=np.float32))
+    return dict(
+        groups=[grp(1), grp(2)], n_genes=G,
+        genes=np.array([f"g{i}" for i in range(G)]), hidden=8,
+        learning_rate=0.05, max_epochs=2, seed=3, walk_seed=5,
+        len_path=5, reps=2, shard_paths=48, compute_dtype="float32")
+
+
+def test_device_feed_streaming_byte_identical_zero_ring_puts():
+    """The fused feed's pinned contract: epoch 0 makes ZERO host-ring
+    puts (shards_emitted metric), saves H2D bytes, and the final outputs
+    are byte-identical to --walker host (native ring) streaming at the
+    same config."""
+    from g2vec_tpu.train.stream import train_cbow_streaming
+    from g2vec_tpu.utils.metrics_schema import EVENT_SCHEMAS
+
+    kw = _stream_kwargs()
+    ref = train_cbow_streaming(**kw)                       # native ring
+    dev = train_cbow_streaming(**kw, walker_backend="device")
+    fused = train_cbow_streaming(**kw, walker_backend="device",
+                                 device_feed=True)
+    ref_w = np.asarray(ref.train.w_ih)
+    assert ref_w.tobytes() == np.asarray(dev.train.w_ih).tobytes()
+    assert ref_w.tobytes() == np.asarray(fused.train.w_ih).tobytes()
+    assert ref.gene_freq == fused.gene_freq
+    assert ref.n_paths == fused.n_paths
+
+    assert ref.stats.feed_mode == "ring"
+    assert ref.stats.shards_emitted > 0
+    assert fused.stats.feed_mode == "device"
+    assert fused.stats.shards_emitted == 0       # zero host-ring puts
+    assert fused.stats.h2d_bytes_saved > 0
+    assert fused.stats.sampling_wall_s > 0
+
+    # The stats carry exactly what the pipeline's device_walk metrics
+    # event requires (paths_per_s derives from n_paths / sampling wall).
+    schema = EVENT_SCHEMAS["device_walk"]
+    assert set(schema["required"]) == {"feed_mode", "h2d_bytes_saved",
+                                       "paths_per_s"}
+
+
+def test_device_feed_resume_mid_epoch0_byte_identical(tmp_path):
+    """Crash at an epoch-0 checkpoint cut, then resume: the async spool
+    must have been drained BEFORE the cursor cut, so the resumed run
+    (re-sampling from the cursor, replaying the spool for epochs 1..N)
+    reproduces the uninterrupted native run byte for byte."""
+    from g2vec_tpu.train.stream import train_cbow_streaming
+
+    kw = _stream_kwargs()
+    kw["max_epochs"] = 3
+    ref = train_cbow_streaming(**kw)
+    ck = dict(checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=1)
+    faults.install_plan("stage=stream_ckpt,kind=crash,epoch=0")
+    with pytest.raises(faults.InjectedFault):
+        train_cbow_streaming(**kw, walker_backend="device",
+                             device_feed=True, **ck)
+    faults._reset_for_tests()
+    resumed = train_cbow_streaming(**kw, walker_backend="device",
+                                   device_feed=True, resume=True, **ck)
+    assert (np.asarray(ref.train.w_ih).tobytes()
+            == np.asarray(resumed.train.w_ih).tobytes())
+
+
+# ---- satellite 4: device_walk fault drill ---------------------------------
+
+def test_device_walk_fault_mid_scan_clean_recompute():
+    """A device_walk fault mid-scan recovers by a clean recompute and the
+    recomputed outputs are byte-identical to the no-fault run."""
+    from g2vec_tpu.train.stream import train_cbow_streaming
+
+    kw = _stream_kwargs()
+    clean = train_cbow_streaming(**kw, walker_backend="device",
+                                 device_feed=True)
+    faults.install_plan("stage=device_walk,kind=crash,epoch=0")
+    try:
+        faulted = train_cbow_streaming(**kw, walker_backend="device",
+                                       device_feed=True)
+    finally:
+        faults.install_plan(None)
+    assert faulted.stats.device_recomputes == 1
+    assert (np.asarray(clean.train.w_ih).tobytes()
+            == np.asarray(faulted.train.w_ih).tobytes())
+    assert clean.gene_freq == faulted.gene_freq
+
+
+def test_device_walk_fault_exhausted_retry_raises():
+    """Two consecutive faults on the same shard exhaust the single
+    clean-recompute retry — the failure must surface, not loop."""
+    from g2vec_tpu.train.stream import train_cbow_streaming
+
+    faults.install_plan("stage=device_walk,kind=crash,times=2")
+    kw = _stream_kwargs()
+    with pytest.raises(faults.InjectedFault):
+        train_cbow_streaming(**kw, walker_backend="device",
+                             device_feed=True)
+
+
+# ---- config surface -------------------------------------------------------
+
+def test_device_feed_cli_flags_roundtrip():
+    from g2vec_tpu.config import config_from_args
+
+    cfg = config_from_args(
+        ["e.tsv", "c.tsv", "n.tsv", "out", "--train-mode", "streaming",
+         "--walker-backend", "device", "--device-feed"])
+    assert cfg.device_feed and cfg.walker_backend == "device"
+    cfg.validate()

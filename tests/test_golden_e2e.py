@@ -8,11 +8,15 @@ fixtures committed under tests/golden/ (format spec:
 G2Vec.py:127-131,159-165,203-215). Any numerics drift in any stage —
 graph, walker, trainer, k-means, scoring, writers — breaks the bytes.
 
-Both samplers carry their own golden: the device walker's jax.random
-streams AND the native sampler's splitmix64 streams are seeded contracts
-(round 4 moved the native sampler's bit-packing into C++ — a change that
-was only provably walk-preserving because the streams are pinned; this
-fixture makes that proof automatic for the next such change).
+Both samplers carry their own golden — and since PR 20 the two fixture
+sets are BYTE-IDENTICAL: the device backend emulates the native
+sampler's splitmix64 streams bit-exactly (ops/device_walker.py), so one
+shared byte contract covers both engines. Keeping separate fixture
+files preserves the per-backend drift attribution (a diff names the
+engine that moved; round 4 moved the native sampler's bit-packing into
+C++ — a change that was only provably walk-preserving because the
+streams are pinned; this fixture makes that proof automatic for the
+next such change, on either engine).
 
 Regenerate intentionally with:
     G2VEC_REGEN_GOLDEN=1 python -m pytest tests/test_golden_e2e.py

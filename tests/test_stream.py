@@ -371,8 +371,16 @@ def test_streaming_config_validation(stream_tsv):
         cfg(resume=True)                             # cursor needs a home
     with pytest.raises(ValueError, match="single"):
         cfg(checkpoint_dir="/tmp/ck", checkpoint_layout="sharded")
-    with pytest.raises(ValueError, match="cannot stream"):
-        cfg(walker_backend="device")
+    # Device backend STREAMS now (bit-exact sampler, PR 20) — and the
+    # fused device feed has its own composition gates.
+    cfg(walker_backend="device")
+    cfg(walker_backend="device", device_feed=True)
+    with pytest.raises(ValueError, match="streaming"):
+        G2VecConfig(device_feed=True, walker_backend="device").validate()
+    with pytest.raises(ValueError, match="walker-backend device"):
+        cfg(device_feed=True)                        # native cannot fuse
+    with pytest.raises(ValueError, match="graph-shards"):
+        cfg(walker_backend="device", device_feed=True, graph_shards=2)
     with pytest.raises(ValueError, match="shard_paths"):
         cfg(shard_paths=2)
     with pytest.raises(ValueError, match="prefetch_depth"):
@@ -382,7 +390,7 @@ def test_streaming_config_validation(stream_tsv):
     with pytest.raises(ValueError, match="train_mode"):
         G2VecConfig(train_mode="sideways").validate()
     for key in ("train_mode", "shard_paths", "prefetch_depth",
-                "stream_patience"):
+                "stream_patience", "device_feed"):
         assert key in SERVE_JOB_KEYS                 # serve jobs may stream
 
 

@@ -55,7 +55,11 @@ while [ "$arms" -lt "$MAX_ARMS" ] && [ "$(date +%s)" -lt "$DEADLINE" ]; do
     # fingerprint/frontier units, bootstrap->noop byte identity,
     # expr-only stage-3 skip, delta re-walk + statistical band vs cold
     # retrain, daemon update lifecycle, generation-keyed QueryCache,
-    # cross-republish torn-read hammer, update_publish SIGKILL drill).
+    # cross-republish torn-read hammer, update_publish SIGKILL drill),
+    # and the device-walker matrix (splitmix64 lane-pair fuzz, host/
+    # device packed-row byte parity, suspend/resume rng word parity,
+    # walk-cache cross-backend HIT, device_walk fault drills, fused
+    # --device-feed zero-ring-puts e2e).
     # Non-fatal: a red matrix is reported, the chip battery still runs.
     if ! JAX_PLATFORMS=cpu "$PY" -m pytest tests/test_resilience.py \
             tests/test_fleet.py tests/test_fleet_e2e.py \
@@ -64,7 +68,7 @@ while [ "$arms" -lt "$MAX_ARMS" ] && [ "$(date +%s)" -lt "$DEADLINE" ]; do
             tests/test_router.py tests/test_edge.py \
             tests/test_scenario.py tests/test_query.py \
             tests/test_autoscale.py tests/test_ann.py \
-            tests/test_update.py \
+            tests/test_update.py tests/test_device_walker.py \
             -q -m "not slow" \
             -p no:cacheprovider >/tmp/fault_matrix_arm$arms.log 2>&1; then
         echo "[watch_loop] WARNING: fault/fleet matrix FAILED on arm $arms (log: /tmp/fault_matrix_arm$arms.log)"
